@@ -1,0 +1,34 @@
+"""ballista_tpu — a TPU-native distributed SQL query execution engine.
+
+A ground-up rebuild of the capabilities of Apache DataFusion Ballista
+(reference: /root/reference, surveyed in SURVEY.md) designed TPU-first:
+
+- The control plane (scheduler, execution-graph state machine, task manager,
+  cluster state) mirrors the reference's architecture
+  (ballista/scheduler/src/*) because that shape is forced by the problem:
+  stages split at shuffle boundaries, one task per partition (slice),
+  materialized shuffle outputs as the durable retry unit.
+- The data plane exchanges Arrow IPC partitions over Arrow Flight
+  (reference: ballista/executor/src/flight_service.rs), with local
+  fast-path reads and an 8 MiB raw-block transport action.
+- The per-partition operator engine — the seam the reference exposes as
+  `ExecutionEngine` (ballista/executor/src/execution_engine.rs:51) — has two
+  implementations selected by `ballista.executor.engine`:
+    * "cpu":  Arrow-native operators over pyarrow.compute (the parity
+              baseline, standing in for the reference's DataFusion engine).
+    * "tpu":  query stages compiled to XLA via JAX — columns are
+              dictionary/int64-encoded into fixed shape-bucketed device
+              tensors, and filter/project/hash-aggregate/hash-join/hash-
+              repartition run as jitted kernels on the MXU/VPU, with
+              per-subtree fallback to the cpu engine.
+
+Nothing in this package is a translation of the reference's Rust; the
+reference defines WHAT (features, wire behavior, test strategy), this
+package decides HOW for TPU hardware.
+"""
+
+from ballista_tpu.version import BALLISTA_VERSION, WIRE_PROTOCOL_VERSION
+
+__version__ = BALLISTA_VERSION
+
+__all__ = ["BALLISTA_VERSION", "WIRE_PROTOCOL_VERSION"]

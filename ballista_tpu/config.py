@@ -1,0 +1,268 @@
+"""Typed session configuration.
+
+Rebuild of the reference's `BallistaConfig` (ballista/core/src/config.rs):
+a registry of `ConfigEntry`s — name, description, type, default — with
+validation at parse time, round-tripped over the wire as key/value pairs so
+every job carries its full session config to the scheduler and executors
+(reference: SessionConfigHelperExt::to_key_value_pairs,
+ballista/core/src/extension.rs:293).
+
+TPU-native additions live under `ballista.tpu.*` (engine selection, shape
+bucketing, device-memory budget) — these are the knobs the reference never
+needed because CPU engines don't recompile per shape.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+from ballista_tpu.errors import ConfigurationError
+
+# -- keys (reference: core/src/config.rs:32-160) ----------------------------
+
+JOB_NAME = "ballista.job.name"
+DEFAULT_SHUFFLE_PARTITIONS = "ballista.shuffle.partitions"
+SHUFFLE_COMPRESSION_CODEC = "ballista.shuffle.compression.codec"
+SHUFFLE_READER_MAX_REQUESTS = "ballista.shuffle.reader.max.requests"
+SHUFFLE_READER_MAX_PER_ADDR = "ballista.shuffle.reader.max.requests.per.address"
+SHUFFLE_READER_MAX_BYTES = "ballista.shuffle.reader.max.inflight.bytes"
+SHUFFLE_READER_FORCE_REMOTE = "ballista.shuffle.reader.force_remote_read"
+SORT_SHUFFLE_ENABLED = "ballista.shuffle.sort.enabled"
+SORT_SHUFFLE_MEMORY_LIMIT = "ballista.shuffle.sort.memory.limit"
+BROADCAST_JOIN_THRESHOLD = "ballista.optimizer.broadcast.join.threshold.bytes"
+BROADCAST_JOIN_ROWS_THRESHOLD = "ballista.optimizer.broadcast.join.threshold.rows"
+MAX_PARTITIONS_PER_TASK = "ballista.scheduler.max_partitions_per_task"
+JOB_RESUBMIT_INTERVAL_MS = "ballista.scheduler.job.resubmit.interval.ms"
+PLANNER_ADAPTIVE_ENABLED = "ballista.planner.adaptive.enabled"
+AQE_TARGET_PARTITION_BYTES = "ballista.planner.adaptive.coalesce.target.bytes"
+AQE_MIN_PARTITION_BYTES = "ballista.planner.adaptive.coalesce.min.bytes"
+AQE_COALESCE_MERGED_FACTOR = "ballista.planner.adaptive.coalesce.merged.factor"
+AQE_EMPTY_PROPAGATION = "ballista.planner.adaptive.empty.propagation"
+AQE_DYNAMIC_JOIN_SELECTION = "ballista.planner.adaptive.join.selection"
+GRPC_CLIENT_MAX_MESSAGE_SIZE = "ballista.grpc.client.max.message.size.bytes"
+GRPC_SERVER_MAX_MESSAGE_SIZE = "ballista.grpc.server.max.message.size.bytes"
+IO_RETRIES = "ballista.io.retries.times"
+IO_RETRY_WAIT_MS = "ballista.io.retry.wait.time.ms"
+CHAOS_ENABLED = "ballista.chaos.enabled"
+CHAOS_SEED = "ballista.chaos.seed"
+CHAOS_PROBABILITY = "ballista.chaos.probability"
+CHAOS_MODE = "ballista.chaos.mode"
+COLLECT_STATISTICS = "ballista.collect_statistics"
+TARGET_PARTITIONS = "ballista.target.partitions"
+BATCH_SIZE = "ballista.batch.size"
+REPARTITION_JOINS = "ballista.repartition.joins"
+REPARTITION_AGGREGATIONS = "ballista.repartition.aggregations"
+PARQUET_PRUNING = "ballista.parquet.pruning"
+EXECUTOR_ENGINE = "ballista.executor.engine"
+# TPU-native knobs
+TPU_SHAPE_BUCKETS = "ballista.tpu.shape.buckets"
+TPU_MAX_DEVICE_BYTES = "ballista.tpu.max.device.bytes"
+TPU_HASH_TABLE_LOAD = "ballista.tpu.hash.table.load.factor"
+TPU_ALLOW_F32_MONEY = "ballista.tpu.allow.f32.money"
+TPU_MIN_ROWS = "ballista.tpu.min.rows"
+TPU_COLLECTIVE_EXCHANGE = "ballista.tpu.collective.exchange"
+
+
+@dataclass(frozen=True)
+class ConfigEntry:
+    """One typed config key (reference: ConfigEntry, config.rs:403)."""
+
+    name: str
+    description: str
+    ty: type  # bool | int | float | str
+    default: Any
+    validator: Callable[[Any], bool] | None = None
+    choices: tuple[str, ...] | None = None
+
+    def parse(self, raw: Any) -> Any:
+        try:
+            if self.ty is bool:
+                if isinstance(raw, bool):
+                    v: Any = raw
+                else:
+                    s = str(raw).strip().lower()
+                    if s not in ("true", "false", "1", "0"):
+                        raise ValueError(s)
+                    v = s in ("true", "1")
+            else:
+                v = self.ty(raw)
+        except (ValueError, TypeError):
+            raise ConfigurationError(
+                f"invalid value {raw!r} for {self.name} (expected {self.ty.__name__})"
+            ) from None
+        if self.choices is not None and v not in self.choices:
+            raise ConfigurationError(
+                f"invalid value {v!r} for {self.name}; expected one of {self.choices}"
+            )
+        if self.validator is not None and not self.validator(v):
+            raise ConfigurationError(f"value {v!r} out of range for {self.name}")
+        return v
+
+
+def _pos(v: Any) -> bool:
+    return v > 0
+
+
+def _nonneg(v: Any) -> bool:
+    return v >= 0
+
+
+_ENTRIES: list[ConfigEntry] = [
+    ConfigEntry(JOB_NAME, "Human-readable job name shown in the UI/REST API.", str, ""),
+    ConfigEntry(DEFAULT_SHUFFLE_PARTITIONS, "Output partition count for hash repartitions.", int, 16, _pos),
+    ConfigEntry(
+        SHUFFLE_COMPRESSION_CODEC,
+        "IPC compression for shuffle files and Flight streams.",
+        str, "lz4", choices=("none", "lz4", "zstd"),
+    ),
+    ConfigEntry(SHUFFLE_READER_MAX_REQUESTS, "Reduce-side fetch governor: max concurrent fetch requests.", int, 64, _pos),
+    ConfigEntry(SHUFFLE_READER_MAX_PER_ADDR, "Reduce-side fetch governor: max concurrent fetches per executor address.", int, 8, _pos),
+    ConfigEntry(SHUFFLE_READER_MAX_BYTES, "Reduce-side fetch governor: in-flight byte budget.", int, 256 * 1024 * 1024, _pos),
+    ConfigEntry(SHUFFLE_READER_FORCE_REMOTE, "Testing: fetch shuffle partitions over Flight even when local.", bool, False),
+    ConfigEntry(SORT_SHUFFLE_ENABLED, "Use sort-based shuffle (M consolidated bucket files + index) for hash repartitions.", bool, True),
+    ConfigEntry(SORT_SHUFFLE_MEMORY_LIMIT, "Bytes of buffered batches before sort-shuffle spills (0 = unlimited).", int, 256 * 1024 * 1024, _nonneg),
+    ConfigEntry(BROADCAST_JOIN_THRESHOLD, "Max build-side bytes to lower a join to a broadcast exchange.", int, 10 * 1024 * 1024, _nonneg),
+    ConfigEntry(BROADCAST_JOIN_ROWS_THRESHOLD, "Max build-side rows to lower a join to a broadcast exchange.", int, 1_000_000, _nonneg),
+    ConfigEntry(MAX_PARTITIONS_PER_TASK, "Group up to N partitions into one task (partition slices).", int, 1, _pos),
+    ConfigEntry(JOB_RESUBMIT_INTERVAL_MS, "Re-queue jobs that could not schedule after this delay (0 = off).", int, 0, _nonneg),
+    ConfigEntry(PLANNER_ADAPTIVE_ENABLED, "Adaptive query execution: replan remaining stages with runtime stats.", bool, True),
+    ConfigEntry(AQE_TARGET_PARTITION_BYTES, "AQE coalescing: target bytes per post-shuffle partition.", int, 64 * 1024 * 1024, _pos),
+    ConfigEntry(AQE_MIN_PARTITION_BYTES, "AQE coalescing: never coalesce below this size.", int, 1024 * 1024, _pos),
+    ConfigEntry(AQE_COALESCE_MERGED_FACTOR, "AQE coalescing: merged-partition slack factor.", float, 1.2, _pos),
+    ConfigEntry(AQE_EMPTY_PROPAGATION, "AQE: prune stages proven empty by runtime stats.", bool, True),
+    ConfigEntry(AQE_DYNAMIC_JOIN_SELECTION, "AQE: choose join strategy at runtime from actual input sizes.", bool, True),
+    ConfigEntry(GRPC_CLIENT_MAX_MESSAGE_SIZE, "Client-side gRPC message ceiling.", int, 256 * 1024 * 1024, _pos),
+    ConfigEntry(GRPC_SERVER_MAX_MESSAGE_SIZE, "Server-side gRPC message ceiling.", int, 256 * 1024 * 1024, _pos),
+    ConfigEntry(IO_RETRIES, "Shuffle fetch retry attempts.", int, 3, _nonneg),
+    ConfigEntry(IO_RETRY_WAIT_MS, "Base backoff between shuffle fetch retries.", int, 100, _nonneg),
+    ConfigEntry(CHAOS_ENABLED, "Fault injection: wrap leaf operators in chaos nodes.", bool, False),
+    ConfigEntry(CHAOS_SEED, "Fault injection RNG seed.", int, 0, _nonneg),
+    ConfigEntry(CHAOS_PROBABILITY, "Per-task fault probability.", float, 0.05, lambda v: 0.0 <= v <= 1.0),
+    ConfigEntry(
+        CHAOS_MODE, "Fault kind to inject.", str, "transient",
+        choices=("transient", "fatal", "panic", "delay"),
+    ),
+    ConfigEntry(COLLECT_STATISTICS, "Collect table statistics at registration.", bool, True),
+    ConfigEntry(TARGET_PARTITIONS, "Planner parallelism target (scan partitioning).", int, 8, _pos),
+    ConfigEntry(BATCH_SIZE, "Rows per record batch in operator pipelines.", int, 64 * 1024, _pos),
+    ConfigEntry(REPARTITION_JOINS, "Insert hash repartitions to parallelize joins.", bool, True),
+    ConfigEntry(REPARTITION_AGGREGATIONS, "Insert hash repartitions to parallelize aggregations.", bool, True),
+    ConfigEntry(PARQUET_PRUNING, "Prune parquet row groups with min/max statistics.", bool, True),
+    ConfigEntry(
+        EXECUTOR_ENGINE,
+        "Operator engine for query stages: 'tpu' compiles supported subtrees to "
+        "XLA with cpu fallback; 'cpu' is Arrow-native.",
+        str, "cpu", choices=("cpu", "tpu"),
+    ),
+    ConfigEntry(
+        TPU_SHAPE_BUCKETS,
+        "Comma-separated row-count buckets batches are padded to before jit "
+        "(bounds XLA recompilation).",
+        str, "4096,16384,65536,262144,1048576",
+    ),
+    ConfigEntry(TPU_MAX_DEVICE_BYTES, "Per-stage HBM budget before falling back to cpu/spill.", int, 12 * 1024**3, _pos),
+    ConfigEntry(TPU_HASH_TABLE_LOAD, "Open-addressing hash table load factor for device joins/aggs.", float, 0.5, lambda v: 0.0 < v <= 0.9),
+    ConfigEntry(TPU_ALLOW_F32_MONEY, "Allow lossy float32 for decimal columns (faster, inexact).", bool, False),
+    ConfigEntry(TPU_MIN_ROWS, "Below this many input rows a stage stays on cpu (compile cost dominates).", int, 8192, _nonneg),
+    ConfigEntry(
+        TPU_COLLECTIVE_EXCHANGE,
+        "Use ICI collectives (shard_map all_to_all) instead of file shuffle for "
+        "co-scheduled intra-slice stages.",
+        bool, False,
+    ),
+]
+
+VALID_ENTRIES: dict[str, ConfigEntry] = {e.name: e for e in _ENTRIES}
+
+# Keys a remote client may NOT override on the shared daemons
+# (reference: restricted-config scrubbing, extension.rs:302).
+RESTRICTED_KEYS = frozenset({GRPC_SERVER_MAX_MESSAGE_SIZE})
+
+
+class BallistaConfig:
+    """Validated session config; unknown `ballista.*` keys are rejected,
+    other namespaces (e.g. datafusion-style passthrough) are carried opaque.
+    """
+
+    def __init__(self, settings: dict[str, Any] | None = None):
+        self._settings: dict[str, Any] = {}
+        self._extra: dict[str, str] = {}
+        for k, v in (settings or {}).items():
+            self.set(k, v)
+
+    def set(self, key: str, value: Any) -> "BallistaConfig":
+        entry = VALID_ENTRIES.get(key)
+        if entry is not None:
+            self._settings[key] = entry.parse(value)
+        elif key.startswith("ballista."):
+            raise ConfigurationError(f"unknown config key: {key}")
+        else:
+            self._extra[key] = str(value)
+        return self
+
+    def get(self, key: str) -> Any:
+        if key in self._settings:
+            return self._settings[key]
+        entry = VALID_ENTRIES.get(key)
+        if entry is not None:
+            return entry.default
+        return self._extra.get(key)
+
+    def __getitem__(self, key: str) -> Any:
+        return self.get(key)
+
+    # -- wire round-trip (reference: extension.rs:293-302) ------------------
+
+    def to_key_value_pairs(self) -> list[tuple[str, str]]:
+        out = [(k, _fmt(v)) for k, v in sorted(self._settings.items())]
+        out.extend(sorted(self._extra.items()))
+        return out
+
+    @classmethod
+    def from_key_value_pairs(
+        cls, pairs: list[tuple[str, str]], scrub_restricted: bool = False
+    ) -> "BallistaConfig":
+        cfg = cls()
+        for k, v in pairs:
+            if scrub_restricted and k in RESTRICTED_KEYS:
+                continue
+            cfg.set(k, v)
+        return cfg
+
+    def copy(self) -> "BallistaConfig":
+        c = BallistaConfig()
+        c._settings = dict(self._settings)
+        c._extra = dict(self._extra)
+        return c
+
+    def shape_buckets(self) -> list[int]:
+        return sorted(int(x) for x in str(self.get(TPU_SHAPE_BUCKETS)).split(",") if x.strip())
+
+    def __repr__(self) -> str:
+        return f"BallistaConfig({self._settings!r})"
+
+
+def _fmt(v: Any) -> str:
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    return str(v)
+
+
+def generate_config_docs() -> str:
+    """Docs-as-code: render the registry as markdown
+    (reference: core/src/bin/update_config_docs.rs → docs/.../configs.md).
+    """
+    lines = [
+        "# Configuration keys",
+        "",
+        "All keys are set per-session and shipped with every job as key/value",
+        "pairs; executors apply them when building the task's runtime.",
+        "",
+        "| key | type | default | description |",
+        "|-----|------|---------|-------------|",
+    ]
+    for e in _ENTRIES:
+        lines.append(f"| `{e.name}` | {e.ty.__name__} | `{_fmt(e.default)}` | {e.description} |")
+    lines.append("")
+    return "\n".join(lines)

@@ -1,0 +1,100 @@
+"""Error model.
+
+Mirrors the reference's `BallistaError` taxonomy
+(ballista/core/src/error.rs:37): distinct variants for planning vs execution
+vs transport vs cancellation matter because the scheduler's retry policy
+branches on them (fetch failures → recompute upstream stage; task failures →
+bounded per-stage retries; cancellation → no retry).
+"""
+
+from __future__ import annotations
+
+
+class BallistaError(Exception):
+    """Base class for all engine errors."""
+
+    retryable: bool = False
+
+
+class NotImplementedError_(BallistaError):
+    pass
+
+
+class GeneralError(BallistaError):
+    pass
+
+
+class PlanningError(BallistaError):
+    """SQL analysis / planning failed. Never retryable."""
+
+
+class SqlParseError(PlanningError):
+    pass
+
+
+class SchemaError(PlanningError):
+    pass
+
+
+class ExecutionError(BallistaError):
+    """An operator failed at runtime on the executor."""
+
+    def __init__(self, msg: str, retryable: bool = False):
+        super().__init__(msg)
+        self.retryable = retryable
+
+
+class FetchFailed(BallistaError):
+    """A shuffle partition could not be fetched.
+
+    Carries enough identity for the scheduler to mark the *upstream* stage's
+    output lost and recompute it (reference: ResultLost failure reason,
+    ballista.proto:595, handled by rerun_successful_stage,
+    scheduler/src/state/execution_graph.rs:216).
+    """
+
+    retryable = True
+
+    def __init__(self, executor_id: str, job_id: str, stage_id: int, map_partition: int, msg: str = ""):
+        super().__init__(
+            f"fetch failed from executor={executor_id} {job_id}/{stage_id}/{map_partition}: {msg}"
+        )
+        self.executor_id = executor_id
+        self.job_id = job_id
+        self.stage_id = stage_id
+        self.map_partition = map_partition
+
+
+class IoError(BallistaError):
+    retryable = True
+
+
+class GrpcError(BallistaError):
+    retryable = True
+
+
+class Cancelled(BallistaError):
+    """Task/job cancelled; terminal, not a failure for retry accounting."""
+
+
+class TokioError(BallistaError):
+    """Internal concurrency failure (named for parity with the reference)."""
+
+
+class ConfigurationError(BallistaError):
+    pass
+
+
+def error_to_proto_kind(err: BaseException) -> str:
+    """Stable string tag used in TaskStatus/FailedTask wire messages."""
+    if isinstance(err, FetchFailed):
+        return "FetchPartitionError"
+    if isinstance(err, Cancelled):
+        return "TaskKilled"
+    if isinstance(err, (IoError, GrpcError)):
+        return "IoError"
+    if isinstance(err, ExecutionError):
+        return "ExecutionError"
+    if isinstance(err, PlanningError):
+        return "PlanningError"
+    return "GeneralError"
