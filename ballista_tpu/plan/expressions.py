@@ -605,4 +605,7 @@ def collect_columns(e: Expr, out: set | None = None) -> set:
 
 
 def to_field(e: Expr, schema: DFSchema) -> DFField:
-    return DFField(e.output_name(), e.data_type(schema), e.nullable(schema), None)
+    # Plain column references keep their qualifier through projections so
+    # self-join disambiguation (e.g. lineitem l1 vs l2) survives SELECT *.
+    qualifier = e.qualifier if isinstance(e, Column) else None
+    return DFField(e.output_name(), e.data_type(schema), e.nullable(schema), qualifier)

@@ -1,0 +1,80 @@
+"""Statement-level AST.
+
+Expressions inside these nodes are already `ballista_tpu.plan.expressions`
+objects (the parser emits the expression IR directly); subquery expressions
+carry a raw `SelectStmt` that the planner replaces with a planned
+LogicalPlan during binding.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from ballista_tpu.plan.expressions import Expr, SortKey
+
+
+@dataclass
+class TableName:
+    name: str
+    alias: Optional[str] = None
+
+
+@dataclass
+class DerivedTable:
+    select: "SelectStmt"
+    alias: str
+
+
+@dataclass
+class JoinClause:
+    left: Any  # TableName | DerivedTable | JoinClause
+    right: Any
+    join_type: str  # inner/left/right/full/cross
+    on: Optional[Expr] = None
+
+
+@dataclass
+class SelectStmt:
+    projections: list[Expr] = field(default_factory=list)
+    distinct: bool = False
+    from_tables: list[Any] = field(default_factory=list)  # comma-separated refs
+    where: Optional[Expr] = None
+    group_by: list[Any] = field(default_factory=list)  # Expr | int ordinal
+    having: Optional[Expr] = None
+    order_by: list[SortKey] = field(default_factory=list)
+    limit: Optional[int] = None
+    offset: int = 0
+    ctes: list[tuple[str, "SelectStmt"]] = field(default_factory=list)
+    set_op: Optional[tuple[str, "SelectStmt"]] = None  # ("union"|"union_all", rhs)
+
+
+@dataclass
+class ExplainStmt:
+    inner: Any
+    analyze: bool = False
+    verbose: bool = False
+
+
+@dataclass
+class CreateExternalTable:
+    name: str
+    location: str
+    file_format: str = "parquet"
+
+
+@dataclass
+class DropTable:
+    name: str
+    if_exists: bool = False
+
+
+@dataclass
+class ShowTables:
+    pass
+
+
+@dataclass
+class SetVariable:
+    key: str
+    value: str

@@ -1,0 +1,282 @@
+"""SQL planner (binder): AST → LogicalPlan.
+
+Responsibilities:
+- resolve table names (catalog + CTE environment) and aliases,
+- plan FROM (comma refs become CrossJoins; explicit JOIN ... ON splits into
+  equi keys + residual filter),
+- detect aggregates and rewrite post-aggregation expressions to reference
+  aggregate outputs,
+- plan subqueries recursively (correlated columns stay unresolved inside the
+  subplan; the decorrelation optimizer turns them into joins),
+- resolve ORDER BY aliases/ordinals against the projection output.
+"""
+
+from __future__ import annotations
+
+from dataclasses import replace
+from typing import Any
+
+from ballista_tpu.errors import PlanningError, SchemaError
+from ballista_tpu.plan.expressions import (
+    AggregateFunction,
+    Alias,
+    BinaryExpr,
+    Column,
+    Exists,
+    Expr,
+    InSubquery,
+    Literal,
+    ScalarSubquery,
+    SortKey,
+    collect_columns,
+    split_conjunction,
+    transform_expr,
+)
+from ballista_tpu.plan.logical import (
+    Aggregate,
+    CrossJoin,
+    Distinct,
+    EmptyRelation,
+    Filter,
+    Join,
+    Limit,
+    LogicalPlan,
+    Projection,
+    Sort,
+    SubqueryAlias,
+    TableScan,
+    Union,
+)
+from ballista_tpu.sql.ast import DerivedTable, JoinClause, SelectStmt, TableName
+
+
+class SqlPlanner:
+    def __init__(self, catalog):
+        self.catalog = catalog
+
+    def plan_query(self, stmt: SelectStmt, cte_env: dict[str, LogicalPlan] | None = None) -> LogicalPlan:
+        cte_env = dict(cte_env or {})
+        for name, sub in stmt.ctes:
+            cte_env[name] = self.plan_query(sub, cte_env)
+        plan = self._plan_select(stmt, cte_env)
+        if stmt.set_op is not None:
+            op, rhs = stmt.set_op
+            rhs_plan = self.plan_query(rhs, cte_env)
+            plan = Union([plan, rhs_plan], all=(op == "union_all"))
+            if op == "union":
+                plan = Distinct(plan)
+        return plan
+
+    # ------------------------------------------------------------------
+
+    def _plan_select(self, stmt: SelectStmt, cte_env: dict[str, LogicalPlan]) -> LogicalPlan:
+        # FROM
+        if stmt.from_tables:
+            plan = self._plan_table_ref(stmt.from_tables[0], cte_env)
+            for ref in stmt.from_tables[1:]:
+                plan = CrossJoin(plan, self._plan_table_ref(ref, cte_env))
+        else:
+            plan = EmptyRelation(produce_one_row=True)
+
+        # WHERE
+        if stmt.where is not None:
+            pred = self._bind_subqueries(stmt.where, cte_env)
+            plan = Filter(plan, pred)
+
+        # projections: expand *, bind subqueries
+        projections: list[Expr] = []
+        for e in stmt.projections:
+            if isinstance(e, Column) and e.name == "*":
+                for f in plan.schema:
+                    projections.append(Column(f.name, f.qualifier))
+            else:
+                projections.append(self._bind_subqueries(e, cte_env))
+
+        having = self._bind_subqueries(stmt.having, cte_env) if stmt.having is not None else None
+
+        # GROUP BY (ordinals refer to select list)
+        group_exprs: list[Expr] = []
+        for g in stmt.group_by:
+            if isinstance(g, int):
+                e = projections[g - 1]
+                group_exprs.append(e.expr if isinstance(e, Alias) else e)
+            else:
+                ge = self._bind_subqueries(g, cte_env)
+                # GROUP BY may name a select alias
+                ge = self._substitute_select_alias(ge, projections)
+                group_exprs.append(ge)
+
+        agg_funcs = _collect_aggs(projections + ([having] if having is not None else []))
+
+        if group_exprs or agg_funcs:
+            agg = Aggregate(plan, group_exprs, agg_funcs)
+            rewrite = lambda e: _rewrite_post_agg(e, group_exprs, agg_funcs)
+            projections = [rewrite(p) for p in projections]
+            plan = agg
+            if having is not None:
+                plan = Filter(plan, rewrite(having))
+
+        proj = Projection(plan, projections)
+        plan = proj
+
+        if stmt.distinct:
+            plan = Distinct(plan)
+
+        # ORDER BY against projection output
+        if stmt.order_by:
+            keys = []
+            for sk in stmt.order_by:
+                e = sk.expr
+                if isinstance(e, Literal) and isinstance(e.value, int):
+                    e = Column(plan.schema.field(e.value - 1).name)
+                else:
+                    e = self._resolve_order_expr(e, proj, cte_env)
+                keys.append(SortKey(e, sk.ascending, sk.nulls_first))
+            plan = Sort(plan, keys, fetch=None)
+
+        if stmt.limit is not None or stmt.offset:
+            if isinstance(plan, Sort):
+                plan = replace(plan, fetch=(stmt.limit + stmt.offset) if stmt.limit is not None else None)
+                plan.__post_init__()
+            plan = Limit(plan, stmt.limit, stmt.offset)
+        return plan
+
+    def _resolve_order_expr(self, e: Expr, proj: Projection, cte_env) -> Expr:
+        out_schema = proj.schema
+        if isinstance(e, Column) and e.qualifier is None:
+            if out_schema.maybe_index_of(e.name) is not None:
+                return e
+        # structural match against a projection expr (e.g. ORDER BY sum(x))
+        bound = self._bind_subqueries(e, cte_env)
+        for p in proj.exprs:
+            inner = p.expr if isinstance(p, Alias) else p
+            if inner == bound:
+                return Column(p.output_name())
+        # falls through: expression over projection-output columns
+        return bound
+
+    def _substitute_select_alias(self, e: Expr, projections: list[Expr]) -> Expr:
+        if isinstance(e, Column) and e.qualifier is None:
+            for p in projections:
+                if isinstance(p, Alias) and p.name == e.name:
+                    return p.expr
+        return e
+
+    # ------------------------------------------------------------------
+
+    def _plan_table_ref(self, ref: Any, cte_env: dict[str, LogicalPlan]) -> LogicalPlan:
+        if isinstance(ref, TableName):
+            if ref.name in cte_env:
+                return SubqueryAlias(cte_env[ref.name], ref.alias or ref.name)
+            provider = self.catalog.get(ref.name)
+            if provider is None:
+                raise PlanningError(f"table not found: {ref.name}")
+            return TableScan(ref.name, provider, alias=ref.alias)
+        if isinstance(ref, DerivedTable):
+            return SubqueryAlias(self.plan_query(ref.select, cte_env), ref.alias)
+        if isinstance(ref, JoinClause):
+            left = self._plan_table_ref(ref.left, cte_env)
+            right = self._plan_table_ref(ref.right, cte_env)
+            if ref.join_type == "cross" or ref.on is None:
+                return CrossJoin(left, right)
+            on = self._bind_subqueries(ref.on, cte_env)
+            keys, residual = split_join_condition(on, left.schema, right.schema)
+            return Join(left, right, keys, ref.join_type, residual)
+        raise PlanningError(f"unsupported table ref {ref!r}")
+
+    # ------------------------------------------------------------------
+
+    def _bind_subqueries(self, e: Expr, cte_env: dict[str, LogicalPlan]) -> Expr:
+        """Replace raw SelectStmt payloads inside subquery exprs with planned
+        LogicalPlans. Correlated outer columns remain unresolved names."""
+
+        def fn(x: Expr) -> Expr:
+            if isinstance(x, ScalarSubquery) and isinstance(x.plan, SelectStmt):
+                return ScalarSubquery(self.plan_query(x.plan, cte_env))
+            if isinstance(x, InSubquery) and isinstance(x.plan, SelectStmt):
+                return InSubquery(x.expr, self.plan_query(x.plan, cte_env), x.negated)
+            if isinstance(x, Exists) and isinstance(x.plan, SelectStmt):
+                return Exists(self.plan_query(x.plan, cte_env), x.negated)
+            return x
+
+        return transform_expr(e, fn)
+
+
+# -- helpers ----------------------------------------------------------------
+
+
+def _collect_aggs(exprs: list[Expr]) -> list[Expr]:
+    """Unique aggregate function expressions, in first-appearance order."""
+    seen: list[Expr] = []
+
+    def walk(e: Expr) -> None:
+        if isinstance(e, AggregateFunction):
+            if e not in seen:
+                seen.append(e)
+            return  # no nested aggs
+        for c in e.children():
+            walk(c)
+        if isinstance(e, (ScalarSubquery, InSubquery, Exists)):
+            pass  # subquery aggs belong to the subquery
+
+    for e in exprs:
+        walk(e)
+    return seen
+
+
+def _rewrite_post_agg(e: Expr, group_exprs: list[Expr], agg_funcs: list[Expr]) -> Expr:
+    """Rewrite an expression evaluated above an Aggregate so every group-expr
+    / agg-func occurrence becomes a column reference to the aggregate output."""
+
+    def rec(x: Expr) -> Expr:
+        if isinstance(x, Alias):
+            return Alias(rec(x.expr), x.name)
+        for g in group_exprs:
+            if x == g:
+                return Column(g.output_name(), g.qualifier if isinstance(g, Column) else None)
+        if isinstance(x, AggregateFunction):
+            for a in agg_funcs:
+                if x == a:
+                    return Column(a.output_name())
+            raise PlanningError(f"aggregate {x} not in aggregate node")
+        kids = x.children()
+        if kids:
+            return x.with_children([rec(k) for k in kids])
+        return x
+
+    return rec(e)
+
+
+def split_join_condition(on: Expr, left_schema, right_schema):
+    """Split an ON condition into equi-key pairs and a residual filter."""
+    keys: list[tuple[Expr, Expr]] = []
+    residual: list[Expr] = []
+    for c in split_conjunction(on):
+        pair = _as_equi_pair(c, left_schema, right_schema)
+        if pair is not None:
+            keys.append(pair)
+        else:
+            residual.append(c)
+    res = None
+    if residual:
+        from ballista_tpu.plan.expressions import and_
+
+        res = and_(*residual)
+    return keys, res
+
+
+def _resolves(e: Expr, schema) -> bool:
+    cols = collect_columns(e)
+    if not cols:
+        return False  # constants belong in residual
+    return all(schema.maybe_index_of(c.name, c.qualifier) is not None for c in cols)
+
+
+def _as_equi_pair(c: Expr, left_schema, right_schema):
+    if isinstance(c, BinaryExpr) and c.op == "=":
+        l, r = c.left, c.right
+        if _resolves(l, left_schema) and _resolves(r, right_schema):
+            return (l, r)
+        if _resolves(r, left_schema) and _resolves(l, right_schema):
+            return (r, l)
+    return None
