@@ -1,0 +1,234 @@
+"""Session context + DataFrame front end.
+
+The reference's client surface (ballista/client/src/extension.rs):
+`SessionContext::standalone()/remote()` with SQL and DataFrame entry points.
+Modes here:
+
+- "local":      plan and execute in this process (DataFusion-alone analog).
+- "standalone": in-process scheduler + executor over the real task/shuffle
+                machinery (reference: standalone.rs) — wired in
+                client/remote.py once the control plane exists.
+- "remote":     gRPC to an external scheduler.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures as _fut
+from typing import Any, Optional
+
+import pyarrow as pa
+
+from ballista_tpu.config import BallistaConfig, EXECUTOR_ENGINE
+from ballista_tpu.errors import PlanningError
+from ballista_tpu.ids import SessionId, new_session_id
+from ballista_tpu.plan.logical import Explain, LogicalPlan
+from ballista_tpu.plan.physical import ExecutionPlan, TaskContext
+from ballista_tpu.plan.provider import Catalog, MemoryTable, ParquetTable, TableProvider
+from ballista_tpu.sql.ast import (
+    CreateExternalTable,
+    DropTable,
+    ExplainStmt,
+    SelectStmt,
+    SetVariable,
+    ShowTables,
+)
+from ballista_tpu.sql.optimizer import optimize
+from ballista_tpu.sql.parser import parse_sql
+from ballista_tpu.sql.planner import SqlPlanner
+
+
+class SessionContext:
+    def __init__(self, config: BallistaConfig | None = None, mode: str = "local"):
+        self.config = config or BallistaConfig()
+        self.mode = mode
+        self.catalog = Catalog()
+        self.session_id: SessionId = new_session_id()
+
+    # -- registration -------------------------------------------------------
+
+    def register_table(self, name: str, provider: TableProvider) -> None:
+        self.catalog.register(name, provider)
+
+    def register_parquet(self, name: str, path: str) -> None:
+        self.catalog.register(name, ParquetTable(path))
+
+    def register_record_batches(self, name: str, batches: list[pa.RecordBatch]) -> None:
+        self.catalog.register(name, MemoryTable(batches))
+
+    def register_arrow_table(self, name: str, table: pa.Table, partitions: int = 1) -> None:
+        self.catalog.register(name, MemoryTable.from_table(table, partitions))
+
+    def deregister_table(self, name: str) -> None:
+        self.catalog.deregister(name)
+
+    # -- SQL ---------------------------------------------------------------
+
+    def sql(self, query: str) -> "DataFrame":
+        stmt = parse_sql(query)
+        if isinstance(stmt, CreateExternalTable):
+            self.register_parquet(stmt.name, stmt.location)
+            return DataFrame._empty(self, f"created table {stmt.name}")
+        if isinstance(stmt, DropTable):
+            self.deregister_table(stmt.name)
+            return DataFrame._empty(self, f"dropped table {stmt.name}")
+        if isinstance(stmt, ShowTables):
+            tbl = pa.table({"table_name": pa.array(self.catalog.names())})
+            from ballista_tpu.plan.logical import TableScan
+            from ballista_tpu.plan.provider import MemoryTable as MT
+
+            return DataFrame(self, TableScan("tables", MT.from_table(tbl)))
+        if isinstance(stmt, SetVariable):
+            self.config.set(stmt.key, stmt.value)
+            return DataFrame._empty(self, f"set {stmt.key}")
+        if isinstance(stmt, ExplainStmt):
+            inner = SqlPlanner(self.catalog).plan_query(stmt.inner)
+            return DataFrame(self, Explain(inner, stmt.analyze, stmt.verbose))
+        if isinstance(stmt, SelectStmt):
+            plan = SqlPlanner(self.catalog).plan_query(stmt)
+            return DataFrame(self, plan)
+        raise PlanningError(f"unsupported statement {type(stmt).__name__}")
+
+    def table(self, name: str) -> "DataFrame":
+        from ballista_tpu.plan.logical import TableScan
+
+        provider = self.catalog.get(name)
+        if provider is None:
+            raise PlanningError(f"table not found: {name}")
+        return DataFrame(self, TableScan(name, provider))
+
+    # -- planning / execution ----------------------------------------------
+
+    def optimize(self, plan: LogicalPlan) -> LogicalPlan:
+        return optimize(plan)
+
+    def create_physical_plan(self, plan: LogicalPlan) -> ExecutionPlan:
+        from ballista_tpu.engine.physical_planner import PhysicalPlanner
+
+        optimized = optimize(plan)
+        return PhysicalPlanner(self.config).plan(optimized)
+
+    def execute_collect(self, physical: ExecutionPlan) -> pa.Table:
+        engine_name = str(self.config.get(EXECUTOR_ENGINE))
+        if engine_name == "tpu":
+            from ballista_tpu.engine.tpu_engine import maybe_compile_tpu
+
+            physical = maybe_compile_tpu(physical, self.config)
+        ctx = TaskContext(self.config)
+        n = physical.output_partition_count()
+        batches: list[pa.RecordBatch] = []
+        if n == 1:
+            batches.extend(physical.execute(0, ctx))
+        else:
+            with _fut.ThreadPoolExecutor(max_workers=min(n, 16)) as pool:
+                futs = [pool.submit(lambda p=p: list(physical.execute(p, ctx))) for p in range(n)]
+                for f in futs:
+                    batches.extend(f.result())
+        batches = [b for b in batches if b.num_rows]
+        schema = physical.schema()
+        if not batches:
+            return pa.table({f.name: pa.array([], f.type) for f in schema}, schema=schema)
+        return pa.Table.from_batches(batches, schema=schema)
+
+
+class DataFrame:
+    """Lazy logical-plan wrapper (reference: DataFusion DataFrame surface
+    re-exported through ballista's prelude)."""
+
+    def __init__(self, ctx: SessionContext, plan: LogicalPlan):
+        self.ctx = ctx
+        self.plan = plan
+
+    @classmethod
+    def _empty(cls, ctx: SessionContext, note: str) -> "DataFrame":
+        tbl = pa.table({"result": pa.array([note])})
+        from ballista_tpu.plan.logical import TableScan
+        from ballista_tpu.plan.provider import MemoryTable as MT
+
+        return cls(ctx, TableScan("result", MT.from_table(tbl)))
+
+    # -- transformations ----------------------------------------------------
+
+    def select(self, *exprs) -> "DataFrame":
+        from ballista_tpu.plan.expressions import col as _col
+        from ballista_tpu.plan.logical import Projection
+
+        es = [(_col(e) if isinstance(e, str) else e) for e in exprs]
+        return DataFrame(self.ctx, Projection(self.plan, es))
+
+    def filter(self, predicate) -> "DataFrame":
+        from ballista_tpu.plan.logical import Filter as F
+
+        return DataFrame(self.ctx, F(self.plan, predicate))
+
+    def aggregate(self, group_exprs, agg_exprs) -> "DataFrame":
+        from ballista_tpu.plan.logical import Aggregate as A
+
+        return DataFrame(self.ctx, A(self.plan, list(group_exprs), list(agg_exprs)))
+
+    def sort(self, *keys) -> "DataFrame":
+        from ballista_tpu.plan.logical import Sort as S
+
+        return DataFrame(self.ctx, S(self.plan, list(keys)))
+
+    def limit(self, fetch: int, skip: int = 0) -> "DataFrame":
+        from ballista_tpu.plan.logical import Limit as L
+
+        return DataFrame(self.ctx, L(self.plan, fetch, skip))
+
+    def join(self, other: "DataFrame", on: list, how: str = "inner") -> "DataFrame":
+        from ballista_tpu.plan.expressions import col as _col
+        from ballista_tpu.plan.logical import Join as J
+
+        pairs = []
+        for item in on:
+            if isinstance(item, str):
+                pairs.append((_col(item), _col(item)))
+            else:
+                l, r = item
+                pairs.append((_col(l) if isinstance(l, str) else l, _col(r) if isinstance(r, str) else r))
+        return DataFrame(self.ctx, J(self.plan, other.plan, pairs, how))
+
+    # -- actions ------------------------------------------------------------
+
+    def logical_plan(self) -> LogicalPlan:
+        return self.plan
+
+    def optimized_plan(self) -> LogicalPlan:
+        return self.ctx.optimize(self.plan)
+
+    def explain_text(self) -> str:
+        logical = self.ctx.optimize(self.plan)
+        physical = self.ctx.create_physical_plan(self.plan)
+        return f"logical plan:\n{logical.display()}\nphysical plan:\n{physical.display()}"
+
+    def collect(self) -> pa.Table:
+        if isinstance(self.plan, Explain):
+            return self._collect_explain()
+        physical = self.ctx.create_physical_plan(self.plan)
+        return self.ctx.execute_collect(physical)
+
+    def _collect_explain(self) -> pa.Table:
+        assert isinstance(self.plan, Explain)
+        logical = self.ctx.optimize(self.plan.input)
+        physical = self.ctx.create_physical_plan(self.plan.input)
+        types = ["logical_plan", "physical_plan"]
+        plans = [logical.display(), physical.display()]
+        if self.plan.analyze:
+            tbl = self.ctx.execute_collect(physical)
+            from ballista_tpu.plan.physical import collect_metrics
+
+            lines = []
+            for depth, name, m in collect_metrics(physical):
+                lines.append(f"{'  ' * depth}{name}: rows={m['output_rows']} elapsed_ms={m['elapsed_ns'] / 1e6:.2f}")
+            types.append("analyzed_plan")
+            plans.append("\n".join(lines))
+        return pa.table({"plan_type": pa.array(types), "plan": pa.array(plans)})
+
+    def to_pandas(self):
+        return self.collect().to_pandas()
+
+    def count(self) -> int:
+        return self.collect().num_rows
+
+    def show(self, n: int = 20) -> None:
+        print(self.collect().slice(0, n).to_pandas().to_string())

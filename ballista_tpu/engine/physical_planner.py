@@ -1,0 +1,420 @@
+"""Physical planner: LogicalPlan → ExecutionPlan.
+
+Performs what the reference delegates to DataFusion's physical planner plus
+the scheduler-side JoinSelection rule
+(scheduler/src/physical_optimizer/join_selection.rs): build-side choice by
+estimated size, broadcast (CollectLeft) vs partitioned joins by threshold,
+two-phase aggregation with hash exchanges, avg/count-distinct
+decomposition, and sort/limit lowering.
+
+RepartitionExec nodes inserted here are the stage boundaries the
+distributed planner later splits at (scheduler/src/planner.rs:108).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import pyarrow as pa
+
+from ballista_tpu.config import (
+    BROADCAST_JOIN_ROWS_THRESHOLD,
+    DEFAULT_SHUFFLE_PARTITIONS,
+    TARGET_PARTITIONS,
+    BallistaConfig,
+)
+from ballista_tpu.errors import PlanningError
+from ballista_tpu.plan.expressions import (
+    AggregateFunction,
+    Alias,
+    BinaryExpr,
+    Cast,
+    Column,
+    Expr,
+    to_field,
+)
+from ballista_tpu.plan.logical import (
+    Aggregate,
+    CrossJoin,
+    Distinct,
+    EmptyRelation,
+    Filter,
+    Join,
+    Limit,
+    LogicalPlan,
+    Projection,
+    Sort,
+    SubqueryAlias,
+    TableScan,
+    Union,
+    Values,
+)
+from ballista_tpu.plan.physical import (
+    AggDesc,
+    CoalescePartitionsExec,
+    CrossJoinExec,
+    EmptyExec,
+    ExecutionPlan,
+    FilterExec,
+    GlobalLimitExec,
+    HashAggregateExec,
+    HashJoinExec,
+    LocalLimitExec,
+    MemoryScanExec,
+    ParquetScanExec,
+    ProjectionExec,
+    RepartitionExec,
+    SortExec,
+    SortPreservingMergeExec,
+    UnionExec,
+)
+from ballista_tpu.plan.provider import MemoryTable, ParquetTable
+from ballista_tpu.plan.schema import DFField, DFSchema
+
+
+class PhysicalPlanner:
+    def __init__(self, config: BallistaConfig | None = None):
+        self.config = config or BallistaConfig()
+        self.shuffle_partitions = int(self.config.get(DEFAULT_SHUFFLE_PARTITIONS))
+        self.target_partitions = int(self.config.get(TARGET_PARTITIONS))
+        self.broadcast_rows = int(self.config.get(BROADCAST_JOIN_ROWS_THRESHOLD))
+
+    def plan(self, logical: LogicalPlan) -> ExecutionPlan:
+        return self._plan(logical)
+
+    # ------------------------------------------------------------------
+
+    def _plan(self, node: LogicalPlan) -> ExecutionPlan:
+        if isinstance(node, TableScan):
+            return self._plan_scan(node)
+        if isinstance(node, Projection):
+            child = self._plan(node.input)
+            return ProjectionExec(child, node.exprs, _rebind_schema(node.schema))
+        if isinstance(node, Filter):
+            return FilterExec(self._plan(node.input), node.predicate)
+        if isinstance(node, Aggregate):
+            return self._plan_aggregate(node)
+        if isinstance(node, Join):
+            return self._plan_join(node)
+        if isinstance(node, CrossJoin):
+            left = self._plan(node.left)
+            right = self._plan(node.right)
+            if estimate_rows(node.left) > estimate_rows(node.right):
+                # build (collected) side should be the small one
+                right_first = CrossJoinExec(right, left, node.right.schema.merge(node.left.schema))
+                order = [
+                    Column(f.name, f.qualifier) for f in node.schema
+                ]
+                return ProjectionExec(right_first, order, node.schema)
+            return CrossJoinExec(left, right, node.schema)
+        if isinstance(node, Sort):
+            child = self._plan(node.input)
+            s = SortExec(child, node.keys, node.fetch)
+            if child.output_partition_count() > 1:
+                return SortPreservingMergeExec(s, node.keys, node.fetch)
+            return s
+        if isinstance(node, Limit):
+            child = self._plan(node.input)
+            fetch, skip = node.fetch, node.skip
+            if child.output_partition_count() > 1:
+                if fetch is not None:
+                    child = LocalLimitExec(child, fetch + skip)
+                child = CoalescePartitionsExec(child)
+            return GlobalLimitExec(child, fetch, skip)
+        if isinstance(node, Distinct):
+            agg = Aggregate(node.input, [Column(f.name, f.qualifier) for f in node.schema], [])
+            return self._plan_aggregate(agg)
+        if isinstance(node, SubqueryAlias):
+            child = self._plan(node.input)
+            # carry the alias-qualified schema so parent expressions binding
+            # against `alias.column` resolve (planner-created nodes are not
+            # shared, so re-stamping the output schema in place is safe)
+            child.df_schema = node.schema
+            return child
+        if isinstance(node, Union):
+            return UnionExec([self._plan(c) for c in node.inputs], node.schema)
+        if isinstance(node, Values):
+            cols = list(zip(*node.rows)) if node.rows else []
+            arrays = [pa.array(list(c)) for c in cols]
+            batch = pa.RecordBatch.from_arrays(arrays, schema=node.schema.to_arrow())
+            return MemoryScanExec(node.schema, [batch])
+        if isinstance(node, EmptyRelation):
+            return EmptyExec(node.schema, node.produce_one_row)
+        raise PlanningError(f"cannot lower {type(node).__name__}")
+
+    # ------------------------------------------------------------------
+
+    def _plan_scan(self, node: TableScan) -> ExecutionPlan:
+        provider = node.provider
+        if isinstance(provider, MemoryTable):
+            child = MemoryScanExec(node.schema, provider.batches, provider.partitions)
+            if node.filters:
+                from ballista_tpu.plan.expressions import and_
+
+                return FilterExec(child, and_(*node.filters))
+            return child
+        partitions = provider.scan_partitions(self.target_partitions)
+        proj_names = [f.name for f in node.schema]
+        # scan output schema must include filter-only columns for evaluation
+        filter_cols: list[str] = []
+        from ballista_tpu.plan.expressions import collect_columns
+
+        for f in node.filters:
+            for c in collect_columns(f):
+                if c.name not in proj_names and c.name not in filter_cols:
+                    filter_cols.append(c.name)
+        if filter_cols:
+            full = provider.df_schema().with_qualifier(node.alias or node.table_name)
+            read_fields = list(node.schema.fields) + [
+                full.field(full.index_of(n)) for n in filter_cols
+            ]
+            read_schema = DFSchema(read_fields)
+            scan = ParquetScanExec(
+                read_schema, partitions, [f.name for f in read_fields], node.filters, node.table_name
+            )
+            keep = [Column(f.name, f.qualifier) for f in node.schema]
+            return ProjectionExec(scan, keep, node.schema)
+        return ParquetScanExec(node.schema, partitions, proj_names, node.filters, node.table_name)
+
+    # ------------------------------------------------------------------
+
+    def _plan_aggregate(self, node: Aggregate) -> ExecutionPlan:
+        child = self._plan(node.input)
+        in_schema = node.input.schema
+        group_exprs = node.group_exprs
+        n_group = len(group_exprs)
+
+        # count(distinct x) → dedup-then-count (two stacked aggregates)
+        if any(isinstance(a, AggregateFunction) and a.func == "count_distinct" for a in node.agg_exprs):
+            if not all(
+                isinstance(a, AggregateFunction) and a.func == "count_distinct"
+                for a in node.agg_exprs
+            ):
+                raise PlanningError("mixing count(distinct) with other aggregates is unsupported")
+            args = [a.arg for a in node.agg_exprs]
+            inner = Aggregate(node.input, list(group_exprs) + args, [])
+            inner_planned = self._plan_aggregate(inner)
+            # outer: group by original keys, count the deduped arg
+            outer_group = [Column(g.output_name()) for g in group_exprs]
+            outer_aggs: list[AggDesc] = []
+            result_exprs: list[Expr] = list(outer_group)
+            for a, arg in zip(node.agg_exprs, args):
+                outer_aggs.append(AggDesc("count", Column(arg.output_name()), a.output_name()))
+                result_exprs.append(Column(a.output_name()))
+            inner_logical_schema = inner.schema
+            return self._two_phase(
+                inner_planned,
+                inner_logical_schema,
+                outer_group,
+                outer_aggs,
+                node,
+                result_exprs_override=None,
+            )
+
+        # decompose logical aggs into accumulator descriptors
+        partial_aggs: list[AggDesc] = []
+        result_exprs: list[Expr] = [
+            Column(g.output_name(), g.qualifier if isinstance(g, Column) else None)
+            for g in group_exprs
+        ]
+        acc_fields: list[DFField] = []
+        i = 0
+        for a in node.agg_exprs:
+            assert isinstance(a, AggregateFunction), a
+            out_name = a.output_name()
+            if a.func == "avg":
+                sname, cname = f"__acc{i}_sum", f"__acc{i}_cnt"
+                partial_aggs.append(AggDesc("sum", a.arg, sname))
+                partial_aggs.append(AggDesc("count", a.arg, cname))
+                sum_t = _sum_type(a.arg.data_type(in_schema))
+                acc_fields.append(DFField(sname, sum_t, True))
+                acc_fields.append(DFField(cname, pa.int64(), False))
+                result_exprs.append(
+                    Alias(BinaryExpr(Column(sname), "/", Column(cname)), out_name)
+                )
+            elif a.func == "sum":
+                nm = f"__acc{i}"
+                partial_aggs.append(AggDesc("sum", a.arg, nm))
+                acc_fields.append(DFField(nm, _sum_type(a.arg.data_type(in_schema)), True))
+                result_exprs.append(Alias(Column(nm), out_name))
+            elif a.func in ("min", "max"):
+                nm = f"__acc{i}"
+                partial_aggs.append(AggDesc(a.func, a.arg, nm))
+                acc_fields.append(DFField(nm, a.arg.data_type(in_schema), True))
+                result_exprs.append(Alias(Column(nm), out_name))
+            elif a.func == "count":
+                nm = f"__acc{i}"
+                if a.arg is None:
+                    partial_aggs.append(AggDesc("count_all", None, nm))
+                else:
+                    partial_aggs.append(AggDesc("count", a.arg, nm))
+                acc_fields.append(DFField(nm, pa.int64(), False))
+                result_exprs.append(Alias(Column(nm), out_name))
+            else:
+                raise PlanningError(f"unsupported aggregate {a.func}")
+            i += 1
+
+        group_fields = [to_field(g, in_schema) for g in group_exprs]
+        acc_schema = DFSchema(group_fields + acc_fields)
+
+        partial = HashAggregateExec(child, list(group_exprs), partial_aggs, "partial", acc_schema)
+
+        if n_group == 0:
+            merged = CoalescePartitionsExec(partial)
+        else:
+            n = self.shuffle_partitions
+            keys = [Column(f.name, f.qualifier) for f in group_fields]
+            merged = RepartitionExec(partial, "hash", n, keys)
+
+        final_group = [Column(f.name, f.qualifier) for f in group_fields]
+        final_aggs = [
+            AggDesc(_merge_func(d.func), Column(d.name), d.name) for d in partial_aggs
+        ]
+        final = HashAggregateExec(merged, final_group, final_aggs, "final", acc_schema)
+        return ProjectionExec(final, result_exprs, _rebind_schema(node.schema))
+
+    def _two_phase(self, inner_planned, inner_schema, outer_group, outer_aggs, node, result_exprs_override):
+        """Lower the count-distinct outer aggregate over a pre-deduped input."""
+        acc_fields = [to_field(g, inner_schema) for g in outer_group] + [
+            DFField(d.name, pa.int64(), False) for d in outer_aggs
+        ]
+        acc_schema = DFSchema(acc_fields)
+        partial = HashAggregateExec(inner_planned, list(outer_group), outer_aggs, "partial", acc_schema)
+        if outer_group:
+            keys = [Column(f.name, f.qualifier) for f in acc_fields[: len(outer_group)]]
+            merged = RepartitionExec(partial, "hash", self.shuffle_partitions, keys)
+        else:
+            merged = CoalescePartitionsExec(partial)
+        final_aggs = [AggDesc("sum", Column(d.name), d.name) for d in outer_aggs]
+        final_group = [Column(f.name, f.qualifier) for f in acc_fields[: len(outer_group)]]
+        final = HashAggregateExec(merged, final_group, final_aggs, "final", acc_schema)
+        result_exprs = list(final_group) + [Alias(Column(d.name), d.name) for d in outer_aggs]
+        return ProjectionExec(final, result_exprs, _rebind_schema(node.schema))
+
+    # ------------------------------------------------------------------
+
+    def _plan_join(self, node: Join) -> ExecutionPlan:
+        left = self._plan(node.left)
+        right = self._plan(node.right)
+        l_rows = estimate_rows(node.left)
+        r_rows = estimate_rows(node.right)
+
+        jt = node.join_type
+        # choose build side (exec always builds its LEFT input)
+        swap = False
+        if jt in ("inner", "full", "left", "right"):
+            swap = r_rows < l_rows
+        elif jt in ("left_semi", "left_anti"):
+            swap = True  # build the (usually small) subquery side, probe outer
+            if r_rows > l_rows * 4:
+                swap = False
+        elif jt in ("right_semi", "right_anti"):
+            swap = False
+
+        if swap:
+            build, probe = right, left
+            build_rows = r_rows
+            on = [(r, l) for (l, r) in node.on]
+            exec_jt = _swap_join_type(jt)
+            build_schema, probe_schema = node.right.schema, node.left.schema
+        else:
+            build, probe = left, right
+            build_rows = l_rows
+            on = list(node.on)
+            exec_jt = jt
+            build_schema, probe_schema = node.left.schema, node.right.schema
+
+        broadcast = build_rows <= self.broadcast_rows or probe.output_partition_count() == 1
+
+        if broadcast:
+            mode = "collect_left"
+        else:
+            mode = "partitioned"
+            n = self.shuffle_partitions
+            build = RepartitionExec(build, "hash", n, [l for l, _ in on])
+            probe = RepartitionExec(probe, "hash", n, [r for _, r in on])
+
+        exec_schema = _join_exec_schema(build_schema, probe_schema, exec_jt)
+        j = HashJoinExec(build, probe, on, exec_jt, node.filter, mode, exec_schema)
+
+        if swap and exec_jt in ("inner", "left", "right", "full"):
+            order = [Column(f.name, f.qualifier) for f in node.schema]
+            return ProjectionExec(j, order, node.schema)
+        return j
+
+
+def _swap_join_type(jt: str) -> str:
+    return {
+        "inner": "inner", "left": "right", "right": "left", "full": "full",
+        "left_semi": "right_semi", "left_anti": "right_anti",
+        "right_semi": "left_semi", "right_anti": "left_anti",
+    }[jt]
+
+
+def _join_exec_schema(build_schema: DFSchema, probe_schema: DFSchema, jt: str) -> DFSchema:
+    if jt in ("left_semi", "left_anti"):
+        return build_schema
+    if jt in ("right_semi", "right_anti"):
+        return probe_schema
+    return build_schema.merge(probe_schema)
+
+
+def _sum_type(t: pa.DataType) -> pa.DataType:
+    if pa.types.is_integer(t):
+        return pa.int64()
+    return pa.float64()
+
+
+def _merge_func(f: str) -> str:
+    return {"sum": "sum", "min": "min", "max": "max", "count": "count", "count_all": "count_all"}[f]
+
+
+def _rebind_schema(s: DFSchema) -> DFSchema:
+    return s
+
+
+# -- crude cardinality estimator (join selection / broadcast decisions) -----
+
+_EST_CACHE: dict[int, float] = {}
+
+
+def estimate_rows(node: LogicalPlan) -> float:
+    key = id(node)
+    if key in _EST_CACHE:
+        return _EST_CACHE[key]
+    v = _estimate(node)
+    _EST_CACHE[key] = v
+    return v
+
+
+def _estimate(node: LogicalPlan) -> float:
+    if isinstance(node, TableScan):
+        stats = node.provider.statistics()
+        base = float(stats.num_rows) if stats.num_rows is not None else 1e6
+        return max(1.0, base * (0.3 ** len(node.filters)))
+    if isinstance(node, Filter):
+        return max(1.0, estimate_rows(node.input) * 0.3)
+    if isinstance(node, Join):
+        l, r = estimate_rows(node.left), estimate_rows(node.right)
+        if node.join_type in ("left_semi", "left_anti"):
+            return max(1.0, l * 0.5)
+        if node.join_type in ("right_semi", "right_anti"):
+            return max(1.0, r * 0.5)
+        return max(l, r)
+    if isinstance(node, CrossJoin):
+        return max(1.0, min(estimate_rows(node.left) * estimate_rows(node.right), 1e12))
+    if isinstance(node, Aggregate):
+        if not node.group_exprs:
+            return 1.0
+        return max(1.0, estimate_rows(node.input) * 0.1)
+    if isinstance(node, Distinct):
+        return max(1.0, estimate_rows(node.input) * 0.5)
+    if isinstance(node, Limit):
+        base = estimate_rows(node.input)
+        return min(base, node.fetch if node.fetch is not None else base)
+    if isinstance(node, Union):
+        return sum(estimate_rows(c) for c in node.inputs)
+    kids = node.children()
+    if kids:
+        return estimate_rows(kids[0])
+    return 1.0

@@ -1,0 +1,87 @@
+"""Vectorized equi-join matching on the host.
+
+Strategy: encode the join keys of both sides into one composite int64 id
+space (joint dictionary-encode per column, then mix), then sort-probe with
+searchsorted. Handles duplicate keys (full match expansion), NULL keys
+(never match), and multi-column keys. This same algorithm — sorted build
+side + binary-search probe — is what the TPU engine expresses in jax
+(ops/tpu/kernels.py), so CPU and TPU joins share shape and semantics.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+
+
+def _combined_ids(build_cols: list[pa.Array], probe_cols: list[pa.Array]) -> tuple[np.ndarray, np.ndarray]:
+    """Encode key columns of both sides into one id space.
+
+    Returns (build_ids, probe_ids) int64, -1 marks NULL (never matches).
+    """
+    nb = len(build_cols[0])
+    b_ids = np.zeros(nb, dtype=np.int64)
+    p_ids = np.zeros(len(probe_cols[0]), dtype=np.int64)
+    b_null = np.zeros(nb, dtype=bool)
+    p_null = np.zeros(len(probe_cols[0]), dtype=bool)
+    for bcol, pcol in zip(build_cols, probe_cols):
+        if isinstance(bcol, pa.ChunkedArray):
+            bcol = bcol.combine_chunks()
+        if isinstance(pcol, pa.ChunkedArray):
+            pcol = pcol.combine_chunks()
+        if bcol.type != pcol.type:
+            target = _common_type(bcol.type, pcol.type)
+            bcol = bcol.cast(target)
+            pcol = pcol.cast(target)
+        both = pa.chunked_array([bcol, pcol]) if len(pcol) else pa.chunked_array([bcol])
+        codes_arr = pc.dictionary_encode(both).combine_chunks()
+        codes = codes_arr.indices.to_numpy(zero_copy_only=False)
+        codes = np.where(np.isnan(codes), -1, codes).astype(np.int64) if codes.dtype.kind == "f" else codes.astype(np.int64)
+        card = len(codes_arr.dictionary) + 1
+        bc = codes[:nb]
+        pc_ = codes[nb:] if len(pcol) else np.zeros(0, dtype=np.int64)
+        b_null |= bc < 0
+        p_null |= pc_ < 0
+        b_ids = b_ids * card + (bc + 1)
+        p_ids = p_ids * card + (pc_ + 1)
+    b_ids[b_null] = -1
+    p_ids[p_null] = -2  # distinct from build's null so they never match
+    return b_ids, p_ids
+
+
+def _common_type(a: pa.DataType, b: pa.DataType) -> pa.DataType:
+    if pa.types.is_floating(a) or pa.types.is_floating(b):
+        return pa.float64()
+    if pa.types.is_integer(a) and pa.types.is_integer(b):
+        return pa.int64()
+    if pa.types.is_string(a) or pa.types.is_string(b):
+        return pa.string()
+    return a
+
+
+def match_pairs(build_cols: list[pa.Array], probe_cols: list[pa.Array]):
+    """All matching (build_idx, probe_idx) pairs.
+
+    Returns (build_idx int64[M], probe_idx int64[M]); NULL keys never match.
+    """
+    b_ids, p_ids = _combined_ids(build_cols, probe_cols)
+    order = np.argsort(b_ids, kind="stable")
+    sorted_ids = b_ids[order]
+    # exclude nulls from the probe-able range
+    start_valid = np.searchsorted(sorted_ids, 0, side="left")  # ids >= 0
+    sorted_valid = sorted_ids[start_valid:]
+    order_valid = order[start_valid:]
+
+    lo = np.searchsorted(sorted_valid, p_ids, side="left")
+    hi = np.searchsorted(sorted_valid, p_ids, side="right")
+    counts = hi - lo
+    total = int(counts.sum())
+    if total == 0:
+        return np.zeros(0, dtype=np.int64), np.zeros(0, dtype=np.int64)
+    probe_idx = np.repeat(np.arange(len(p_ids), dtype=np.int64), counts)
+    # expand [lo, hi) ranges: standard cumsum trick
+    offs = np.concatenate(([0], np.cumsum(counts)[:-1]))
+    flat = np.arange(total, dtype=np.int64) - np.repeat(offs, counts) + np.repeat(lo, counts)
+    build_idx = order_valid[flat]
+    return build_idx, probe_idx

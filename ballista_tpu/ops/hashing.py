@@ -1,0 +1,104 @@
+"""Deterministic row hashing for repartitioning.
+
+THE WIRE CONTRACT: the hash of a row must be identical no matter which
+engine (cpu numpy, tpu jax, native C++) computed it, or shuffled data lands
+in the wrong partition. Mirrors the role of the reference's fixed-seed
+ahash in RepartitionExec. Algorithm: per-column 64-bit mix (splitmix64 over
+the canonical int64 encoding of the value), columns combined with a
+boost-style hash_combine. Null hashes to a fixed tag.
+
+The jax twin of this function lives in ops/tpu/kernels.py
+(hash64/hash_combine) and tests assert they agree bit-for-bit.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pyarrow as pa
+
+_NULL_TAG = np.uint64(0x9E3779B97F4A7C15)
+
+
+def splitmix64(x: np.ndarray) -> np.ndarray:
+    """splitmix64 finalizer; x is uint64."""
+    with np.errstate(over="ignore"):
+        x = (x + np.uint64(0x9E3779B97F4A7C15)).astype(np.uint64)
+        x = ((x ^ (x >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)).astype(np.uint64)
+        x = ((x ^ (x >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)).astype(np.uint64)
+        return (x ^ (x >> np.uint64(31))).astype(np.uint64)
+
+
+def hash_combine(h: np.ndarray, v: np.ndarray) -> np.ndarray:
+    with np.errstate(over="ignore"):
+        return (h ^ (v + np.uint64(0x9E3779B97F4A7C15) + (h << np.uint64(6)) + (h >> np.uint64(2)))).astype(np.uint64)
+
+
+def _int64_encoding(arr: pa.Array) -> tuple[np.ndarray, np.ndarray | None]:
+    """Canonical int64 view of an array + validity mask (None = all valid)."""
+    if isinstance(arr, pa.ChunkedArray):
+        arr = arr.combine_chunks()
+    t = arr.type
+    mask = None
+    if arr.null_count:
+        mask = np.asarray(arr.is_valid())
+    if pa.types.is_dictionary(t):
+        arr = arr.cast(t.value_type)
+        return _int64_encoding(arr)
+    if pa.types.is_integer(t):
+        vals = arr.cast(pa.int64(), safe=False).to_numpy(zero_copy_only=False)
+        return vals.astype(np.int64, copy=False).view(np.uint64), mask
+    if pa.types.is_date(t):
+        vals = arr.cast(pa.int32(), safe=False).cast(pa.int64()).to_numpy(zero_copy_only=False)
+        return vals.view(np.uint64), mask
+    if pa.types.is_boolean(t):
+        vals = arr.cast(pa.int64()).to_numpy(zero_copy_only=False)
+        return vals.view(np.uint64), mask
+    if pa.types.is_floating(t):
+        vals = arr.cast(pa.float64()).to_numpy(zero_copy_only=False)
+        # normalize -0.0 to 0.0 so equal keys hash equal
+        vals = np.where(vals == 0.0, 0.0, vals)
+        return vals.view(np.uint64), mask
+    if pa.types.is_string(t) or pa.types.is_large_string(t) or pa.types.is_binary(t):
+        # FNV-1a over utf8 bytes, vectorized via offsets
+        data = arr.cast(pa.large_binary())
+        buffers = data.buffers()
+        offsets = np.frombuffer(buffers[1], dtype=np.int64, count=len(arr) + 1 + (data.offset))
+        offsets = offsets[data.offset : data.offset + len(arr) + 1]
+        raw = np.frombuffer(buffers[2], dtype=np.uint8) if buffers[2] is not None else np.zeros(0, np.uint8)
+        return _fnv1a_segments(raw, offsets), mask
+    raise TypeError(f"unhashable key type {t}")
+
+
+def _fnv1a_segments(data: np.ndarray, offsets: np.ndarray) -> np.ndarray:
+    """FNV-1a per segment. Vectorized over fixed byte positions: iterate
+    max_len times over a (n,) lane, cheap because strings are short keys."""
+    n = len(offsets) - 1
+    lens = (offsets[1:] - offsets[:-1]).astype(np.int64)
+    h = np.full(n, 0xCBF29CE484222325, dtype=np.uint64)
+    max_len = int(lens.max()) if n else 0
+    with np.errstate(over="ignore"):
+        for i in range(max_len):
+            sel = lens > i
+            idx = offsets[:-1][sel] + i
+            h_sel = h[sel]
+            h_sel = ((h_sel ^ data[idx].astype(np.uint64)) * np.uint64(0x100000001B3)).astype(np.uint64)
+            h[sel] = h_sel
+    return h
+
+
+def hash_arrays(arrays: list[pa.Array]) -> np.ndarray:
+    """Combined row hash over multiple key columns → uint64[n]."""
+    n = len(arrays[0])
+    out = np.zeros(n, dtype=np.uint64)
+    for arr in arrays:
+        enc, mask = _int64_encoding(arr)
+        hv = splitmix64(enc)
+        if mask is not None:
+            hv = np.where(mask, hv, _NULL_TAG)
+        out = hash_combine(out, hv)
+    return out
+
+
+def partition_indices(arrays: list[pa.Array], num_partitions: int) -> np.ndarray:
+    """Row → output partition id (uint64 % K, same as the jax kernel)."""
+    return (hash_arrays(arrays) % np.uint64(num_partitions)).astype(np.int64)

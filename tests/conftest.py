@@ -1,0 +1,41 @@
+import os
+
+# Sharding/parallelism tests run on a virtual 8-device CPU mesh (the driver
+# separately dry-runs the multi-chip path); set before any jax import.
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import pytest
+
+
+@pytest.fixture(scope="session")
+def tpch_dir(tmp_path_factory):
+    """Session-scoped TPC-H SF0.01 parquet directory."""
+    from ballista_tpu.testing.tpchgen import generate_tpch
+
+    d = tmp_path_factory.mktemp("tpch") / "sf001"
+    generate_tpch(str(d), scale=0.01, seed=42, files_per_table=2)
+    return str(d)
+
+
+@pytest.fixture()
+def tpch_ctx(tpch_dir):
+    from ballista_tpu.client.context import SessionContext
+    from ballista_tpu.testing.tpchgen import register_tpch
+
+    ctx = SessionContext()
+    register_tpch(ctx, tpch_dir)
+    return ctx
+
+
+@pytest.fixture(scope="session")
+def tpch_ref_tables(tpch_dir):
+    from ballista_tpu.testing.reference import load_tables
+
+    return load_tables(tpch_dir)
+
+
+def tpch_query(n: int) -> str:
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with open(os.path.join(root, "benchmarks", "tpch", "queries", f"q{n}.sql")) as f:
+        return f.read()

@@ -1,0 +1,76 @@
+"""Operator / kernel unit tests: hashing contract, join matching, config."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from ballista_tpu.config import BallistaConfig, EXECUTOR_ENGINE, DEFAULT_SHUFFLE_PARTITIONS
+from ballista_tpu.errors import ConfigurationError
+from ballista_tpu.ops.cpu.join_kernel import match_pairs
+from ballista_tpu.ops.hashing import hash_arrays, partition_indices
+
+
+def test_hash_deterministic_across_types():
+    a = pa.array([1, 2, 3, 2**40], pa.int64())
+    h1 = hash_arrays([a])
+    h2 = hash_arrays([a.cast(pa.int32(), safe=False)])  # 2**40 wraps; ignore last
+    assert (h1[:3] == h2[:3]).all()
+    d = pa.array([0, 1, 2], pa.int32()).cast(pa.date32())
+    hd = hash_arrays([d])
+    assert len(set(hd.tolist())) == 3
+
+
+def test_hash_strings_and_nulls():
+    s = pa.array(["abc", "abd", None, "abc"])
+    h = hash_arrays([s])
+    assert h[0] == h[3] and h[0] != h[1]
+    # null has its own stable hash
+    h2 = hash_arrays([pa.array([None], pa.string())])
+    assert h[2] == h2[0]
+
+
+def test_partition_indices_range():
+    a = pa.array(np.arange(1000), pa.int64())
+    p = partition_indices([a], 7)
+    assert p.min() >= 0 and p.max() < 7
+    # roughly uniform
+    counts = np.bincount(p, minlength=7)
+    assert counts.min() > 80
+
+
+def test_match_pairs_duplicates_and_nulls():
+    build = [pa.array([1, 2, 2, None, 5], pa.int64())]
+    probe = [pa.array([2, 5, 7, None], pa.int64())]
+    bi, pi = match_pairs(build, probe)
+    pairs = sorted(zip(pi.tolist(), bi.tolist()))
+    # probe row 0 (val 2) matches build rows 1 and 2; probe row 1 (val 5) matches build 4
+    assert pairs == [(0, 1), (0, 2), (1, 4)]
+
+
+def test_match_pairs_multi_key():
+    build = [pa.array([1, 1, 2]), pa.array(["a", "b", "a"])]
+    probe = [pa.array([1, 2]), pa.array(["b", "a"])]
+    bi, pi = match_pairs(build, probe)
+    assert sorted(zip(pi.tolist(), bi.tolist())) == [(0, 1), (1, 2)]
+
+
+def test_config_validation():
+    c = BallistaConfig()
+    assert c.get(DEFAULT_SHUFFLE_PARTITIONS) == 16
+    c.set(DEFAULT_SHUFFLE_PARTITIONS, "8")
+    assert c.get(DEFAULT_SHUFFLE_PARTITIONS) == 8
+    with pytest.raises(ConfigurationError):
+        c.set("ballista.unknown.key", 1)
+    with pytest.raises(ConfigurationError):
+        c.set(EXECUTOR_ENGINE, "gpu")
+    pairs = c.to_key_value_pairs()
+    c2 = BallistaConfig.from_key_value_pairs(pairs)
+    assert c2.get(DEFAULT_SHUFFLE_PARTITIONS) == 8
+
+
+def test_config_docs_generation():
+    from ballista_tpu.config import generate_config_docs
+
+    docs = generate_config_docs()
+    assert "ballista.executor.engine" in docs
+    assert "ballista.tpu.shape.buckets" in docs

@@ -1,0 +1,74 @@
+"""Parser / planner / optimizer unit tests (reference analog: DataFusion's
+sql planner tests + ballista's plan-shape assertions)."""
+
+import datetime as dt
+
+import pytest
+
+from ballista_tpu.errors import SqlParseError
+from ballista_tpu.plan.expressions import BinaryExpr, Column, Literal
+from ballista_tpu.sql.ast import SelectStmt
+from ballista_tpu.sql.parser import parse_sql
+from ballista_tpu.sql.tokenizer import tokenize
+
+from .conftest import tpch_query
+
+
+def test_tokenize_basics():
+    toks = tokenize("select a, 'x''y', 1.5e3 from t -- comment\nwhere a >= 2")
+    kinds = [t.kind for t in toks]
+    assert "eof" in kinds
+    assert any(t.kind == "string" and t.value == "x'y" for t in toks)
+    assert any(t.kind == "number" and t.value == "1.5e3" for t in toks)
+
+
+def test_parse_date_interval():
+    stmt = parse_sql("select date '1994-01-01' + interval '3' month from t")
+    assert isinstance(stmt, SelectStmt)
+    e = stmt.projections[0]
+    assert isinstance(e, BinaryExpr)
+    assert e.left.value == dt.date(1994, 1, 1)
+
+
+def test_parse_errors():
+    with pytest.raises(SqlParseError):
+        parse_sql("select from")
+    with pytest.raises(SqlParseError):
+        parse_sql("select 1 extra_token still_here (")
+
+
+@pytest.mark.parametrize("q", list(range(1, 23)))
+def test_parse_all_tpch(q):
+    stmt = parse_sql(tpch_query(q))
+    assert isinstance(stmt, SelectStmt)
+
+
+@pytest.mark.parametrize("q", list(range(1, 23)))
+def test_plan_and_optimize_all_tpch(q, tpch_ctx):
+    df = tpch_ctx.sql(tpch_query(q))
+    opt = tpch_ctx.optimize(df.plan)
+    text = opt.display()
+    # decorrelation must leave no subquery expressions behind
+    # (SubqueryAlias nodes are fine; "<subquery>" placeholders are not)
+    assert "<subquery>" not in text and "<scalar subquery>" not in text
+
+
+def test_q19_or_factoring(tpch_ctx):
+    opt = tpch_ctx.optimize(tpch_ctx.sql(tpch_query(19)).plan)
+    text = opt.display()
+    # the common join key must have been factored out of the OR into a Join
+    assert "Join: type=inner" in text
+
+
+def test_filter_pushdown_to_scan(tpch_ctx):
+    opt = tpch_ctx.optimize(
+        tpch_ctx.sql("select l_orderkey from lineitem where l_quantity < 5 and l_orderkey > 100").plan
+    )
+    text = opt.display()
+    assert "TableScan" in text and "filters=" in text
+
+
+def test_projection_pushdown(tpch_ctx):
+    opt = tpch_ctx.optimize(tpch_ctx.sql("select l_orderkey from lineitem").plan)
+    text = opt.display()
+    assert "projection=[l_orderkey]" in text

@@ -1,0 +1,19 @@
+"""TPC-H correctness: engine output vs the independent pandas oracle.
+
+Reference analog: benchmarks `verify expected results` CI leg
+(.github/workflows/rust.yml) and the SF10 distributed matrix (tpch.yml).
+"""
+
+import pytest
+
+from ballista_tpu.testing.reference import compare_results, run_reference
+
+from .conftest import tpch_query
+
+
+@pytest.mark.parametrize("q", list(range(1, 23)))
+def test_tpch_local_cpu(q, tpch_ctx, tpch_ref_tables):
+    eng = tpch_ctx.sql(tpch_query(q)).collect()
+    ref = run_reference(q, tpch_ref_tables)
+    problems = compare_results(eng, ref, q)
+    assert not problems, "\n".join(problems)
