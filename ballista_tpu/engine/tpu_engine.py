@@ -1,3 +1,76 @@
-"""TPU engine stub — replaced by the real XLA stage compiler in ops/tpu."""
-def maybe_compile_tpu(physical, config):
-    return physical
+"""TPU engine: rewrite supported subtrees of a physical plan to XLA stages.
+
+The seam the reference exposes as `ExecutionEngine`
+(ballista/executor/src/execution_engine.rs:51): given a query stage's
+physical plan, produce the executor that runs it. `ballista.executor.engine
+= tpu` routes stages through here; unsupported subtrees keep their CPU
+operators (per-subtree dispatch like execution_engine.rs:124-147).
+
+v1 lowers Filter*/Projection* → HashAggregateExec(partial) pipelines over a
+scan (the FLOP/bandwidth-dominant part of aggregation queries). Joins and
+large-domain aggregations stay on the CPU engine this round; the device
+join kernel lands with the on-device shuffle path.
+"""
+
+from __future__ import annotations
+
+from ballista_tpu.config import BallistaConfig
+from ballista_tpu.plan.physical import (
+    CoalesceBatchesExec,
+    ExecutionPlan,
+    FilterExec,
+    HashAggregateExec,
+    MemoryScanExec,
+    ParquetScanExec,
+    ProjectionExec,
+)
+
+
+def maybe_compile_tpu(physical: ExecutionPlan, config: BallistaConfig) -> ExecutionPlan:
+    from ballista_tpu.ops.tpu.stage_compiler import TpuStageExec
+
+    def walk(node: ExecutionPlan) -> ExecutionPlan:
+        if isinstance(node, HashAggregateExec) and node.mode == "partial":
+            chain = _match_chain(node.input)
+            if chain is not None:
+                ops, scan = chain
+                if _static_ok(node):
+                    return TpuStageExec(node, ops, scan, config)
+        kids = node.children()
+        if not kids:
+            return node
+        new_kids = [walk(c) for c in kids]
+        if all(a is b for a, b in zip(new_kids, kids)):
+            return node
+        return node.with_children(new_kids)
+
+    return walk(physical)
+
+
+def _match_chain(node: ExecutionPlan):
+    """Descend Filter/Projection/CoalesceBatches to a scan; return
+    (dataflow-ordered op list, scan) or None."""
+    ops: list[ExecutionPlan] = []
+    cur = node
+    while True:
+        if isinstance(cur, (ParquetScanExec, MemoryScanExec)):
+            ops.reverse()
+            return ops, cur
+        if isinstance(cur, (FilterExec, ProjectionExec, CoalesceBatchesExec)):
+            ops.append(cur)
+            cur = cur.children()[0]
+            continue
+        return None
+
+
+def _static_ok(agg: HashAggregateExec) -> bool:
+    from ballista_tpu.plan.expressions import Alias, Column
+
+    for g in agg.group_exprs:
+        inner = g.expr if isinstance(g, Alias) else g
+        if not isinstance(inner, Column):
+            return False
+    for d in agg.aggs:
+        if d.func not in ("sum", "min", "max", "count", "count_all"):
+            return False
+    return True

@@ -1,0 +1,139 @@
+"""Arrow → device columnar encoding.
+
+The central TPU-native design problem (SURVEY.md §7 hard-part #1/#2): Arrow
+batches are ragged and typed for CPUs; XLA wants fixed shapes and hardware
+lanes. Encoding rules:
+
+- integers            → int64 device lanes (jax x64 enabled by the engine)
+- date32              → int32 day counts (comparisons become int compares)
+- float64 that proves to be N-decimal fixed-point (TPC-H money) → int64
+  scaled integers: exact on-device arithmetic and overflow-safe to ~9.2e18
+  scale units — beyond the SF1000 aggregate range at scale 1e6
+- other float64       → float64 (XLA emulates f64 on TPU; correctness first,
+  the money path is the fast path)
+- strings             → dictionary codes (int32) + host-side dictionary; all
+  string predicates become host-computed boolean LUTs over the dictionary,
+  gathered on device (predicates never touch bytes on the TPU)
+- booleans            → bool lanes
+- NULLs               → per-column validity masks are NOT yet lowered; any
+  nullable data falls back to the CPU engine at runtime
+
+Rows are padded to the session's shape buckets with a row-validity mask so
+one XLA compilation serves every batch in the bucket
+(`ballista.tpu.shape.buckets`).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+
+
+@dataclass
+class DeviceCol:
+    kind: str  # i64 | f64 | money | date | code | bool
+    data: Any  # np/jnp array, padded
+    dictionary: Optional[list] = None  # for kind == "code"
+    scale: int = 0  # for kind == "money": value = data / 10**scale
+
+
+@dataclass
+class DeviceBatch:
+    n_rows: int  # valid rows (<= padded length)
+    columns: dict[str, DeviceCol]
+    mask: Any  # bool[n_padded] row validity
+
+
+def next_bucket(n: int, buckets: list[int]) -> int:
+    for b in buckets:
+        if n <= b:
+            return b
+    # beyond the largest bucket: round up to a multiple of it
+    top = buckets[-1]
+    return ((n + top - 1) // top) * top
+
+
+def _is_fixed_point(vals: np.ndarray, scale: int = 2) -> bool:
+    if len(vals) == 0:
+        return True
+    m = 10**scale
+    scaled = vals * m
+    return bool(np.all(np.abs(scaled - np.rint(scaled)) < 1e-6))
+
+
+def _narrow_int(vals: np.ndarray) -> np.ndarray:
+    """Transfer-dtype narrowing: the PCIe/tunnel link is the bottleneck, so
+    ship the smallest int that holds the range; device readers upcast to
+    int64 in HBM (free relative to the link)."""
+    if len(vals) == 0:
+        return vals.astype(np.int32)
+    lo, hi = int(vals.min()), int(vals.max())
+    if -(2**15) <= lo and hi < 2**15:
+        return vals.astype(np.int16)
+    if -(2**31) <= lo and hi < 2**31:
+        return vals.astype(np.int32)
+    return vals.astype(np.int64)
+
+
+def encode_column(arr: pa.Array) -> Optional[DeviceCol]:
+    """Encode one Arrow column; None = not encodable (fallback to CPU)."""
+    if isinstance(arr, pa.ChunkedArray):
+        arr = arr.combine_chunks()
+    if arr.null_count:
+        return None
+    t = arr.type
+    if pa.types.is_dictionary(t):
+        codes = arr.indices.to_numpy(zero_copy_only=False)
+        return DeviceCol("code", _narrow_int(codes), dictionary=arr.dictionary.to_pylist())
+    if pa.types.is_integer(t):
+        vals = arr.cast(pa.int64(), safe=False).to_numpy(zero_copy_only=False)
+        return DeviceCol("i64", _narrow_int(vals))
+    if pa.types.is_date(t):
+        return DeviceCol("date", arr.cast(pa.int32(), safe=False).to_numpy(zero_copy_only=False))
+    if pa.types.is_boolean(t):
+        return DeviceCol("bool", arr.to_numpy(zero_copy_only=False))
+    if pa.types.is_floating(t):
+        vals = arr.cast(pa.float64()).to_numpy(zero_copy_only=False)
+        if _is_fixed_point(vals, 2):
+            return DeviceCol("money", _narrow_int(np.rint(vals * 100)), scale=2)
+        return DeviceCol("f64", vals)
+    if pa.types.is_string(t) or pa.types.is_large_string(t):
+        enc = pc.dictionary_encode(arr)
+        if isinstance(enc, pa.ChunkedArray):
+            enc = enc.combine_chunks()
+        codes = enc.indices.to_numpy(zero_copy_only=False)
+        return DeviceCol("code", _narrow_int(codes), dictionary=enc.dictionary.to_pylist())
+    return None
+
+
+def encode_table(tbl: pa.Table, buckets: list[int]) -> Optional[DeviceBatch]:
+    n = tbl.num_rows
+    padded = next_bucket(max(n, 1), buckets)
+    cols: dict[str, DeviceCol] = {}
+    for name, col in zip(tbl.column_names, tbl.columns):
+        dc = encode_column(col)
+        if dc is None:
+            return None
+        dc.data = _pad(dc.data, padded)
+        cols[name] = dc
+    mask = np.zeros(padded, dtype=bool)
+    mask[:n] = True
+    return DeviceBatch(n, cols, mask)
+
+
+def _pad(a: np.ndarray, n: int) -> np.ndarray:
+    if len(a) == n:
+        return a
+    out = np.zeros(n, dtype=a.dtype)
+    out[: len(a)] = a
+    return out
+
+
+def decode_value(val: float | int, kind: str, scale: int):
+    if kind == "money":
+        return val / (10**scale)
+    return val

@@ -1,0 +1,468 @@
+"""JAX kernels: expression lowering, masked segment aggregation, row hash.
+
+Design rules (pallas_guide / XLA-friendly):
+- no data-dependent shapes: filters produce MASKS, never compaction; the
+  aggregation consumes (value, mask) pairs with segment ops
+- string work never reaches the device: predicates over dictionary columns
+  are host-precomputed boolean LUTs, gathered by code on device
+- money arithmetic stays in int64 scaled integers (exact); scale tracking
+  happens at lowering time (static), not at runtime
+- one jitted function per (stage fingerprint, shape bucket, dict sizes):
+  the compile cache is keyed exactly on what changes the traced program
+
+hash64/hash_combine are the bit-exact twins of ops/hashing.py — the wire
+contract that lets device-side hash partitioning interoperate with host and
+C++ shuffle readers.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import fnmatch
+from dataclasses import dataclass
+from typing import Any, Callable
+
+import numpy as np
+
+from ballista_tpu.errors import ExecutionError
+from ballista_tpu.plan.expressions import (
+    Alias,
+    Between,
+    BinaryExpr,
+    Case,
+    Cast,
+    Column,
+    Expr,
+    InList,
+    Like,
+    Literal,
+    Negative,
+    Not,
+    ScalarFunction,
+)
+from ballista_tpu.plan.schema import DFSchema
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+# -- device value with static (lowering-time) type info ---------------------
+
+
+@dataclass
+class DevVal:
+    kind: str  # i64 | f64 | money | date | code | bool
+    arr: Any  # jnp array
+    scale: int = 0
+    dictionary: list | None = None
+
+
+class Unsupported(Exception):
+    """Raised at lowering time → subtree falls back to the CPU engine."""
+
+
+# -- bit-exact twin of ops/hashing.py ---------------------------------------
+
+
+def hash64(x):
+    """splitmix64 over uint64 lanes (jax)."""
+    jnp = _jnp()
+    x = x.astype(jnp.uint64)
+    x = x + jnp.uint64(0x9E3779B97F4A7C15)
+    x = (x ^ (x >> jnp.uint64(30))) * jnp.uint64(0xBF58476D1CE4E5B9)
+    x = (x ^ (x >> jnp.uint64(27))) * jnp.uint64(0x94D049BB133111EB)
+    return x ^ (x >> jnp.uint64(31))
+
+
+def hash_combine_jax(h, v):
+    jnp = _jnp()
+    return h ^ (v + jnp.uint64(0x9E3779B97F4A7C15) + (h << jnp.uint64(6)) + (h >> jnp.uint64(2)))
+
+
+# -- lowering ----------------------------------------------------------------
+
+
+class Lowering:
+    """Collects host-side LUT constants while lowering expressions into
+    closures over (cols, luts). LUTs are padded to pow2 so jit keys are
+    stable across partitions with slightly different dictionaries."""
+
+    def __init__(self, schema: DFSchema, kinds: list[tuple[str, int]], dictionaries: list[list | None]):
+        self.schema = schema
+        self.kinds = kinds  # per-field (kind, scale)
+        self.dictionaries = dictionaries
+        # LUTs are registered as (source_slot, builder) so they can be
+        # REBUILT for each partition's dictionaries without re-tracing: the
+        # compiled function takes LUTs as traced args, only their contents
+        # change across partitions (padded size is part of the jit key).
+        self.lut_builders: list[tuple[int, Any]] = []
+        self.slots: list[int] = list(range(len(kinds)))  # field → source slot
+        # env indirection (set by the stage compiler): field index → lowered
+        # fn, so projections rebind what a Column reference means
+        self.env_fns: list | None = None
+        self.env_meta: list | None = None
+
+    def add_lut(self, src_slot: int, builder) -> int:
+        self.lut_builders.append((src_slot, builder))
+        return len(self.lut_builders) - 1
+
+    def build_luts(self, dictionaries_by_slot: list[list | None]) -> list[np.ndarray]:
+        out = []
+        for slot, builder in self.lut_builders:
+            vals = builder(dictionaries_by_slot[slot])
+            n = 1
+            while n < max(len(vals), 1):
+                n *= 2
+            padded = np.zeros(n, dtype=vals.dtype)
+            padded[: len(vals)] = vals
+            out.append(padded)
+        return out
+
+    def col_index(self, c: Column) -> int:
+        return self.schema.index_of(c.name, c.qualifier)
+
+
+LoweredFn = Callable[[list, list], DevVal]  # (cols, luts) -> DevVal
+
+
+def lower_expr(e: Expr, ctx: Lowering) -> LoweredFn:
+    jnp_mod = None  # resolved lazily inside closures
+
+    if isinstance(e, Alias):
+        return lower_expr(e.expr, ctx)
+
+    if isinstance(e, Column):
+        i = ctx.col_index(e)
+        if ctx.env_fns is not None:
+            return ctx.env_fns[i]
+        kind, scale = ctx.kinds[i]
+        dic = ctx.dictionaries[i]
+        return lambda cols, luts: DevVal(kind, cols[i], scale, dic)
+
+    if isinstance(e, Literal):
+        v = e.value
+        if isinstance(v, bool):
+            return lambda cols, luts: DevVal("bool", _jnp().asarray(v))
+        if isinstance(v, int):
+            return lambda cols, luts: DevVal("i64", _jnp().asarray(v, dtype=_jnp().int64))
+        if isinstance(v, float):
+            cents = v * 100
+            if abs(cents - round(cents)) < 1e-9:
+                c = int(round(cents))
+                return lambda cols, luts: DevVal("money", _jnp().asarray(c, dtype=_jnp().int64), 2)
+            return lambda cols, luts: DevVal("f64", _jnp().asarray(v, dtype=_jnp().float64))
+        if isinstance(v, _dt.date):
+            days = (v - _dt.date(1970, 1, 1)).days
+            return lambda cols, luts: DevVal("date", _jnp().asarray(days, dtype=_jnp().int32))
+        raise Unsupported(f"literal {v!r}")
+
+    if isinstance(e, BinaryExpr):
+        lf = lower_expr(e.left, ctx)
+        rf = lower_expr(e.right, ctx)
+        op = e.op
+
+        def run(cols, luts):
+            return _binop(lf(cols, luts), op, rf(cols, luts))
+
+        return run
+
+    if isinstance(e, Not):
+        f = lower_expr(e.expr, ctx)
+        return lambda cols, luts: DevVal("bool", ~f(cols, luts).arr)
+
+    if isinstance(e, Negative):
+        f = lower_expr(e.expr, ctx)
+
+        def run(cols, luts):
+            v = f(cols, luts)
+            return DevVal(v.kind, -v.arr, v.scale)
+
+        return run
+
+    if isinstance(e, Between):
+        vf = lower_expr(e.expr, ctx)
+        lof = lower_expr(e.low, ctx)
+        hif = lower_expr(e.high, ctx)
+        neg = e.negated
+
+        def run(cols, luts):
+            v = vf(cols, luts)
+            lo = _binop(v, ">=", lof(cols, luts)).arr
+            hi = _binop(v, "<=", hif(cols, luts)).arr
+            out = lo & hi
+            return DevVal("bool", ~out if neg else out)
+
+        return run
+
+    if isinstance(e, InList):
+        inner = lower_expr(e.expr, ctx)
+        # resolved per-dictionary at closure build; only code/i64 supported
+        if isinstance(e.expr, (Column, Alias)):
+            col = e.expr.expr if isinstance(e.expr, Alias) else e.expr
+            i = ctx.col_index(col)
+            kind, _ = ctx.kinds[i]
+            src = inner
+            if kind == "code":
+                values = set(e.values)
+                li = ctx.add_lut(
+                    ctx.slots[i],
+                    lambda dic, values=values: np.array([x in values for x in dic], dtype=bool),
+                )
+                neg = e.negated
+
+                def run(cols, luts):
+                    codes = src(cols, luts).arr
+                    out = luts[li][codes]
+                    return DevVal("bool", ~out if neg else out)
+
+                return run
+            if kind in ("i64", "date"):
+                vals = list(e.values)
+                neg = e.negated
+
+                def run(cols, luts):
+                    jnp = _jnp()
+                    arr = src(cols, luts).arr
+                    out = jnp.zeros(arr.shape, dtype=bool)
+                    for v in vals:
+                        if isinstance(v, _dt.date):
+                            v = (v - _dt.date(1970, 1, 1)).days
+                        out = out | (arr == v)
+                    return DevVal("bool", ~out if neg else out)
+
+                return run
+        raise Unsupported(f"IN over {e.expr}")
+
+    if isinstance(e, Like):
+        if not isinstance(e.expr, Column):
+            raise Unsupported("LIKE over non-column")
+        i = ctx.col_index(e.expr)
+        kind, _ = ctx.kinds[i]
+        if kind != "code":
+            raise Unsupported("LIKE over non-string")
+        src = lower_expr(e.expr, ctx)
+        pat = _like_to_fnmatch(e.pattern)
+        li = ctx.add_lut(
+            ctx.slots[i],
+            lambda dic, pat=pat: np.array(
+                [fnmatch.fnmatchcase(x, pat) for x in dic], dtype=bool
+            ),
+        )
+        neg = e.negated
+
+        def run(cols, luts):
+            out = luts[li][src(cols, luts).arr]
+            return DevVal("bool", ~out if neg else out)
+
+        return run
+
+    if isinstance(e, Case):
+        branch_fns = [(lower_expr(w, ctx), lower_expr(t, ctx)) for w, t in e.branches]
+        else_fn = lower_expr(e.else_expr, ctx) if e.else_expr is not None else None
+
+        def run(cols, luts):
+            jnp = _jnp()
+            thens = [tf(cols, luts) for _, tf in branch_fns]
+            whens = [wf(cols, luts) for wf, _ in branch_fns]
+            # align all branch values to a common kind/scale
+            target = thens[0]
+            if else_fn is not None:
+                evd = else_fn(cols, luts)
+            else:
+                evd = DevVal(target.kind, jnp.zeros((), dtype=target.arr.dtype), target.scale)
+            allv = thens + [evd]
+            kind, scale = _common_kind([ (v.kind, v.scale) for v in allv ])
+            allv = [_coerce(v, kind, scale) for v in allv]
+            out = allv[-1].arr
+            decided = jnp.zeros((), dtype=bool)
+            for w, t in zip(whens, allv[:-1]):
+                cond = w.arr & ~decided
+                out = jnp.where(cond, t.arr, out)
+                decided = decided | w.arr
+            return DevVal(kind, out, scale)
+
+        return run
+
+    if isinstance(e, Cast):
+        f = lower_expr(e.expr, ctx)
+        import pyarrow as pa
+
+        to = e.to
+
+        def run(cols, luts):
+            jnp = _jnp()
+            v = f(cols, luts)
+            if pa.types.is_floating(to):
+                return _coerce(v, "f64", 0)
+            if pa.types.is_integer(to):
+                if v.kind == "money":
+                    return DevVal("i64", v.arr // (10**v.scale))
+                return DevVal("i64", v.arr.astype(jnp.int64))
+            raise Unsupported(f"cast to {to}")
+
+        return run
+
+    if isinstance(e, ScalarFunction):
+        if e.name in ("extract_year", "extract_month"):
+            f = lower_expr(e.args[0], ctx)
+            part = e.name
+
+            def run(cols, luts):
+                jnp = _jnp()
+                v = f(cols, luts)
+                if v.kind != "date":
+                    raise Unsupported("extract over non-date")
+                days = v.arr.astype(jnp.int64)
+                # civil-from-days (Howard Hinnant's algorithm, vectorized)
+                z = days + 719468
+                era = jnp.where(z >= 0, z, z - 146096) // 146097
+                doe = z - era * 146097
+                yoe = (doe - doe // 1460 + doe // 36524 - doe // 146096) // 365
+                y = yoe + era * 400
+                doy = doe - (365 * yoe + yoe // 4 - yoe // 100)
+                mp = (5 * doy + 2) // 153
+                m = jnp.where(mp < 10, mp + 3, mp - 9)
+                y = jnp.where(m <= 2, y + 1, y)
+                if part == "extract_year":
+                    return DevVal("i64", y.astype(jnp.int64))
+                return DevVal("i64", m.astype(jnp.int64))
+
+            return run
+        raise Unsupported(f"scalar fn {e.name}")
+
+    raise Unsupported(f"{type(e).__name__}")
+
+
+def _like_to_fnmatch(pat: str) -> str:
+    out = []
+    for ch in pat:
+        if ch == "%":
+            out.append("*")
+        elif ch == "_":
+            out.append("?")
+        elif ch in "*?[]":
+            out.append(f"[{ch}]")
+        else:
+            out.append(ch)
+    return "".join(out)
+
+
+def _common_kind(pairs: list[tuple[str, int]]) -> tuple[str, int]:
+    kinds = {k for k, _ in pairs}
+    if "f64" in kinds:
+        return "f64", 0
+    if "money" in kinds:
+        scale = max(s for k, s in pairs if k == "money")
+        return "money", scale
+    if kinds <= {"i64", "bool"}:
+        return "i64", 0
+    if kinds == {"date"}:
+        return "date", 0
+    if kinds == {"code"}:
+        raise Unsupported("code-valued CASE")
+    return "i64", 0
+
+
+def _coerce(v: DevVal, kind: str, scale: int) -> DevVal:
+    jnp = _jnp()
+    if v.kind == kind and v.scale == scale:
+        return v
+    if kind == "f64":
+        if v.kind == "money":
+            return DevVal("f64", v.arr.astype(jnp.float64) / (10**v.scale))
+        return DevVal("f64", v.arr.astype(jnp.float64))
+    if kind == "money":
+        if v.kind == "money":
+            return DevVal("money", v.arr * (10 ** (scale - v.scale)), scale)
+        if v.kind in ("i64", "bool"):
+            return DevVal("money", v.arr.astype(jnp.int64) * (10**scale), scale)
+    if kind == "i64":
+        return DevVal("i64", v.arr.astype(jnp.int64))
+    raise Unsupported(f"coerce {v.kind}->{kind}")
+
+
+_CMP_OPS = {"=", "<>", "<", "<=", ">", ">="}
+
+
+def _binop(l: DevVal, op: str, r: DevVal) -> DevVal:
+    jnp = _jnp()
+    if op in ("and", "or"):
+        if op == "and":
+            return DevVal("bool", l.arr & r.arr)
+        return DevVal("bool", l.arr | r.arr)
+
+    if op in _CMP_OPS:
+        if l.kind == "code" or r.kind == "code":
+            code, lit = (l, r) if l.kind == "code" else (r, l)
+            raise Unsupported("code comparison must be pre-lowered via LUT")
+        kind, scale = _common_kind([(l.kind, l.scale), (r.kind, r.scale)])
+        a, b = _coerce(l, kind, scale).arr, _coerce(r, kind, scale).arr
+        fn = {
+            "=": lambda: a == b, "<>": lambda: a != b, "<": lambda: a < b,
+            "<=": lambda: a <= b, ">": lambda: a > b, ">=": lambda: a >= b,
+        }[op]
+        return DevVal("bool", fn())
+
+    # arithmetic
+    if op == "/":
+        a = _coerce(l, "f64", 0).arr
+        b = _coerce(r, "f64", 0).arr
+        return DevVal("f64", a / b)
+    if op == "*":
+        if l.kind == "money" and r.kind == "money":
+            return DevVal("money", l.arr * r.arr, l.scale + r.scale)
+        if l.kind == "money" and r.kind in ("i64", "bool"):
+            return DevVal("money", l.arr * r.arr.astype(jnp.int64), l.scale)
+        if r.kind == "money" and l.kind in ("i64", "bool"):
+            return DevVal("money", r.arr * l.arr.astype(jnp.int64), r.scale)
+        if "f64" in (l.kind, r.kind):
+            return DevVal("f64", _coerce(l, "f64", 0).arr * _coerce(r, "f64", 0).arr)
+        return DevVal("i64", l.arr.astype(jnp.int64) * r.arr.astype(jnp.int64))
+    if op in ("+", "-"):
+        if l.kind == "date" and r.kind == "i64":
+            arr = l.arr + (r.arr if op == "+" else -r.arr).astype(l.arr.dtype)
+            return DevVal("date", arr)
+        kind, scale = _common_kind([(l.kind, l.scale), (r.kind, r.scale)])
+        a, b = _coerce(l, kind, scale).arr, _coerce(r, kind, scale).arr
+        return DevVal(kind, a + b if op == "+" else a - b, scale)
+    raise Unsupported(f"binop {op}")
+
+
+# -- aggregation -------------------------------------------------------------
+
+
+def segment_aggregate(values: DevVal, mask, gids, num_segments: int, func: str):
+    """Masked per-group aggregate; returns jnp array[num_segments]."""
+    import jax
+
+    jnp = _jnp()
+    if func in ("count", "count_all"):
+        return jax.ops.segment_sum(mask.astype(jnp.int64), gids, num_segments=num_segments)
+    v = values.arr
+    if func == "sum":
+        zero = jnp.zeros((), dtype=v.dtype)
+        return jax.ops.segment_sum(jnp.where(mask, v, zero), gids, num_segments=num_segments)
+    if func == "min":
+        big = _max_of(v.dtype)
+        return jax.ops.segment_min(jnp.where(mask, v, big), gids, num_segments=num_segments)
+    if func == "max":
+        small = _min_of(v.dtype)
+        return jax.ops.segment_max(jnp.where(mask, v, small), gids, num_segments=num_segments)
+    raise Unsupported(f"agg {func}")
+
+
+def _max_of(dtype):
+    jnp = _jnp()
+    if jnp.issubdtype(dtype, jnp.integer):
+        return jnp.iinfo(dtype).max
+    return jnp.inf
+
+
+def _min_of(dtype):
+    jnp = _jnp()
+    if jnp.issubdtype(dtype, jnp.integer):
+        return jnp.iinfo(dtype).min
+    return -jnp.inf

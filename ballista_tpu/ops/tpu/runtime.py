@@ -1,0 +1,35 @@
+"""JAX runtime bootstrap for the TPU engine.
+
+x64 is required: join keys are int64 and money arithmetic is int64 scaled
+(ops/tpu/columnar.py). On TPU, f64 falls back to XLA software emulation —
+acceptable because the hot paths (masks, money, codes) are integer.
+"""
+
+from __future__ import annotations
+
+import threading
+
+_lock = threading.Lock()
+_ready = False
+
+
+def ensure_jax():
+    global _ready
+    with _lock:
+        if _ready:
+            import jax
+
+            return jax
+        import jax
+
+        jax.config.update("jax_enable_x64", True)
+        _ready = True
+        return jax
+
+
+def device_kind() -> str:
+    jax = ensure_jax()
+    try:
+        return jax.devices()[0].platform
+    except Exception:
+        return "cpu"
