@@ -1,0 +1,105 @@
+"""Intra-slice collective exchange: the ICI data plane.
+
+The reference's data plane is Arrow-IPC-over-Flight between executor
+processes (SURVEY.md §2.5). On a TPU pod slice, co-scheduled stages can
+exchange partitions over ICI instead of files: this module implements the
+stage patterns as jittable collectives under `shard_map` over a Mesh:
+
+- `partial_then_psum`: per-device partial aggregation merged with psum —
+  the collective form of partial-agg → shuffle(1) → final-agg.
+- `hash_exchange_all_to_all`: rows routed by the engine-wide key hash
+  (bit-identical twin of ops/hashing.py) into fixed-capacity per-device
+  buckets, exchanged with all_to_all — the collective form of
+  ShuffleWriter(hash K) → ShuffleReader. Fixed capacity keeps shapes
+  static for XLA; overflow falls back to the file shuffle path (the
+  capacity check happens host-side before dispatch).
+
+The file-based Flight shuffle remains the general path (elasticity, retry,
+cross-host); gated by `ballista.tpu.collective.exchange`.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import numpy as np
+
+
+def make_mesh(n_devices: int | None = None, axis: str = "part"):
+    """1-D device mesh over the partition axis (data parallel over rows)."""
+    import jax
+    from jax.sharding import Mesh
+
+    devs = jax.devices()
+    n = n_devices or len(devs)
+    return Mesh(np.array(devs[:n]), (axis,))
+
+
+def partial_then_psum(values, gmask_fn, num_groups: int, mesh, axis: str = "part"):
+    """Group-aggregate values sharded by rows across the mesh; returns the
+    globally-merged per-group (sums, counts) replicated on every device.
+
+    values: [rows] array sharded on `axis`; gmask_fn(local_rows) -> bool
+    masks [num_groups, local_rows].
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+
+    def local(vals):
+        gm = gmask_fn(vals)
+        sums = jnp.stack([jnp.where(gm[g], vals, 0).sum() for g in range(num_groups)])
+        cnts = jnp.stack([gm[g].sum() for g in range(num_groups)])
+        sums = jax.lax.psum(sums, axis)
+        cnts = jax.lax.psum(cnts, axis)
+        return sums, cnts
+
+    return shard_map(local, mesh=mesh, in_specs=(P(axis),), out_specs=(P(), P()))(values)
+
+
+def hash_exchange_all_to_all(keys, payload, mesh, axis: str = "part", capacity: int | None = None):
+    """Route (key, payload) rows to device hash(key) % n via all_to_all.
+
+    keys/payload: [rows] int64 sharded on `axis`. Every device receives the
+    rows whose key hashes to it, in fixed-capacity slots:
+    returns (keys_out, payload_out, valid_out) with per-device shape
+    [n_dev * capacity] where valid marks real rows.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+
+    from ballista_tpu.ops.tpu.kernels import hash64
+
+    n = mesh.devices.size
+    local_rows = keys.shape[0] // n
+    cap = capacity or local_rows  # worst case: all rows to one bucket
+
+    def local(k, v):
+        dest = (hash64(k.astype(jnp.uint64)) % jnp.uint64(n)).astype(jnp.int32)
+        # stable slot assignment per destination bucket
+        slot = jnp.zeros_like(dest)
+        eye = []
+        for d in range(n):
+            is_d = dest == d
+            slot = jnp.where(is_d, jnp.cumsum(is_d) - 1, slot)
+            eye.append(is_d)
+        # scatter into [n, cap] send buffers (overflow rows dropped — caller
+        # guarantees capacity; the file shuffle path is the escape hatch)
+        send_k = jnp.zeros((n, cap), dtype=k.dtype)
+        send_v = jnp.zeros((n, cap), dtype=v.dtype)
+        send_ok = jnp.zeros((n, cap), dtype=bool)
+        ok = slot < cap
+        send_k = send_k.at[dest, jnp.where(ok, slot, cap - 1)].set(jnp.where(ok, k, 0))
+        send_v = send_v.at[dest, jnp.where(ok, slot, cap - 1)].set(jnp.where(ok, v, 0))
+        send_ok = send_ok.at[dest, jnp.where(ok, slot, cap - 1)].set(ok)
+        rk = jax.lax.all_to_all(send_k, axis, 0, 0, tiled=True)
+        rv = jax.lax.all_to_all(send_v, axis, 0, 0, tiled=True)
+        ro = jax.lax.all_to_all(send_ok, axis, 0, 0, tiled=True)
+        return rk.reshape(-1), rv.reshape(-1), ro.reshape(-1)
+
+    return shard_map(
+        local, mesh=mesh, in_specs=(P(axis), P(axis)), out_specs=(P(axis), P(axis), P(axis))
+    )(keys, payload)

@@ -1,0 +1,74 @@
+"""Benchmark entry point (driver-run on real TPU hardware).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Workload: TPC-H q1 at SF1 (the first BASELINE.json config) — the
+scan→filter→project→group-aggregate pipeline that dominates analytic
+engines. value = lineitem rows aggregated per second per chip on the TPU
+engine (hot path: device-resident columns, compiled stage).
+vs_baseline = speedup over this framework's CPU engine (pyarrow C++
+operators) on the same host — the "CPU-executor baseline" the north-star
+gate compares against (BASELINE.json: ≥3x target at SF100/v5e-8).
+"""
+
+import json
+import os
+import sys
+import time
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def main() -> None:
+    data_dir = os.environ.get("TPCH_DATA", "/tmp/ballista_tpch_sf1")
+    scale = float(os.environ.get("TPCH_SCALE", "1.0"))
+    if not os.path.isdir(os.path.join(data_dir, "lineitem")):
+        log(f"generating TPC-H sf={scale} at {data_dir} ...")
+        from ballista_tpu.testing.tpchgen import generate_tpch
+
+        t0 = time.time()
+        generate_tpch(data_dir, scale=scale, files_per_table=4)
+        log(f"datagen {time.time() - t0:.1f}s")
+
+    from ballista_tpu.client.context import SessionContext
+    from ballista_tpu.config import BallistaConfig, EXECUTOR_ENGINE
+    from ballista_tpu.testing.tpchgen import register_tpch
+
+    sql = open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "benchmarks", "tpch", "queries", "q1.sql")).read()
+
+    def best_time(engine: str, warmups: int, iters: int) -> tuple[float, int]:
+        ctx = SessionContext(BallistaConfig({EXECUTOR_ENGINE: engine}))
+        register_tpch(ctx, data_dir)
+        rows = ctx.catalog.get("lineitem").statistics().num_rows or 0
+        for _ in range(warmups):
+            ctx.sql(sql).collect()
+        best = float("inf")
+        for _ in range(iters):
+            t0 = time.time()
+            out = ctx.sql(sql).collect()
+            best = min(best, time.time() - t0)
+            assert out.num_rows > 0
+        return best, rows
+
+    log("running cpu engine baseline ...")
+    cpu_t, rows = best_time("cpu", warmups=1, iters=3)
+    log(f"cpu q1: {cpu_t:.3f}s")
+    log("running tpu engine ...")
+    tpu_t, _ = best_time("tpu", warmups=1, iters=3)
+    log(f"tpu q1: {tpu_t:.3f}s ({cpu_t / tpu_t:.1f}x)")
+
+    tpu_rps = rows / tpu_t
+    cpu_rps = rows / cpu_t
+    print(json.dumps({
+        "metric": "tpch_q1_sf1_rows_per_sec_per_chip",
+        "value": round(tpu_rps),
+        "unit": "rows/s",
+        "vs_baseline": round(tpu_rps / cpu_rps, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -4,6 +4,8 @@ import os
 # separately dry-runs the multi-chip path); set before any jax import.
 os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# the axon TPU plugin ignores JAX_PLATFORMS; JAX_PLATFORM_NAME wins
+os.environ.setdefault("JAX_PLATFORM_NAME", "cpu")
 
 import pytest
 
