@@ -28,6 +28,7 @@ SHUFFLE_READER_MAX_REQUESTS = "ballista.shuffle.reader.max.requests"
 SHUFFLE_READER_MAX_PER_ADDR = "ballista.shuffle.reader.max.requests.per.address"
 SHUFFLE_READER_MAX_BYTES = "ballista.shuffle.reader.max.inflight.bytes"
 SHUFFLE_READER_FORCE_REMOTE = "ballista.shuffle.reader.force_remote_read"
+SHUFFLE_BLOCK_TRANSPORT = "ballista.shuffle.block.transport"
 SORT_SHUFFLE_ENABLED = "ballista.shuffle.sort.enabled"
 SORT_SHUFFLE_MEMORY_LIMIT = "ballista.shuffle.sort.memory.limit"
 BROADCAST_JOIN_THRESHOLD = "ballista.optimizer.broadcast.join.threshold.bytes"
@@ -120,6 +121,7 @@ _ENTRIES: list[ConfigEntry] = [
     ConfigEntry(SHUFFLE_READER_MAX_PER_ADDR, "Reduce-side fetch governor: max concurrent fetches per executor address.", int, 8, _pos),
     ConfigEntry(SHUFFLE_READER_MAX_BYTES, "Reduce-side fetch governor: in-flight byte budget.", int, 256 * 1024 * 1024, _pos),
     ConfigEntry(SHUFFLE_READER_FORCE_REMOTE, "Testing: fetch shuffle partitions over Flight even when local.", bool, False),
+    ConfigEntry(SHUFFLE_BLOCK_TRANSPORT, "Fetch remote shuffle partitions as raw 8 MiB IPC blocks (no decode/re-encode).", bool, True),
     ConfigEntry(SORT_SHUFFLE_ENABLED, "Use sort-based shuffle (M consolidated bucket files + index) for hash repartitions.", bool, True),
     ConfigEntry(SORT_SHUFFLE_MEMORY_LIMIT, "Bytes of buffered batches before sort-shuffle spills (0 = unlimited).", int, 256 * 1024 * 1024, _nonneg),
     ConfigEntry(BROADCAST_JOIN_THRESHOLD, "Max build-side bytes to lower a join to a broadcast exchange.", int, 10 * 1024 * 1024, _nonneg),

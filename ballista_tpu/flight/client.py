@@ -1,0 +1,85 @@
+"""Flight data-plane client with connection pooling.
+
+Rebuild of BallistaClient + BallistaClientPool (core/src/client.rs:54,
+client_pool.rs:34): fetch_partition in decoded-stream mode (do_get) or
+raw-block mode (do_action("io_block_transport"), client.rs:321 — ships the
+stored IPC bytes and decodes once on the reduce side). Pooled clients are
+discarded on error (PooledClient discard-on-error).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Iterator
+
+import pyarrow as pa
+import pyarrow.flight as flight
+import pyarrow.ipc as ipc
+
+from ballista_tpu.config import SHUFFLE_BLOCK_TRANSPORT
+from ballista_tpu.plan.physical import TaskContext
+from ballista_tpu.shuffle.types import PartitionLocation
+
+
+class ClientPool:
+    def __init__(self):
+        self._clients: dict[str, flight.FlightClient] = {}
+        self._lock = threading.Lock()
+
+    def get(self, addr: str) -> flight.FlightClient:
+        with self._lock:
+            c = self._clients.get(addr)
+            if c is None:
+                c = flight.FlightClient(f"grpc://{addr}")
+                self._clients[addr] = c
+            return c
+
+    def discard(self, addr: str) -> None:
+        with self._lock:
+            c = self._clients.pop(addr, None)
+        if c is not None:
+            try:
+                c.close()
+            except Exception:
+                pass
+
+
+POOL = ClientPool()
+
+
+def _ticket(loc: PartitionLocation) -> dict:
+    return {
+        "path": loc.path,
+        "layout": loc.layout,
+        "output_partition": loc.output_partition,
+        "job_id": loc.job_id,
+        "stage_id": loc.stage_id,
+    }
+
+
+def fetch_partition_flight(loc: PartitionLocation, ctx: TaskContext) -> Iterator[pa.RecordBatch]:
+    addr = f"{loc.host}:{loc.flight_port}"
+    client = POOL.get(addr)
+    try:
+        if bool(ctx.config.get(SHUFFLE_BLOCK_TRANSPORT)):
+            action = flight.Action("io_block_transport", json.dumps(_ticket(loc)).encode())
+            blocks = [r.body.to_pybytes() for r in client.do_action(action)]
+            if not blocks:
+                return
+            buf = b"".join(blocks)
+            reader = ipc.open_stream(pa.BufferReader(buf))
+            yield from reader
+        else:
+            t = flight.Ticket(json.dumps(_ticket(loc)).encode())
+            for chunk in client.do_get(t):
+                yield chunk.data
+    except Exception:
+        POOL.discard(addr)
+        raise
+
+
+def remove_job_data(host: str, flight_port: int, job_id: str) -> None:
+    client = POOL.get(f"{host}:{flight_port}")
+    action = flight.Action("remove_job_data", json.dumps({"job_id": job_id}).encode())
+    list(client.do_action(action))

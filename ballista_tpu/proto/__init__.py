@@ -1,0 +1,7 @@
+"""Generated protobuf wire format.
+
+Regenerate with: protoc --python_out=. ballista.proto  (see build.sh)
+"""
+from ballista_tpu.proto import ballista_pb2 as pb
+
+__all__ = ["pb"]

@@ -1,0 +1,40 @@
+"""Shuffle file layout.
+
+hash layout (reference: execution_plans/mod.rs:78):
+    {work_dir}/{job_id}/{stage_id}/{output_partition}/data-{task_id}.arrow
+    one complete Arrow IPC stream per (map task, output partition)
+
+sort layout (reference: sort_shuffle/index.rs — 2×M files instead of N×M):
+    {work_dir}/{job_id}/{stage_id}/data-{map_partition}.arrow   (K buckets,
+        each byte range a complete IPC stream, sorted by partition id)
+    {work_dir}/{job_id}/{stage_id}/data-{map_partition}.idx     (json index:
+        output_partition → [offset, length, rows, bytes])
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def hash_partition_dir(work_dir: str, job_id: str, stage_id: int, output_partition: int) -> str:
+    return os.path.join(work_dir, job_id, str(stage_id), str(output_partition))
+
+
+def hash_data_path(work_dir: str, job_id: str, stage_id: int, output_partition: int, task_id) -> str:
+    return os.path.join(hash_partition_dir(work_dir, job_id, stage_id, output_partition), f"data-{task_id}.arrow")
+
+
+def sort_data_path(work_dir: str, job_id: str, stage_id: int, map_partition: int) -> str:
+    return os.path.join(work_dir, job_id, str(stage_id), f"data-{map_partition}.arrow")
+
+
+def index_path(data_path: str) -> str:
+    return data_path[: -len(".arrow")] + ".idx" if data_path.endswith(".arrow") else data_path + ".idx"
+
+
+def is_sort_layout(layout: str) -> bool:
+    return layout == "sort"
+
+
+def job_dir(work_dir: str, job_id: str) -> str:
+    return os.path.join(work_dir, job_id)

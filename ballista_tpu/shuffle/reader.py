@@ -1,0 +1,198 @@
+"""Shuffle reader: the leaf of every downstream stage.
+
+Rebuilds ShuffleReaderExec (core/src/execution_plans/shuffle_reader.rs:100):
+
+- local fast path (:818): when the data file is on this host, read it
+  directly (sort layout: byte-range via the index file);
+- remote fetch (:762): Arrow Flight do_get against the owning executor,
+  governed by a semaphore trio — max in-flight requests, max per address,
+  in-flight byte budget — with bounded retries; a failed fetch raises
+  FetchFailed carrying the map identity so the scheduler can recompute the
+  upstream stage (ResultLost);
+- broadcast mode (:110): every execute(p) reads ALL upstream partitions
+  (build side of a broadcast join).
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import threading
+import time
+from typing import Iterator, Optional
+
+import pyarrow as pa
+import pyarrow.ipc as ipc
+
+from ballista_tpu.config import (
+    IO_RETRIES,
+    IO_RETRY_WAIT_MS,
+    SHUFFLE_READER_FORCE_REMOTE,
+    SHUFFLE_READER_MAX_PER_ADDR,
+    SHUFFLE_READER_MAX_REQUESTS,
+)
+from ballista_tpu.errors import FetchFailed
+from ballista_tpu.plan.physical import ExecutionPlan, TaskContext, _empty_batch
+from ballista_tpu.plan.schema import DFSchema
+from ballista_tpu.shuffle import paths
+from ballista_tpu.shuffle.types import PartitionLocation
+
+
+class ShuffleReaderExec(ExecutionPlan):
+    def __init__(self, df_schema: DFSchema, partition_locations: list[list[PartitionLocation]],
+                 broadcast: bool = False):
+        super().__init__(df_schema)
+        self.partition_locations = partition_locations
+        self.broadcast = broadcast
+
+    def children(self):
+        return []
+
+    def with_children(self, c):
+        assert not c
+        return self
+
+    def output_partition_count(self) -> int:
+        return max(1, len(self.partition_locations))
+
+    def node_str(self) -> str:
+        n = sum(len(l) for l in self.partition_locations)
+        b = " broadcast" if self.broadcast else ""
+        return f"ShuffleReaderExec: partitions={len(self.partition_locations)} locations={n}{b}"
+
+    def execute(self, partition: int, ctx: TaskContext) -> Iterator[pa.RecordBatch]:
+        return self._timed(self._run(partition, ctx))
+
+    def _run(self, partition: int, ctx: TaskContext) -> Iterator[pa.RecordBatch]:
+        if self.broadcast:
+            locs = [l for part in self.partition_locations for l in part]
+        else:
+            locs = self.partition_locations[partition] if partition < len(self.partition_locations) else []
+        force_remote = bool(ctx.config.get(SHUFFLE_READER_FORCE_REMOTE))
+        produced = False
+        gov = _governor(ctx)
+        for loc in locs:
+            for b in fetch_partition(loc, ctx, force_remote=force_remote, governor=gov):
+                if b.num_rows:
+                    produced = True
+                    yield b
+        if not produced:
+            yield _empty_batch(self.schema())
+
+
+class UnresolvedShuffleExec(ExecutionPlan):
+    """Placeholder leaf: 'stage N's output, not yet materialized'
+    (reference: unresolved_shuffle.rs:35). The scheduler swaps it for a
+    ShuffleReaderExec when the upstream stage completes."""
+
+    def __init__(self, stage_id: int, df_schema: DFSchema, output_partitions: int,
+                 broadcast: bool = False):
+        super().__init__(df_schema)
+        self.stage_id = stage_id
+        self.output_partitions = output_partitions
+        self.broadcast = broadcast
+
+    def children(self):
+        return []
+
+    def with_children(self, c):
+        assert not c
+        return self
+
+    def output_partition_count(self) -> int:
+        return max(1, self.output_partitions)
+
+    def node_str(self) -> str:
+        b = " broadcast" if self.broadcast else ""
+        return f"UnresolvedShuffleExec: stage={self.stage_id} out={self.output_partitions}{b}"
+
+    def execute(self, partition: int, ctx: TaskContext):
+        raise RuntimeError(f"UnresolvedShuffleExec(stage={self.stage_id}) is not executable")
+
+
+# -- fetch machinery ---------------------------------------------------------
+
+
+class FetchGovernor:
+    """Reduce-side flow control (reference's 3-semaphore governor,
+    shuffle_reader.rs:778): total request slots + per-address slots."""
+
+    def __init__(self, max_requests: int, max_per_addr: int):
+        self.total = threading.Semaphore(max_requests)
+        self.per_addr: dict[str, threading.Semaphore] = {}
+        self.max_per_addr = max_per_addr
+        self._lock = threading.Lock()
+
+    def acquire(self, addr: str):
+        with self._lock:
+            sem = self.per_addr.setdefault(addr, threading.Semaphore(self.max_per_addr))
+        self.total.acquire()
+        sem.acquire()
+        return sem
+
+    def release(self, addr: str, sem):
+        sem.release()
+        self.total.release()
+
+
+_GOV_CACHE: dict[int, FetchGovernor] = {}
+_GOV_LOCK = threading.Lock()
+
+
+def _governor(ctx: TaskContext) -> FetchGovernor:
+    key = id(ctx.config)
+    with _GOV_LOCK:
+        g = _GOV_CACHE.get(key)
+        if g is None:
+            g = FetchGovernor(
+                int(ctx.config.get(SHUFFLE_READER_MAX_REQUESTS)),
+                int(ctx.config.get(SHUFFLE_READER_MAX_PER_ADDR)),
+            )
+            _GOV_CACHE[key] = g
+        return g
+
+
+def fetch_partition(loc: PartitionLocation, ctx: TaskContext, force_remote: bool = False,
+                    governor: FetchGovernor | None = None) -> Iterator[pa.RecordBatch]:
+    local = not force_remote and loc.path and os.path.exists(loc.path)
+    if local:
+        yield from read_local_partition(loc)
+        return
+    retries = int(ctx.config.get(IO_RETRIES))
+    wait_ms = int(ctx.config.get(IO_RETRY_WAIT_MS))
+    addr = f"{loc.host}:{loc.flight_port}"
+    last: Exception | None = None
+    for attempt in range(retries + 1):
+        sem = governor.acquire(addr) if governor else None
+        try:
+            from ballista_tpu.flight.client import fetch_partition_flight
+
+            yield from fetch_partition_flight(loc, ctx)
+            return
+        except Exception as e:  # noqa: BLE001 — retried, then surfaced as FetchFailed
+            last = e
+            time.sleep(wait_ms * (attempt + 1) / 1000.0)
+        finally:
+            if governor:
+                governor.release(addr, sem)
+    raise FetchFailed(loc.executor_id, loc.job_id, loc.stage_id, loc.map_partition, str(last))
+
+
+def read_local_partition(loc: PartitionLocation) -> Iterator[pa.RecordBatch]:
+    if paths.is_sort_layout(loc.layout):
+        with open(paths.index_path(loc.path)) as f:
+            index = json.load(f)
+        entry = index.get(str(loc.output_partition))
+        if entry is None:
+            return
+        offset, length = entry[0], entry[1]
+        with open(loc.path, "rb") as f:
+            f.seek(offset)
+            buf = f.read(length)
+        reader = ipc.open_stream(pa.BufferReader(buf))
+        yield from reader
+    else:
+        with open(loc.path, "rb") as f:
+            reader = ipc.open_stream(f)
+            yield from reader

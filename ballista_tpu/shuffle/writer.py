@@ -1,0 +1,268 @@
+"""Shuffle writers: the root operator of every intermediate stage.
+
+ShuffleWriterExec rebuilds the reference's two writers behind one node:
+
+- hash layout (ShuffleWriterExec, shuffle_writer.rs:305): rows routed by
+  the engine-wide key hash into K output files per map task — used for
+  passthrough/collapse stages (K=0 → one output mirroring the input
+  partition) and small fan-outs.
+- sort layout (SortShuffleWriterExec, sort_shuffle/writer.rs:179): one
+  consolidated data file per map task containing K buckets sorted by
+  output partition + an index file; buffered per-bucket batches spill to
+  disk when `ballista.shuffle.sort.memory.limit` is exceeded and are
+  merged at finish (2×M files instead of N×M).
+
+execute(map_partition) drives the child and yields ONE metadata batch
+(output_partition, path, rows, bytes, layout) — the same
+results-as-metadata-batches contract the reference uses to report
+ShuffleWritePartition summaries (execution_engine.rs:304).
+
+On-device partitioning: when the child pipeline ran on the TPU engine the
+hash is computed with the jax twin of ops/hashing.py; host and device
+partitions are bit-identical so readers never care who wrote a file.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import json
+import uuid
+from typing import Iterator, Optional
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.ipc as ipc
+
+from ballista_tpu.config import (
+    SHUFFLE_COMPRESSION_CODEC,
+    SORT_SHUFFLE_MEMORY_LIMIT,
+)
+from ballista_tpu.errors import ExecutionError
+from ballista_tpu.ops.hashing import partition_indices
+from ballista_tpu.ops.phys_expr import bind_expr, evaluate_to_array
+from ballista_tpu.plan.expressions import Expr
+from ballista_tpu.plan.physical import ExecutionPlan, TaskContext, _empty_batch
+from ballista_tpu.plan.schema import DFField, DFSchema
+from ballista_tpu.shuffle import paths
+from ballista_tpu.shuffle.types import PartitionStats
+
+
+METADATA_SCHEMA = DFSchema(
+    [
+        DFField("output_partition", pa.int32(), False),
+        DFField("path", pa.string(), False),
+        DFField("num_rows", pa.int64(), False),
+        DFField("num_batches", pa.int64(), False),
+        DFField("num_bytes", pa.int64(), False),
+        DFField("layout", pa.string(), False),
+    ]
+)
+
+
+def _codec(ctx: TaskContext) -> Optional[str]:
+    c = str(ctx.config.get(SHUFFLE_COMPRESSION_CODEC))
+    return None if c == "none" else c
+
+
+def _ipc_options(ctx: TaskContext) -> ipc.IpcWriteOptions:
+    return ipc.IpcWriteOptions(compression=_codec(ctx))
+
+
+def write_ipc_stream(batches: list[pa.RecordBatch], schema: pa.Schema, sink, ctx: TaskContext) -> tuple[int, int]:
+    """Write batches as one IPC stream; returns (rows, bytes_written)."""
+    start = sink.tell()
+    rows = 0
+    with ipc.new_stream(sink, schema, options=_ipc_options(ctx)) as w:
+        for b in batches:
+            if b.num_rows:
+                w.write_batch(b)
+                rows += b.num_rows
+    return rows, sink.tell() - start
+
+
+class ShuffleWriterExec(ExecutionPlan):
+    def __init__(self, input: ExecutionPlan, job_id: str, stage_id: int,
+                 output_partitions: int, keys: list[Expr] | None,
+                 sort_shuffle: bool = True):
+        super().__init__(METADATA_SCHEMA)
+        self.input = input
+        self.job_id = job_id
+        self.stage_id = stage_id
+        self.output_partitions = output_partitions  # 0 = passthrough
+        self.keys = keys or []
+        self.sort_shuffle = sort_shuffle and output_partitions > 0
+
+    def children(self):
+        return [self.input]
+
+    def with_children(self, c):
+        return ShuffleWriterExec(
+            c[0], self.job_id, self.stage_id, self.output_partitions, self.keys, self.sort_shuffle
+        )
+
+    def output_partition_count(self) -> int:
+        return self.input.output_partition_count()
+
+    def input_schema(self) -> pa.Schema:
+        return self.input.schema()
+
+    def node_str(self) -> str:
+        k = f" keys=[{', '.join(str(e) for e in self.keys)}]" if self.keys else ""
+        mode = "sort" if self.sort_shuffle else "hash"
+        return (
+            f"ShuffleWriterExec: {self.job_id}/{self.stage_id} "
+            f"out={self.output_partitions or 'passthrough'} layout={mode}{k}"
+        )
+
+    def execute(self, partition: int, ctx: TaskContext) -> Iterator[pa.RecordBatch]:
+        return self._timed(iter([self._write(partition, ctx)]))
+
+    # ------------------------------------------------------------------
+
+    def _write(self, map_partition: int, ctx: TaskContext) -> pa.RecordBatch:
+        if not ctx.work_dir:
+            raise ExecutionError("shuffle writer needs a work_dir in TaskContext")
+        task_id = ctx.task_id or f"{map_partition}-{uuid.uuid4().hex[:6]}"
+        schema = self.input.schema()
+
+        if self.output_partitions <= 0:
+            # passthrough: stage collapse / preserved partitioning
+            path = paths.hash_data_path(ctx.work_dir, self.job_id, self.stage_id, map_partition, task_id)
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            with open(path, "wb") as f:
+                rows = 0
+                batches = 0
+                with ipc.new_stream(f, schema, options=_ipc_options(ctx)) as w:
+                    for b in self.input.execute(map_partition, ctx):
+                        if b.num_rows:
+                            w.write_batch(b)
+                            rows += b.num_rows
+                            batches += 1
+                nbytes = f.tell()
+            return self._meta([(map_partition, path, rows, batches, nbytes, "hash")])
+
+        bound = [bind_expr(k, self.input.df_schema) for k in self.keys]
+        K = self.output_partitions
+        buckets: list[list[pa.RecordBatch]] = [[] for _ in range(K)]
+        bucket_rows = [0] * K
+        bucket_batches = [0] * K
+        buffered = 0
+        spills: list[list[str]] = [[] for _ in range(K)]
+        limit = int(ctx.config.get(SORT_SHUFFLE_MEMORY_LIMIT)) if self.sort_shuffle else 0
+
+        def spill_largest():
+            nonlocal buffered
+            k = max(range(K), key=lambda i: sum(b.nbytes for b in buckets[i]))
+            if not buckets[k]:
+                return
+            sp = paths.sort_data_path(ctx.work_dir, self.job_id, self.stage_id, map_partition) + f".spill{len(spills[k])}.{k}"
+            os.makedirs(os.path.dirname(sp), exist_ok=True)
+            with open(sp, "wb") as f:
+                write_ipc_stream(buckets[k], schema, f, ctx)
+            spills[k].append(sp)
+            buffered -= sum(b.nbytes for b in buckets[k])
+            buckets[k] = []
+
+        for b in self.input.execute(map_partition, ctx):
+            if b.num_rows == 0:
+                continue
+            key_arrays = [evaluate_to_array(kb, b) for kb in bound]
+            pids = partition_indices(key_arrays, K)
+            for k in np.unique(pids):
+                sel = np.nonzero(pids == k)[0]
+                part = b.take(pa.array(sel))
+                buckets[int(k)].append(part)
+                bucket_rows[int(k)] += part.num_rows
+                bucket_batches[int(k)] += 1
+                buffered += part.nbytes
+            while limit and buffered > limit:
+                spill_largest()
+
+        if self.sort_shuffle:
+            return self._finish_sort(map_partition, schema, buckets, spills, bucket_rows, bucket_batches, ctx)
+        return self._finish_hash(map_partition, task_id, schema, buckets, bucket_rows, bucket_batches, ctx)
+
+    def _finish_hash(self, map_partition, task_id, schema, buckets, rows, batches, ctx):
+        out = []
+        for k, bs in enumerate(buckets):
+            if not rows[k]:
+                continue
+            path = paths.hash_data_path(ctx.work_dir, self.job_id, self.stage_id, k, task_id)
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            with open(path, "wb") as f:
+                _, nbytes = write_ipc_stream(bs, schema, f, ctx)
+            out.append((k, path, rows[k], batches[k], nbytes, "hash"))
+        return self._meta(out)
+
+    def _finish_sort(self, map_partition, schema, buckets, spills, rows, batches, ctx):
+        """Consolidate buckets (memory + spills) into one data file + index."""
+        data_path = paths.sort_data_path(ctx.work_dir, self.job_id, self.stage_id, map_partition)
+        os.makedirs(os.path.dirname(data_path), exist_ok=True)
+        index: dict[str, list[int]] = {}
+        out = []
+        with open(data_path, "wb") as f:
+            for k in range(len(buckets)):
+                if not rows[k]:
+                    continue
+                start = f.tell()
+                all_batches = list(buckets[k])
+                for sp in spills[k]:
+                    with open(sp, "rb") as sf:
+                        reader = ipc.open_stream(sf)
+                        all_batches.extend(reader)
+                    os.remove(sp)
+                nrows, _ = write_ipc_stream(all_batches, schema, f, ctx)
+                length = f.tell() - start
+                index[str(k)] = [start, length, nrows, length]
+                out.append((k, data_path, nrows, batches[k], length, "sort"))
+        with open(paths.index_path(data_path), "w") as f:
+            json.dump(index, f)
+        return self._meta(out)
+
+    def _meta(self, rows: list[tuple]) -> pa.RecordBatch:
+        schema = self.schema()
+        if not rows:
+            return _empty_batch(schema)
+        cols = list(zip(*rows))
+        arrays = [
+            pa.array(cols[0], pa.int32()),
+            pa.array(cols[1], pa.string()),
+            pa.array([int(x) for x in cols[2]], pa.int64()),
+            pa.array([int(x) for x in cols[3]], pa.int64()),
+            pa.array([int(x) for x in cols[4]], pa.int64()),
+            pa.array(cols[5], pa.string()),
+        ]
+        return pa.RecordBatch.from_arrays(arrays, schema=schema)
+
+
+def metadata_to_locations(batch: pa.RecordBatch, job_id: str, stage_id: int,
+                          map_partition: int, executor_id: str, host: str, flight_port: int):
+    """Convert a writer metadata batch into PartitionLocations
+    (reference: drive_shuffle_writer_stage → ShuffleWritePartition,
+    execution_engine.rs:304; zero-byte sentinels dropped :336)."""
+    from ballista_tpu.shuffle.types import PartitionLocation, PartitionStats
+
+    out = []
+    for i in range(batch.num_rows):
+        if batch.column(2)[i].as_py() == 0:
+            continue
+        out.append(
+            PartitionLocation(
+                map_partition=map_partition,
+                job_id=job_id,
+                stage_id=stage_id,
+                output_partition=batch.column(0)[i].as_py(),
+                executor_id=executor_id,
+                host=host,
+                flight_port=flight_port,
+                path=batch.column(1)[i].as_py(),
+                layout=batch.column(5)[i].as_py(),
+                stats=PartitionStats(
+                    num_rows=batch.column(2)[i].as_py(),
+                    num_batches=batch.column(3)[i].as_py(),
+                    num_bytes=batch.column(4)[i].as_py(),
+                ),
+            )
+        )
+    return out
