@@ -38,19 +38,63 @@ from ballista_tpu.sql.planner import SqlPlanner
 
 
 class SessionContext:
-    def __init__(self, config: BallistaConfig | None = None, mode: str = "local"):
+    def __init__(self, config: BallistaConfig | None = None, mode: str = "local",
+                 num_executors: int = 1, vcores: int = 4, scheduler_url: str = ""):
         self.config = config or BallistaConfig()
         self.mode = mode
         self.catalog = Catalog()
         self.session_id: SessionId = new_session_id()
+        self._cluster = None  # StandaloneCluster (standalone mode)
+        self._remote = None  # RemoteSchedulerClient (remote mode)
+        self._num_executors = num_executors
+        self._vcores = vcores
+        self._scheduler_url = scheduler_url
+
+    @classmethod
+    def standalone(cls, config: BallistaConfig | None = None, num_executors: int = 1,
+                   vcores: int = 4) -> "SessionContext":
+        """In-process scheduler + executors over the real task/shuffle
+        machinery (reference: SessionContextExt::standalone(),
+        client/src/extension.rs:146)."""
+        return cls(config, mode="standalone", num_executors=num_executors, vcores=vcores)
+
+    @classmethod
+    def remote(cls, scheduler_url: str, config: BallistaConfig | None = None) -> "SessionContext":
+        """Connect to an external scheduler over gRPC
+        (reference: SessionContextExt::remote())."""
+        return cls(config, mode="remote", scheduler_url=scheduler_url)
+
+    def _ensure_cluster(self):
+        if self._cluster is None:
+            from ballista_tpu.executor.standalone import StandaloneCluster
+
+            self._cluster = StandaloneCluster(self._num_executors, self._vcores, config=self.config)
+        return self._cluster
+
+    def _ensure_remote(self):
+        if self._remote is None:
+            from ballista_tpu.client.remote import RemoteSchedulerClient
+
+            self._remote = RemoteSchedulerClient(self._scheduler_url, self.config)
+        return self._remote
+
+    def shutdown(self) -> None:
+        if self._cluster is not None:
+            self._cluster.shutdown()
+            self._cluster = None
 
     # -- registration -------------------------------------------------------
 
     def register_table(self, name: str, provider: TableProvider) -> None:
         self.catalog.register(name, provider)
+        if isinstance(provider, ParquetTable):
+            # ship the registration with the session so remote planning sees it
+            self.config.set(f"ballista.catalog.table.{name.lower()}", provider.path)
 
     def register_parquet(self, name: str, path: str) -> None:
         self.catalog.register(name, ParquetTable(path))
+        # ship the registration with the session so remote planning sees it
+        self.config.set(f"ballista.catalog.table.{name.lower()}", path)
 
     def register_record_batches(self, name: str, batches: list[pa.RecordBatch]) -> None:
         self.catalog.register(name, MemoryTable(batches))
@@ -85,7 +129,7 @@ class SessionContext:
             return DataFrame(self, Explain(inner, stmt.analyze, stmt.verbose))
         if isinstance(stmt, SelectStmt):
             plan = SqlPlanner(self.catalog).plan_query(stmt)
-            return DataFrame(self, plan)
+            return DataFrame(self, plan, sql_text=query)
         raise PlanningError(f"unsupported statement {type(stmt).__name__}")
 
     def table(self, name: str) -> "DataFrame":
@@ -134,9 +178,10 @@ class DataFrame:
     """Lazy logical-plan wrapper (reference: DataFusion DataFrame surface
     re-exported through ballista's prelude)."""
 
-    def __init__(self, ctx: SessionContext, plan: LogicalPlan):
+    def __init__(self, ctx: SessionContext, plan: LogicalPlan, sql_text: str | None = None):
         self.ctx = ctx
         self.plan = plan
+        self.sql_text = sql_text
 
     @classmethod
     def _empty(cls, ctx: SessionContext, note: str) -> "DataFrame":
@@ -204,8 +249,33 @@ class DataFrame:
     def collect(self) -> pa.Table:
         if isinstance(self.plan, Explain):
             return self._collect_explain()
+        if self.ctx.mode == "standalone":
+            return self._collect_standalone()
+        if self.ctx.mode == "remote":
+            return self.ctx._ensure_remote().collect(self)
         physical = self.ctx.create_physical_plan(self.plan)
         return self.ctx.execute_collect(physical)
+
+    def _collect_standalone(self) -> pa.Table:
+        """Submit through the in-process scheduler: real stages, real
+        shuffle files, results fetched from the final stage's partitions
+        (the DistributedQueryExec flow, distributed_query.rs:211)."""
+        from ballista_tpu.errors import ExecutionError
+
+        cluster = self.ctx._ensure_cluster()
+        scheduler = cluster.scheduler
+        session_id = scheduler.sessions.create_or_update(
+            self.ctx.config.to_key_value_pairs(), str(self.ctx.session_id)
+        )
+        if self.sql_text is not None:
+            job_id = scheduler.submit_sql(self.sql_text, session_id)
+        else:
+            physical = self.ctx.create_physical_plan(self.plan)
+            job_id = scheduler.submit_physical_plan(physical, session_id)
+        status = scheduler.wait_for_job(job_id)
+        if status["state"] != "successful":
+            raise ExecutionError(f"job {job_id} {status['state']}: {status.get('error', '')}")
+        return fetch_job_results(status, self.ctx.config)
 
     def _collect_explain(self) -> pa.Table:
         assert isinstance(self.plan, Explain)
@@ -232,3 +302,24 @@ class DataFrame:
 
     def show(self, n: int = 20) -> None:
         print(self.collect().slice(0, n).to_pandas().to_string())
+
+
+def fetch_job_results(status: dict, config: BallistaConfig) -> pa.Table:
+    """Fetch a successful job's final-stage partitions (local fast path or
+    Flight) and assemble the client result table."""
+    from ballista_tpu.plan.physical import TaskContext
+    from ballista_tpu.shuffle.reader import fetch_partition
+
+    schema = status["schema"].to_arrow() if status.get("schema") is not None else None
+    locs = sorted(status.get("partitions", []), key=lambda l: (l.output_partition, l.map_partition))
+    ctx = TaskContext(config)
+    batches = []
+    for loc in locs:
+        for b in fetch_partition(loc, ctx):
+            if b.num_rows:
+                batches.append(b)
+    if not batches:
+        if schema is None:
+            return pa.table({})
+        return pa.table({f.name: pa.array([], f.type) for f in schema}, schema=schema)
+    return pa.Table.from_batches(batches, schema=batches[0].schema)

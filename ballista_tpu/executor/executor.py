@@ -1,0 +1,143 @@
+"""Executor core: run one query-stage task and publish shuffle outputs.
+
+Rebuild of Executor::execute_query_stage + the ExecutionEngine seam
+(ballista/executor/src/executor.rs:226, execution_engine.rs:51):
+
+- `ExecutionEngine.create_query_stage_exec` prepares a stage plan for this
+  executor: stamps the work dir, and (tpu engine) compiles supported
+  subtrees to XLA (engine/tpu_engine.py);
+- `execute_query_stage` drives the stage's ShuffleWriterExec for every
+  partition in the task's slice, converts metadata batches to
+  PartitionLocations (zero-byte outputs dropped — the reference's
+  sentinel rule, execution_engine.rs:336), catches panics, and returns a
+  TaskStatus-shaped result;
+- cancellation via a cooperative flag checked between partitions.
+"""
+
+from __future__ import annotations
+
+import logging
+import socket
+import threading
+import time
+import traceback
+from dataclasses import dataclass, field
+
+from ballista_tpu.config import EXECUTOR_ENGINE, BallistaConfig
+from ballista_tpu.errors import BallistaError, Cancelled, error_to_proto_kind
+from ballista_tpu.ids import ExecutorId, new_executor_id
+from ballista_tpu.plan.physical import ExecutionPlan, TaskContext, collect_metrics
+from ballista_tpu.scheduler.state.execution_graph import TaskDescription
+from ballista_tpu.shuffle.types import PartitionLocation
+from ballista_tpu.shuffle.writer import ShuffleWriterExec, metadata_to_locations
+from ballista_tpu.version import WIRE_PROTOCOL_VERSION
+
+log = logging.getLogger(__name__)
+
+
+@dataclass
+class ExecutorMetadata:
+    id: str
+    host: str = "localhost"
+    grpc_port: int = 0
+    flight_port: int = 0
+    vcores: int = 4
+    wire_version: str = WIRE_PROTOCOL_VERSION
+
+
+@dataclass
+class TaskResult:
+    task_id: int
+    job_id: str
+    stage_id: int
+    stage_attempt: int
+    partitions: list[int]
+    state: str  # success | failed | cancelled
+    locations: list[PartitionLocation] = field(default_factory=list)
+    error: str = ""
+    error_kind: str = ""
+    retryable: bool = False
+    metrics: list = field(default_factory=list)
+
+
+class ExecutionEngine:
+    """THE seam (execution_engine.rs:51): prepare a stage plan to run here."""
+
+    def create_query_stage_exec(self, plan: ExecutionPlan, config: BallistaConfig) -> ExecutionPlan:
+        engine = str(config.get(EXECUTOR_ENGINE))
+        if engine == "tpu":
+            from ballista_tpu.engine.tpu_engine import maybe_compile_tpu
+
+            return maybe_compile_tpu(plan, config)
+        return plan
+
+
+class Executor:
+    def __init__(self, work_dir: str, metadata: ExecutorMetadata | None = None,
+                 engine: ExecutionEngine | None = None,
+                 config: BallistaConfig | None = None):
+        self.work_dir = work_dir
+        self.metadata = metadata or ExecutorMetadata(id=new_executor_id())
+        self.engine = engine or ExecutionEngine()
+        self.default_config = config or BallistaConfig()
+        self._cancelled: set[tuple[str, int]] = set()
+        self._lock = threading.Lock()
+        self.tasks_run = 0
+        self.tasks_failed = 0
+
+    # ------------------------------------------------------------------
+
+    def cancel_task(self, job_id: str, stage_id: int) -> None:
+        with self._lock:
+            self._cancelled.add((job_id, stage_id))
+
+    def clear_cancellations(self, job_id: str) -> None:
+        with self._lock:
+            self._cancelled = {c for c in self._cancelled if c[0] != job_id}
+
+    def _is_cancelled(self, job_id: str, stage_id: int) -> bool:
+        with self._lock:
+            return (job_id, stage_id) in self._cancelled
+
+    # ------------------------------------------------------------------
+
+    def execute_task(self, task: TaskDescription, config: BallistaConfig | None = None) -> TaskResult:
+        cfg = config or self.default_config
+        base = TaskResult(
+            task_id=task.task_id, job_id=task.job_id, stage_id=task.stage_id,
+            stage_attempt=task.stage_attempt, partitions=list(task.partitions), state="failed",
+        )
+        try:
+            plan = task.plan
+            assert isinstance(plan, ShuffleWriterExec), f"stage root must be a shuffle writer: {plan}"
+            prepared = self.engine.create_query_stage_exec(plan, cfg)
+            locations: list[PartitionLocation] = []
+            for p in task.partitions:
+                if self._is_cancelled(task.job_id, task.stage_id):
+                    raise Cancelled(f"task {task.task_id} cancelled")
+                ctx = TaskContext(cfg, task_id=f"{task.task_id}", work_dir=self.work_dir)
+                for meta_batch in prepared.execute(p, ctx):
+                    locations.extend(
+                        metadata_to_locations(
+                            meta_batch, task.job_id, task.stage_id, p,
+                            self.metadata.id, self.metadata.host, self.metadata.flight_port,
+                        )
+                    )
+            base.state = "success"
+            base.locations = locations
+            base.metrics = [
+                {"depth": d, "name": n, **m} for d, n, m in collect_metrics(prepared)
+            ]
+            self.tasks_run += 1
+            return base
+        except Cancelled as e:
+            base.state = "cancelled"
+            base.error = str(e)
+            return base
+        except BaseException as e:  # noqa: BLE001 — catch_unwind parity
+            self.tasks_failed += 1
+            base.error = f"{type(e).__name__}: {e}\n{traceback.format_exc(limit=8)}"
+            base.error_kind = error_to_proto_kind(e)
+            base.retryable = bool(getattr(e, "retryable", False))
+            log.warning("task %s/%s failed: %s", task.job_id, task.task_id, e)
+            return base

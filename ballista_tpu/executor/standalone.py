@@ -1,0 +1,69 @@
+"""In-process executors + task launcher (standalone mode).
+
+Rebuild of the standalone helpers (scheduler/src/standalone.rs:47,
+executor/src/standalone.rs:51): a real SchedulerServer and real Executors
+in one process — the full task/shuffle machinery with no gRPC in between.
+This is both the `SessionContext::standalone()` backend and the
+virtual-cluster layer integration tests build on.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures as fut
+import tempfile
+import threading
+
+from ballista_tpu.config import BallistaConfig
+from ballista_tpu.executor.executor import Executor, ExecutorMetadata
+from ballista_tpu.ids import new_executor_id
+from ballista_tpu.scheduler.server import SchedulerServer, TaskLauncher
+from ballista_tpu.scheduler.state.execution_graph import TaskDescription
+
+
+class InProcessTaskLauncher(TaskLauncher):
+    """Runs launched tasks on local Executor objects via a thread pool and
+    feeds TaskResults straight back into the scheduler (push-mode shape)."""
+
+    def __init__(self, executors: dict[str, Executor], max_workers: int = 16):
+        self.executors = executors
+        self.pool = fut.ThreadPoolExecutor(max_workers=max_workers, thread_name_prefix="task")
+
+    def launch(self, executor_id: str, tasks: list[TaskDescription], server: SchedulerServer) -> None:
+        ex = self.executors[executor_id]
+
+        def run(task: TaskDescription) -> None:
+            cfg = server.sessions.get(task.session_id)
+            result = ex.execute_task(task, cfg)
+            server.update_task_status(executor_id, [result])
+
+        for t in tasks:
+            self.pool.submit(run, t)
+
+
+class StandaloneCluster:
+    def __init__(self, num_executors: int = 1, vcores: int = 4,
+                 work_dir: str | None = None, config: BallistaConfig | None = None,
+                 with_flight: bool = True):
+        self.work_dir = work_dir or tempfile.mkdtemp(prefix="ballista-tpu-")
+        self.flight_server = None
+        flight_port = 0
+        if with_flight:
+            from ballista_tpu.flight.server import start_flight_server
+
+            self.flight_server, flight_port = start_flight_server(self.work_dir, "localhost")
+        self.executors: dict[str, Executor] = {}
+        for _ in range(num_executors):
+            meta = ExecutorMetadata(id=str(new_executor_id()), vcores=vcores,
+                                    host="localhost", flight_port=flight_port)
+            self.executors[meta.id] = Executor(self.work_dir, meta, config=config)
+        self.launcher = InProcessTaskLauncher(self.executors)
+        self.scheduler = SchedulerServer(self.launcher)
+        self.scheduler.start()
+        for ex in self.executors.values():
+            self.scheduler.register_executor(ex.metadata)
+
+    def shutdown(self) -> None:
+        self.scheduler.stop()
+        self.launcher.pool.shutdown(wait=False)
+        if self.flight_server is not None:
+            self.flight_server.shutdown()

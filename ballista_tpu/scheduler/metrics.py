@@ -1,0 +1,128 @@
+"""Scheduler metrics collection.
+
+Rebuild of SchedulerMetricsCollector (scheduler/src/metrics/mod.rs:64):
+Noop + in-memory implementations, with a Prometheus text exposition
+renderer (metrics/prometheus.rs:42 equivalent — histograms for job
+execution/planning, counters for outcomes, pending-tasks gauge) served by
+the REST API at /api/metrics.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+
+class SchedulerMetricsCollector:
+    def record_submitted(self, job_id: str) -> None: ...
+
+    def record_completed(self, job_id: str, exec_seconds: float) -> None: ...
+
+    def record_failed(self, job_id: str) -> None: ...
+
+    def record_cancelled(self, job_id: str) -> None: ...
+
+    def record_planning_ms(self, job_id: str, ms: float) -> None: ...
+
+    def set_pending_tasks(self, n: int) -> None: ...
+
+    def record_protocol_mismatch(self) -> None: ...
+
+
+class NoopMetricsCollector(SchedulerMetricsCollector):
+    pass
+
+
+_LATENCY_BUCKETS = [0.1, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0, 600.0]
+_PLANNING_BUCKETS = [1.0, 5.0, 25.0, 100.0, 500.0, 2500.0, 10000.0]
+
+
+class _Histogram:
+    def __init__(self, buckets: list[float]):
+        self.buckets = buckets
+        self.counts = [0] * (len(buckets) + 1)
+        self.total = 0.0
+        self.n = 0
+
+    def observe(self, v: float) -> None:
+        self.n += 1
+        self.total += v
+        for i, b in enumerate(self.buckets):
+            if v <= b:
+                self.counts[i] += 1
+                return
+        self.counts[-1] += 1
+
+    def render(self, name: str, help_: str) -> list[str]:
+        out = [f"# HELP {name} {help_}", f"# TYPE {name} histogram"]
+        acc = 0
+        for b, c in zip(self.buckets, self.counts):
+            acc += c
+            out.append(f'{name}_bucket{{le="{b}"}} {acc}')
+        out.append(f'{name}_bucket{{le="+Inf"}} {self.n}')
+        out.append(f"{name}_sum {self.total}")
+        out.append(f"{name}_count {self.n}")
+        return out
+
+
+class InMemoryMetricsCollector(SchedulerMetricsCollector):
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.submitted = 0
+        self.completed = 0
+        self.failed = 0
+        self.cancelled = 0
+        self.protocol_mismatches = 0
+        self.pending_tasks = 0
+        self.exec_hist = _Histogram(_LATENCY_BUCKETS)
+        self.plan_hist = _Histogram(_PLANNING_BUCKETS)
+
+    def record_submitted(self, job_id: str) -> None:
+        with self._lock:
+            self.submitted += 1
+
+    def record_completed(self, job_id: str, exec_seconds: float) -> None:
+        with self._lock:
+            self.completed += 1
+            self.exec_hist.observe(exec_seconds)
+
+    def record_failed(self, job_id: str) -> None:
+        with self._lock:
+            self.failed += 1
+
+    def record_cancelled(self, job_id: str) -> None:
+        with self._lock:
+            self.cancelled += 1
+
+    def record_planning_ms(self, job_id: str, ms: float) -> None:
+        with self._lock:
+            self.plan_hist.observe(ms)
+
+    def set_pending_tasks(self, n: int) -> None:
+        with self._lock:
+            self.pending_tasks = n
+
+    def record_protocol_mismatch(self) -> None:
+        with self._lock:
+            self.protocol_mismatches += 1
+
+    def render_prometheus(self) -> str:
+        with self._lock:
+            lines = []
+            for name, v, help_ in [
+                ("ballista_scheduler_jobs_submitted_total", self.submitted, "Jobs submitted"),
+                ("ballista_scheduler_jobs_completed_total", self.completed, "Jobs completed"),
+                ("ballista_scheduler_jobs_failed_total", self.failed, "Jobs failed"),
+                ("ballista_scheduler_jobs_cancelled_total", self.cancelled, "Jobs cancelled"),
+                ("ballista_scheduler_protocol_mismatch_total", self.protocol_mismatches, "Executor wire-version mismatches"),
+                ("ballista_scheduler_pending_tasks", self.pending_tasks, "Pending task gauge"),
+            ]:
+                lines.append(f"# HELP {name} {help_}")
+                kind = "gauge" if name.endswith("pending_tasks") else "counter"
+                lines.append(f"# TYPE {name} {kind}")
+                lines.append(f"{name} {v}")
+            lines.extend(self.exec_hist.render(
+                "ballista_scheduler_job_exec_time_seconds", "Job execution wall time"))
+            lines.extend(self.plan_hist.render(
+                "ballista_scheduler_planning_time_ms", "Job planning time"))
+            return "\n".join(lines) + "\n"

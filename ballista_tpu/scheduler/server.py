@@ -1,0 +1,321 @@
+"""Scheduler server core: job state machine + event loop + task binding.
+
+Rebuild of SchedulerServer / QueryStageScheduler / SchedulerState
+(scheduler/src/scheduler_server/mod.rs:75, query_stage_scheduler.rs:96,
+state/mod.rs:98):
+
+- events (JobQueued, JobSubmitted, TaskUpdating, ReviveOffers,
+  ExecutorLost, JobFinished/Failed, CancelJob) flow through a single
+  bounded event loop; PLANNING runs on a spawned thread so the loop never
+  blocks (query_stage_scheduler.rs:372);
+- ReviveOffers: reserve executor slots → pop runnable tasks from job
+  graphs → hand to the TaskLauncher (push mode); pull-mode executors call
+  `poll_work` which pops directly from the same state;
+- the TaskLauncher seam is what the virtual-cluster test harness fakes
+  (reference: VirtualTaskLauncher, test_utils.rs:349).
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from ballista_tpu.config import BallistaConfig
+from ballista_tpu.errors import BallistaError
+from ballista_tpu.executor.executor import ExecutorMetadata, TaskResult
+from ballista_tpu.ids import JobId, new_job_id
+from ballista_tpu.scheduler.metrics import NoopMetricsCollector, SchedulerMetricsCollector
+from ballista_tpu.scheduler.planner import DistributedPlanner
+from ballista_tpu.scheduler.state.execution_graph import (
+    ExecutionGraph,
+    JobState,
+    TaskDescription,
+)
+from ballista_tpu.scheduler.state.executor_manager import ExecutorManager
+from ballista_tpu.scheduler.state.session_manager import SessionManager
+
+log = logging.getLogger(__name__)
+
+
+class TaskLauncher:
+    """Seam for pushing bound tasks to executors."""
+
+    def launch(self, executor_id: str, tasks: list[TaskDescription], server: "SchedulerServer") -> None:
+        raise NotImplementedError
+
+
+@dataclass
+class Event:
+    kind: str  # job_queued | revive | task_update | executor_lost | cancel | shutdown
+    payload: object = None
+
+
+class SchedulerServer:
+    def __init__(self, launcher: TaskLauncher | None = None,
+                 metrics: SchedulerMetricsCollector | None = None,
+                 task_distribution: str = "bias",
+                 executor_timeout_s: float = 180.0,
+                 scheduler_id: str = "scheduler-0"):
+        self.scheduler_id = scheduler_id
+        self.executors = ExecutorManager(task_distribution, executor_timeout_s)
+        self.sessions = SessionManager()
+        self.jobs: dict[str, ExecutionGraph] = {}
+        self.launcher = launcher
+        self.metrics = metrics or NoopMetricsCollector()
+        self._events: "queue.Queue[Event]" = queue.Queue(maxsize=10_000)
+        self._jobs_lock = threading.RLock()
+        self._running = False
+        self._loop_thread: threading.Thread | None = None
+        self._watchers: dict[str, list[threading.Event]] = {}
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        self._running = True
+        self._loop_thread = threading.Thread(target=self._event_loop, daemon=True, name="scheduler-events")
+        self._loop_thread.start()
+
+    def stop(self) -> None:
+        self._running = False
+        self._events.put(Event("shutdown"))
+        if self._loop_thread is not None:
+            self._loop_thread.join(timeout=5)
+
+    def post(self, ev: Event) -> None:
+        self._events.put(ev)
+
+    def _event_loop(self) -> None:
+        while self._running:
+            try:
+                ev = self._events.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            try:
+                self._handle(ev)
+            except Exception:  # noqa: BLE001
+                log.exception("event loop error on %s", ev.kind)
+
+    def _handle(self, ev: Event) -> None:
+        if ev.kind == "shutdown":
+            return
+        if ev.kind == "job_queued":
+            # planning off the event loop (query_stage_scheduler.rs:372)
+            threading.Thread(target=self._plan_job, args=(ev.payload,), daemon=True).start()
+        elif ev.kind == "revive":
+            self._offer_reservation()
+        elif ev.kind == "task_update":
+            executor_id, results = ev.payload
+            self._apply_task_updates(executor_id, results)
+            self._offer_reservation()
+        elif ev.kind == "executor_lost":
+            self._on_executor_lost(ev.payload)
+            self._offer_reservation()
+        elif ev.kind == "cancel":
+            self._cancel_job(ev.payload)
+
+    # -- job submission --------------------------------------------------------
+
+    def submit_sql(self, sql: str, session_id: str, job_name: str = "") -> str:
+        job_id = str(new_job_id())
+        with self._jobs_lock:
+            self.jobs[job_id] = ExecutionGraph(job_id, job_name, session_id, [],
+                                               self.sessions.get(session_id))
+            self.jobs[job_id].status = JobState.QUEUED
+        self.metrics.record_submitted(job_id)
+        self.post(Event("job_queued", (job_id, "sql", sql, session_id)))
+        return job_id
+
+    def submit_physical_plan(self, plan, session_id: str, job_name: str = "") -> str:
+        job_id = str(new_job_id())
+        with self._jobs_lock:
+            self.jobs[job_id] = ExecutionGraph(job_id, job_name, session_id, [],
+                                               self.sessions.get(session_id))
+            self.jobs[job_id].status = JobState.QUEUED
+        self.metrics.record_submitted(job_id)
+        self.post(Event("job_queued", (job_id, "physical", plan, session_id)))
+        return job_id
+
+    def _plan_job(self, payload) -> None:
+        job_id, kind, body, session_id = payload
+        t0 = time.time()
+        try:
+            ctx = self.sessions.create_planning_context(session_id)
+            if kind == "sql":
+                df = ctx.sql(body)
+                physical = ctx.create_physical_plan(df.plan)
+            else:
+                physical = body
+            stages = DistributedPlanner(job_id).plan_query_stages(physical)
+            cfg = self.sessions.get(session_id) or BallistaConfig()
+            old = self.jobs.get(job_id)
+            graph = ExecutionGraph(job_id, old.job_name if old else "", session_id, stages, cfg)
+            with self._jobs_lock:
+                self.jobs[job_id] = graph
+            self.metrics.record_planning_ms(job_id, (time.time() - t0) * 1000)
+            self.post(Event("revive"))
+        except BaseException as e:  # noqa: BLE001
+            log.warning("planning failed for %s: %s", job_id, e, exc_info=True)
+            with self._jobs_lock:
+                g = self.jobs.get(job_id)
+                if g is not None:
+                    g.status = JobState.FAILED
+                    g.error = f"planning failed: {e}"
+                    g.ended_at = time.time()
+            self.metrics.record_failed(job_id)
+            self._notify(job_id)
+
+    # -- scheduling (push mode) -------------------------------------------------
+
+    def _offer_reservation(self) -> None:
+        """Bind runnable tasks to free executor slots and launch them
+        (state/mod.rs:181-221: offer → bind → launch → unbind leftovers)."""
+        if self.launcher is None:
+            return
+        with self._jobs_lock:
+            running = [g for g in self.jobs.values() if g.status is JobState.RUNNING]
+        demand = sum(g.available_task_count() for g in running)
+        if demand == 0:
+            return
+        reservations = self.executors.reserve_slots(demand)
+        for executor_id, count in reservations:
+            tasks: list[TaskDescription] = []
+            for g in running:
+                while len(tasks) < count:
+                    t = g.pop_next_task(executor_id)
+                    if t is None:
+                        break
+                    tasks.append(t)
+                if len(tasks) >= count:
+                    break
+            unused = count - len(tasks)
+            if unused:
+                self.executors.free_slot(executor_id, unused)
+            if tasks:
+                try:
+                    self.launcher.launch(executor_id, tasks, self)
+                except Exception as e:  # noqa: BLE001
+                    log.warning("launch to %s failed: %s", executor_id, e)
+                    self.post(Event("executor_lost", executor_id))
+
+    # -- pull mode ---------------------------------------------------------------
+
+    def poll_work(self, metadata: ExecutorMetadata, can_accept: bool, free_slots: int,
+                  results: list[TaskResult]) -> list[TaskDescription]:
+        """PollWork doubles as heartbeat + status sink + task source
+        (scheduler_server/grpc.rs:92)."""
+        if not self.executors.heartbeat(metadata.id):
+            self.executors.register(metadata)
+        if results:
+            self._apply_task_updates(metadata.id, results, free_slots_managed=False)
+        out: list[TaskDescription] = []
+        if can_accept:
+            with self._jobs_lock:
+                running = [g for g in self.jobs.values() if g.status is JobState.RUNNING]
+            for g in running:
+                while len(out) < free_slots:
+                    t = g.pop_next_task(metadata.id)
+                    if t is None:
+                        break
+                    out.append(t)
+                if len(out) >= free_slots:
+                    break
+        return out
+
+    # -- status ingestion ----------------------------------------------------------
+
+    def update_task_status(self, executor_id: str, results: list[TaskResult]) -> None:
+        self.post(Event("task_update", (executor_id, results)))
+
+    def _apply_task_updates(self, executor_id: str, results: list[TaskResult],
+                            free_slots_managed: bool = True) -> None:
+        for r in results:
+            if free_slots_managed:
+                self.executors.free_slot(executor_id, 1)
+            with self._jobs_lock:
+                g = self.jobs.get(r.job_id)
+            if g is None:
+                continue
+            events = g.update_task_status(
+                r.task_id, r.stage_id, r.stage_attempt, r.state, r.partitions,
+                r.locations, r.error, r.retryable, r.metrics,
+            )
+            for ev in events:
+                if ev == "job_finished":
+                    self.metrics.record_completed(g.job_id, time.time() - g.queued_at)
+                    self._notify(g.job_id)
+                elif ev == "job_failed":
+                    self.metrics.record_failed(g.job_id)
+                    self._notify(g.job_id)
+
+    # -- executor lifecycle -----------------------------------------------------------
+
+    def register_executor(self, metadata: ExecutorMetadata) -> None:
+        self.executors.register(metadata)
+        self.post(Event("revive"))
+
+    def executor_heartbeat(self, executor_id: str) -> bool:
+        return self.executors.heartbeat(executor_id)
+
+    def _on_executor_lost(self, executor_id: str) -> None:
+        self.executors.deregister(executor_id)
+        with self._jobs_lock:
+            graphs = list(self.jobs.values())
+        for g in graphs:
+            n = g.reset_stages_on_lost_executor(executor_id)
+            if n:
+                log.info("rolled back %d task/stage units of %s after losing %s", n, g.job_id, executor_id)
+
+    def check_expired_executors(self) -> None:
+        for eid in self.executors.expire_dead():
+            log.warning("executor %s expired (no heartbeat)", eid)
+            self.post(Event("executor_lost", eid))
+
+    # -- job control ---------------------------------------------------------------------
+
+    def _cancel_job(self, job_id: str) -> None:
+        with self._jobs_lock:
+            g = self.jobs.get(job_id)
+        if g is not None:
+            g.cancel()
+            self.metrics.record_cancelled(job_id)
+            self._notify(job_id)
+
+    def cancel_job(self, job_id: str) -> None:
+        self.post(Event("cancel", job_id))
+
+    def job_status(self, job_id: str) -> dict | None:
+        with self._jobs_lock:
+            g = self.jobs.get(job_id)
+        return None if g is None else g.job_status()
+
+    def wait_for_job(self, job_id: str, timeout: float = 300.0) -> dict:
+        ev = threading.Event()
+        with self._jobs_lock:
+            self._watchers.setdefault(job_id, []).append(ev)
+            g = self.jobs.get(job_id)
+        if g is not None and g.status in (JobState.SUCCESSFUL, JobState.FAILED, JobState.CANCELLED):
+            return g.job_status()
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if ev.wait(timeout=0.5):
+                break
+            st = self.job_status(job_id)
+            if st and st["state"] in ("successful", "failed", "cancelled"):
+                break
+        st = self.job_status(job_id)
+        if st is None:
+            raise BallistaError(f"unknown job {job_id}")
+        return st
+
+    def _notify(self, job_id: str) -> None:
+        with self._jobs_lock:
+            for ev in self._watchers.pop(job_id, []):
+                ev.set()
+
+    def clean_job_data(self, job_id: str) -> None:
+        with self._jobs_lock:
+            self.jobs.pop(job_id, None)

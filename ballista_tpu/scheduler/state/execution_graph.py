@@ -1,0 +1,321 @@
+"""Per-job execution graph: the stage DAG state machine.
+
+Rebuild of ExecutionGraph / ExecutionStage
+(scheduler/src/state/execution_graph.rs:103, execution_stage.rs):
+
+stage lifecycle  UNRESOLVED → RESOLVED → RUNNING → SUCCESSFUL | FAILED
+- a stage resolves when every input stage is successful: its
+  UnresolvedShuffleExec leaves are swapped for ShuffleReaderExec carrying
+  the input stages' partition locations (remove_unresolved_shuffles)
+- tasks are handed out per partition SLICE (PendingPartitions::next_slice,
+  max_partitions_per_task)
+- failure handling: bounded per-stage retries with attempt counters and
+  failure dedup (execution_stage.rs:142); executor loss rolls running
+  stages back and reruns successful stages whose shuffle outputs were on
+  the lost executor (reset_stages_on_lost_executor :180,
+  rerun_successful_stage :216 — ResultLost recompute)
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Optional
+
+from ballista_tpu.config import MAX_PARTITIONS_PER_TASK, BallistaConfig
+from ballista_tpu.scheduler.planner import QueryStage, remove_unresolved_shuffles
+from ballista_tpu.shuffle.reader import ShuffleReaderExec
+from ballista_tpu.shuffle.types import PartitionLocation
+
+MAX_STAGE_ATTEMPTS = 4
+MAX_TASK_FAILURES = 4
+
+
+class StageState(Enum):
+    UNRESOLVED = "unresolved"
+    RESOLVED = "resolved"
+    RUNNING = "running"
+    SUCCESSFUL = "successful"
+    FAILED = "failed"
+
+
+class JobState(Enum):
+    QUEUED = "queued"
+    RUNNING = "running"
+    SUCCESSFUL = "successful"
+    FAILED = "failed"
+    CANCELLED = "cancelled"
+
+
+@dataclass
+class TaskDescription:
+    job_id: str
+    stage_id: int
+    stage_attempt: int
+    task_id: int
+    partitions: list[int]
+    plan: object  # ExecutionPlan (stage plan with resolved readers)
+    session_id: str
+
+
+@dataclass
+class RunningTask:
+    task_id: int
+    partitions: list[int]
+    executor_id: str
+    launched_at: float = field(default_factory=time.time)
+
+
+class ExecutionStage:
+    def __init__(self, stage: QueryStage):
+        self.spec = stage
+        self.stage_id = stage.stage_id
+        self.state = StageState.UNRESOLVED if stage.input_stage_ids else StageState.RESOLVED
+        self.attempt = 0
+        self.resolved_plan = stage.plan if not stage.input_stage_ids else None
+        self.pending: list[int] = list(range(stage.partitions))
+        self.running: dict[int, RunningTask] = {}
+        # map_partition → locations published by the finished task
+        self.completed: dict[int, list[PartitionLocation]] = {}
+        self.failure_reasons: set[str] = set()
+        self.task_failures = 0
+
+    @property
+    def is_runnable(self) -> bool:
+        return self.state in (StageState.RESOLVED, StageState.RUNNING) and bool(self.pending)
+
+    def all_done(self) -> bool:
+        return not self.pending and not self.running and len(self.completed) == self.spec.partitions
+
+    def reset_for_retry(self) -> None:
+        self.attempt += 1
+        self.pending = list(range(self.spec.partitions))
+        self.running.clear()
+        self.completed.clear()
+        self.state = StageState.UNRESOLVED if self.spec.input_stage_ids else StageState.RESOLVED
+        if not self.spec.input_stage_ids:
+            self.resolved_plan = self.spec.plan
+
+    def output_locations(self) -> list[PartitionLocation]:
+        out: list[PartitionLocation] = []
+        for locs in self.completed.values():
+            out.extend(locs)
+        return out
+
+
+class ExecutionGraph:
+    def __init__(self, job_id: str, job_name: str, session_id: str, stages: list[QueryStage],
+                 config: BallistaConfig | None = None):
+        self.job_id = job_id
+        self.job_name = job_name
+        self.session_id = session_id
+        self.config = config or BallistaConfig()
+        self.stages: dict[int, ExecutionStage] = {s.stage_id: ExecutionStage(s) for s in stages}
+        self.final_stage_id = max(self.stages) if self.stages else 0
+        self.status = JobState.RUNNING
+        self.error: str = ""
+        self.next_task_id = 0
+        self.queued_at = time.time()
+        self.ended_at: float | None = None
+        self.output_links: dict[int, list[int]] = {sid: [] for sid in self.stages}
+        for s in stages:
+            for inp in s.input_stage_ids:
+                self.output_links[inp].append(s.stage_id)
+        self._lock = threading.RLock()
+        self.stage_metrics: dict[int, list] = {}
+
+    # ------------------------------------------------------------------
+
+    def available_task_count(self) -> int:
+        with self._lock:
+            if self.status is not JobState.RUNNING:
+                return 0
+            return sum(len(s.pending) for s in self.stages.values() if s.is_runnable)
+
+    def pop_next_task(self, executor_id: str) -> Optional[TaskDescription]:
+        """Hand out one task (a slice of a runnable stage's partitions)."""
+        with self._lock:
+            if self.status is not JobState.RUNNING:
+                return None
+            slice_size = max(1, int(self.config.get(MAX_PARTITIONS_PER_TASK)))
+            for stage in sorted(self.stages.values(), key=lambda s: s.stage_id):
+                if not stage.is_runnable:
+                    continue
+                parts = stage.pending[:slice_size]
+                stage.pending = stage.pending[slice_size:]
+                self.next_task_id += 1
+                task = TaskDescription(
+                    job_id=self.job_id,
+                    stage_id=stage.stage_id,
+                    stage_attempt=stage.attempt,
+                    task_id=self.next_task_id,
+                    partitions=parts,
+                    plan=stage.resolved_plan,
+                    session_id=self.session_id,
+                )
+                stage.running[task.task_id] = RunningTask(task.task_id, parts, executor_id)
+                stage.state = StageState.RUNNING
+                return task
+            return None
+
+    # ------------------------------------------------------------------
+
+    def update_task_status(self, task_id: int, stage_id: int, stage_attempt: int,
+                           state: str, partitions: list[int],
+                           locations: list[PartitionLocation],
+                           error: str = "", retryable: bool = False,
+                           metrics: list | None = None) -> list[str]:
+        """Ingest one task status; returns job-level events
+        ('stage_completed', 'job_finished', 'job_failed')."""
+        events: list[str] = []
+        with self._lock:
+            stage = self.stages.get(stage_id)
+            if stage is None or self.status is not JobState.RUNNING:
+                return events
+            if stage_attempt != stage.attempt:
+                return events  # stale attempt
+            running = stage.running.pop(task_id, None)
+            if state == "success":
+                for p in partitions:
+                    stage.completed[p] = [l for l in locations if l.map_partition == p]
+                if metrics:
+                    self.stage_metrics.setdefault(stage_id, []).extend(metrics)
+                if stage.all_done():
+                    stage.state = StageState.SUCCESSFUL
+                    events.append("stage_completed")
+                    self._on_stage_success(stage, events)
+            elif state in ("failed", "cancelled"):
+                if running is not None:
+                    stage.pending.extend(running.partitions)
+                stage.task_failures += 1
+                if error:
+                    stage.failure_reasons.add(error.splitlines()[0][:200])
+                if state == "cancelled":
+                    pass
+                elif not retryable or stage.task_failures > MAX_TASK_FAILURES:
+                    self._fail_job(f"stage {stage_id} failed: {error}")
+                    events.append("job_failed")
+            return events
+
+    def _on_stage_success(self, stage: ExecutionStage, events: list[str]) -> None:
+        if stage.stage_id == self.final_stage_id:
+            self.status = JobState.SUCCESSFUL
+            self.ended_at = time.time()
+            events.append("job_finished")
+            return
+        for out_id in self.output_links.get(stage.stage_id, []):
+            self._try_resolve(self.stages[out_id])
+
+    def _try_resolve(self, stage: ExecutionStage) -> None:
+        if stage.state is not StageState.UNRESOLVED:
+            return
+        inputs = [self.stages[i] for i in stage.spec.input_stage_ids]
+        if not all(i.state is StageState.SUCCESSFUL for i in inputs):
+            return
+        resolved: dict[int, ShuffleReaderExec] = {}
+        for inp in inputs:
+            resolved[inp.stage_id] = self._build_reader(inp)
+        stage.resolved_plan = remove_unresolved_shuffles(stage.spec.plan, resolved)
+        stage.state = StageState.RESOLVED
+
+    def _build_reader(self, inp: ExecutionStage) -> ShuffleReaderExec:
+        locs = inp.output_locations()
+        k = inp.spec.output_partitions
+        by_output: list[list[PartitionLocation]] = [[] for _ in range(max(1, k))]
+        for l in locs:
+            by_output[l.output_partition].append(l)
+        schema = inp.spec.plan.input.df_schema
+        return ShuffleReaderExec(schema, by_output, broadcast=inp.spec.broadcast)
+
+    def _fail_job(self, error: str) -> None:
+        self.status = JobState.FAILED
+        self.error = error
+        self.ended_at = time.time()
+
+    def cancel(self) -> list[RunningTask]:
+        with self._lock:
+            self.status = JobState.CANCELLED
+            self.ended_at = time.time()
+            out = []
+            for s in self.stages.values():
+                out.extend(s.running.values())
+                s.running.clear()
+                s.pending.clear()
+            return out
+
+    # ------------------------------------------------------------------
+
+    def reset_stages_on_lost_executor(self, executor_id: str) -> int:
+        """Roll back running tasks on the executor and rerun successful
+        stages whose shuffle outputs lived there (ResultLost recompute)."""
+        with self._lock:
+            if self.status is not JobState.RUNNING:
+                return 0
+            affected = 0
+            lost_output_stages: set[int] = set()
+            for stage in self.stages.values():
+                # running tasks on the lost executor → back to pending
+                dead = [t for t in stage.running.values() if t.executor_id == executor_id]
+                for t in dead:
+                    stage.running.pop(t.task_id, None)
+                    stage.pending.extend(t.partitions)
+                    affected += 1
+                # successful outputs on the lost executor → stage rerun
+                if stage.state is StageState.SUCCESSFUL and any(
+                    l.executor_id == executor_id for l in stage.output_locations()
+                ):
+                    lost_output_stages.add(stage.stage_id)
+            for sid in lost_output_stages:
+                self._rerun_stage_tree(sid)
+                affected += 1
+            return affected
+
+    def _rerun_stage_tree(self, stage_id: int) -> None:
+        """Rerun a successful stage; downstream stages that already consumed
+        it roll back to unresolved."""
+        stage = self.stages[stage_id]
+        if stage.attempt + 1 > MAX_STAGE_ATTEMPTS:
+            self._fail_job(f"stage {stage_id} exceeded {MAX_STAGE_ATTEMPTS} attempts")
+            return
+        stage.reset_for_retry()
+        # try re-resolving immediately (inputs may still be intact)
+        self._try_resolve(stage)
+        for out_id in self.output_links.get(stage_id, []):
+            out = self.stages[out_id]
+            if out.state in (StageState.RESOLVED, StageState.RUNNING, StageState.SUCCESSFUL):
+                out.reset_for_retry()
+
+    # ------------------------------------------------------------------
+
+    def job_status(self) -> dict:
+        with self._lock:
+            final = self.stages.get(self.final_stage_id)
+            done = sum(1 for s in self.stages.values() if s.state is StageState.SUCCESSFUL)
+            out = {
+                "job_id": self.job_id,
+                "job_name": self.job_name,
+                "state": self.status.value,
+                "error": self.error,
+                "completed_stages": done,
+                "total_stages": len(self.stages),
+                "queued_at": self.queued_at,
+                "ended_at": self.ended_at,
+            }
+            if final is not None:
+                out["schema"] = final.spec.plan.input.df_schema
+            if self.status is JobState.SUCCESSFUL and final is not None:
+                out["partitions"] = final.output_locations()
+            return out
+
+    def display(self) -> str:
+        with self._lock:
+            lines = [f"Job {self.job_id} [{self.status.value}]"]
+            for sid in sorted(self.stages):
+                s = self.stages[sid]
+                lines.append(
+                    f"  stage {sid}: {s.state.value} attempt={s.attempt} "
+                    f"pending={len(s.pending)} running={len(s.running)} done={len(s.completed)}"
+                )
+            return "\n".join(lines)

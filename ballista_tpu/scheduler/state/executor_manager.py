@@ -1,0 +1,118 @@
+"""Executor registry: registration, heartbeats, slots, expiry.
+
+Rebuild of ExecutorManager (scheduler/src/state/executor_manager.rs:62) +
+the in-memory ClusterState slot accounting (cluster/memory.rs:54):
+executors register with vcore counts (gated on wire-protocol version),
+heartbeat on a cadence, get expired after `executor_timeout_seconds`
+without one, and tasks bind against free slots under a distribution
+policy (bias = fill one executor first; round-robin = spread).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+
+from ballista_tpu.errors import GeneralError
+from ballista_tpu.executor.executor import ExecutorMetadata
+from ballista_tpu.version import WIRE_PROTOCOL_VERSION
+
+DEFAULT_EXECUTOR_TIMEOUT_S = 180
+
+
+@dataclass
+class ExecutorSlot:
+    metadata: ExecutorMetadata
+    total_slots: int
+    free_slots: int
+    last_seen: float = field(default_factory=time.time)
+    terminating: bool = False
+
+
+class ExecutorManager:
+    def __init__(self, task_distribution: str = "bias", timeout_s: float = DEFAULT_EXECUTOR_TIMEOUT_S):
+        self.executors: dict[str, ExecutorSlot] = {}
+        self.task_distribution = task_distribution
+        self.timeout_s = timeout_s
+        self._lock = threading.RLock()
+        self._rr = 0
+
+    def register(self, metadata: ExecutorMetadata) -> None:
+        if metadata.wire_version != WIRE_PROTOCOL_VERSION:
+            raise GeneralError(
+                f"wire protocol mismatch: executor {metadata.wire_version!r} != "
+                f"scheduler {WIRE_PROTOCOL_VERSION!r}"
+            )
+        with self._lock:
+            self.executors[metadata.id] = ExecutorSlot(metadata, metadata.vcores, metadata.vcores)
+
+    def heartbeat(self, executor_id: str) -> bool:
+        """Returns False if the executor is unknown (must re-register)."""
+        with self._lock:
+            ex = self.executors.get(executor_id)
+            if ex is None:
+                return False
+            ex.last_seen = time.time()
+            return True
+
+    def deregister(self, executor_id: str) -> None:
+        with self._lock:
+            self.executors.pop(executor_id, None)
+
+    def get(self, executor_id: str) -> ExecutorSlot | None:
+        with self._lock:
+            return self.executors.get(executor_id)
+
+    def alive_executors(self) -> list[ExecutorSlot]:
+        with self._lock:
+            return [e for e in self.executors.values() if not e.terminating]
+
+    def expire_dead(self) -> list[str]:
+        """Executors without a heartbeat for timeout_s (config.rs:310)."""
+        now = time.time()
+        with self._lock:
+            dead = [eid for eid, e in self.executors.items() if now - e.last_seen > self.timeout_s]
+            for eid in dead:
+                del self.executors[eid]
+            return dead
+
+    # -- slot binding --------------------------------------------------------
+
+    def reserve_slots(self, n: int) -> list[tuple[str, int]]:
+        """Reserve up to n slots; returns [(executor_id, count)]."""
+        with self._lock:
+            avail = [e for e in self.executors.values() if e.free_slots > 0 and not e.terminating]
+            if not avail:
+                return []
+            out: list[tuple[str, int]] = []
+            if self.task_distribution == "bias":
+                avail.sort(key=lambda e: -e.free_slots)
+                for e in avail:
+                    take = min(e.free_slots, n)
+                    if take:
+                        e.free_slots -= take
+                        out.append((e.metadata.id, take))
+                        n -= take
+                    if n <= 0:
+                        break
+            else:  # round-robin
+                i = self._rr
+                while n > 0 and any(e.free_slots > 0 for e in avail):
+                    e = avail[i % len(avail)]
+                    if e.free_slots > 0:
+                        e.free_slots -= 1
+                        if out and out[-1][0] == e.metadata.id:
+                            out[-1] = (e.metadata.id, out[-1][1] + 1)
+                        else:
+                            out.append((e.metadata.id, 1))
+                        n -= 1
+                    i += 1
+                self._rr = i
+            return out
+
+    def free_slot(self, executor_id: str, n: int = 1) -> None:
+        with self._lock:
+            e = self.executors.get(executor_id)
+            if e is not None:
+                e.free_slots = min(e.total_slots, e.free_slots + n)

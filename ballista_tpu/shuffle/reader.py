@@ -54,6 +54,10 @@ class ShuffleReaderExec(ExecutionPlan):
         return self
 
     def output_partition_count(self) -> int:
+        if self.broadcast:
+            # every partition reads everything; expose ONE so consumers
+            # (CollectLeft builds) pull the full input exactly once
+            return 1
         return max(1, len(self.partition_locations))
 
     def node_str(self) -> str:
@@ -101,6 +105,8 @@ class UnresolvedShuffleExec(ExecutionPlan):
         return self
 
     def output_partition_count(self) -> int:
+        if self.broadcast:
+            return 1
         return max(1, self.output_partitions)
 
     def node_str(self) -> str:

@@ -1,0 +1,208 @@
+"""Distributed machinery tests.
+
+Layers (mirroring SURVEY.md §4's pyramid):
+- distributed planner stage shapes
+- execution graph state machine (virtual cluster: fake launcher, no real
+  execution — reference SchedulerTest/VirtualTaskLauncher)
+- standalone end-to-end: real scheduler + executors + shuffle files
+- Flight remote-read path via force_remote_read (reference sort_shuffle.rs)
+"""
+
+import pyarrow as pa
+import pytest
+
+from ballista_tpu.config import (
+    BallistaConfig,
+    DEFAULT_SHUFFLE_PARTITIONS,
+    SHUFFLE_READER_FORCE_REMOTE,
+)
+from ballista_tpu.testing.reference import compare_results, run_reference
+
+from .conftest import tpch_query
+
+
+@pytest.fixture()
+def standalone_ctx(tpch_dir):
+    from ballista_tpu.client.context import SessionContext
+    from ballista_tpu.testing.tpchgen import register_tpch
+
+    cfg = BallistaConfig({DEFAULT_SHUFFLE_PARTITIONS: 4})
+    ctx = SessionContext.standalone(cfg, num_executors=2, vcores=4)
+    register_tpch(ctx, tpch_dir)
+    yield ctx
+    ctx.shutdown()
+
+
+# -- distributed planner -----------------------------------------------------
+
+
+def test_stage_split_shapes(tpch_ctx):
+    from ballista_tpu.scheduler.planner import DistributedPlanner
+
+    df = tpch_ctx.sql(tpch_query(1))
+    physical = tpch_ctx.create_physical_plan(df.plan)
+    stages = DistributedPlanner("job1").plan_query_stages(physical)
+    # q1: partial agg stage (hash shuffle) + final stage
+    assert len(stages) >= 2
+    assert stages[-1].stage_id == max(s.stage_id for s in stages)
+    # every non-final stage is an input of something
+    consumed = {i for s in stages for i in s.input_stage_ids}
+    for s in stages[:-1]:
+        assert s.stage_id in consumed or s.broadcast
+
+
+def test_broadcast_stage_for_join(tpch_ctx):
+    from ballista_tpu.scheduler.planner import DistributedPlanner
+
+    df = tpch_ctx.sql(tpch_query(3))
+    physical = tpch_ctx.create_physical_plan(df.plan)
+    stages = DistributedPlanner("job3").plan_query_stages(physical)
+    assert any(s.broadcast for s in stages), "q3 should produce a broadcast build stage"
+
+
+# -- execution graph (virtual cluster, no real execution) ---------------------
+
+
+def _tiny_graph(tpch_ctx, q=1):
+    from ballista_tpu.scheduler.planner import DistributedPlanner
+    from ballista_tpu.scheduler.state.execution_graph import ExecutionGraph
+
+    physical = tpch_ctx.create_physical_plan(tpch_ctx.sql(tpch_query(q)).plan)
+    stages = DistributedPlanner("jobv").plan_query_stages(physical)
+    return ExecutionGraph("jobv", "", "s1", stages)
+
+
+def _fake_success(graph, task, executor_id="e1"):
+    from ballista_tpu.shuffle.types import PartitionLocation, PartitionStats
+
+    locs = []
+    stage = graph.stages[task.stage_id]
+    k = stage.spec.output_partitions
+    for p in task.partitions:
+        outs = range(k) if stage.spec.plan.output_partitions > 0 else [p]
+        for o in outs:
+            locs.append(PartitionLocation(
+                map_partition=p, job_id=task.job_id, stage_id=task.stage_id,
+                output_partition=o, executor_id=executor_id, path=f"/fake/{task.stage_id}/{p}/{o}",
+                stats=PartitionStats(num_rows=1, num_batches=1, num_bytes=10),
+            ))
+    return graph.update_task_status(
+        task.task_id, task.stage_id, task.stage_attempt, "success", task.partitions, locs
+    )
+
+
+def test_graph_lifecycle_virtual(tpch_ctx):
+    g = _tiny_graph(tpch_ctx)
+    seen_stages = set()
+    guard = 0
+    while g.status.value == "running" and guard < 1000:
+        guard += 1
+        t = g.pop_next_task("e1")
+        if t is None:
+            break
+        seen_stages.add(t.stage_id)
+        _fake_success(g, t)
+    assert g.status.value == "successful", g.display()
+    assert len(seen_stages) == len(g.stages)
+
+
+def test_graph_executor_lost_recompute(tpch_ctx):
+    g = _tiny_graph(tpch_ctx)
+    # finish stage 1 on e1
+    tasks = []
+    while True:
+        t = g.pop_next_task("e1")
+        if t is None or t.stage_id != 1:
+            break
+        tasks.append(t)
+    for t in tasks:
+        _fake_success(g, t, "e1")
+    assert g.stages[1].state.value == "successful"
+    # losing e1 must rerun stage 1 (its shuffle outputs lived there)
+    n = g.reset_stages_on_lost_executor("e1")
+    assert n >= 1
+    assert g.stages[1].state.value in ("resolved", "unresolved")
+    assert g.stages[1].attempt == 1
+
+
+def test_graph_task_failure_retry(tpch_ctx):
+    g = _tiny_graph(tpch_ctx)
+    t = g.pop_next_task("e1")
+    ev = g.update_task_status(t.task_id, t.stage_id, t.stage_attempt, "failed",
+                              t.partitions, [], "transient io", retryable=True)
+    assert "job_failed" not in ev
+    # failed partitions go back in the queue
+    assert set(t.partitions) <= set(g.stages[t.stage_id].pending)
+    t2 = g.pop_next_task("e1")
+    assert t2 is not None
+    ev = g.update_task_status(t2.task_id, t2.stage_id, t2.stage_attempt, "failed",
+                              t2.partitions, [], "fatal", retryable=False)
+    assert "job_failed" in ev
+    assert g.status.value == "failed"
+
+
+# -- standalone end-to-end -----------------------------------------------------
+
+
+@pytest.mark.parametrize("q", [1, 3, 5, 7, 13, 17, 18, 21, 22])
+def test_tpch_standalone(q, standalone_ctx, tpch_ref_tables):
+    eng = standalone_ctx.sql(tpch_query(q)).collect()
+    ref = run_reference(q, tpch_ref_tables)
+    problems = compare_results(eng, ref, q)
+    assert not problems, "\n".join(problems)
+
+
+def test_tpch_standalone_remote_reads(tpch_dir, tpch_ref_tables):
+    """Force every shuffle read over Arrow Flight (no local fast path)."""
+    from ballista_tpu.client.context import SessionContext
+    from ballista_tpu.testing.tpchgen import register_tpch
+
+    cfg = BallistaConfig({DEFAULT_SHUFFLE_PARTITIONS: 4, SHUFFLE_READER_FORCE_REMOTE: True})
+    ctx = SessionContext.standalone(cfg, num_executors=2, vcores=4)
+    register_tpch(ctx, tpch_dir)
+    try:
+        eng = ctx.sql(tpch_query(3)).collect()
+        problems = compare_results(eng, run_reference(3, tpch_ref_tables), 3)
+        assert not problems, "\n".join(problems)
+    finally:
+        ctx.shutdown()
+
+
+def test_plan_proto_roundtrip(tpch_ctx):
+    from ballista_tpu.serde import plan_from_bytes, plan_to_bytes
+
+    for q in (1, 3, 17):
+        physical = tpch_ctx.create_physical_plan(tpch_ctx.sql(tpch_query(q)).plan)
+        b = plan_to_bytes(physical)
+        restored = plan_from_bytes(b)
+        assert restored.display() == physical.display()
+
+
+def test_shuffle_writer_reader_roundtrip(tmp_path):
+    """Unit: hash + sort layouts round-trip through writer → reader."""
+    from ballista_tpu.plan.expressions import col
+    from ballista_tpu.plan.physical import MemoryScanExec, TaskContext
+    from ballista_tpu.plan.schema import DFSchema
+    from ballista_tpu.shuffle.reader import ShuffleReaderExec
+    from ballista_tpu.shuffle.types import PartitionLocation
+    from ballista_tpu.shuffle.writer import ShuffleWriterExec, metadata_to_locations
+
+    tbl = pa.table({"k": pa.array(list(range(100)), pa.int64()),
+                    "v": pa.array([f"s{i}" for i in range(100)])})
+    scan = MemoryScanExec(DFSchema.from_arrow(tbl.schema), tbl.to_batches(), partitions=2)
+    for sort_shuffle in (False, True):
+        writer = ShuffleWriterExec(scan, "jobx", 1, 4, [col("k")], sort_shuffle=sort_shuffle)
+        ctx = TaskContext(BallistaConfig(), task_id="t0", work_dir=str(tmp_path))
+        locations = []
+        for p in range(2):
+            for meta in writer.execute(p, ctx):
+                locations.extend(metadata_to_locations(meta, "jobx", 1, p, "e1", "localhost", 0))
+        by_out = [[] for _ in range(4)]
+        for l in locations:
+            by_out[l.output_partition].append(l)
+        reader = ShuffleReaderExec(scan.df_schema, by_out)
+        seen = []
+        for p in range(4):
+            for b in reader.execute(p, TaskContext(BallistaConfig())):
+                seen.extend(b.column(0).to_pylist())
+        assert sorted(seen) == list(range(100)), f"sort_shuffle={sort_shuffle}"
