@@ -1,0 +1,91 @@
+"""Remote scheduler client: the client side of the distributed query flow.
+
+Rebuild of DistributedQueryExec (core/src/execution_plans/
+distributed_query.rs:64,211): CreateUpdateSession with the full session
+config (catalog registrations ride along as KV pairs), ExecuteQuery (SQL
+or physical-plan protobuf), GetJobStatus polling, then fetch result
+partitions from executors over Flight (local fast path applies when
+colocated).
+"""
+
+from __future__ import annotations
+
+import time
+
+import grpc
+import pyarrow as pa
+
+from ballista_tpu.config import BallistaConfig
+from ballista_tpu.errors import ExecutionError, GrpcError
+from ballista_tpu.proto import pb
+from ballista_tpu.scheduler.grpc_service import scheduler_stub
+from ballista_tpu.serde import encode_plan
+from ballista_tpu.serde_control import decode_job_status
+
+POLL_INTERVAL_S = 0.1
+
+
+class RemoteSchedulerClient:
+    def __init__(self, scheduler_url: str, config: BallistaConfig):
+        addr = scheduler_url.replace("df://", "").replace("grpc://", "")
+        self.channel = grpc.insecure_channel(addr)
+        self.stub = scheduler_stub(self.channel)
+        self.config = config
+        self.session_id: str = ""
+
+    def _settings(self) -> list[pb.KeyValuePair]:
+        return [pb.KeyValuePair(key=k, value=v) for k, v in self.config.to_key_value_pairs()]
+
+    def ensure_session(self) -> str:
+        req = pb.CreateSessionParams(session_id=self.session_id)
+        req.settings.extend(self._settings())
+        resp = self.stub.CreateUpdateSession(req, timeout=10)
+        self.session_id = resp.session_id
+        return self.session_id
+
+    def execute_sql(self, sql: str, job_name: str = "") -> str:
+        sid = self.ensure_session()
+        req = pb.ExecuteQueryParams(sql=sql, session_id=sid, job_name=job_name)
+        req.settings.extend(self._settings())
+        try:
+            resp = self.stub.ExecuteQuery(req, timeout=30)
+        except grpc.RpcError as e:
+            raise GrpcError(f"ExecuteQuery failed: {e}") from None
+        return resp.job_id
+
+    def execute_physical(self, physical, job_name: str = "") -> str:
+        sid = self.ensure_session()
+        req = pb.ExecuteQueryParams(session_id=sid, job_name=job_name)
+        req.physical_plan.CopyFrom(encode_plan(physical))
+        req.settings.extend(self._settings())
+        resp = self.stub.ExecuteQuery(req, timeout=30)
+        return resp.job_id
+
+    def wait_for_job(self, job_id: str, timeout: float = 600.0) -> dict:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            resp = self.stub.GetJobStatus(pb.GetJobStatusParams(job_id=job_id), timeout=10)
+            status = decode_job_status(resp.status)
+            if status["state"] in ("successful", "failed", "cancelled"):
+                return status
+            time.sleep(POLL_INTERVAL_S)
+        raise ExecutionError(f"job {job_id} timed out")
+
+    def cancel_job(self, job_id: str) -> None:
+        self.stub.CancelJob(pb.CancelJobParams(job_id=job_id), timeout=10)
+
+    def job_metrics(self, job_id: str):
+        return self.stub.GetJobMetrics(pb.GetJobMetricsParams(job_id=job_id), timeout=10)
+
+    def collect(self, df) -> pa.Table:
+        from ballista_tpu.client.context import fetch_job_results
+
+        if df.sql_text is not None:
+            job_id = self.execute_sql(df.sql_text)
+        else:
+            physical = df.ctx.create_physical_plan(df.plan)
+            job_id = self.execute_physical(physical)
+        status = self.wait_for_job(job_id)
+        if status["state"] != "successful":
+            raise ExecutionError(f"job {job_id} {status['state']}: {status.get('error', '')}")
+        return fetch_job_results(status, self.config)

@@ -326,6 +326,16 @@ class PhysicalPlanner:
 
         broadcast = build_rows <= self.broadcast_rows or probe.output_partition_count() == 1
 
+        # build-side-emitting joins (left/full/left_semi/left_anti after the
+        # swap) need every probe row to pass through ONE join instance before
+        # the unmatched-build tail can be emitted. Distributed tasks each
+        # decode their own plan copy, so CollectLeft is only sound when the
+        # probe is a single partition; otherwise co-hash-partition both sides
+        # and let each task own its build partition outright.
+        build_emitting = exec_jt in ("left", "full", "left_semi", "left_anti")
+        if build_emitting and probe.output_partition_count() > 1:
+            broadcast = False
+
         if broadcast:
             mode = "collect_left"
         else:

@@ -1,0 +1,3 @@
+from ballista_tpu.executor.executor_process import main
+
+main()

@@ -1,0 +1,213 @@
+"""Executor daemon process.
+
+Rebuild of executor/src/executor_process.rs: registers with the scheduler
+(wire-version gated), serves ExecutorGrpc + the Flight shuffle server,
+heartbeats, optionally runs the pull-mode poll loop
+(execution_loop.rs:88 — PollWork doubles as heartbeat), sweeps expired
+job dirs by TTL (:1042), drains gracefully on SIGTERM.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import shutil
+import signal
+import socket
+import tempfile
+import threading
+import time
+from concurrent import futures
+
+import grpc
+
+from ballista_tpu.config import BallistaConfig, EXECUTOR_ENGINE
+from ballista_tpu.executor.executor import Executor, ExecutorMetadata
+from ballista_tpu.executor.executor_server import ExecutorGrpcService, add_executor_service
+from ballista_tpu.flight.server import start_flight_server
+from ballista_tpu.ids import new_executor_id
+from ballista_tpu.proto import pb
+from ballista_tpu.scheduler.grpc_service import scheduler_stub
+from ballista_tpu.serde_control import encode_executor_metadata, encode_task_status
+
+log = logging.getLogger(__name__)
+
+HEARTBEAT_INTERVAL_S = 5.0
+POLL_INTERVAL_S = 0.25
+DIR_TTL_CHECK_S = 300.0
+
+
+class ExecutorProcess:
+    def __init__(self, scheduler_addr: str, bind_host: str = "0.0.0.0",
+                 external_host: str | None = None, grpc_port: int = 0,
+                 flight_port: int = 0, vcores: int | None = None,
+                 work_dir: str | None = None, engine: str = "cpu",
+                 policy: str = "push", work_dir_ttl_s: float = 4 * 3600):
+        self.scheduler_addr = scheduler_addr
+        self.work_dir = work_dir or tempfile.mkdtemp(prefix="ballista-tpu-executor-")
+        self.policy = policy
+        self.work_dir_ttl_s = work_dir_ttl_s
+        vcores = vcores or (os.cpu_count() or 4)
+        host = external_host or socket.gethostname()
+
+        config = BallistaConfig({EXECUTOR_ENGINE: engine})
+        self.flight_server, bound_flight = start_flight_server(self.work_dir, bind_host, flight_port)
+
+        self.metadata = ExecutorMetadata(
+            id=str(new_executor_id()), host=host, flight_port=bound_flight, vcores=vcores
+        )
+        self.executor = Executor(self.work_dir, self.metadata, config=config)
+
+        self._channel = grpc.insecure_channel(scheduler_addr)
+        self._scheduler = scheduler_stub(self._channel)
+        self._stopping = threading.Event()
+        self._pending_status: list = []
+        self._status_lock = threading.Lock()
+
+        self.grpc_server = grpc.server(futures.ThreadPoolExecutor(max_workers=8))
+        self.service = ExecutorGrpcService(self.executor, self._send_status, self.shutdown)
+        add_executor_service(self.grpc_server, self.service)
+        self.grpc_port = self.grpc_server.add_insecure_port(f"{bind_host}:{grpc_port}")
+        self.metadata.grpc_port = self.grpc_port
+
+    # ------------------------------------------------------------------
+
+    def start(self) -> None:
+        self.grpc_server.start()
+        self._register()
+        threading.Thread(target=self._heartbeat_loop, daemon=True, name="heartbeat").start()
+        threading.Thread(target=self._dir_ttl_loop, daemon=True, name="dir-ttl").start()
+        if self.policy == "pull":
+            threading.Thread(target=self._poll_loop, daemon=True, name="poll").start()
+        log.info(
+            "executor %s up: grpc=%d flight=%d vcores=%d work_dir=%s",
+            self.metadata.id, self.grpc_port, self.metadata.flight_port,
+            self.metadata.vcores, self.work_dir,
+        )
+
+    def _register(self) -> None:
+        req = pb.RegisterExecutorParams(metadata=encode_executor_metadata(self.metadata))
+        for attempt in range(30):
+            try:
+                resp = self._scheduler.RegisterExecutor(req, timeout=5)
+                if not resp.success:
+                    raise RuntimeError(f"registration rejected: {resp.error}")
+                return
+            except grpc.RpcError:
+                time.sleep(min(2.0, 0.2 * (attempt + 1)))
+        raise RuntimeError(f"cannot reach scheduler at {self.scheduler_addr}")
+
+    def _send_status(self, results) -> None:
+        if self.policy == "pull":
+            with self._status_lock:
+                self._pending_status.extend(results)
+            return
+        req = pb.UpdateTaskStatusParams(executor_id=self.metadata.id)
+        for r in results:
+            req.task_status.append(encode_task_status(r, self.metadata.id))
+        self._scheduler.UpdateTaskStatus(req, timeout=30)
+
+    def _heartbeat_loop(self) -> None:
+        while not self._stopping.wait(HEARTBEAT_INTERVAL_S):
+            try:
+                resp = self._scheduler.HeartBeatFromExecutor(
+                    pb.HeartBeatParams(
+                        executor_id=self.metadata.id,
+                        metadata=encode_executor_metadata(self.metadata),
+                        status="active",
+                    ),
+                    timeout=5,
+                )
+                if resp.reregister:
+                    self._register()
+            except grpc.RpcError as e:
+                log.warning("heartbeat failed: %s", e.code() if hasattr(e, "code") else e)
+
+    def _poll_loop(self) -> None:
+        """Pull mode: PollWork carries statuses and pulls new tasks."""
+        from ballista_tpu.serde_control import decode_task_definition
+
+        while not self._stopping.wait(POLL_INTERVAL_S):
+            with self._status_lock:
+                statuses, self._pending_status = self._pending_status, []
+            free = max(0, self.metadata.vcores - self.service._queue.qsize())
+            req = pb.PollWorkParams(
+                metadata=encode_executor_metadata(self.metadata),
+                can_accept_task=free > 0,
+                free_slots=free,
+            )
+            for r in statuses:
+                req.task_status.append(encode_task_status(r, self.metadata.id))
+            try:
+                resp = self._scheduler.PollWork(req, timeout=10)
+            except grpc.RpcError as e:
+                log.warning("poll failed: %s", e)
+                continue
+            for tp in resp.tasks:
+                task = decode_task_definition(tp)
+                cfg = BallistaConfig.from_key_value_pairs(
+                    [(kv.key, kv.value) for kv in tp.props], scrub_restricted=True
+                )
+                self.service._queue.put((task, cfg))
+
+    def _dir_ttl_loop(self) -> None:
+        while not self._stopping.wait(DIR_TTL_CHECK_S):
+            cutoff = time.time() - self.work_dir_ttl_s
+            try:
+                for name in os.listdir(self.work_dir):
+                    p = os.path.join(self.work_dir, name)
+                    if os.path.isdir(p) and os.path.getmtime(p) < cutoff:
+                        shutil.rmtree(p, ignore_errors=True)
+                        log.info("TTL-swept job dir %s", p)
+            except OSError:
+                pass
+
+    def shutdown(self) -> None:
+        if self._stopping.is_set():
+            return
+        self._stopping.set()
+        try:
+            self._scheduler.ExecutorStopped(
+                pb.ExecutorStoppedParams(executor_id=self.metadata.id, reason="shutdown"), timeout=3
+            )
+        except grpc.RpcError:
+            pass
+        self.service.stop()
+        self.grpc_server.stop(grace=2)
+        self.flight_server.shutdown()
+
+    def wait(self) -> None:
+        try:
+            while not self._stopping.wait(1.0):
+                pass
+        except KeyboardInterrupt:
+            self.shutdown()
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(description="ballista_tpu executor daemon")
+    ap.add_argument("--scheduler", default="localhost:50050", help="scheduler host:port")
+    ap.add_argument("--bind-host", default="0.0.0.0")
+    ap.add_argument("--external-host", default=None)
+    ap.add_argument("--grpc-port", type=int, default=0)
+    ap.add_argument("--flight-port", type=int, default=0)
+    ap.add_argument("--concurrent-tasks", type=int, default=None, help="vcores (default: all)")
+    ap.add_argument("--work-dir", default=None)
+    ap.add_argument("--engine", choices=("cpu", "tpu"), default="cpu")
+    ap.add_argument("--policy", choices=("push", "pull"), default="push")
+    ap.add_argument("--log-level", default="INFO")
+    args = ap.parse_args(argv)
+    logging.basicConfig(level=args.log_level, format="%(asctime)s %(levelname)s %(name)s %(message)s")
+
+    proc = ExecutorProcess(
+        args.scheduler, args.bind_host, args.external_host, args.grpc_port,
+        args.flight_port, args.concurrent_tasks, args.work_dir, args.engine, args.policy,
+    )
+    signal.signal(signal.SIGTERM, lambda *_: proc.shutdown())
+    proc.start()
+    proc.wait()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,128 @@
+"""ExecutorGrpc service + push-mode task runner pool.
+
+Rebuild of executor/src/executor_server.rs: LaunchMultiTask enqueues task
+definitions; a worker pool sized to vcores runs them (TaskRunnerPool
+:691); completed statuses are batched back to the owning scheduler via
+UpdateTaskStatus; StopExecutor / CancelTasks / RemoveJobData complete the
+rpc surface (ballista.proto:984).
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+
+import grpc
+
+from ballista_tpu.executor.executor import Executor
+from ballista_tpu.proto import pb
+from ballista_tpu.serde_control import decode_task_definition, encode_task_status
+
+log = logging.getLogger(__name__)
+
+SERVICE_NAME = "ballista_tpu.ExecutorGrpc"
+
+
+class ExecutorGrpcService:
+    def __init__(self, executor: Executor, status_sender, shutdown_cb=None):
+        """status_sender(results: list[TaskResult]) → ships to scheduler."""
+        self.executor = executor
+        self.status_sender = status_sender
+        self.shutdown_cb = shutdown_cb
+        self._queue: "queue.Queue" = queue.Queue()
+        self._workers: list[threading.Thread] = []
+        self._running = True
+        for i in range(max(1, executor.metadata.vcores)):
+            t = threading.Thread(target=self._worker, daemon=True, name=f"task-runner-{i}")
+            t.start()
+            self._workers.append(t)
+
+    def _worker(self) -> None:
+        while self._running:
+            try:
+                item = self._queue.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            task, config = item
+            result = self.executor.execute_task(task, config)
+            try:
+                self.status_sender([result])
+            except Exception:  # noqa: BLE001
+                log.exception("failed to report task status")
+
+    def stop(self) -> None:
+        self._running = False
+
+    # -- rpcs ----------------------------------------------------------------
+
+    def LaunchMultiTask(self, request: pb.LaunchMultiTaskParams, context) -> pb.LaunchMultiTaskResult:
+        from ballista_tpu.config import BallistaConfig
+
+        for tp in request.tasks:
+            task = decode_task_definition(tp)
+            cfg = BallistaConfig.from_key_value_pairs(
+                [(kv.key, kv.value) for kv in tp.props], scrub_restricted=True
+            )
+            self._queue.put((task, cfg))
+        return pb.LaunchMultiTaskResult(success=True)
+
+    def StopExecutor(self, request: pb.StopExecutorParams, context) -> pb.StopExecutorResult:
+        log.info("stop requested (force=%s): %s", request.force, request.reason)
+        self.stop()
+        if self.shutdown_cb is not None:
+            threading.Thread(target=self.shutdown_cb, daemon=True).start()
+        return pb.StopExecutorResult()
+
+    def CancelTasks(self, request: pb.CancelTasksParams, context) -> pb.CancelTasksResult:
+        for t in request.tasks:
+            self.executor.cancel_task(t.job_id, t.stage_id)
+        return pb.CancelTasksResult(cancelled=True)
+
+    def RemoveJobData(self, request: pb.RemoveJobDataParams, context) -> pb.RemoveJobDataResult:
+        import shutil
+        import os
+
+        from ballista_tpu.shuffle.paths import job_dir
+
+        d = job_dir(self.executor.work_dir, request.job_id)
+        if os.path.isdir(d):
+            shutil.rmtree(d, ignore_errors=True)
+        self.executor.clear_cancellations(request.job_id)
+        return pb.RemoveJobDataResult()
+
+
+_RPCS = {
+    "LaunchMultiTask": (pb.LaunchMultiTaskParams, pb.LaunchMultiTaskResult),
+    "StopExecutor": (pb.StopExecutorParams, pb.StopExecutorResult),
+    "CancelTasks": (pb.CancelTasksParams, pb.CancelTasksResult),
+    "RemoveJobData": (pb.RemoveJobDataParams, pb.RemoveJobDataResult),
+}
+
+
+def add_executor_service(server: grpc.Server, service: ExecutorGrpcService) -> None:
+    handlers = {}
+    for name, (req_t, _r) in _RPCS.items():
+        handlers[name] = grpc.unary_unary_rpc_method_handler(
+            getattr(service, name),
+            request_deserializer=req_t.FromString,
+            response_serializer=lambda resp: resp.SerializeToString(),
+        )
+    server.add_generic_rpc_handlers((grpc.method_handlers_generic_handler(SERVICE_NAME, handlers),))
+
+
+def executor_stub(channel: grpc.Channel):
+    class Stub:
+        pass
+
+    stub = Stub()
+    for name, (req_t, resp_t) in _RPCS.items():
+        setattr(
+            stub, name,
+            channel.unary_unary(
+                f"/{SERVICE_NAME}/{name}",
+                request_serializer=req_t.SerializeToString,
+                response_deserializer=resp_t.FromString,
+            ),
+        )
+    return stub

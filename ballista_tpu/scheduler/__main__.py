@@ -1,0 +1,3 @@
+from ballista_tpu.scheduler.process import main
+
+main()

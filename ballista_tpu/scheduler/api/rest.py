@@ -1,0 +1,125 @@
+"""Scheduler REST API.
+
+Rebuild of the axum REST surface (scheduler/src/api/routes.rs:24,
+handlers.rs): scheduler state/version, executors, jobs (+cancel), per-job
+stages with operator metrics, dot-format stage graphs, Prometheus metrics
+passthrough, and a health endpoint. stdlib http.server — zero deps, same
+routes.
+
+GET  /api/state                 GET  /api/executors
+GET  /api/jobs                  GET  /api/job/{id}
+GET  /api/job/{id}/stages       GET  /api/job/{id}/dot
+POST /api/job/{id}/cancel       GET  /api/metrics
+GET  /health
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ballista_tpu.scheduler.metrics import InMemoryMetricsCollector
+from ballista_tpu.scheduler.server import SchedulerServer
+from ballista_tpu.version import BALLISTA_VERSION
+
+
+def start_rest_api(scheduler: SchedulerServer, metrics: InMemoryMetricsCollector,
+                   host: str = "0.0.0.0", port: int = 0):
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):  # quiet
+            pass
+
+        def _send(self, code: int, body: str, ctype: str = "application/json"):
+            data = body.encode()
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def _json(self, obj, code: int = 200):
+            self._send(code, json.dumps(obj, default=str, indent=1))
+
+        def do_GET(self):  # noqa: N802
+            p = self.path.rstrip("/")
+            if p == "/health":
+                return self._json({"status": "healthy"})
+            if p == "/api/state":
+                with scheduler._jobs_lock:
+                    jobs = len(scheduler.jobs)
+                return self._json({
+                    "version": BALLISTA_VERSION,
+                    "scheduler_id": scheduler.scheduler_id,
+                    "executors": len(scheduler.executors.alive_executors()),
+                    "jobs": jobs,
+                })
+            if p == "/api/executors":
+                out = []
+                for e in scheduler.executors.alive_executors():
+                    out.append({
+                        "id": e.metadata.id, "host": e.metadata.host,
+                        "grpc_port": e.metadata.grpc_port, "flight_port": e.metadata.flight_port,
+                        "total_slots": e.total_slots, "free_slots": e.free_slots,
+                        "last_seen": e.last_seen,
+                    })
+                return self._json(out)
+            if p == "/api/jobs":
+                with scheduler._jobs_lock:
+                    out = [g.job_status() for g in scheduler.jobs.values()]
+                for o in out:
+                    o.pop("partitions", None)
+                    o.pop("schema", None)
+                return self._json(out)
+            m = re.match(r"^/api/job/([^/]+)$", p)
+            if m:
+                st = scheduler.job_status(m.group(1))
+                if st is None:
+                    return self._json({"error": "not found"}, 404)
+                st.pop("partitions", None)
+                st.pop("schema", None)
+                return self._json(st)
+            m = re.match(r"^/api/job/([^/]+)/stages$", p)
+            if m:
+                with scheduler._jobs_lock:
+                    g = scheduler.jobs.get(m.group(1))
+                if g is None:
+                    return self._json({"error": "not found"}, 404)
+                stages = []
+                for sid in sorted(g.stages):
+                    s = g.stages[sid]
+                    stages.append({
+                        "stage_id": sid, "state": s.state.value, "attempt": s.attempt,
+                        "partitions": s.spec.partitions,
+                        "output_partitions": s.spec.output_partitions,
+                        "pending": len(s.pending), "running": len(s.running),
+                        "completed": len(s.completed),
+                        "plan": s.spec.plan.display(),
+                        "metrics": g.stage_metrics.get(sid, [])[:200],
+                    })
+                return self._json(stages)
+            m = re.match(r"^/api/job/([^/]+)/dot$", p)
+            if m:
+                with scheduler._jobs_lock:
+                    g = scheduler.jobs.get(m.group(1))
+                if g is None:
+                    return self._json({"error": "not found"}, 404)
+                from ballista_tpu.utils.dot import graph_to_dot
+
+                return self._send(200, graph_to_dot(g), "text/vnd.graphviz")
+            if p == "/api/metrics":
+                return self._send(200, metrics.render_prometheus(), "text/plain; version=0.0.4")
+            return self._json({"error": "not found"}, 404)
+
+        def do_POST(self):  # noqa: N802
+            m = re.match(r"^/api/job/([^/]+)/cancel$", self.path.rstrip("/"))
+            if m:
+                scheduler.cancel_job(m.group(1))
+                return self._json({"cancelled": m.group(1)})
+            return self._json({"error": "not found"}, 404)
+
+    server = ThreadingHTTPServer((host, port), Handler)
+    t = threading.Thread(target=server.serve_forever, daemon=True, name="rest-api")
+    t.start()
+    return server, server.server_port

@@ -1,0 +1,175 @@
+"""SchedulerGrpc service (reference: ballista.proto:952, grpc.rs).
+
+Hand-registered method handlers (no grpc_tools codegen in this
+environment): each rpc deserializes with the generated protobuf messages.
+Includes the wire-protocol version gate on registration/poll
+(grpc.rs:92,200) and PollWork's heartbeat+status+handout composite.
+"""
+
+from __future__ import annotations
+
+import logging
+
+import grpc
+
+from ballista_tpu.errors import BallistaError
+from ballista_tpu.proto import pb
+from ballista_tpu.scheduler.server import SchedulerServer
+from ballista_tpu.serde_control import (
+    decode_executor_metadata,
+    decode_task_status,
+    encode_job_status,
+    encode_task_definition,
+)
+
+log = logging.getLogger(__name__)
+
+SERVICE_NAME = "ballista_tpu.SchedulerGrpc"
+
+
+class SchedulerGrpcService:
+    def __init__(self, scheduler: SchedulerServer):
+        self.scheduler = scheduler
+
+    # -- client-facing -------------------------------------------------------
+
+    def ExecuteQuery(self, request: pb.ExecuteQueryParams, context) -> pb.ExecuteQueryResult:
+        session_id = request.session_id or self.scheduler.sessions.create_or_update(
+            [(kv.key, kv.value) for kv in request.settings]
+        )
+        if request.settings and request.session_id:
+            self.scheduler.sessions.create_or_update(
+                [(kv.key, kv.value) for kv in request.settings], session_id
+            )
+        which = request.WhichOneof("query")
+        if which == "sql":
+            job_id = self.scheduler.submit_sql(request.sql, session_id, request.job_name)
+        else:
+            from ballista_tpu.serde import decode_plan
+
+            plan = decode_plan(request.physical_plan)
+            job_id = self.scheduler.submit_physical_plan(plan, session_id, request.job_name)
+        return pb.ExecuteQueryResult(job_id=job_id, session_id=session_id)
+
+    def GetJobStatus(self, request: pb.GetJobStatusParams, context) -> pb.GetJobStatusResult:
+        status = self.scheduler.job_status(request.job_id)
+        out = pb.GetJobStatusResult()
+        if status is not None:
+            out.status.CopyFrom(encode_job_status(status))
+        return out
+
+    def CreateUpdateSession(self, request: pb.CreateSessionParams, context) -> pb.CreateSessionResult:
+        sid = self.scheduler.sessions.create_or_update(
+            [(kv.key, kv.value) for kv in request.settings], request.session_id
+        )
+        return pb.CreateSessionResult(session_id=sid)
+
+    def RemoveSession(self, request: pb.RemoveSessionParams, context) -> pb.RemoveSessionResult:
+        self.scheduler.sessions.remove(request.session_id)
+        return pb.RemoveSessionResult()
+
+    def CancelJob(self, request: pb.CancelJobParams, context) -> pb.CancelJobResult:
+        self.scheduler.cancel_job(request.job_id)
+        return pb.CancelJobResult(cancelled=True)
+
+    def CleanJobData(self, request: pb.CleanJobDataParams, context) -> pb.CleanJobDataResult:
+        self.scheduler.clean_job_data(request.job_id)
+        return pb.CleanJobDataResult()
+
+    def GetJobMetrics(self, request: pb.GetJobMetricsParams, context) -> pb.GetJobMetricsResult:
+        out = pb.GetJobMetricsResult()
+        with self.scheduler._jobs_lock:
+            g = self.scheduler.jobs.get(request.job_id)
+        if g is not None:
+            for sid, metrics in sorted(g.stage_metrics.items()):
+                sp = out.stages.add()
+                sp.stage_id = sid
+                for m in metrics:
+                    sp.metrics.add(
+                        name=str(m.get("name", "")), output_rows=int(m.get("output_rows", 0)),
+                        elapsed_ns=int(m.get("elapsed_ns", 0)), depth=int(m.get("depth", 0)),
+                    )
+        return out
+
+    # -- executor-facing -----------------------------------------------------
+
+    def RegisterExecutor(self, request: pb.RegisterExecutorParams, context) -> pb.RegisterExecutorResult:
+        try:
+            self.scheduler.register_executor(decode_executor_metadata(request.metadata))
+            return pb.RegisterExecutorResult(success=True)
+        except BallistaError as e:
+            self.scheduler.metrics.record_protocol_mismatch()
+            return pb.RegisterExecutorResult(success=False, error=str(e))
+
+    def HeartBeatFromExecutor(self, request: pb.HeartBeatParams, context) -> pb.HeartBeatResult:
+        known = self.scheduler.executor_heartbeat(request.executor_id)
+        return pb.HeartBeatResult(reregister=not known)
+
+    def UpdateTaskStatus(self, request: pb.UpdateTaskStatusParams, context) -> pb.UpdateTaskStatusResult:
+        meta = self.scheduler.executors.get(request.executor_id)
+        results = [
+            decode_task_status(p, meta.metadata if meta else None) for p in request.task_status
+        ]
+        self.scheduler.update_task_status(request.executor_id, results)
+        return pb.UpdateTaskStatusResult(success=True)
+
+    def PollWork(self, request: pb.PollWorkParams, context) -> pb.PollWorkResult:
+        meta = decode_executor_metadata(request.metadata)
+        results = [decode_task_status(p, meta) for p in request.task_status]
+        tasks = self.scheduler.poll_work(meta, request.can_accept_task, request.free_slots, results)
+        out = pb.PollWorkResult()
+        for t in tasks:
+            out.tasks.append(encode_task_definition(t))
+        return out
+
+    def ExecutorStopped(self, request: pb.ExecutorStoppedParams, context) -> pb.ExecutorStoppedResult:
+        from ballista_tpu.scheduler.server import Event
+
+        self.scheduler.post(Event("executor_lost", request.executor_id))
+        return pb.ExecutorStoppedResult()
+
+
+_RPCS = {
+    "ExecuteQuery": (pb.ExecuteQueryParams, pb.ExecuteQueryResult),
+    "GetJobStatus": (pb.GetJobStatusParams, pb.GetJobStatusResult),
+    "CreateUpdateSession": (pb.CreateSessionParams, pb.CreateSessionResult),
+    "RemoveSession": (pb.RemoveSessionParams, pb.RemoveSessionResult),
+    "CancelJob": (pb.CancelJobParams, pb.CancelJobResult),
+    "CleanJobData": (pb.CleanJobDataParams, pb.CleanJobDataResult),
+    "GetJobMetrics": (pb.GetJobMetricsParams, pb.GetJobMetricsResult),
+    "RegisterExecutor": (pb.RegisterExecutorParams, pb.RegisterExecutorResult),
+    "HeartBeatFromExecutor": (pb.HeartBeatParams, pb.HeartBeatResult),
+    "UpdateTaskStatus": (pb.UpdateTaskStatusParams, pb.UpdateTaskStatusResult),
+    "PollWork": (pb.PollWorkParams, pb.PollWorkResult),
+    "ExecutorStopped": (pb.ExecutorStoppedParams, pb.ExecutorStoppedResult),
+}
+
+
+def add_scheduler_service(server: grpc.Server, service: SchedulerGrpcService) -> None:
+    handlers = {}
+    for name, (req_t, _resp_t) in _RPCS.items():
+        handlers[name] = grpc.unary_unary_rpc_method_handler(
+            getattr(service, name),
+            request_deserializer=req_t.FromString,
+            response_serializer=lambda resp: resp.SerializeToString(),
+        )
+    server.add_generic_rpc_handlers((grpc.method_handlers_generic_handler(SERVICE_NAME, handlers),))
+
+
+def scheduler_stub(channel: grpc.Channel):
+    """Typed callables for every scheduler rpc."""
+
+    class Stub:
+        pass
+
+    stub = Stub()
+    for name, (req_t, resp_t) in _RPCS.items():
+        setattr(
+            stub, name,
+            channel.unary_unary(
+                f"/{SERVICE_NAME}/{name}",
+                request_serializer=req_t.SerializeToString,
+                response_deserializer=resp_t.FromString,
+            ),
+        )
+    return stub

@@ -1,0 +1,134 @@
+"""Scheduler daemon process.
+
+Rebuild of scheduler/src/scheduler_process.rs + bin/main.rs: gRPC server
+with the full SchedulerGrpc surface, push-mode task launching over gRPC to
+executors, dead-executor expiry sweep, REST API + Prometheus metrics.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import threading
+import time
+from concurrent import futures
+
+import grpc
+
+from ballista_tpu.executor.executor_server import executor_stub
+from ballista_tpu.proto import pb
+from ballista_tpu.scheduler.grpc_service import SchedulerGrpcService, add_scheduler_service
+from ballista_tpu.scheduler.metrics import InMemoryMetricsCollector
+from ballista_tpu.scheduler.server import SchedulerServer, TaskLauncher
+from ballista_tpu.scheduler.state.execution_graph import TaskDescription
+from ballista_tpu.serde_control import encode_task_definition
+
+log = logging.getLogger(__name__)
+
+EXPIRY_CHECK_S = 15.0
+
+
+class GrpcTaskLauncher(TaskLauncher):
+    """Push mode: LaunchMultiTask to the executor's gRPC endpoint
+    (reference: executor_manager.rs:406)."""
+
+    def __init__(self):
+        self._stubs: dict[str, object] = {}
+        self._lock = threading.Lock()
+
+    def _stub_for(self, addr: str):
+        with self._lock:
+            s = self._stubs.get(addr)
+            if s is None:
+                s = executor_stub(grpc.insecure_channel(addr))
+                self._stubs[addr] = s
+            return s
+
+    def launch(self, executor_id: str, tasks: list[TaskDescription], server: SchedulerServer) -> None:
+        slot = server.executors.get(executor_id)
+        if slot is None:
+            raise RuntimeError(f"unknown executor {executor_id}")
+        addr = f"{slot.metadata.host}:{slot.metadata.grpc_port}"
+        req = pb.LaunchMultiTaskParams(scheduler_id=server.scheduler_id)
+        for t in tasks:
+            tp = encode_task_definition(t)
+            cfg = server.sessions.get(t.session_id)
+            if cfg is not None:
+                for k, v in cfg.to_key_value_pairs():
+                    tp.props.add(key=k, value=v)
+            req.tasks.append(tp)
+        stub = self._stub_for(addr)
+        stub.LaunchMultiTask(req, timeout=30)
+
+
+class SchedulerProcess:
+    def __init__(self, bind_host: str = "0.0.0.0", port: int = 50050,
+                 task_distribution: str = "bias", executor_timeout_s: float = 180.0,
+                 rest_port: int = 0):
+        self.metrics = InMemoryMetricsCollector()
+        self.scheduler = SchedulerServer(
+            GrpcTaskLauncher(), self.metrics, task_distribution, executor_timeout_s
+        )
+        self.grpc_server = grpc.server(futures.ThreadPoolExecutor(max_workers=32))
+        self.service = SchedulerGrpcService(self.scheduler)
+        add_scheduler_service(self.grpc_server, self.service)
+        self.port = self.grpc_server.add_insecure_port(f"{bind_host}:{port}")
+        self._stopping = threading.Event()
+        self.rest_server = None
+        self.rest_port = 0
+        if rest_port >= 0:
+            from ballista_tpu.scheduler.api.rest import start_rest_api
+
+            self.rest_server, self.rest_port = start_rest_api(
+                self.scheduler, self.metrics, bind_host, rest_port
+            )
+
+    def start(self) -> None:
+        self.scheduler.start()
+        self.grpc_server.start()
+        threading.Thread(target=self._expiry_loop, daemon=True, name="executor-expiry").start()
+        log.info("scheduler up: grpc=%d rest=%s", self.port, self.rest_port or "off")
+
+    def _expiry_loop(self) -> None:
+        while not self._stopping.wait(EXPIRY_CHECK_S):
+            self.scheduler.check_expired_executors()
+
+    def shutdown(self) -> None:
+        self._stopping.set()
+        self.scheduler.stop()
+        self.grpc_server.stop(grace=2)
+        if self.rest_server is not None:
+            self.rest_server.shutdown()
+
+    def wait(self) -> None:
+        try:
+            while not self._stopping.wait(1.0):
+                pass
+        except KeyboardInterrupt:
+            self.shutdown()
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(description="ballista_tpu scheduler daemon")
+    ap.add_argument("--bind-host", default="0.0.0.0")
+    ap.add_argument("--port", type=int, default=50050)
+    ap.add_argument("--rest-port", type=int, default=50080)
+    ap.add_argument("--task-distribution", choices=("bias", "round-robin"), default="bias")
+    ap.add_argument("--executor-timeout-seconds", type=float, default=180.0)
+    ap.add_argument("--log-level", default="INFO")
+    args = ap.parse_args(argv)
+    logging.basicConfig(level=args.log_level, format="%(asctime)s %(levelname)s %(name)s %(message)s")
+
+    proc = SchedulerProcess(
+        args.bind_host, args.port,
+        "round_robin" if args.task_distribution == "round-robin" else "bias",
+        args.executor_timeout_seconds, args.rest_port,
+    )
+    signal.signal(signal.SIGTERM, lambda *_: proc.shutdown())
+    proc.start()
+    proc.wait()
+
+
+if __name__ == "__main__":
+    main()

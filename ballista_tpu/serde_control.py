@@ -1,0 +1,134 @@
+"""Control-plane message serde: dataclasses ↔ protobuf.
+
+Covers TaskDefinition/TaskStatus/ExecutorMetadata/JobStatus — the messages
+the SchedulerGrpc and ExecutorGrpc services exchange (reference:
+serde/scheduler/{to,from}_proto.rs).
+"""
+
+from __future__ import annotations
+
+from ballista_tpu.executor.executor import ExecutorMetadata, TaskResult
+from ballista_tpu.proto import pb
+from ballista_tpu.scheduler.state.execution_graph import TaskDescription
+from ballista_tpu.serde import (
+    decode_location,
+    decode_plan,
+    decode_schema,
+    encode_location,
+    encode_plan,
+    encode_schema,
+)
+
+
+def encode_executor_metadata(m: ExecutorMetadata) -> pb.ExecutorMetadataProto:
+    return pb.ExecutorMetadataProto(
+        id=m.id, host=m.host, grpc_port=m.grpc_port, flight_port=m.flight_port,
+        vcores=m.vcores, wire_version=m.wire_version,
+    )
+
+
+def decode_executor_metadata(p: pb.ExecutorMetadataProto) -> ExecutorMetadata:
+    return ExecutorMetadata(
+        id=p.id, host=p.host, grpc_port=p.grpc_port, flight_port=p.flight_port,
+        vcores=p.vcores, wire_version=p.wire_version,
+    )
+
+
+def encode_task_definition(t: TaskDescription) -> pb.TaskDefinitionProto:
+    out = pb.TaskDefinitionProto(
+        task_id=t.task_id, job_id=t.job_id, stage_id=t.stage_id,
+        stage_attempt=t.stage_attempt, session_id=t.session_id,
+    )
+    out.partitions.extend(t.partitions)
+    out.plan.CopyFrom(encode_plan(t.plan))
+    return out
+
+
+def decode_task_definition(p: pb.TaskDefinitionProto) -> TaskDescription:
+    return TaskDescription(
+        job_id=p.job_id, stage_id=p.stage_id, stage_attempt=p.stage_attempt,
+        task_id=p.task_id, partitions=list(p.partitions),
+        plan=decode_plan(p.plan), session_id=p.session_id,
+    )
+
+
+def encode_task_status(r: TaskResult, executor_id: str) -> pb.TaskStatusProto:
+    out = pb.TaskStatusProto(
+        task_id=r.task_id, job_id=r.job_id, stage_id=r.stage_id,
+        stage_attempt=r.stage_attempt, executor_id=executor_id,
+        state=r.state, error=r.error, error_kind=r.error_kind, retryable=r.retryable,
+    )
+    out.partitions.extend(r.partitions)
+    for l in r.locations:
+        out.shuffle_partitions.append(
+            pb.ShuffleWritePartitionProto(
+                output_partition=l.output_partition, path=l.path,
+                num_rows=l.stats.num_rows, num_bytes=l.stats.num_bytes, layout=l.layout,
+                map_partition=l.map_partition,
+            )
+        )
+    for m in r.metrics or []:
+        out.metrics.append(
+            pb.OperatorMetricProto(
+                name=str(m.get("name", "")), output_rows=int(m.get("output_rows", 0)),
+                elapsed_ns=int(m.get("elapsed_ns", 0)), depth=int(m.get("depth", 0)),
+            )
+        )
+    if r.locations:
+        out.map_partition = r.locations[0].map_partition
+    return out
+
+
+def decode_task_status(p: pb.TaskStatusProto, executor_meta: ExecutorMetadata | None) -> TaskResult:
+    from ballista_tpu.shuffle.types import PartitionLocation, PartitionStats
+
+    locations = []
+    if p.state == "success" and executor_meta is not None:
+        for sp in p.shuffle_partitions:
+            locations.append(
+                PartitionLocation(
+                    map_partition=sp.map_partition,
+                    job_id=p.job_id, stage_id=p.stage_id,
+                    output_partition=sp.output_partition,
+                    executor_id=executor_meta.id, host=executor_meta.host,
+                    flight_port=executor_meta.flight_port, path=sp.path,
+                    layout=sp.layout or "hash",
+                    stats=PartitionStats(num_rows=sp.num_rows, num_bytes=sp.num_bytes),
+                )
+            )
+    return TaskResult(
+        task_id=p.task_id, job_id=p.job_id, stage_id=p.stage_id,
+        stage_attempt=p.stage_attempt, partitions=list(p.partitions),
+        state=p.state, locations=locations, error=p.error,
+        error_kind=p.error_kind, retryable=p.retryable,
+        metrics=[
+            {"name": m.name, "output_rows": m.output_rows, "elapsed_ns": m.elapsed_ns, "depth": m.depth}
+            for m in p.metrics
+        ],
+    )
+
+
+def encode_job_status(status: dict) -> pb.JobStatusProto:
+    out = pb.JobStatusProto(
+        job_id=status["job_id"], job_name=status.get("job_name", ""),
+        state=status["state"], error=status.get("error", ""),
+        completed_stages=status.get("completed_stages", 0),
+        total_stages=status.get("total_stages", 0),
+    )
+    if status.get("schema") is not None:
+        out.schema.CopyFrom(encode_schema(status["schema"]))
+    for l in status.get("partitions", []) or []:
+        out.partitions.append(encode_location(l))
+    return out
+
+
+def decode_job_status(p: pb.JobStatusProto) -> dict:
+    out = {
+        "job_id": p.job_id, "job_name": p.job_name, "state": p.state,
+        "error": p.error, "completed_stages": p.completed_stages,
+        "total_stages": p.total_stages,
+        "partitions": [decode_location(l) for l in p.partitions],
+    }
+    if p.HasField("schema"):
+        out["schema"] = decode_schema(p.schema)
+    return out
