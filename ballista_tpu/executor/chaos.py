@@ -1,0 +1,82 @@
+"""Chaos fault injection.
+
+Rebuild of ChaosExec + ChaosCreatingRule (core/src/execution_plans/
+chaos_exec.rs:49, scheduler/src/state/aqe/optimizer_rule/chaos_exec.rs:58):
+when `ballista.chaos.enabled` is on, the executor's engine seam wraps every
+leaf operator in a ChaosExec that — with seeded probability — injects a
+transient error (retryable), a fatal error, a panic (non-BallistaError
+exception), or a delay. Robustness tests run real queries under injected
+failures and assert the retry machinery converges.
+
+Determinism: the RNG seed mixes (config seed, job, stage, partition,
+attempt) so a retried task sees DIFFERENT luck — exactly what makes
+transient-fault tests terminate.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import time
+from typing import Iterator
+
+from ballista_tpu.config import (
+    CHAOS_ENABLED,
+    CHAOS_MODE,
+    CHAOS_PROBABILITY,
+    CHAOS_SEED,
+    BallistaConfig,
+)
+from ballista_tpu.errors import ExecutionError
+from ballista_tpu.plan.physical import ExecutionPlan, TaskContext
+
+
+class ChaosExec(ExecutionPlan):
+    def __init__(self, input: ExecutionPlan, seed: int, probability: float, mode: str,
+                 stage_attempt: int = 0):
+        super().__init__(input.df_schema)
+        self.input = input
+        self.seed = seed
+        self.probability = probability
+        self.mode = mode
+        self.stage_attempt = stage_attempt
+
+    def children(self):
+        return [self.input]
+
+    def with_children(self, c):
+        return ChaosExec(c[0], self.seed, self.probability, self.mode, self.stage_attempt)
+
+    def node_str(self) -> str:
+        return f"ChaosExec: mode={self.mode} p={self.probability}"
+
+    def execute(self, partition: int, ctx: TaskContext) -> Iterator:
+        h = hashlib.sha256(
+            f"{self.seed}|{ctx.task_id}|{partition}|{self.stage_attempt}".encode()
+        ).digest()
+        roll = int.from_bytes(h[:8], "big") / 2**64
+        if roll < self.probability:
+            if self.mode == "transient":
+                raise ExecutionError(f"chaos: injected transient failure (roll={roll:.4f})", retryable=True)
+            if self.mode == "fatal":
+                raise ExecutionError(f"chaos: injected fatal failure (roll={roll:.4f})", retryable=False)
+            if self.mode == "panic":
+                raise RuntimeError("chaos: injected panic")
+            if self.mode == "delay":
+                time.sleep(0.2)
+        return self.input.execute(partition, ctx)
+
+
+def maybe_inject_chaos(plan: ExecutionPlan, config: BallistaConfig, stage_attempt: int = 0) -> ExecutionPlan:
+    if not bool(config.get(CHAOS_ENABLED)):
+        return plan
+    seed = int(config.get(CHAOS_SEED))
+    prob = float(config.get(CHAOS_PROBABILITY))
+    mode = str(config.get(CHAOS_MODE))
+
+    def walk(n: ExecutionPlan) -> ExecutionPlan:
+        kids = n.children()
+        if not kids:
+            return ChaosExec(n, seed, prob, mode, stage_attempt)
+        return n.with_children([walk(c) for c in kids])
+
+    return walk(plan)

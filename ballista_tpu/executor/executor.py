@@ -63,7 +63,11 @@ class TaskResult:
 class ExecutionEngine:
     """THE seam (execution_engine.rs:51): prepare a stage plan to run here."""
 
-    def create_query_stage_exec(self, plan: ExecutionPlan, config: BallistaConfig) -> ExecutionPlan:
+    def create_query_stage_exec(self, plan: ExecutionPlan, config: BallistaConfig,
+                                stage_attempt: int = 0) -> ExecutionPlan:
+        from ballista_tpu.executor.chaos import maybe_inject_chaos
+
+        plan = maybe_inject_chaos(plan, config, stage_attempt)
         engine = str(config.get(EXECUTOR_ENGINE))
         if engine == "tpu":
             from ballista_tpu.engine.tpu_engine import maybe_compile_tpu
@@ -110,7 +114,7 @@ class Executor:
         try:
             plan = task.plan
             assert isinstance(plan, ShuffleWriterExec), f"stage root must be a shuffle writer: {plan}"
-            prepared = self.engine.create_query_stage_exec(plan, cfg)
+            prepared = self.engine.create_query_stage_exec(plan, cfg, task.stage_attempt)
             locations: list[PartitionLocation] = []
             for p in task.partitions:
                 if self._is_cancelled(task.job_id, task.stage_id):

@@ -1,0 +1,203 @@
+"""Adaptive query execution: replan stages with runtime statistics.
+
+Rebuild of the reference's AQE subsystem (scheduler/src/state/aqe/), scoped
+to its three headline optimizations, applied when a stage RESOLVES (all
+inputs finished, actual per-partition stats in hand):
+
+- PropagateEmptyExecRule: an inner join whose build or probe input produced
+  ZERO rows collapses to an EmptyExec subtree (semi joins likewise; anti
+  joins with an empty right side collapse to their left input).
+- CoalescePartitionsRule: post-shuffle reduce partitions are bin-packed to
+  `ballista.planner.adaptive.coalesce.target.bytes` — ONE group assignment
+  per stage (computed over the summed sizes of every hash input) so
+  co-partitioned join sides stay aligned (coalesce/algorithm.rs).
+- SelectJoinRule (dynamic join selection): a partitioned inner join whose
+  build side turned out tiny is rewritten to CollectLeft with a broadcast
+  reader, skipping the per-partition build (join swap by ACTUAL sizes, not
+  estimates). Build-side-emitting join types keep partitioned mode — the
+  correctness constraint from the physical planner applies at runtime too.
+
+The reference plans stages incrementally (AdaptivePlanner::replan_stages);
+this build plans statically and rewrites at resolution — same signals,
+same rewrites, one fewer moving part. Incremental planning is the round-2
+item that also unlocks probe-side-shuffle elision.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass
+
+from ballista_tpu.config import (
+    AQE_COALESCE_MERGED_FACTOR,
+    AQE_DYNAMIC_JOIN_SELECTION,
+    AQE_EMPTY_PROPAGATION,
+    AQE_MIN_PARTITION_BYTES,
+    AQE_TARGET_PARTITION_BYTES,
+    BROADCAST_JOIN_ROWS_THRESHOLD,
+    PLANNER_ADAPTIVE_ENABLED,
+    BallistaConfig,
+)
+from ballista_tpu.plan.physical import EmptyExec, ExecutionPlan, HashJoinExec
+from ballista_tpu.shuffle.reader import ShuffleReaderExec
+
+log = logging.getLogger(__name__)
+
+
+def coalesce_groups(sizes: list[int], target: int, min_bytes: int, merged_factor: float) -> list[list[int]]:
+    """Bin-pack contiguous reduce partitions by byte size.
+
+    Greedy sequential packing to `target` bytes with a slack factor; a
+    trailing small group merges backwards (the reference's merged-factor +
+    small-tail refinements, aqe/coalesce/algorithm.rs)."""
+    if not sizes:
+        return []
+    groups: list[list[int]] = []
+    cur: list[int] = []
+    cur_bytes = 0
+    for i, s in enumerate(sizes):
+        if cur and cur_bytes + s > target * merged_factor:
+            groups.append(cur)
+            cur, cur_bytes = [], 0
+        cur.append(i)
+        cur_bytes += s
+    if cur:
+        tail_bytes = sum(sizes[i] for i in cur)
+        if groups and tail_bytes < min_bytes:
+            groups[-1].extend(cur)
+        else:
+            groups.append(cur)
+    return groups
+
+
+@dataclass
+class InputStageStats:
+    stage_id: int
+    total_rows: int
+    total_bytes: int
+    bucket_bytes: list[int]  # per output partition
+    broadcast: bool
+
+
+def apply_aqe(plan: ExecutionPlan, input_stats: dict[int, InputStageStats],
+              config: BallistaConfig) -> tuple[ExecutionPlan, int | None]:
+    """Rewrite a freshly-resolved stage plan using actual input statistics.
+
+    `plan` has concrete ShuffleReaderExec leaves tagged with their source
+    stage id (set by the graph at resolution). Returns (new_plan,
+    coalesced_partition_count or None).
+    """
+    if not bool(config.get(PLANNER_ADAPTIVE_ENABLED)):
+        return plan, None
+
+    if bool(config.get(AQE_EMPTY_PROPAGATION)):
+        plan = _propagate_empty(plan, input_stats)
+
+    if bool(config.get(AQE_DYNAMIC_JOIN_SELECTION)):
+        plan = _select_joins(plan, input_stats, config)
+
+    new_parts = None
+    target = int(config.get(AQE_TARGET_PARTITION_BYTES))
+    min_b = int(config.get(AQE_MIN_PARTITION_BYTES))
+    factor = float(config.get(AQE_COALESCE_MERGED_FACTOR))
+    hash_inputs = [
+        s for s in input_stats.values() if not s.broadcast and len(s.bucket_bytes) > 1
+    ]
+    readers = _hash_readers(plan)
+    if hash_inputs and readers and all(
+        len(r.partition_locations) == len(hash_inputs[0].bucket_bytes) for r in readers
+    ):
+        k = len(hash_inputs[0].bucket_bytes)
+        combined = [0] * k
+        for s in hash_inputs:
+            if len(s.bucket_bytes) == k:
+                for i, b in enumerate(s.bucket_bytes):
+                    combined[i] += b
+        groups = coalesce_groups(combined, target, min_b, factor)
+        if 0 < len(groups) < k:
+            for r in readers:
+                r.partition_locations = [
+                    [loc for i in g for loc in r.partition_locations[i]] for g in groups
+                ]
+            new_parts = len(groups)
+            log.info("AQE coalesced %d reduce partitions into %d groups", k, len(groups))
+    return plan, new_parts
+
+
+def _hash_readers(plan: ExecutionPlan) -> list[ShuffleReaderExec]:
+    out = []
+
+    def walk(n, under_collect_build=False):
+        if isinstance(n, ShuffleReaderExec) and not n.broadcast and not under_collect_build:
+            out.append(n)
+        if isinstance(n, HashJoinExec) and n.mode == "collect_left":
+            walk(n.left, True)
+            walk(n.right, under_collect_build)
+            return
+        for c in n.children():
+            walk(c, under_collect_build)
+
+    walk(plan)
+    return out
+
+
+def _stats_of(reader: ShuffleReaderExec, input_stats: dict[int, InputStageStats]):
+    sid = getattr(reader, "source_stage_id", None)
+    return input_stats.get(sid) if sid is not None else None
+
+
+def _propagate_empty(plan: ExecutionPlan, input_stats) -> ExecutionPlan:
+    def is_empty(n: ExecutionPlan) -> bool:
+        if isinstance(n, ShuffleReaderExec):
+            s = _stats_of(n, input_stats)
+            return s is not None and s.total_rows == 0
+        if isinstance(n, EmptyExec):
+            return not n.produce_one_row
+        return False
+
+    def walk(n: ExecutionPlan) -> ExecutionPlan:
+        kids = n.children()
+        if kids:
+            n = n.with_children([walk(c) for c in kids])
+        if isinstance(n, HashJoinExec):
+            l_empty, r_empty = is_empty(n.left), is_empty(n.right)
+            jt = n.join_type
+            if jt == "inner" and (l_empty or r_empty):
+                return EmptyExec(n.df_schema, False)
+            if jt in ("left_semi", "right_semi") and (l_empty or r_empty):
+                return EmptyExec(n.df_schema, False)
+            if jt == "left_anti" and r_empty:
+                return n.left  # nothing to subtract: pass the build side through
+            if jt == "right_anti" and l_empty:
+                return n.right
+        return n
+
+    return walk(plan)
+
+
+def _select_joins(plan: ExecutionPlan, input_stats, config: BallistaConfig) -> ExecutionPlan:
+    rows_threshold = int(config.get(BROADCAST_JOIN_ROWS_THRESHOLD))
+
+    def walk(n: ExecutionPlan) -> ExecutionPlan:
+        kids = n.children()
+        if kids:
+            n = n.with_children([walk(c) for c in kids])
+        if (
+            isinstance(n, HashJoinExec)
+            and n.mode == "partitioned"
+            and n.join_type in ("inner", "right", "right_semi", "right_anti")
+            and isinstance(n.left, ShuffleReaderExec)
+        ):
+            s = _stats_of(n.left, input_stats)
+            if s is not None and s.total_rows <= rows_threshold // 8:
+                bcast = ShuffleReaderExec(n.left.df_schema, n.left.partition_locations, broadcast=True)
+                bcast.source_stage_id = getattr(n.left, "source_stage_id", None)
+                log.info(
+                    "AQE join selection: build side has %d rows → CollectLeft broadcast", s.total_rows
+                )
+                return HashJoinExec(
+                    bcast, n.right, n.on, n.join_type, n.filter, "collect_left", n.df_schema
+                )
+        return n
+
+    return walk(plan)

@@ -76,6 +76,7 @@ class ExecutionStage:
         self.attempt = 0
         self.resolved_plan = stage.plan if not stage.input_stage_ids else None
         self.pending: list[int] = list(range(stage.partitions))
+        self.effective_partitions = stage.partitions  # may shrink via AQE coalescing
         self.running: dict[int, RunningTask] = {}
         # map_partition → locations published by the finished task
         self.completed: dict[int, list[PartitionLocation]] = {}
@@ -87,11 +88,12 @@ class ExecutionStage:
         return self.state in (StageState.RESOLVED, StageState.RUNNING) and bool(self.pending)
 
     def all_done(self) -> bool:
-        return not self.pending and not self.running and len(self.completed) == self.spec.partitions
+        return not self.pending and not self.running and len(self.completed) == self.effective_partitions
 
     def reset_for_retry(self) -> None:
         self.attempt += 1
         self.pending = list(range(self.spec.partitions))
+        self.effective_partitions = self.spec.partitions
         self.running.clear()
         self.completed.clear()
         self.state = StageState.UNRESOLVED if self.spec.input_stage_ids else StageState.RESOLVED
@@ -217,7 +219,31 @@ class ExecutionGraph:
         resolved: dict[int, ShuffleReaderExec] = {}
         for inp in inputs:
             resolved[inp.stage_id] = self._build_reader(inp)
-        stage.resolved_plan = remove_unresolved_shuffles(stage.spec.plan, resolved)
+        plan = remove_unresolved_shuffles(stage.spec.plan, resolved)
+
+        # adaptive replanning with the inputs' ACTUAL statistics
+        from ballista_tpu.scheduler.aqe.rules import InputStageStats, apply_aqe
+
+        stats: dict[int, InputStageStats] = {}
+        for inp in inputs:
+            locs = inp.output_locations()
+            k = max(1, inp.spec.output_partitions)
+            buckets = [0] * k
+            for l in locs:
+                if l.output_partition < k:
+                    buckets[l.output_partition] += l.stats.num_bytes
+            stats[inp.stage_id] = InputStageStats(
+                stage_id=inp.stage_id,
+                total_rows=sum(l.stats.num_rows for l in locs),
+                total_bytes=sum(l.stats.num_bytes for l in locs),
+                bucket_bytes=buckets,
+                broadcast=inp.spec.broadcast,
+            )
+        plan, new_parts = apply_aqe(plan, stats, self.config)
+        stage.resolved_plan = plan
+        if new_parts is not None and new_parts < stage.spec.partitions:
+            stage.pending = list(range(new_parts))
+            stage.effective_partitions = new_parts
         stage.state = StageState.RESOLVED
 
     def _build_reader(self, inp: ExecutionStage) -> ShuffleReaderExec:
@@ -227,7 +253,9 @@ class ExecutionGraph:
         for l in locs:
             by_output[l.output_partition].append(l)
         schema = inp.spec.plan.input.df_schema
-        return ShuffleReaderExec(schema, by_output, broadcast=inp.spec.broadcast)
+        reader = ShuffleReaderExec(schema, by_output, broadcast=inp.spec.broadcast)
+        reader.source_stage_id = inp.stage_id  # AQE stats lookup tag
+        return reader
 
     def _fail_job(self, error: str) -> None:
         self.status = JobState.FAILED

@@ -1,0 +1,116 @@
+"""AQE + chaos tests (reference: scheduler/src/state/aqe/test/,
+chaos robustness runs)."""
+
+import pyarrow as pa
+import pytest
+
+from ballista_tpu.config import (
+    AQE_TARGET_PARTITION_BYTES,
+    BallistaConfig,
+    CHAOS_ENABLED,
+    CHAOS_MODE,
+    CHAOS_PROBABILITY,
+    CHAOS_SEED,
+    DEFAULT_SHUFFLE_PARTITIONS,
+    PLANNER_ADAPTIVE_ENABLED,
+)
+from ballista_tpu.scheduler.aqe.rules import coalesce_groups
+from ballista_tpu.testing.reference import compare_results, run_reference
+
+from .conftest import tpch_query
+
+
+def test_coalesce_groups_binpack():
+    # 8 buckets of 10 bytes, target 35 → 3 groups
+    groups = coalesce_groups([10] * 8, 35, 5, 1.2)
+    assert [len(g) for g in groups] == [4, 4]
+    # skewed: big bucket alone, small ones packed
+    groups = coalesce_groups([100, 1, 1, 1, 100, 1], 50, 2, 1.0)
+    flat = [i for g in groups for i in g]
+    assert flat == list(range(6))
+    # tiny tail merges backwards
+    groups = coalesce_groups([40, 40, 1], 45, 5, 1.0)
+    assert groups[-1][-1] == 2 and len(groups) == 2
+
+
+def test_aqe_coalescing_end_to_end(tpch_dir, tpch_ref_tables):
+    """Large shuffle partition count + tiny data → AQE shrinks reduce tasks."""
+    from ballista_tpu.client.context import SessionContext
+    from ballista_tpu.testing.tpchgen import register_tpch
+
+    cfg = BallistaConfig({
+        DEFAULT_SHUFFLE_PARTITIONS: 16,
+        PLANNER_ADAPTIVE_ENABLED: True,
+        AQE_TARGET_PARTITION_BYTES: 1 << 30,  # everything packs into one group
+    })
+    ctx = SessionContext.standalone(cfg, num_executors=1, vcores=4)
+    register_tpch(ctx, tpch_dir)
+    try:
+        eng = ctx.sql(tpch_query(3)).collect()
+        problems = compare_results(eng, run_reference(3, tpch_ref_tables), 3)
+        assert not problems, "\n".join(problems)
+        # at least one stage must have been coalesced below 16 partitions
+        sched = ctx._cluster.scheduler
+        with sched._jobs_lock:
+            g = list(sched.jobs.values())[-1]
+        coalesced = [
+            s for s in g.stages.values()
+            if s.effective_partitions < s.spec.partitions
+        ]
+        assert coalesced, g.display()
+    finally:
+        ctx.shutdown()
+
+
+def test_aqe_empty_propagation(tpch_dir):
+    from ballista_tpu.client.context import SessionContext
+    from ballista_tpu.testing.tpchgen import register_tpch
+
+    cfg = BallistaConfig({PLANNER_ADAPTIVE_ENABLED: True, DEFAULT_SHUFFLE_PARTITIONS: 4})
+    ctx = SessionContext.standalone(cfg, num_executors=1)
+    register_tpch(ctx, tpch_dir)
+    try:
+        # impossible predicate → empty side → inner join prunes to empty
+        out = ctx.sql(
+            "select n_name, r_name from nation join region on n_regionkey = r_regionkey "
+            "where r_name = 'NOWHERE'"
+        ).collect()
+        assert out.num_rows == 0
+    finally:
+        ctx.shutdown()
+
+
+def test_chaos_transient_retries_converge(tpch_dir, tpch_ref_tables):
+    """Transient injected failures must be retried to a correct result."""
+    from ballista_tpu.client.context import SessionContext
+    from ballista_tpu.testing.tpchgen import register_tpch
+
+    cfg = BallistaConfig({
+        CHAOS_ENABLED: True, CHAOS_MODE: "transient", CHAOS_PROBABILITY: 0.25,
+        CHAOS_SEED: 7, DEFAULT_SHUFFLE_PARTITIONS: 4,
+    })
+    ctx = SessionContext.standalone(cfg, num_executors=1, vcores=4)
+    register_tpch(ctx, tpch_dir)
+    try:
+        eng = ctx.sql(tpch_query(6)).collect()
+        problems = compare_results(eng, run_reference(6, tpch_ref_tables), 6)
+        assert not problems, "\n".join(problems)
+    finally:
+        ctx.shutdown()
+
+
+def test_chaos_fatal_fails_job(tpch_dir):
+    from ballista_tpu.client.context import SessionContext
+    from ballista_tpu.errors import ExecutionError
+    from ballista_tpu.testing.tpchgen import register_tpch
+
+    cfg = BallistaConfig({
+        CHAOS_ENABLED: True, CHAOS_MODE: "fatal", CHAOS_PROBABILITY: 1.0,
+    })
+    ctx = SessionContext.standalone(cfg, num_executors=1)
+    register_tpch(ctx, tpch_dir)
+    try:
+        with pytest.raises(ExecutionError, match="chaos"):
+            ctx.sql("select count(*) from lineitem").collect()
+    finally:
+        ctx.shutdown()
