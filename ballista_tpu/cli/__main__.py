@@ -1,0 +1,3 @@
+from ballista_tpu.cli.main import main
+
+main()

@@ -102,3 +102,30 @@ def hash_arrays(arrays: list[pa.Array]) -> np.ndarray:
 def partition_indices(arrays: list[pa.Array], num_partitions: int) -> np.ndarray:
     """Row → output partition id (uint64 % K, same as the jax kernel)."""
     return (hash_arrays(arrays) % np.uint64(num_partitions)).astype(np.int64)
+
+
+def split_batch_by_partition(batch: pa.RecordBatch, key_arrays: list[pa.Array], k: int):
+    """Route a batch's rows into K partition sub-batches in one pass.
+
+    Uses the native C++ router (hash + counting-sort grouping, then a single
+    Arrow take + zero-copy slices) when available; numpy otherwise.
+    Yields (partition_id, sub_batch) for non-empty partitions.
+    """
+    from ballista_tpu.ops import native
+
+    h = native.hash_arrays_native(key_arrays)
+    if h is None:
+        h = hash_arrays(key_arrays)
+    routed = native.route_native(h, k)
+    if routed is not None:
+        _, bounds, order = routed
+        taken = batch.take(pa.array(order))
+        for p in range(k):
+            n = int(bounds[p + 1] - bounds[p])
+            if n:
+                yield p, taken.slice(int(bounds[p]), n)
+        return
+    pids = (h % np.uint64(k)).astype(np.int64)
+    for p in np.unique(pids):
+        sel = np.nonzero(pids == p)[0]
+        yield int(p), batch.take(pa.array(sel))

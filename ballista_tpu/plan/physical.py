@@ -290,6 +290,10 @@ class EmptyExec(ExecutionPlan):
     def execute(self, partition: int, ctx: TaskContext):
         schema = self.schema()
         if self.produce_one_row:
+            if len(schema) == 0:
+                # a 1-row batch needs at least one column in Arrow; SELECTs
+                # without FROM project literals over this placeholder
+                schema = pa.schema([pa.field("__placeholder", pa.null())])
             arrays = [pa.nulls(1, f.type) for f in schema]
             return iter([pa.RecordBatch.from_arrays(arrays, schema=schema)])
         return iter([_empty_batch(schema)])
@@ -1018,12 +1022,11 @@ class RepartitionExec(ExecutionPlan):
                         outs[rr % self.n].append(b)
                         rr += 1
                     else:
+                        from ballista_tpu.ops.hashing import split_batch_by_partition
+
                         key_arrays = [evaluate_to_array(k, b) for k in bound]
-                        pids = partition_indices(key_arrays, self.n)
-                        for k in range(self.n):
-                            sel = np.nonzero(pids == k)[0]
-                            if len(sel):
-                                outs[k].append(b.take(pa.array(sel)))
+                        for k, part in split_batch_by_partition(b, key_arrays, self.n):
+                            outs[k].append(part)
             self._cache = outs
             return outs
 

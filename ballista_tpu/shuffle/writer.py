@@ -164,17 +164,16 @@ class ShuffleWriterExec(ExecutionPlan):
             buffered -= sum(b.nbytes for b in buckets[k])
             buckets[k] = []
 
+        from ballista_tpu.ops.hashing import split_batch_by_partition
+
         for b in self.input.execute(map_partition, ctx):
             if b.num_rows == 0:
                 continue
             key_arrays = [evaluate_to_array(kb, b) for kb in bound]
-            pids = partition_indices(key_arrays, K)
-            for k in np.unique(pids):
-                sel = np.nonzero(pids == k)[0]
-                part = b.take(pa.array(sel))
-                buckets[int(k)].append(part)
-                bucket_rows[int(k)] += part.num_rows
-                bucket_batches[int(k)] += 1
+            for k, part in split_batch_by_partition(b, key_arrays, K):
+                buckets[k].append(part)
+                bucket_rows[k] += part.num_rows
+                bucket_batches[k] += 1
                 buffered += part.nbytes
             while limit and buffered > limit:
                 spill_largest()

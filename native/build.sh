@@ -1,0 +1,5 @@
+#!/bin/sh
+# Build the native runtime components → native/libballista_native.so
+cd "$(dirname "$0")"
+g++ -O3 -march=native -shared -fPIC -o libballista_native.so row_router.cpp
+echo "built $(pwd)/libballista_native.so"

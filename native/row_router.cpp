@@ -1,0 +1,104 @@
+// Shuffle row router: the host-side hot loop of the shuffle writer.
+//
+// Native rebuild of the role ballista's Rust repartitioner plays inside
+// ShuffleWriterExec (reference: core/src/execution_plans/shuffle_writer.rs
+// hash-repartitioning of record batches): computes the engine-wide row hash
+// (splitmix64 per column + boost-style combine + FNV-1a for strings — the
+// SAME bit contract as ballista_tpu/ops/hashing.py and the jax twin in
+// ops/tpu/kernels.py) and builds partition-grouped selection vectors in ONE
+// pass, so the Python writer does a single Arrow take() and slices.
+//
+// Exposed as a C ABI for ctypes (no pybind11 in this environment).
+// Build: native/build.sh (g++ -O3 -shared -fPIC).
+
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+static inline uint64_t splitmix64(uint64_t x) {
+    x += 0x9E3779B97F4A7C15ULL;
+    x = (x ^ (x >> 30)) * 0xBF58476D1CE4E5B9ULL;
+    x = (x ^ (x >> 27)) * 0x94D049BB133111EBULL;
+    return x ^ (x >> 31);
+}
+
+static inline uint64_t hash_combine(uint64_t h, uint64_t v) {
+    return h ^ (v + 0x9E3779B97F4A7C15ULL + (h << 6) + (h >> 2));
+}
+
+static const uint64_t NULL_TAG = 0x9E3779B97F4A7C15ULL;
+
+// mix an int64-encoded column into the running row hashes.
+// valid: optional validity bytes (1 = valid), may be null.
+void hash_mix_i64(uint64_t* h, const int64_t* v, const uint8_t* valid, int64_t n) {
+    if (valid == nullptr) {
+        for (int64_t i = 0; i < n; i++)
+            h[i] = hash_combine(h[i], splitmix64((uint64_t)v[i]));
+    } else {
+        for (int64_t i = 0; i < n; i++) {
+            uint64_t hv = valid[i] ? splitmix64((uint64_t)v[i]) : NULL_TAG;
+            h[i] = hash_combine(h[i], hv);
+        }
+    }
+}
+
+// mix a float64 column (normalizing -0.0 → 0.0 like the host hasher)
+void hash_mix_f64(uint64_t* h, const double* v, const uint8_t* valid, int64_t n) {
+    for (int64_t i = 0; i < n; i++) {
+        uint64_t hv;
+        if (valid != nullptr && !valid[i]) {
+            hv = NULL_TAG;
+        } else {
+            double d = v[i] == 0.0 ? 0.0 : v[i];
+            uint64_t bits;
+            std::memcpy(&bits, &d, 8);
+            hv = splitmix64(bits);
+        }
+        h[i] = hash_combine(h[i], hv);
+    }
+}
+
+// float64 column hashed under the int64 contract is not a case the engine
+// produces; kept out deliberately.
+
+// mix a utf8/binary column: FNV-1a over each row's bytes
+void hash_mix_bytes(uint64_t* h, const uint8_t* data, const int64_t* offsets,
+                    const uint8_t* valid, int64_t n) {
+    for (int64_t i = 0; i < n; i++) {
+        uint64_t hv;
+        if (valid != nullptr && !valid[i]) {
+            hv = NULL_TAG;
+        } else {
+            uint64_t f = 0xCBF29CE484222325ULL;
+            for (int64_t j = offsets[i]; j < offsets[i + 1]; j++)
+                f = (f ^ data[j]) * 0x100000001B3ULL;
+            // the host hasher treats the FNV value as the column's int64
+            // encoding and splitmix-finalizes it — match exactly
+            hv = splitmix64(f);
+        }
+        h[i] = hash_combine(h[i], hv);
+    }
+}
+
+// route rows: pids[i] = h[i] % k; order = row indices grouped by partition
+// (stable within a partition); bounds[p]..bounds[p+1] delimit partition p
+// inside order. Returns 0.
+int route(const uint64_t* h, int64_t n, uint32_t k, uint32_t* pids,
+          int64_t* bounds /* k+1 */, uint32_t* order /* n */) {
+    for (uint32_t p = 0; p <= k; p++) bounds[p] = 0;
+    for (int64_t i = 0; i < n; i++) {
+        uint32_t p = (uint32_t)(h[i] % k);
+        pids[i] = p;
+        bounds[p + 1]++;
+    }
+    for (uint32_t p = 0; p < k; p++) bounds[p + 1] += bounds[p];
+    // stable counting-sort placement
+    int64_t* cursor = new int64_t[k];
+    for (uint32_t p = 0; p < k; p++) cursor[p] = bounds[p];
+    for (int64_t i = 0; i < n; i++) order[cursor[pids[i]]++] = (uint32_t)i;
+    delete[] cursor;
+    return 0;
+}
+
+}  // extern "C"
