@@ -109,6 +109,23 @@ class PhysicalPlanner:
             return CrossJoinExec(left, right, node.schema)
         if isinstance(node, Sort):
             child = self._plan(node.input)
+            # large full sorts scale out via the dynamic range-repartition
+            # pipeline: stats tap → dam → quantile-cut router → per-range
+            # sorts whose in-order concatenation IS the total order
+            big = estimate_rows(node.input) > 2_000_000
+            if node.fetch is None and big and child.output_partition_count() > 1 and node.keys:
+                from ballista_tpu.ops.cpu.range_repartition import (
+                    BufferExec,
+                    RuntimeStatsExec,
+                    UnorderedRangeRepartitionExec,
+                )
+
+                tapped = RuntimeStatsExec(child, node.keys[0].expr)
+                dammed = BufferExec(tapped)
+                ranged = UnorderedRangeRepartitionExec(
+                    dammed, node.keys[0], child.output_partition_count()
+                )
+                return CoalescePartitionsExec(SortExec(ranged, node.keys, None))
             s = SortExec(child, node.keys, node.fetch)
             if child.output_partition_count() > 1:
                 return SortPreservingMergeExec(s, node.keys, node.fetch)

@@ -347,3 +347,67 @@ class ExecutionGraph:
                     f"pending={len(s.pending)} running={len(s.running)} done={len(s.completed)}"
                 )
             return "\n".join(lines)
+
+    # -- externalization (reference: ExecutionGraph proto, ballista.proto:185;
+    #    enables JobState persistence / scheduler fail-over) -----------------
+
+    def to_proto(self):
+        from ballista_tpu.proto import pb
+        from ballista_tpu.serde import encode_location, encode_plan
+
+        with self._lock:
+            out = pb.ExecutionGraphProto(
+                job_id=self.job_id, job_name=self.job_name,
+                session_id=self.session_id, status=self.status.value,
+            )
+            for sid in sorted(self.stages):
+                s = self.stages[sid]
+                sp = out.stages.add()
+                sp.stage_id = sid
+                sp.state = s.state.value
+                sp.partitions = s.spec.partitions
+                sp.attempt = s.attempt
+                sp.plan.CopyFrom(encode_plan(s.spec.plan))
+                sp.output_links.extend(self.output_links.get(sid, []))
+                for l in s.output_locations():
+                    sp.completed.append(encode_location(l))
+            return out
+
+    @classmethod
+    def from_proto(cls, proto, config: BallistaConfig | None = None) -> "ExecutionGraph":
+        """Rebuild a graph from its externalized form. Successful stages keep
+        their completed locations; anything mid-flight restarts (the durable
+        unit is the materialized shuffle output, SURVEY.md §5)."""
+        from ballista_tpu.scheduler.planner import QueryStage
+        from ballista_tpu.serde import decode_location, decode_plan
+
+        stages = []
+        links: dict[int, list[int]] = {}
+        for sp in proto.stages:
+            plan = decode_plan(sp.plan)
+            from ballista_tpu.scheduler.planner import _find_input_stages
+
+            stages.append(
+                QueryStage(
+                    stage_id=sp.stage_id, plan=plan,
+                    partitions=sp.partitions,
+                    output_partitions=plan.output_partitions or sp.partitions,
+                    input_stage_ids=_find_input_stages(plan),
+                )
+            )
+            links[sp.stage_id] = list(sp.output_links)
+        g = cls(proto.job_id, proto.job_name, proto.session_id, stages, config)
+        g.status = JobState(proto.status) if proto.status else JobState.RUNNING
+        for sp in proto.stages:
+            if sp.state == "successful":
+                st = g.stages[sp.stage_id]
+                for lp in sp.completed:
+                    loc = decode_location(lp)
+                    st.completed.setdefault(loc.map_partition, []).append(loc)
+                st.pending = []
+                st.state = StageState.SUCCESSFUL
+                st.attempt = sp.attempt
+        # re-resolve downstream stages from recovered outputs
+        for st in g.stages.values():
+            g._try_resolve(st)
+        return g
