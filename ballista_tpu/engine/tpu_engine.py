@@ -48,8 +48,12 @@ def maybe_compile_tpu(physical: ExecutionPlan, config: BallistaConfig) -> Execut
 
 
 def _match_chain(node: ExecutionPlan):
-    """Descend Filter/Projection/CoalesceBatches to a scan; return
-    (dataflow-ordered op list, scan) or None."""
+    """Descend the PROBE path through Filter/Projection/CoalesceBatches and
+    CollectLeft inner hash joins to a scan; return (dataflow-ordered op
+    list, scan) or None. Join build sides stay CPU-side subplans executed
+    at stage start; probe-side rows never leave the device."""
+    from ballista_tpu.plan.physical import HashJoinExec
+
     ops: list[ExecutionPlan] = []
     cur = node
     while True:
@@ -59,6 +63,15 @@ def _match_chain(node: ExecutionPlan):
         if isinstance(cur, (FilterExec, ProjectionExec, CoalesceBatchesExec)):
             ops.append(cur)
             cur = cur.children()[0]
+            continue
+        if (
+            isinstance(cur, HashJoinExec)
+            and cur.mode == "collect_left"
+            and cur.join_type == "inner"
+            and cur.filter is None
+        ):
+            ops.append(cur)
+            cur = cur.right  # probe side continues the device chain
             continue
         return None
 

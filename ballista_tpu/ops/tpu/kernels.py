@@ -105,14 +105,21 @@ class Lowering:
         self.env_fns: list | None = None
         self.env_meta: list | None = None
 
-    def add_lut(self, src_slot: int, builder) -> int:
+    def add_lut(self, src_slot, builder) -> int:
+        """src_slot: scan column index, or ('build', join_idx, col_idx) for
+        dictionaries that live in a join's build table."""
         self.lut_builders.append((src_slot, builder))
         return len(self.lut_builders) - 1
 
-    def build_luts(self, dictionaries_by_slot: list[list | None]) -> list[np.ndarray]:
+    def build_luts(self, dictionaries_by_slot: list[list | None],
+                   build_dicts: list[list[list | None]] | None = None) -> list[np.ndarray]:
         out = []
         for slot, builder in self.lut_builders:
-            vals = builder(dictionaries_by_slot[slot])
+            if isinstance(slot, tuple) and slot[0] == "build":
+                dic = build_dicts[slot[1]][slot[2]] if build_dicts else None
+            else:
+                dic = dictionaries_by_slot[slot]
+            vals = builder(dic)
             n = 1
             while n < max(len(vals), 1):
                 n *= 2
@@ -160,6 +167,29 @@ def lower_expr(e: Expr, ctx: Lowering) -> LoweredFn:
         raise Unsupported(f"literal {v!r}")
 
     if isinstance(e, BinaryExpr):
+        # string equality over dictionary columns → host LUT, device gather
+        if e.op in ("=", "<>"):
+            for a, b in ((e.left, e.right), (e.right, e.left)):
+                if (
+                    isinstance(a, Column)
+                    and isinstance(b, Literal)
+                    and isinstance(b.value, str)
+                ):
+                    i = ctx.col_index(a)
+                    if ctx.kinds[i][0] == "code":
+                        src = lower_expr(a, ctx)
+                        val = b.value
+                        li = ctx.add_lut(
+                            ctx.slots[i],
+                            lambda dic, val=val: np.array([x == val for x in dic], dtype=bool),
+                        )
+                        neg = e.op == "<>"
+
+                        def run(cols, luts, src=src, li=li, neg=neg):
+                            out = luts[li][src(cols, luts).arr]
+                            return DevVal("bool", ~out if neg else out)
+
+                        return run
         lf = lower_expr(e.left, ctx)
         rf = lower_expr(e.right, ctx)
         op = e.op

@@ -65,7 +65,40 @@ MAX_SEGMENTS = 1 << 16
 
 _COMPILE_CACHE: dict = {}
 _COMPILE_LOCK = threading.Lock()
-_LUT_CACHE: dict = {}  # (table_key, lowering_id, lut_index) → device array
+_LUT_CACHE: dict = {}  # (table_key, fingerprint) → device arrays
+_BUILD_CACHE: dict = {}  # (table_key, fingerprint, join_idx) → BuildTable
+
+KEY_SHIFT = 21  # multi-key combine: k = k1 << 21 | k2 (guarded ranges)
+
+
+DIRECT_TABLE_MAX = 1 << 27  # 128M entries × int32 = 512 MB HBM ceiling
+
+
+class BuildTable:
+    """A join's build side, encoded for device probing.
+
+    mode 'direct': keys are dense-enough ints → a [T] int32 lookup table
+    (key → build row, -1 absent): ONE gather per probe. mode 'sorted':
+    binary search over sorted keys (log B gathers) — the fallback for huge
+    key ranges."""
+
+    def __init__(self, mode, keys, payloads, kinds, scales, dicts, n_rows, device=False):
+        self.mode = mode  # direct | sorted
+        self.keys = keys  # direct: int32 [T] row table; sorted: int64 [B] keys
+        self.payloads = payloads  # per column, padded (direct: original order)
+        self.kinds = kinds
+        self.scales = scales
+        self.dicts = dicts
+        self.n_rows = n_rows
+        self.device = device
+        self.shifts: list[int] = []  # multi-key combine shifts (per extra key)
+
+    def shape_key(self):
+        return (
+            self.mode, len(self.keys), tuple(self.shifts),
+            tuple(str(p.dtype) for p in self.payloads),
+            tuple(_pow2(len(d)) if d else 0 for d in self.dicts),
+        )
 
 
 class DeviceTable:
@@ -236,17 +269,117 @@ class TpuStageExec(ExecutionPlan):
 
     def _fallback(self, partition: int, ctx: TaskContext) -> list[pa.RecordBatch]:
         """Re-run the original CPU subtree (scan filters applied on host)."""
+        from ballista_tpu.plan.physical import HashJoinExec
+
         self.fallback_count += 1
         node: ExecutionPlan = self.scan
         for op in self.ops:
-            node = op.with_children([node])
+            if isinstance(op, HashJoinExec):
+                node = op.with_children([op.left, node])
+            else:
+                node = op.with_children([node])
         agg = self.partial_agg.with_children([node])
         return [b for b in agg.execute(partition, ctx)]
 
     # ------------------------------------------------------------------
 
+    def _prepare_build(self, join, jidx: int, ctx: TaskContext, table_key) -> BuildTable:
+        """Collect + encode + sort a join's build side for device probing."""
+        import numpy as np
+
+        from ballista_tpu.ops.phys_expr import bind_expr, evaluate_to_array
+        from ballista_tpu.ops.tpu.columnar import encode_column
+
+        jax = ensure_jax()
+        jnp = jax.numpy
+        cache_key = (table_key, self.fingerprint, jidx)
+        hit = _BUILD_CACHE.get(cache_key)
+        if hit is not None:
+            return hit
+
+        batches = []
+        for p in range(join.left.output_partition_count()):
+            batches.extend(b for b in join.left.execute(p, ctx) if b.num_rows)
+        tbl = _concat(batches, join.left.schema()).combine_chunks()
+        if tbl.num_rows == 0:
+            raise Unsupported("empty build side (let CPU/AQE handle it)")
+        batch = tbl.to_batches()[0]
+
+        # combined int64 key, verified unique + range-guarded; each extra
+        # key gets the smallest shift covering its build-side range (keeps
+        # combined keys dense enough for direct addressing)
+        key_np = None
+        shifts: list[int] = []
+        for l_expr, _ in join.on:
+            arr = evaluate_to_array(bind_expr(l_expr, join.left.df_schema), batch)
+            if arr.null_count:
+                raise Unsupported("NULL build keys")
+            import pyarrow as _pa
+
+            t = arr.type
+            if _pa.types.is_date(t):
+                vals = arr.cast(_pa.int32()).cast(_pa.int64()).to_numpy(zero_copy_only=False)
+            elif _pa.types.is_integer(t):
+                vals = arr.cast(_pa.int64(), safe=False).to_numpy(zero_copy_only=False)
+            else:
+                raise Unsupported(f"non-integer join key {t}")
+            vals = vals.astype(np.int64)
+            if key_np is None:
+                key_np = vals
+            else:
+                if (vals < 0).any():
+                    raise Unsupported("negative secondary join key")
+                shift = max(1, int(vals.max()).bit_length())
+                if (key_np < 0).any() or (int(key_np.max()) >> (62 - shift)) > 0:
+                    raise Unsupported("primary join key out of combine range")
+                key_np = (key_np << shift) | vals
+                shifts.append(shift)
+        if len(np.unique(key_np)) != len(key_np):
+            raise Unsupported("non-unique build keys (expansion joins stay on cpu)")
+
+        max_key = int(key_np.max())
+        min_key = int(key_np.min())
+        direct = min_key >= 0 and max_key + 1 <= DIRECT_TABLE_MAX
+        if direct:
+            T = _pow2(max_key + 1)
+            table = np.full(T, -1, dtype=np.int32)
+            table[key_np] = np.arange(len(key_np), dtype=np.int32)
+            keys_dev = table
+            order = np.arange(len(key_np))
+            B = _pow2(len(key_np))
+            mode = "direct"
+        else:
+            order = np.argsort(key_np)
+            sorted_keys = key_np[order]
+            B = _pow2(len(sorted_keys))
+            keys_dev = np.full(B, np.iinfo(np.int64).max, dtype=np.int64)
+            keys_dev[: len(sorted_keys)] = sorted_keys
+            mode = "sorted"
+
+        kinds, scales, dicts, payloads = [], [], [], []
+        for name in batch.schema.names:
+            dc = encode_column(batch.column(batch.schema.get_field_index(name)))
+            if dc is None:
+                raise Unsupported(f"unencodable build column {name}")
+            kinds.append(dc.kind)
+            scales.append(dc.scale)
+            dicts.append(dc.dictionary)
+            padded = np.zeros(B, dtype=dc.data.dtype)
+            padded[: len(order)] = dc.data[order]
+            payloads.append(padded)
+
+        bt = BuildTable(
+            mode, jnp.asarray(keys_dev), [jnp.asarray(p) for p in payloads],
+            kinds, scales, dicts, len(order), device=True,
+        )
+        bt.shifts = shifts
+        _BUILD_CACHE[cache_key] = bt
+        return bt
+
     def _tpu_run_all(self, ctx: TaskContext) -> dict[int, list[pa.RecordBatch]]:
         """One dispatch + one fetch for every partition of this stage."""
+        from ballista_tpu.plan.physical import HashJoinExec
+
         jax = ensure_jax()
         jnp = jax.numpy
 
@@ -254,6 +387,11 @@ class TpuStageExec(ExecutionPlan):
         dt = DEVICE_CACHE.get(self.scan, self.buckets, ctx, max_bytes)
         if sum(dt.part_rows) < self.min_rows:
             raise Unsupported(f"only {sum(dt.part_rows)} rows (< tpu min)")
+
+        table_key = DEVICE_CACHE.key_of(self.scan)
+        builds: list[BuildTable] = []
+        for jidx, op in enumerate(o for o in self.ops if isinstance(o, HashJoinExec)):
+            builds.append(self._prepare_build(op, jidx, ctx, table_key))
 
         P, N = dt.shape
         kinds = list(zip(dt.kinds, dt.scales))
@@ -263,32 +401,38 @@ class TpuStageExec(ExecutionPlan):
         key = (
             self.fingerprint, P, N, tuple(kinds), dtypes,
             tuple(_pow2(len(d)) if d else 0 for d in dicts),
+            tuple(b.shape_key() for b in builds),
         )
         with _COMPILE_LOCK:
             cached = _COMPILE_CACHE.get(key)
             if cached is None:
-                cached = self._compile(dt, kinds, dicts, P, N)
+                cached = self._compile(dt, kinds, dicts, P, N, builds)
                 _COMPILE_CACHE[key] = cached
         fn, lowering, meta = cached
 
         # device LUTs cached per (table, stage): zero uploads when hot
-        lut_key = (DEVICE_CACHE.key_of(self.scan), self.fingerprint)
+        lut_key = (table_key, self.fingerprint)
         luts = _LUT_CACHE.get(lut_key)
         if luts is None:
-            luts = [jnp.asarray(l) for l in lowering.build_luts(dicts)]
+            luts = [jnp.asarray(l) for l in lowering.build_luts(dicts, [b.dicts for b in builds])]
             _LUT_CACHE[lut_key] = luts
 
-        outs = fn(dt.cols, luts, dt.mask)
+        build_args = [[b.keys] + list(b.payloads) for b in builds]
+        outs = fn(dt.cols, luts, dt.mask, build_args)
         outs = jax.device_get(list(outs))  # ONE batched fetch
-        return self._decode_all(outs, meta, P, dicts)
+        return self._decode_all(outs, meta, P, dicts, [b.dicts for b in builds])
 
     # ------------------------------------------------------------------
 
-    def _compile(self, dt: DeviceTable, kinds, dicts, P: int, N: int):
+    def _compile(self, dt: DeviceTable, kinds, dicts, P: int, N: int,
+                 builds: list[BuildTable] | None = None):
+        from ballista_tpu.plan.physical import HashJoinExec
+
         jax = ensure_jax()
         jnp = jax.numpy
         agg = self.partial_agg
         scan_schema = self.scan.df_schema
+        builds = builds or []
 
         ctx = Lowering(scan_schema, kinds, dicts)
         env_fns = []
@@ -305,10 +449,31 @@ class TpuStageExec(ExecutionPlan):
         for f in getattr(self.scan, "filters", []):
             filter_fns.append(lower_expr(f, ctx))
 
+        jidx = 0
         for op in self.ops:
             _bind_env(ctx, cur_schema)
             if isinstance(op, FilterExec):
                 filter_fns.append(lower_expr(op.predicate, ctx))
+            elif isinstance(op, HashJoinExec):
+                bt = builds[jidx]
+                # build arrays ride at the tail of the flattened cols list
+                off = len(kinds) + sum(1 + len(builds[i].payloads) for i in range(jidx))
+                probe_fns = [lower_expr(r, ctx) for (_, r) in op.on]
+                finder = _mk_join_finder(off, probe_fns, bt.mode, bt.shifts)
+                filter_fns.append(lambda cols, luts, _f=finder: _f(cols, luts)[1])
+                build_fns = [
+                    _mk_build_gather(off, ci, bt.kinds[ci], bt.scales[ci], bt.dicts[ci], finder)
+                    for ci in range(len(bt.payloads))
+                ]
+                build_meta = [
+                    (bt.kinds[ci], bt.scales[ci], bt.dicts[ci], ("build", jidx, ci))
+                    for ci in range(len(bt.payloads))
+                ]
+                # exec output order: build fields then probe fields
+                ctx.env_fns = build_fns + list(ctx.env_fns)
+                ctx.env_meta = build_meta + list(ctx.env_meta)
+                cur_schema = op.df_schema
+                jidx += 1
             elif isinstance(op, ProjectionExec):
                 new_fns, new_meta = [], []
                 for e in op.exprs:
@@ -359,10 +524,12 @@ class TpuStageExec(ExecutionPlan):
         meta_holder: dict = {}
         aggs = agg.aggs
 
-        def raw(cols, luts, mask):
+        def raw(cols, luts, mask, build_args):
             # keep [P, N]: partitions are the leading axis, reductions run
             # over axis=1 — XLA fuses the per-group masked sums into single
-            # VPU passes, no scatter anywhere
+            # VPU passes, no scatter anywhere. Join-probe gathers hit the
+            # build arrays appended after the scan columns.
+            cols = list(cols) + [a for b in build_args for a in b]
             m = mask
             for ff in filter_fns:
                 m = m & ff(cols, luts).arr
@@ -393,10 +560,15 @@ class TpuStageExec(ExecutionPlan):
 
         jitted = jax.jit(raw)
         cols_spec = [jax.ShapeDtypeStruct(c.shape, c.dtype) for c in dt.cols]
-        luts0 = ctx.build_luts(dicts)
+        luts0 = ctx.build_luts(dicts, [b.dicts for b in builds])
         luts_spec = [jax.ShapeDtypeStruct(l.shape, l.dtype) for l in luts0]
         mask_spec = jax.ShapeDtypeStruct(dt.mask.shape, np.bool_)
-        jitted.lower(cols_spec, luts_spec, mask_spec)  # trace only → meta
+        builds_spec = [
+            [jax.ShapeDtypeStruct(b.keys.shape, b.keys.dtype)]
+            + [jax.ShapeDtypeStruct(p.shape, p.dtype) for p in b.payloads]
+            for b in builds
+        ]
+        jitted.lower(cols_spec, luts_spec, mask_spec, builds_spec)  # trace only → meta
         meta = {
             "out": meta_holder["out"],
             "group_src_slots": group_src_slots,
@@ -407,10 +579,16 @@ class TpuStageExec(ExecutionPlan):
 
     # ------------------------------------------------------------------
 
-    def _decode_all(self, outs: list[np.ndarray], meta: dict, P: int, dicts) -> dict[int, list[pa.RecordBatch]]:
+    def _decode_all(self, outs: list[np.ndarray], meta: dict, P: int, dicts,
+                    build_dicts: list | None = None) -> dict[int, list[pa.RecordBatch]]:
         agg = self.partial_agg
         schema = self.schema()
-        group_dicts = [dicts[s] for s in meta["group_src_slots"]]
+        group_dicts = []
+        for s in meta["group_src_slots"]:
+            if isinstance(s, tuple) and s[0] == "build":
+                group_dicts.append(build_dicts[s[1]][s[2]])
+            else:
+                group_dicts.append(dicts[s])
         presence = outs[-1]  # [P, G]
         results: dict[int, list[pa.RecordBatch]] = {}
         n_group = len(agg.group_exprs)
@@ -480,6 +658,66 @@ def _mk_col_reader(i: int, kind: str, scale: int, dictionary):
         elif kind == "code" and arr.dtype != jnp.int32:
             arr = arr.astype(jnp.int32)
         elif kind == "date" and arr.dtype != jnp.int32:
+            arr = arr.astype(jnp.int32)
+        return DevVal(kind, arr, scale, dictionary)
+
+    return run
+
+
+def _mk_join_finder(off: int, probe_fns, mode: str, shifts: list[int]):
+    """Closure computing (clamped build index, matched mask) for one join.
+
+    'direct' mode: the build shipped a dense key→row int32 table — ONE
+    gather per probe (the TPU-friendly hash table: identity hash, no
+    collisions by construction). 'sorted' mode: binary search over sorted
+    keys with an int64.max tail. Multi-key probes combine as
+    k1 << KEY_SHIFT | k2 with device range guards mirroring the host-side
+    guards, so out-of-range keys can never alias a real build key.
+    XLA CSEs the duplicate lookups issued by the per-column gathers.
+    """
+
+    def run(cols, luts):
+        import jax.numpy as jnp
+
+        keys_arr = cols[off]
+        valid = None
+        k = None
+        for i, pf in enumerate(probe_fns):
+            v = pf(cols, luts)
+            if v.kind not in ("i64", "date"):
+                raise Unsupported(f"non-integer probe key kind {v.kind}")
+            ki = v.arr.astype(jnp.int64)
+            if i == 0:
+                k = ki
+                valid = ki >= 0
+            else:
+                shift = shifts[i - 1]
+                valid = valid & (ki >= 0) & (ki < (1 << shift))
+                k = (k << shift) | ki
+        if mode == "direct":
+            T = keys_arr.shape[0]
+            in_range = valid & (k >= 0) & (k < T)
+            row = keys_arr[jnp.where(in_range, k, 0)]
+            matched = in_range & (row >= 0)
+            idxc = jnp.clip(row, 0, None).astype(jnp.int32)
+            return idxc, DevVal("bool", matched)
+        idx = jnp.searchsorted(keys_arr, k)
+        idxc = jnp.clip(idx, 0, keys_arr.shape[0] - 1)
+        matched = (keys_arr[idxc] == k) & valid
+        return idxc, DevVal("bool", matched)
+
+    return run
+
+
+def _mk_build_gather(off: int, ci: int, kind: str, scale: int, dictionary, finder):
+    def run(cols, luts):
+        import jax.numpy as jnp
+
+        idxc, _ = finder(cols, luts)
+        arr = cols[off + 1 + ci][idxc]
+        if kind in ("i64", "money") and arr.dtype != jnp.int64:
+            arr = arr.astype(jnp.int64)
+        elif kind in ("code", "date") and arr.dtype != jnp.int32:
             arr = arr.astype(jnp.int32)
         return DevVal(kind, arr, scale, dictionary)
 
