@@ -71,6 +71,12 @@ class ExecutorProcess:
         self.grpc_port = self.grpc_server.add_insecure_port(f"{bind_host}:{grpc_port}")
         self.metadata.grpc_port = self.grpc_port
 
+        from ballista_tpu.executor.health import start_health_server
+
+        self.health_server, self.health_port = start_health_server(
+            self.executor, self._stopping, bind_host
+        )
+
     # ------------------------------------------------------------------
 
     def start(self) -> None:
@@ -176,6 +182,7 @@ class ExecutorProcess:
         self.service.stop()
         self.grpc_server.stop(grace=2)
         self.flight_server.shutdown()
+        self.health_server.shutdown()
 
     def wait(self) -> None:
         try:

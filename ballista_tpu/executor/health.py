@@ -1,0 +1,39 @@
+"""Executor HTTP health endpoint (reference: executor/src/health.rs:94).
+
+GET /health → {"status": "healthy", ...liveness facts} — the probe target
+for k8s-style deployments; reports degraded once shutdown begins.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+def start_health_server(executor, stopping_event, host: str = "0.0.0.0", port: int = 0):
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):
+            pass
+
+        def do_GET(self):  # noqa: N802
+            if self.path.rstrip("/") not in ("", "/health"):
+                self.send_response(404)
+                self.end_headers()
+                return
+            body = json.dumps({
+                "status": "draining" if stopping_event.is_set() else "healthy",
+                "executor_id": executor.metadata.id,
+                "tasks_run": executor.tasks_run,
+                "tasks_failed": executor.tasks_failed,
+            }).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    server = ThreadingHTTPServer((host, port), Handler)
+    t = threading.Thread(target=server.serve_forever, daemon=True, name="executor-health")
+    t.start()
+    return server, server.server_port

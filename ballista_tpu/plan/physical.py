@@ -165,7 +165,14 @@ class ParquetScanExec(ExecutionPlan):
         out_schema = self.schema()
         produced = False
         for fdesc in part.get("files", []):
-            pf = pq.ParquetFile(fdesc["file"])
+            fpath = fdesc["file"]
+            if fpath.startswith("s3://"):
+                from ballista_tpu.plan.object_store import resolve_filesystem
+
+                fs, inner = resolve_filesystem(fpath)
+                pf = pq.ParquetFile(inner, filesystem=fs)
+            else:
+                pf = pq.ParquetFile(fpath)
             rgs = fdesc.get("row_groups")
             if rgs is None:
                 rgs = list(range(pf.metadata.num_row_groups))

@@ -67,7 +67,16 @@ class TableProvider:
 class ParquetTable(TableProvider):
     def __init__(self, path: str, collect_statistics: bool = True):
         self.path = path
-        if os.path.isdir(path):
+        if path.startswith("s3://"):
+            from ballista_tpu.plan.object_store import resolve_filesystem
+            import pyarrow.fs as pafs
+
+            fs, inner = resolve_filesystem(path)
+            infos = fs.get_file_info(pafs.FileSelector(inner.rstrip("/"), recursive=True))
+            self.files = sorted(
+                "s3://" + i.path for i in infos if i.path.endswith(".parquet")
+            ) or [path]
+        elif os.path.isdir(path):
             self.files = sorted(glob.glob(os.path.join(path, "**", "*.parquet"), recursive=True))
         elif "*" in path:
             self.files = sorted(glob.glob(path))
@@ -75,7 +84,7 @@ class ParquetTable(TableProvider):
             self.files = [path]
         if not self.files:
             raise FileNotFoundError(f"no parquet files under {path}")
-        self._schema = pq.read_schema(self.files[0])
+        self._schema = _read_schema(self.files[0])
         self._stats: TableStats | None = None
         if collect_statistics:
             self._collect_stats()
@@ -87,7 +96,7 @@ class ParquetTable(TableProvider):
         rows = 0
         tbytes = 0
         for f in self.files:
-            md = pq.read_metadata(f)
+            md = _read_metadata(f)
             rows += md.num_rows
             tbytes += sum(
                 md.row_group(i).total_byte_size for i in range(md.num_row_groups)
@@ -102,7 +111,7 @@ class ParquetTable(TableProvider):
         `target_partitions` groups by byte size."""
         units: list[tuple[str, int, int]] = []  # (file, rg_index, bytes)
         for f in self.files:
-            md = pq.read_metadata(f)
+            md = _read_metadata(f)
             for rg in range(md.num_row_groups):
                 units.append((f, rg, md.row_group(rg).total_byte_size))
         if not units:
@@ -126,6 +135,24 @@ class ParquetTable(TableProvider):
                 {"files": [{"file": f, "row_groups": sorted(rgs)} for f, rgs in sorted(by_file.items())]}
             )
         return parts
+
+
+def _read_schema(path: str) -> pa.Schema:
+    if path.startswith("s3://"):
+        from ballista_tpu.plan.object_store import resolve_filesystem
+
+        fs, inner = resolve_filesystem(path)
+        return pq.read_schema(inner, filesystem=fs)
+    return pq.read_schema(path)
+
+
+def _read_metadata(path: str):
+    if path.startswith("s3://"):
+        from ballista_tpu.plan.object_store import resolve_filesystem
+
+        fs, inner = resolve_filesystem(path)
+        return pq.read_metadata(inner, filesystem=fs)
+    return pq.read_metadata(path)
 
 
 class MemoryTable(TableProvider):
