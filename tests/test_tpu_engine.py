@@ -83,6 +83,34 @@ def test_tpu_stage_actually_ran(tpu_ctx):
     assert stages[0].fallback_count == 0
 
 
+def test_q5_join_pipeline_on_device(tpu_ctx, tpch_ref_tables):
+    """q5's 4-join probe chain must compile and run on the device path."""
+    import ballista_tpu.ops.tpu.stage_compiler as sc
+    from ballista_tpu.engine.tpu_engine import maybe_compile_tpu
+    from ballista_tpu.plan.physical import TaskContext
+
+    phys = maybe_compile_tpu(
+        tpu_ctx.create_physical_plan(tpu_ctx.sql(tpch_query(5)).plan), tpu_ctx.config
+    )
+    stages = [n for n in _walk(phys) if isinstance(n, sc.TpuStageExec)]
+    assert stages
+    joins = [op for s in stages for op in s.ops if type(op).__name__ == "HashJoinExec"]
+    assert len(joins) >= 3
+    ctx = TaskContext(tpu_ctx.config)
+    for p in range(phys.output_partition_count()):
+        list(phys.execute(p, ctx))
+    assert sum(s.tpu_count for s in stages) >= 1
+    assert sum(s.fallback_count for s in stages) == 0
+
+
+def test_non_unique_build_falls_back(tpu_ctx, tpch_ref_tables):
+    """q12's build side (lineitem) has duplicate keys → clean CPU fallback
+    with a correct result."""
+    eng = tpu_ctx.sql(tpch_query(12)).collect()
+    problems = compare_results(eng, run_reference(12, tpch_ref_tables), 12)
+    assert not problems, "\n".join(problems)
+
+
 def test_money_encoding_exact():
     from ballista_tpu.ops.tpu.columnar import encode_column
 
