@@ -14,7 +14,7 @@ needed because CPU engines don't recompile per shape.
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Any, Callable
 
 from ballista_tpu.errors import ConfigurationError

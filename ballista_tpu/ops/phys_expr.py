@@ -9,7 +9,6 @@ moral equivalent of the reference's create_physical_expr seam.
 
 from __future__ import annotations
 
-import datetime as _dt
 from dataclasses import dataclass
 from typing import Any
 

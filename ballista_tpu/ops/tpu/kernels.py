@@ -24,7 +24,6 @@ from typing import Any, Callable
 
 import numpy as np
 
-from ballista_tpu.errors import ExecutionError
 from ballista_tpu.plan.expressions import (
     Alias,
     Between,

@@ -28,7 +28,7 @@ from __future__ import annotations
 
 import logging
 import threading
-from typing import Iterator, Optional
+from typing import Iterator
 
 import numpy as np
 import pyarrow as pa
@@ -40,17 +40,14 @@ from ballista_tpu.ops.tpu.kernels import (
     Lowering,
     Unsupported,
     lower_expr,
-    segment_aggregate,
 )
 from ballista_tpu.ops.tpu.runtime import ensure_jax
 from ballista_tpu.plan.expressions import Alias, Column, Expr
 from ballista_tpu.plan.physical import (
-    AggDesc,
     CoalesceBatchesExec,
     ExecutionPlan,
     FilterExec,
     HashAggregateExec,
-    MemoryScanExec,
     ParquetScanExec,
     ProjectionExec,
     TaskContext,

@@ -21,16 +21,14 @@ key column). A general three-valued-logic rewrite is future work.
 from __future__ import annotations
 
 import datetime as _dt
-from dataclasses import replace
-from typing import Any
+
+
 
 from ballista_tpu.errors import PlanningError
 from ballista_tpu.plan.expressions import (
     Alias,
     Between,
     BinaryExpr,
-    Case,
-    Cast,
     Column,
     Exists,
     Expr,

@@ -16,7 +16,7 @@ from __future__ import annotations
 from dataclasses import replace
 from typing import Any
 
-from ballista_tpu.errors import PlanningError, SchemaError
+from ballista_tpu.errors import PlanningError
 from ballista_tpu.plan.expressions import (
     AggregateFunction,
     Alias,
