@@ -58,6 +58,9 @@ class TaskResult:
     error_kind: str = ""
     retryable: bool = False
     metrics: list = field(default_factory=list)
+    # ResultLost identity when a shuffle fetch failed
+    fetch_failed_executor_id: str = ""
+    fetch_failed_stage_id: int = 0
 
 
 class ExecutionEngine:
@@ -139,9 +142,14 @@ class Executor:
             base.error = str(e)
             return base
         except BaseException as e:  # noqa: BLE001 — catch_unwind parity
+            from ballista_tpu.errors import FetchFailed
+
             self.tasks_failed += 1
             base.error = f"{type(e).__name__}: {e}\n{traceback.format_exc(limit=8)}"
             base.error_kind = error_to_proto_kind(e)
             base.retryable = bool(getattr(e, "retryable", False))
+            if isinstance(e, FetchFailed):
+                base.fetch_failed_executor_id = e.executor_id
+                base.fetch_failed_stage_id = e.stage_id
             log.warning("task %s/%s failed: %s", task.job_id, task.task_id, e)
             return base

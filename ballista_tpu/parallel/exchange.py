@@ -26,12 +26,28 @@ import numpy as np
 
 
 def make_mesh(n_devices: int | None = None, axis: str = "part"):
-    """1-D device mesh over the partition axis (data parallel over rows)."""
+    """1-D device mesh over the partition axis (data parallel over rows).
+
+    Falls back to the CPU backend's virtual devices when the default
+    platform has fewer chips than requested (the driver validates
+    multi-chip sharding with xla_force_host_platform_device_count; the
+    axon TPU plugin ignores JAX_PLATFORMS, so ask the cpu backend
+    explicitly)."""
     import jax
     from jax.sharding import Mesh
 
     devs = jax.devices()
+    if n_devices is not None and len(devs) < n_devices:
+        try:
+            devs = jax.devices("cpu")
+        except RuntimeError:
+            pass
     n = n_devices or len(devs)
+    if len(devs) < n:
+        raise RuntimeError(
+            f"need {n} devices, have {len(devs)} "
+            "(set XLA_FLAGS=--xla_force_host_platform_device_count=N)"
+        )
     return Mesh(np.array(devs[:n]), (axis,))
 
 

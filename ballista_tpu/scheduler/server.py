@@ -242,6 +242,7 @@ class SchedulerServer:
             events = g.update_task_status(
                 r.task_id, r.stage_id, r.stage_attempt, r.state, r.partitions,
                 r.locations, r.error, r.retryable, r.metrics,
+                r.fetch_failed_executor_id, r.fetch_failed_stage_id,
             )
             for ev in events:
                 if ev == "job_finished":

@@ -168,7 +168,9 @@ class ExecutionGraph:
                            state: str, partitions: list[int],
                            locations: list[PartitionLocation],
                            error: str = "", retryable: bool = False,
-                           metrics: list | None = None) -> list[str]:
+                           metrics: list | None = None,
+                           fetch_failed_executor_id: str = "",
+                           fetch_failed_stage_id: int = 0) -> list[str]:
         """Ingest one task status; returns job-level events
         ('stage_completed', 'job_finished', 'job_failed')."""
         events: list[str] = []
@@ -191,9 +193,23 @@ class ExecutionGraph:
             elif state in ("failed", "cancelled"):
                 if running is not None:
                     stage.pending.extend(running.partitions)
-                stage.task_failures += 1
                 if error:
                     stage.failure_reasons.add(error.splitlines()[0][:200])
+                if fetch_failed_executor_id and fetch_failed_stage_id in self.stages:
+                    # ResultLost: the UPSTREAM stage's shuffle output is gone —
+                    # drop that executor's outputs and recompute the upstream
+                    # stage (+ roll back its consumers) instead of burning
+                    # this task's retry budget (execution_graph.rs:216)
+                    up = self.stages[fetch_failed_stage_id]
+                    up.completed = {
+                        p: locs for p, locs in up.completed.items()
+                        if not any(l.executor_id == fetch_failed_executor_id for l in locs)
+                    }
+                    self._rerun_stage_tree(fetch_failed_stage_id)
+                    if self.status is JobState.FAILED:
+                        events.append("job_failed")
+                    return events
+                stage.task_failures += 1
                 if state == "cancelled":
                     pass
                 elif not retryable or stage.task_failures > MAX_TASK_FAILURES:

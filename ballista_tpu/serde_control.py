@@ -57,6 +57,8 @@ def encode_task_status(r: TaskResult, executor_id: str) -> pb.TaskStatusProto:
         task_id=r.task_id, job_id=r.job_id, stage_id=r.stage_id,
         stage_attempt=r.stage_attempt, executor_id=executor_id,
         state=r.state, error=r.error, error_kind=r.error_kind, retryable=r.retryable,
+        fetch_failed_executor_id=r.fetch_failed_executor_id,
+        fetch_failed_stage_id=r.fetch_failed_stage_id,
     )
     out.partitions.extend(r.partitions)
     for l in r.locations:
@@ -105,6 +107,8 @@ def decode_task_status(p: pb.TaskStatusProto, executor_meta: ExecutorMetadata | 
             {"name": m.name, "output_rows": m.output_rows, "elapsed_ns": m.elapsed_ns, "depth": m.depth}
             for m in p.metrics
         ],
+        fetch_failed_executor_id=p.fetch_failed_executor_id,
+        fetch_failed_stage_id=p.fetch_failed_stage_id,
     )
 
 

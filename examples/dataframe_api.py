@@ -1,0 +1,26 @@
+"""DataFrame-API example (reference: examples/standalone-dataframe)."""
+
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ballista_tpu.client.context import SessionContext
+from ballista_tpu.plan.expressions import AggregateFunction, col, lit
+from ballista_tpu.testing.tpchgen import generate_tpch, register_tpch
+
+data = os.path.join(tempfile.gettempdir(), "ballista_example_tpch")
+if not os.path.isdir(os.path.join(data, "lineitem")):
+    generate_tpch(data, scale=0.01)
+
+ctx = SessionContext()  # local mode
+register_tpch(ctx, data)
+
+df = (
+    ctx.table("lineitem")
+    .filter(col("l_quantity") > lit(45))
+    .aggregate([col("l_returnflag")], [AggregateFunction("count", None)])
+    .sort(col("l_returnflag").sort())
+)
+df.show()

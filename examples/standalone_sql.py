@@ -1,0 +1,33 @@
+"""Standalone-cluster SQL example (reference: examples/standalone-sql).
+
+Spins an in-process scheduler + 2 executors, registers TPC-H data, runs a
+query over the real stage/shuffle machinery.
+"""
+
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ballista_tpu.client.context import SessionContext
+from ballista_tpu.testing.tpchgen import generate_tpch, register_tpch
+
+data = os.path.join(tempfile.gettempdir(), "ballista_example_tpch")
+if not os.path.isdir(os.path.join(data, "lineitem")):
+    generate_tpch(data, scale=0.01)
+
+ctx = SessionContext.standalone(num_executors=2, vcores=4)
+register_tpch(ctx, data)
+
+df = ctx.sql(
+    """
+    select l_returnflag, l_linestatus, sum(l_quantity) as sum_qty, count(*) as n
+    from lineitem
+    where l_shipdate <= date '1998-09-02'
+    group by l_returnflag, l_linestatus
+    order by l_returnflag, l_linestatus
+    """
+)
+df.show()
+ctx.shutdown()

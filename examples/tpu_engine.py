@@ -1,0 +1,29 @@
+"""TPU engine example: same SQL, engine selected per session
+(reference seam: ballista.executor.engine)."""
+
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ballista_tpu.client.context import SessionContext
+from ballista_tpu.config import BallistaConfig, EXECUTOR_ENGINE
+from ballista_tpu.testing.tpchgen import generate_tpch, register_tpch
+
+data = os.path.join(tempfile.gettempdir(), "ballista_example_tpch_sf1")
+if not os.path.isdir(os.path.join(data, "lineitem")):
+    print("generating SF1 ...")
+    generate_tpch(data, scale=1.0, files_per_table=4)
+
+sql = open(os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                        "benchmarks", "tpch", "queries", "q1.sql")).read()
+
+for engine in ("cpu", "tpu"):
+    ctx = SessionContext(BallistaConfig({EXECUTOR_ENGINE: engine}))
+    register_tpch(ctx, data)
+    ctx.sql(sql).collect()  # warm (device cache + XLA compile on tpu)
+    t0 = time.time()
+    out = ctx.sql(sql).collect()
+    print(f"{engine}: {time.time() - t0:.3f}s ({out.num_rows} rows)")
