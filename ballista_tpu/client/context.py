@@ -283,8 +283,28 @@ class DataFrame:
         physical = self.ctx.create_physical_plan(self.plan.input)
         types = ["logical_plan", "physical_plan"]
         plans = [logical.display(), physical.display()]
-        if self.plan.analyze:
-            tbl = self.ctx.execute_collect(physical)
+        if self.plan.analyze and self.ctx.mode == "standalone":
+            # distributed EXPLAIN ANALYZE: run the job through the cluster,
+            # then render per-stage operator metrics from the scheduler
+            # (reference: DistributedExplainAnalyzeExec + GetJobMetrics)
+            inner = DataFrame(self.ctx, self.plan.input)
+            inner.collect()
+            sched = self.ctx._cluster.scheduler
+            with sched._jobs_lock:
+                g = list(sched.jobs.values())[-1]
+            lines = []
+            for sid in sorted(g.stage_metrics):
+                lines.append(f"stage {sid}:")
+                for m in g.stage_metrics[sid][:100]:
+                    lines.append(
+                        f"  {'  ' * int(m.get('depth', 0))}{m.get('name', '')}: "
+                        f"rows={m.get('output_rows', 0)} "
+                        f"elapsed_ms={m.get('elapsed_ns', 0) / 1e6:.2f}"
+                    )
+            types.append("analyzed_plan (distributed)")
+            plans.append("\n".join(lines))
+        elif self.plan.analyze:
+            self.ctx.execute_collect(physical)
             from ballista_tpu.plan.physical import collect_metrics
 
             lines = []

@@ -122,22 +122,37 @@ class UnresolvedShuffleExec(ExecutionPlan):
 
 class FetchGovernor:
     """Reduce-side flow control (reference's 3-semaphore governor,
-    shuffle_reader.rs:778): total request slots + per-address slots."""
+    shuffle_reader.rs:778): total request slots + per-address slots + an
+    in-flight byte budget (fetches declare their expected size from the
+    partition stats; oversized singletons are admitted alone rather than
+    deadlocked)."""
 
-    def __init__(self, max_requests: int, max_per_addr: int):
+    def __init__(self, max_requests: int, max_per_addr: int, max_bytes: int = 256 * 1024 * 1024):
         self.total = threading.Semaphore(max_requests)
         self.per_addr: dict[str, threading.Semaphore] = {}
         self.max_per_addr = max_per_addr
+        self.max_bytes = max_bytes
+        self.inflight_bytes = 0
         self._lock = threading.Lock()
+        self._bytes_free = threading.Condition(self._lock)
 
-    def acquire(self, addr: str):
+    def acquire(self, addr: str, nbytes: int = 0):
         with self._lock:
             sem = self.per_addr.setdefault(addr, threading.Semaphore(self.max_per_addr))
         self.total.acquire()
         sem.acquire()
-        return sem
+        nbytes = min(nbytes, self.max_bytes)  # oversized fetches admit alone
+        with self._bytes_free:
+            while self.inflight_bytes > 0 and self.inflight_bytes + nbytes > self.max_bytes:
+                self._bytes_free.wait(timeout=5)
+            self.inflight_bytes += nbytes
+        return (sem, nbytes)
 
-    def release(self, addr: str, sem):
+    def release(self, addr: str, token):
+        sem, nbytes = token
+        with self._bytes_free:
+            self.inflight_bytes -= nbytes
+            self._bytes_free.notify_all()
         sem.release()
         self.total.release()
 
@@ -147,6 +162,8 @@ _GOV_LOCK = threading.Lock()
 
 
 def _governor(ctx: TaskContext) -> FetchGovernor:
+    from ballista_tpu.config import SHUFFLE_READER_MAX_BYTES
+
     key = id(ctx.config)
     with _GOV_LOCK:
         g = _GOV_CACHE.get(key)
@@ -154,6 +171,7 @@ def _governor(ctx: TaskContext) -> FetchGovernor:
             g = FetchGovernor(
                 int(ctx.config.get(SHUFFLE_READER_MAX_REQUESTS)),
                 int(ctx.config.get(SHUFFLE_READER_MAX_PER_ADDR)),
+                int(ctx.config.get(SHUFFLE_READER_MAX_BYTES)),
             )
             _GOV_CACHE[key] = g
         return g
@@ -170,7 +188,7 @@ def fetch_partition(loc: PartitionLocation, ctx: TaskContext, force_remote: bool
     addr = f"{loc.host}:{loc.flight_port}"
     last: Exception | None = None
     for attempt in range(retries + 1):
-        sem = governor.acquire(addr) if governor else None
+        token = governor.acquire(addr, loc.stats.num_bytes) if governor else None
         try:
             from ballista_tpu.flight.client import fetch_partition_flight
 
@@ -181,7 +199,7 @@ def fetch_partition(loc: PartitionLocation, ctx: TaskContext, force_remote: bool
             time.sleep(wait_ms * (attempt + 1) / 1000.0)
         finally:
             if governor:
-                governor.release(addr, sem)
+                governor.release(addr, token)
     raise FetchFailed(loc.executor_id, loc.job_id, loc.stage_id, loc.map_partition, str(last))
 
 
