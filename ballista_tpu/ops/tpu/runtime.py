@@ -7,6 +7,7 @@ acceptable because the hot paths (masks, money, codes) are integer.
 
 from __future__ import annotations
 
+import os
 import threading
 
 _lock = threading.Lock()
@@ -22,6 +23,12 @@ def ensure_jax():
             return jax
         import jax
 
+        # honor JAX_PLATFORMS even when a site hook pre-imported jax with a
+        # different platform baked in (env vars are read at import time);
+        # without this, JAX_PLATFORMS=cpu can still dial a dead TPU plugin
+        plat = os.environ.get("JAX_PLATFORMS")
+        if plat:
+            jax.config.update("jax_platforms", plat)
         jax.config.update("jax_enable_x64", True)
         _ready = True
         return jax

@@ -260,6 +260,14 @@ class TpuStageExec(ExecutionPlan):
                 except Unsupported as e:
                     log.info("tpu fallback (%s): %s", e, self.partial_agg.node_str())
                     self._results = {}
+                except Exception:  # noqa: BLE001
+                    # the device path must never fail a query the CPU engine
+                    # can run: adaptive per-subtree dispatch, loudly
+                    log.warning(
+                        "tpu stage raised; falling back to cpu for %s",
+                        self.partial_agg.node_str(), exc_info=True,
+                    )
+                    self._results = {}
         if partition in self._results:
             return self._results.pop(partition)
         return self._fallback(partition, ctx)
@@ -416,6 +424,8 @@ class TpuStageExec(ExecutionPlan):
 
         build_args = [[b.keys] + list(b.payloads) for b in builds]
         outs = fn(dt.cols, luts, dt.mask, build_args)
+        if meta["mode"] == "sorted":
+            return self._decode_sorted(outs, meta, P, dicts, [b.dicts for b in builds])
         outs = jax.device_get(list(outs))  # ONE batched fetch
         return self._decode_all(outs, meta, P, dicts, [b.dicts for b in builds])
 
@@ -483,28 +493,38 @@ class TpuStageExec(ExecutionPlan):
             else:
                 raise Unsupported(f"op {type(op).__name__}")
         _bind_env(ctx, cur_schema)
+        ctx.stage_filter_fns = filter_fns  # shared with the sorted path
 
-        group_src_slots = []
-        group_fns = []
-        pad_sizes = []
+        # Group-key strategy: small dictionary domains unroll into per-group
+        # masked reductions (pure VPU, no scatter/sort). Everything else —
+        # int64 keys like l_orderkey, composite keys, big dictionaries —
+        # goes through the sort-based segmented reduction below.
+        unrolled = True
+        group_src_slots: list = []
+        group_fns: list = []
+        pad_sizes: list = []
         for g in agg.group_exprs:
             gc = g.expr if isinstance(g, Alias) else g
             if not isinstance(gc, Column):
-                raise Unsupported(f"non-column group key {g}")
+                unrolled = False
+                break
             i = cur_schema.index_of(gc.name, gc.qualifier)
-            meta = ctx.env_meta[i]
-            if meta is None or meta[0] != "code" or meta[2] is None:
-                raise Unsupported(f"group key {gc} is not a dictionary column")
+            gmeta = ctx.env_meta[i]
+            if gmeta is None or gmeta[0] != "code" or gmeta[2] is None:
+                unrolled = False
+                break
             group_fns.append(ctx.env_fns[i])
-            group_src_slots.append(meta[3])
-            pad_sizes.append(_pow2(len(meta[2])))
+            group_src_slots.append(gmeta[3])
+            pad_sizes.append(_pow2(len(gmeta[2])))
 
         G = 1
         for p in pad_sizes:
             G *= p
         G = max(G, 1)
-        if G * P > MAX_SEGMENTS * 16:
-            raise Unsupported(f"group domain {G}x{P} too large")
+        if unrolled and agg.group_exprs and (G > 64 or G * P > MAX_SEGMENTS * 16):
+            # the unrolled form materializes G masked reductions; beyond this
+            # the sorted form wins (and scatter-free unrolling stops scaling)
+            unrolled = False
 
         agg_fns = []
         for d in agg.aggs:
@@ -512,11 +532,22 @@ class TpuStageExec(ExecutionPlan):
                 raise Unsupported(f"agg {d.func}")
             agg_fns.append(lower_expr(d.expr, ctx) if d.expr is not None else None)
 
-        if G > 64:
-            # scatter-based segment ops are pathological on TPU; larger group
-            # domains stay on the CPU engine until the sort-based device
-            # aggregation lands
-            raise Unsupported(f"group domain {G} > unrolled limit")
+        if not unrolled:
+            group_fns = [lower_expr(g, ctx) for g in agg.group_exprs]
+            # live-dictionary slots for decode (compilations are shared
+            # across tables with equal shapes/dict sizes; dict CONTENTS are
+            # resolved at decode time, never baked into the cached meta)
+            key_slots: list = []
+            for g in agg.group_exprs:
+                gc = g.expr if isinstance(g, Alias) else g
+                slot = None
+                if isinstance(gc, Column):
+                    i = cur_schema.index_of(gc.name, gc.qualifier)
+                    gmeta = ctx.env_meta[i]
+                    if gmeta is not None:
+                        slot = gmeta[3]
+                key_slots.append(slot)
+            return self._compile_sorted(dt, ctx, P, N, builds, group_fns, agg_fns, key_slots)
 
         meta_holder: dict = {}
         aggs = agg.aggs
@@ -567,6 +598,7 @@ class TpuStageExec(ExecutionPlan):
         ]
         jitted.lower(cols_spec, luts_spec, mask_spec, builds_spec)  # trace only → meta
         meta = {
+            "mode": "unrolled",
             "out": meta_holder["out"],
             "group_src_slots": group_src_slots,
             "pad_sizes": pad_sizes,
@@ -574,7 +606,194 @@ class TpuStageExec(ExecutionPlan):
         }
         return jitted, ctx, meta
 
+    def _compile_sorted(self, dt: DeviceTable, ctx: Lowering, P: int, N: int,
+                        builds: list[BuildTable], group_fns, agg_fns, key_slots):
+        """Sort-based segmented reduction for large/int group domains.
+
+        The TPU has no fast random scatter, so hash aggregation is out; the
+        device-native plan for arbitrary group keys is: lexicographic
+        `lax.sort` over (validity, key...) with agg inputs as payload,
+        segment boundaries from adjacent-key diffs, per-segment totals via
+        cumsum-subtract (sum/count: exact int64) or a segmented associative
+        scan (min/max), then ONE unique-index scatter per output column to
+        compact segment results into a static [C] capacity. The fetch is
+        sliced to pow2(actual segment count), so a 4M-slot capacity costs
+        nothing when a query yields 10k groups. Overflow (> C distinct
+        groups) raises and the stage re-runs on the CPU engine.
+        """
+        jax = ensure_jax()
+        jnp = jax.numpy
+        agg = self.partial_agg
+        aggs = agg.aggs
+        filter_fns = ctx.stage_filter_fns
+        M = P * N
+        C = min(_pow2(M), 1 << 22)
+        meta_holder: dict = {}
+
+        def raw(cols, luts, mask, build_args):
+            cols = list(cols) + [a for b in build_args for a in b]
+            m = mask
+            for ff in filter_fns:
+                m = m & ff(cols, luts).arr
+            valid = m.reshape(-1)
+            keys = []
+            key_meta = []
+            for gf, slot in zip(group_fns, key_slots):
+                v = gf(cols, luts)
+                if v.kind == "f64":
+                    raise Unsupported("f64 group key")
+                if v.kind == "code" and slot is None:
+                    raise Unsupported("code group key without a dictionary slot")
+                arr = v.arr
+                if arr.dtype == jnp.bool_:
+                    arr = arr.astype(jnp.int32)
+                keys.append(jnp.broadcast_to(arr, mask.shape).reshape(-1))
+                key_meta.append((v.kind, v.scale, slot))
+            meta_holder["key_meta"] = key_meta
+            vals = []
+            out_meta = []
+            for d, af in zip(aggs, agg_fns):
+                if af is None or d.func in ("count", "count_all"):
+                    vals.append(None)  # counts come from segment lengths
+                    out_meta.append(("i64", 0))
+                else:
+                    v = af(cols, luts)
+                    vals.append(v)
+                    out_meta.append((v.kind, v.scale))
+            meta_holder["out"] = out_meta
+
+            operands = (
+                [(~valid).astype(jnp.int32)]
+                + keys
+                + [jnp.broadcast_to(v.arr, mask.shape).reshape(-1) for v in vals if v is not None]
+            )
+            sorted_ = jax.lax.sort(tuple(operands), num_keys=1 + len(keys))
+            svalid = sorted_[0] == 0
+            skeys = sorted_[1 : 1 + len(keys)]
+            spays = list(sorted_[1 + len(keys) :])
+
+            diff = jnp.zeros((M,), bool).at[0].set(True)
+            for k in skeys:
+                diff = diff | jnp.concatenate([jnp.ones((1,), bool), k[1:] != k[:-1]])
+            boundary = svalid & diff
+            seg = jnp.cumsum(boundary.astype(jnp.int32)) - 1
+            bor_inv = boundary | ~svalid
+            is_end = svalid & jnp.concatenate([bor_inv[1:], jnp.ones((1,), bool)])
+            n_seg = boundary.sum().astype(jnp.int32)
+
+            arange = jnp.arange(M, dtype=jnp.int32)
+            # segment-start position of each row's segment, via one scatter
+            # + gather (indices unique: one boundary row per segment)
+            spos = (
+                jnp.zeros((C,), jnp.int32)
+                .at[jnp.where(boundary, seg, C)]
+                .set(arange, mode="drop", unique_indices=True)
+            )
+            start = spos[jnp.clip(seg, 0, C - 1)]
+            end_idx = jnp.where(is_end, seg, C)
+
+            def compact(src):
+                return (
+                    jnp.zeros((C,), src.dtype)
+                    .at[end_idx]
+                    .set(src, mode="drop", unique_indices=True)
+                )
+
+            key_outs = [compact(k) for k in skeys]
+            agg_outs = []
+            pi = 0
+            for d, v in zip(aggs, vals):
+                if v is None:
+                    agg_outs.append(compact((arange - start + 1).astype(jnp.int64)))
+                    continue
+                sv = spays[pi]
+                pi += 1
+                if d.func == "sum" and jnp.issubdtype(sv.dtype, jnp.integer):
+                    # exact int64: global cumsum minus prefix-at-segment-start
+                    w = sv.astype(jnp.int64)
+                    csum = jnp.cumsum(w)
+                    presum = csum - w  # exclusive
+                    agg_outs.append(compact(csum - presum[start]))
+                else:
+                    # float sums use the segmented scan too: cumsum-subtract
+                    # would difference two near-equal whole-table totals
+                    # (catastrophic cancellation for small late segments)
+                    agg_outs.append(compact(_segscan(jnp, sv, boundary, d.func)))
+            return tuple(key_outs) + tuple(agg_outs) + (n_seg,)
+
+        jitted = jax.jit(raw)
+        cols_spec = [jax.ShapeDtypeStruct(c.shape, c.dtype) for c in dt.cols]
+        luts0 = ctx.build_luts(dt.dicts, [b.dicts for b in builds])
+        luts_spec = [jax.ShapeDtypeStruct(l.shape, l.dtype) for l in luts0]
+        mask_spec = jax.ShapeDtypeStruct(dt.mask.shape, np.bool_)
+        builds_spec = [
+            [jax.ShapeDtypeStruct(b.keys.shape, b.keys.dtype)]
+            + [jax.ShapeDtypeStruct(p.shape, p.dtype) for p in b.payloads]
+            for b in builds
+        ]
+        jitted.lower(cols_spec, luts_spec, mask_spec, builds_spec)  # trace → meta
+        meta = {
+            "mode": "sorted",
+            "out": meta_holder["out"],
+            "key_meta": meta_holder["key_meta"],
+            "C": C,
+        }
+        return jitted, ctx, meta
+
     # ------------------------------------------------------------------
+
+    def _decode_sorted(self, outs, meta: dict, P: int, dicts,
+                       build_dicts: list) -> dict[int, list[pa.RecordBatch]]:
+        """Decode the sorted-path compacted outputs. Partial-agg results are
+        mergeable, so all segments land in output partition 0 (globally
+        deduplicated across input partitions — strictly better reduction
+        than per-partition partials); other partitions emit empty."""
+        jax = ensure_jax()
+        schema = self.schema()
+        key_meta = meta["key_meta"]
+        n_keys = len(key_meta)
+        C = meta["C"]
+        n = int(jax.device_get(outs[-1]))
+        if n > C:
+            raise Unsupported(f"group capacity overflow ({n} > {C})")
+        results = {p: [_empty_batch(schema)] for p in range(P)}
+        if n == 0:
+            return results
+        cp = min(_pow2(n), C)  # sliced fetch: pay for actual groups only
+        host = jax.device_get([o[:cp] for o in outs[:-1]])
+        arrays: list[pa.Array] = []
+        for kv, (kind, scale, slot), f in zip(host[:n_keys], key_meta, schema):
+            vals = kv[:n]
+            if kind == "code":
+                # resolve the LIVE dictionary (compilations are shared across
+                # tables with equal shapes; contents are per-table)
+                if isinstance(slot, tuple) and slot[0] == "build":
+                    dic = build_dicts[slot[1]][slot[2]]
+                else:
+                    dic = dicts[slot]
+                arr = pa.array([dic[int(c)] for c in vals], f.type)
+            elif kind == "date":
+                arr = pa.array(vals.astype(np.int32), pa.int32()).cast(pa.date32())
+            elif kind == "money":
+                arr = pa.array(vals.astype(np.float64) / (10**scale), pa.float64())
+            else:
+                arr = pa.array(vals)
+            if arr.type != f.type:
+                arr = arr.cast(f.type)
+            arrays.append(arr)
+        for out, (kind, scale), f in zip(host[n_keys:], meta["out"], list(schema)[n_keys:]):
+            vals = out[:n]
+            if kind == "money":
+                arr = pa.array(vals.astype(np.float64) / (10**scale), pa.float64())
+            elif kind == "date":
+                arr = pa.array(vals.astype(np.int32), pa.int32()).cast(pa.date32())
+            else:
+                arr = pa.array(vals)
+            if arr.type != f.type:
+                arr = arr.cast(f.type)
+            arrays.append(arr)
+        results[0] = [pa.RecordBatch.from_arrays(arrays, schema=schema)]
+        return results
 
     def _decode_all(self, outs: list[np.ndarray], meta: dict, P: int, dicts,
                     build_dicts: list | None = None) -> dict[int, list[pa.RecordBatch]]:
@@ -616,6 +835,23 @@ class TpuStageExec(ExecutionPlan):
                 arrays.append(arr)
             results[p] = [pa.RecordBatch.from_arrays(arrays, schema=schema)]
         return results
+
+
+def _segscan(jnp, values, boundary, func: str):
+    """Inclusive segmented sum/min/max scan: resets at boundary rows. The
+    combine is the classic segmented-scan monoid — associative, so XLA
+    lowers it to a log-depth scan."""
+    import jax
+
+    op = {"min": jnp.minimum, "max": jnp.maximum, "sum": jnp.add}[func]
+
+    def combine(a, b):
+        av, af = a
+        bv, bf = b
+        return jnp.where(bf, bv, op(av, bv)), af | bf
+
+    out, _ = jax.lax.associative_scan(combine, (values, boundary))
+    return out
 
 
 def _masked_reduce(jnp, v, gm, func: str):

@@ -1,11 +1,18 @@
 import os
 
 # Sharding/parallelism tests run on a virtual 8-device CPU mesh (the driver
-# separately dry-runs the multi-chip path); set before any jax import.
+# separately dry-runs the multi-chip path). Tests must be hermetic: a TPU
+# plugin whose tunnel died must never hang CPU-only test runs. Env vars
+# alone are too late here — a sitecustomize on PYTHONPATH may have imported
+# jax at interpreter startup — so pin the platform through the supported
+# post-import config override as well.
 os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-# the axon TPU plugin ignores JAX_PLATFORMS; JAX_PLATFORM_NAME wins
-os.environ.setdefault("JAX_PLATFORM_NAME", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["JAX_PLATFORM_NAME"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest
 

@@ -56,12 +56,85 @@ def test_q1_compiles_to_tpu_stage(tpu_ctx):
     assert "TpuStageExec" in compiled.display()
 
 
-@pytest.mark.parametrize("q", [1, 3, 5, 6, 12, 14, 19])
+@pytest.mark.parametrize("q", [1, 3, 5, 6, 10, 12, 14, 18, 19])
 def test_tpch_tpu_engine(q, tpu_ctx, tpch_ref_tables):
     eng = tpu_ctx.sql(tpch_query(q)).collect()
     ref = run_reference(q, tpch_ref_tables)
     problems = compare_results(eng, ref, q)
     assert not problems, "\n".join(problems)
+
+
+def test_large_domain_groupby_on_device(tpu_ctx):
+    """q3's group-by (l_orderkey × build-side keys — thousands of groups)
+    must take the sort-based segmented-reduction path, not fall back."""
+    import ballista_tpu.ops.tpu.stage_compiler as sc
+    from ballista_tpu.engine.tpu_engine import maybe_compile_tpu
+    from ballista_tpu.plan.physical import TaskContext
+
+    phys = maybe_compile_tpu(
+        tpu_ctx.create_physical_plan(tpu_ctx.sql(tpch_query(3)).plan), tpu_ctx.config
+    )
+    stages = [n for n in _walk(phys) if isinstance(n, sc.TpuStageExec)]
+    assert stages
+    ctx = TaskContext(tpu_ctx.config)
+    for p in range(phys.output_partition_count()):
+        list(phys.execute(p, ctx))
+    assert sum(s.tpu_count for s in stages) >= 1
+    assert sum(s.fallback_count for s in stages) == 0
+
+
+def test_sorted_path_min_max_sum_count_oracle():
+    """Synthetic large-domain aggregation: every agg func through the
+    sorted path must match pandas (int money math exact, f64 sums via the
+    segmented scan) — and must actually run on the device path."""
+    import ballista_tpu.ops.tpu.stage_compiler as sc
+    from ballista_tpu.client.context import SessionContext
+    from ballista_tpu.engine.tpu_engine import maybe_compile_tpu
+    from ballista_tpu.plan.physical import TaskContext
+
+    rng = np.random.default_rng(7)
+    n = 20_000
+    tbl = pa.table({
+        "k": rng.integers(0, 3000, n),
+        "price": np.round(rng.uniform(1, 100, n), 2),   # money (int64 cents)
+        "weight": rng.uniform(0.0, 1.0, n),              # true f64
+        "qty": rng.integers(1, 50, n),
+    })
+    cfg = BallistaConfig({EXECUTOR_ENGINE: "tpu", TPU_MIN_ROWS: 0})
+    ctx = SessionContext(cfg)
+    ctx.register_arrow_table("t", tbl, partitions=4)
+    sql = (
+        "SELECT k, sum(price) AS s, sum(weight) AS w, count(*) AS c, "
+        "min(qty) AS mn, max(qty) AS mx FROM t WHERE qty > 5 GROUP BY k ORDER BY k"
+    )
+    out = ctx.sql(sql).collect().to_pandas()
+    df = tbl.to_pandas()
+    df = df[df.qty > 5]
+    g = (
+        df.groupby("k")
+        .agg(s=("price", "sum"), w=("weight", "sum"), c=("price", "size"),
+             mn=("qty", "min"), mx=("qty", "max"))
+        .reset_index()
+        .sort_values("k")
+        .reset_index(drop=True)
+    )
+    assert len(out) == len(g)
+    assert (out.k.values == g.k.values).all()
+    assert np.allclose(out.s.values, g.s.values, atol=1e-9)
+    assert np.allclose(out.w.values, g.w.values, rtol=1e-12)
+    assert (out.c.values == g.c.values).all()
+    assert (out.mn.values == g.mn.values).all()
+    assert (out.mx.values == g.mx.values).all()
+
+    # the oracle match must come from the DEVICE path, not a silent fallback
+    phys = maybe_compile_tpu(ctx.create_physical_plan(ctx.sql(sql).plan), cfg)
+    stages = [nd for nd in _walk(phys) if isinstance(nd, sc.TpuStageExec)]
+    assert stages
+    tc = TaskContext(cfg)
+    for p in range(phys.output_partition_count()):
+        list(phys.execute(p, tc))
+    assert sum(s.tpu_count for s in stages) >= 1
+    assert sum(s.fallback_count for s in stages) == 0
 
 
 def test_tpu_stage_actually_ran(tpu_ctx):
