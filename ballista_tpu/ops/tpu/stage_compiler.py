@@ -26,6 +26,7 @@ domains, or tiny inputs re-run the original subtree on the CPU engine.
 
 from __future__ import annotations
 
+import itertools
 import logging
 import threading
 from typing import Iterator
@@ -70,6 +71,8 @@ KEY_SHIFT = 21  # multi-key combine: k = k1 << 21 | k2 (guarded ranges)
 
 DIRECT_TABLE_MAX = 1 << 27  # 128M entries × int32 = 512 MB HBM ceiling
 
+MAX_JOIN_DUP = 16  # expansion joins unroll this many match lanes at most
+
 
 class BuildTable:
     """A join's build side, encoded for device probing.
@@ -77,25 +80,45 @@ class BuildTable:
     mode 'direct': keys are dense-enough ints → a [T] int32 lookup table
     (key → build row, -1 absent): ONE gather per probe. mode 'sorted':
     binary search over sorted keys (log B gathers) — the fallback for huge
-    key ranges."""
+    key ranges. Non-unique build keys (dup > 1, "expansion joins"): the
+    payloads are laid out key-sorted and the lookup yields (first row,
+    count); the probe pipeline unrolls dup match lanes (d < count masks)
+    so each probe row can emit up to dup joined rows into the agg."""
 
-    def __init__(self, mode, keys, payloads, kinds, scales, dicts, n_rows, device=False):
+    def __init__(self, mode, keys, payloads, kinds, scales, dicts, n_rows, device=False,
+                 dup=1, cnt=None):
         self.mode = mode  # direct | sorted
-        self.keys = keys  # direct: int32 [T] row table; sorted: int64 [B] keys
-        self.payloads = payloads  # per column, padded (direct: original order)
+        self.keys = keys  # direct: int32 [T] row/lo table; sorted: int64 [B] keys
+        self.payloads = payloads  # per column, padded (unique direct: original order)
         self.kinds = kinds
         self.scales = scales
         self.dicts = dicts
         self.n_rows = n_rows
         self.device = device
+        self.dup = dup  # max duplicates per key (1 = unique fast paths)
+        self.cnt = cnt  # direct expansion mode: int32 [T] per-key match count
         self.shifts: list[int] = []  # multi-key combine shifts (per extra key)
+
+    def flat_arrays(self):
+        """Device-arg layout: keys [, cnt] , payloads... (offset contract
+        shared with the lowering closures)."""
+        out = [self.keys]
+        if self.cnt is not None:
+            out.append(self.cnt)
+        return out + list(self.payloads)
 
     def shape_key(self):
         return (
-            self.mode, len(self.keys), tuple(self.shifts),
+            self.mode, len(self.keys), tuple(self.shifts), self.dup,
+            self.cnt is not None, self.padded_rows(),
             tuple(str(p.dtype) for p in self.payloads),
             tuple(_pow2(len(d)) if d else 0 for d in self.dicts),
         )
+
+    def padded_rows(self) -> int:
+        """Padded payload length B — a compiled fn clips expansion-lane
+        indices against it, so it must be part of the compile-cache key."""
+        return self.payloads[0].shape[0] if self.payloads else _pow2(max(self.n_rows, 1))
 
 
 class DeviceTable:
@@ -339,13 +362,16 @@ class TpuStageExec(ExecutionPlan):
                     raise Unsupported("primary join key out of combine range")
                 key_np = (key_np << shift) | vals
                 shifts.append(shift)
-        if len(np.unique(key_np)) != len(key_np):
-            raise Unsupported("non-unique build keys (expansion joins stay on cpu)")
+        uniq, counts = np.unique(key_np, return_counts=True)
+        dup = int(counts.max())
+        if dup > MAX_JOIN_DUP:
+            raise Unsupported(f"build key multiplicity {dup} > {MAX_JOIN_DUP}")
 
         max_key = int(key_np.max())
         min_key = int(key_np.min())
         direct = min_key >= 0 and max_key + 1 <= DIRECT_TABLE_MAX
-        if direct:
+        cnt_dev = None
+        if dup == 1 and direct:
             T = _pow2(max_key + 1)
             table = np.full(T, -1, dtype=np.int32)
             table[key_np] = np.arange(len(key_np), dtype=np.int32)
@@ -353,8 +379,23 @@ class TpuStageExec(ExecutionPlan):
             order = np.arange(len(key_np))
             B = _pow2(len(key_np))
             mode = "direct"
+        elif direct:
+            # expansion layout: payloads key-sorted; lo/cnt tables give each
+            # probe its first matching row and its match count
+            order = np.argsort(key_np, kind="stable")
+            sorted_keys = key_np[order]
+            B = _pow2(len(sorted_keys))
+            T = _pow2(max_key + 1)
+            lo_table = np.zeros(T, dtype=np.int32)
+            cnt_table = np.zeros(T, dtype=np.int32)
+            firsts = np.searchsorted(sorted_keys, uniq)
+            lo_table[uniq] = firsts.astype(np.int32)
+            cnt_table[uniq] = counts.astype(np.int32)
+            keys_dev = lo_table
+            cnt_dev = cnt_table
+            mode = "direct"
         else:
-            order = np.argsort(key_np)
+            order = np.argsort(key_np, kind="stable")
             sorted_keys = key_np[order]
             B = _pow2(len(sorted_keys))
             keys_dev = np.full(B, np.iinfo(np.int64).max, dtype=np.int64)
@@ -375,7 +416,8 @@ class TpuStageExec(ExecutionPlan):
 
         bt = BuildTable(
             mode, jnp.asarray(keys_dev), [jnp.asarray(p) for p in payloads],
-            kinds, scales, dicts, len(order), device=True,
+            kinds, scales, dicts, len(order), device=True, dup=dup,
+            cnt=None if cnt_dev is None else jnp.asarray(cnt_dev),
         )
         bt.shifts = shifts
         _BUILD_CACHE[cache_key] = bt
@@ -422,7 +464,7 @@ class TpuStageExec(ExecutionPlan):
             luts = [jnp.asarray(l) for l in lowering.build_luts(dicts, [b.dicts for b in builds])]
             _LUT_CACHE[lut_key] = luts
 
-        build_args = [[b.keys] + list(b.payloads) for b in builds]
+        build_args = [b.flat_arrays() for b in builds]
         outs = fn(dt.cols, luts, dt.mask, build_args)
         if meta["mode"] == "sorted":
             return self._decode_sorted(outs, meta, P, dicts, [b.dicts for b in builds])
@@ -456,6 +498,7 @@ class TpuStageExec(ExecutionPlan):
         for f in getattr(self.scan, "filters", []):
             filter_fns.append(lower_expr(f, ctx))
 
+        lane_cells = [{"d": 0} for _ in builds]
         jidx = 0
         for op in self.ops:
             _bind_env(ctx, cur_schema)
@@ -464,12 +507,13 @@ class TpuStageExec(ExecutionPlan):
             elif isinstance(op, HashJoinExec):
                 bt = builds[jidx]
                 # build arrays ride at the tail of the flattened cols list
-                off = len(kinds) + sum(1 + len(builds[i].payloads) for i in range(jidx))
+                off = len(kinds) + sum(len(builds[i].flat_arrays()) for i in range(jidx))
+                pay_off = off + (2 if bt.cnt is not None else 1)
                 probe_fns = [lower_expr(r, ctx) for (_, r) in op.on]
-                finder = _mk_join_finder(off, probe_fns, bt.mode, bt.shifts)
+                finder = _mk_join_finder(off, probe_fns, bt, lane_cells[jidx])
                 filter_fns.append(lambda cols, luts, _f=finder: _f(cols, luts)[1])
                 build_fns = [
-                    _mk_build_gather(off, ci, bt.kinds[ci], bt.scales[ci], bt.dicts[ci], finder)
+                    _mk_build_gather(pay_off, ci, bt.kinds[ci], bt.scales[ci], bt.dicts[ci], finder)
                     for ci in range(len(bt.payloads))
                 ]
                 build_meta = [
@@ -494,6 +538,11 @@ class TpuStageExec(ExecutionPlan):
                 raise Unsupported(f"op {type(op).__name__}")
         _bind_env(ctx, cur_schema)
         ctx.stage_filter_fns = filter_fns  # shared with the sorted path
+        lane_sets = list(itertools.product(*[range(b.dup) for b in builds]))
+        if len(lane_sets) > MAX_JOIN_DUP:
+            raise Unsupported(f"{len(lane_sets)} expansion-join lanes > {MAX_JOIN_DUP}")
+        ctx.lane_sets = lane_sets
+        ctx.lane_cells = lane_cells
 
         # Group-key strategy: small dictionary domains unroll into per-group
         # masked reductions (pure VPU, no scatter/sort). Everything else —
@@ -521,9 +570,13 @@ class TpuStageExec(ExecutionPlan):
         for p in pad_sizes:
             G *= p
         G = max(G, 1)
-        if unrolled and agg.group_exprs and (G > 64 or G * P > MAX_SEGMENTS * 16):
-            # the unrolled form materializes G masked reductions; beyond this
-            # the sorted form wins (and scatter-free unrolling stops scaling)
+        n_lanes = len(ctx.lane_sets)
+        if unrolled and agg.group_exprs and (
+            G * n_lanes > 64 or G * n_lanes * P > MAX_SEGMENTS * 16
+        ):
+            # the unrolled form materializes G masked reductions PER
+            # expansion lane; beyond this budget the sorted form wins
+            # (and scatter-free unrolling stops scaling)
             unrolled = False
 
         agg_fns = []
@@ -552,38 +605,62 @@ class TpuStageExec(ExecutionPlan):
         meta_holder: dict = {}
         aggs = agg.aggs
 
+        lane_sets = ctx.lane_sets
+        lane_cells = ctx.lane_cells
+
         def raw(cols, luts, mask, build_args):
             # keep [P, N]: partitions are the leading axis, reductions run
             # over axis=1 — XLA fuses the per-group masked sums into single
             # VPU passes, no scatter anywhere. Join-probe gathers hit the
-            # build arrays appended after the scan columns.
+            # build arrays appended after the scan columns. Expansion joins
+            # unroll match lanes: the full pipeline is traced once per lane
+            # combination (XLA CSEs lane-invariant work) and reductions
+            # accumulate across lanes.
             cols = list(cols) + [a for b in build_args for a in b]
-            m = mask
-            for ff in filter_fns:
-                m = m & ff(cols, luts).arr
-            if group_fns:
-                gid = None
-                for gf, psz in zip(group_fns, pad_sizes):
-                    codes = gf(cols, luts).arr.astype(jnp.int32)
-                    gid = codes if gid is None else gid * psz + codes
-                gmasks = [m & (gid == g) for g in range(G)]
-            else:
-                gmasks = [m]
-            outs = []
-            out_meta = []
-            for d, af in zip(aggs, agg_fns):
-                if af is None:
-                    v = None
-                    out_meta.append(("i64", 0))
+            outs = None
+            presence = None
+            for lane in lane_sets:
+                for cell, d_ in zip(lane_cells, lane):
+                    cell["d"] = d_
+                m = mask
+                for ff in filter_fns:
+                    m = m & ff(cols, luts).arr
+                if group_fns:
+                    gid = None
+                    for gf, psz in zip(group_fns, pad_sizes):
+                        codes = gf(cols, luts).arr.astype(jnp.int32)
+                        gid = codes if gid is None else gid * psz + codes
+                    gmasks = [m & (gid == g) for g in range(G)]
                 else:
-                    v = af(cols, luts)
-                    out_meta.append(("i64", 0) if d.func == "count" else (v.kind, v.scale))
-                cols_out = []
-                for gm in gmasks:
-                    cols_out.append(_masked_reduce(jnp, v, gm, d.func))
-                outs.append(jnp.stack(cols_out, axis=1))  # [P, G]
-            presence = jnp.stack([gm.sum(axis=1) for gm in gmasks], axis=1)
-            meta_holder["out"] = out_meta
+                    gmasks = [m]
+                outs_lane = []
+                out_meta = []
+                for d, af in zip(aggs, agg_fns):
+                    if af is None:
+                        v = None
+                        out_meta.append(("i64", 0))
+                    else:
+                        v = af(cols, luts)
+                        out_meta.append(("i64", 0) if d.func == "count" else (v.kind, v.scale))
+                    cols_out = []
+                    for gm in gmasks:
+                        cols_out.append(_masked_reduce(jnp, v, gm, d.func))
+                    outs_lane.append(jnp.stack(cols_out, axis=1))  # [P, G]
+                presence_lane = jnp.stack([gm.sum(axis=1) for gm in gmasks], axis=1)
+                meta_holder["out"] = out_meta
+                if outs is None:
+                    outs, presence = outs_lane, presence_lane
+                else:
+                    merged = []
+                    for d, prev, cur in zip(aggs, outs, outs_lane):
+                        if d.func == "min":
+                            merged.append(jnp.minimum(prev, cur))
+                        elif d.func == "max":
+                            merged.append(jnp.maximum(prev, cur))
+                        else:  # sum / count: additive across lanes
+                            merged.append(prev + cur)
+                    outs = merged
+                    presence = presence + presence_lane
             return tuple(outs) + (presence,)
 
         jitted = jax.jit(raw)
@@ -592,8 +669,7 @@ class TpuStageExec(ExecutionPlan):
         luts_spec = [jax.ShapeDtypeStruct(l.shape, l.dtype) for l in luts0]
         mask_spec = jax.ShapeDtypeStruct(dt.mask.shape, np.bool_)
         builds_spec = [
-            [jax.ShapeDtypeStruct(b.keys.shape, b.keys.dtype)]
-            + [jax.ShapeDtypeStruct(p.shape, p.dtype) for p in b.payloads]
+            [jax.ShapeDtypeStruct(a.shape, a.dtype) for a in b.flat_arrays()]
             for b in builds
         ]
         jitted.lower(cols_spec, luts_spec, mask_spec, builds_spec)  # trace only → meta
@@ -626,51 +702,71 @@ class TpuStageExec(ExecutionPlan):
         agg = self.partial_agg
         aggs = agg.aggs
         filter_fns = ctx.stage_filter_fns
-        M = P * N
+        lane_sets = ctx.lane_sets
+        lane_cells = ctx.lane_cells
+        M = P * N * len(lane_sets)
         C = min(_pow2(M), 1 << 22)
         meta_holder: dict = {}
 
         def raw(cols, luts, mask, build_args):
             cols = list(cols) + [a for b in build_args for a in b]
-            m = mask
-            for ff in filter_fns:
-                m = m & ff(cols, luts).arr
-            valid = m.reshape(-1)
-            keys = []
-            key_meta = []
-            for gf, slot in zip(group_fns, key_slots):
-                v = gf(cols, luts)
-                if v.kind == "f64":
-                    raise Unsupported("f64 group key")
-                if v.kind == "code" and slot is None:
-                    raise Unsupported("code group key without a dictionary slot")
-                arr = v.arr
-                if arr.dtype == jnp.bool_:
-                    arr = arr.astype(jnp.int32)
-                keys.append(jnp.broadcast_to(arr, mask.shape).reshape(-1))
-                key_meta.append((v.kind, v.scale, slot))
-            meta_holder["key_meta"] = key_meta
-            vals = []
-            out_meta = []
-            for d, af in zip(aggs, agg_fns):
-                if af is None or d.func in ("count", "count_all"):
-                    vals.append(None)  # counts come from segment lengths
-                    out_meta.append(("i64", 0))
-                else:
-                    v = af(cols, luts)
-                    vals.append(v)
-                    out_meta.append((v.kind, v.scale))
-            meta_holder["out"] = out_meta
+            # per expansion-join match lane: (valid, keys, agg values);
+            # lanes concatenate into one row set feeding a single sort
+            lane_valid, lane_keys, lane_vals = [], [], []
+            for lane in lane_sets:
+                for cell, d_ in zip(lane_cells, lane):
+                    cell["d"] = d_
+                m = mask
+                for ff in filter_fns:
+                    m = m & ff(cols, luts).arr
+                lane_valid.append(m.reshape(-1))
+                keys = []
+                key_meta = []
+                for gf, slot in zip(group_fns, key_slots):
+                    v = gf(cols, luts)
+                    if v.kind == "f64":
+                        raise Unsupported("f64 group key")
+                    if v.kind == "code" and slot is None:
+                        raise Unsupported("code group key without a dictionary slot")
+                    arr = v.arr
+                    if arr.dtype == jnp.bool_:
+                        arr = arr.astype(jnp.int32)
+                    keys.append(jnp.broadcast_to(arr, mask.shape).reshape(-1))
+                    key_meta.append((v.kind, v.scale, slot))
+                meta_holder["key_meta"] = key_meta
+                lane_keys.append(keys)
+                vals = []
+                out_meta = []
+                for d, af in zip(aggs, agg_fns):
+                    if af is None or d.func in ("count", "count_all"):
+                        vals.append(None)  # counts come from segment lengths
+                        out_meta.append(("i64", 0))
+                    else:
+                        v = af(cols, luts)
+                        vals.append(jnp.broadcast_to(v.arr, mask.shape).reshape(-1))
+                        out_meta.append((v.kind, v.scale))
+                meta_holder["out"] = out_meta
+                lane_vals.append(vals)
 
+            valid = jnp.concatenate(lane_valid)
+            n_keys = len(lane_keys[0])
+            cat_keys = [
+                jnp.concatenate([lk[i] for lk in lane_keys]) for i in range(n_keys)
+            ]
+            cat_vals = [
+                None if lane_vals[0][i] is None
+                else jnp.concatenate([lv[i] for lv in lane_vals])
+                for i in range(len(aggs))
+            ]
             operands = (
                 [(~valid).astype(jnp.int32)]
-                + keys
-                + [jnp.broadcast_to(v.arr, mask.shape).reshape(-1) for v in vals if v is not None]
+                + cat_keys
+                + [v for v in cat_vals if v is not None]
             )
-            sorted_ = jax.lax.sort(tuple(operands), num_keys=1 + len(keys))
+            sorted_ = jax.lax.sort(tuple(operands), num_keys=1 + n_keys)
             svalid = sorted_[0] == 0
-            skeys = sorted_[1 : 1 + len(keys)]
-            spays = list(sorted_[1 + len(keys) :])
+            skeys = sorted_[1 : 1 + n_keys]
+            spays = list(sorted_[1 + n_keys :])
 
             diff = jnp.zeros((M,), bool).at[0].set(True)
             for k in skeys:
@@ -702,7 +798,7 @@ class TpuStageExec(ExecutionPlan):
             key_outs = [compact(k) for k in skeys]
             agg_outs = []
             pi = 0
-            for d, v in zip(aggs, vals):
+            for d, v in zip(aggs, cat_vals):
                 if v is None:
                     agg_outs.append(compact((arange - start + 1).astype(jnp.int64)))
                     continue
@@ -727,8 +823,7 @@ class TpuStageExec(ExecutionPlan):
         luts_spec = [jax.ShapeDtypeStruct(l.shape, l.dtype) for l in luts0]
         mask_spec = jax.ShapeDtypeStruct(dt.mask.shape, np.bool_)
         builds_spec = [
-            [jax.ShapeDtypeStruct(b.keys.shape, b.keys.dtype)]
-            + [jax.ShapeDtypeStruct(p.shape, p.dtype) for p in b.payloads]
+            [jax.ShapeDtypeStruct(a.shape, a.dtype) for a in b.flat_arrays()]
             for b in builds
         ]
         jitted.lower(cols_spec, luts_spec, mask_spec, builds_spec)  # trace → meta
@@ -897,17 +992,23 @@ def _mk_col_reader(i: int, kind: str, scale: int, dictionary):
     return run
 
 
-def _mk_join_finder(off: int, probe_fns, mode: str, shifts: list[int]):
+def _mk_join_finder(off: int, probe_fns, bt: BuildTable, cell: dict):
     """Closure computing (clamped build index, matched mask) for one join.
 
-    'direct' mode: the build shipped a dense key→row int32 table — ONE
-    gather per probe (the TPU-friendly hash table: identity hash, no
-    collisions by construction). 'sorted' mode: binary search over sorted
-    keys with an int64.max tail. Multi-key probes combine as
-    k1 << KEY_SHIFT | k2 with device range guards mirroring the host-side
-    guards, so out-of-range keys can never alias a real build key.
-    XLA CSEs the duplicate lookups issued by the per-column gathers.
+    'direct' unique mode: the build shipped a dense key→row int32 table —
+    ONE gather per probe (the TPU-friendly hash table: identity hash, no
+    collisions by construction). 'direct' expansion mode (dup > 1): lo/cnt
+    tables; the probe's match lane d (`cell["d"]`, set by the lane loop at
+    trace time) selects row lo+d, matched iff d < cnt. 'sorted' mode:
+    binary search over sorted keys with an int64.max tail (two searches
+    when expansion). Multi-key probes combine as k1 << shift | k2 with
+    device range guards mirroring the host-side guards, so out-of-range
+    keys can never alias a real build key. XLA CSEs the duplicate lookups
+    issued by the per-column gathers.
     """
+    mode, shifts, dup = bt.mode, bt.shifts, bt.dup
+    has_cnt = bt.cnt is not None
+    b_static = bt.padded_rows()  # in shape_key, so cache hits can't go stale
 
     def run(cols, luts):
         import jax.numpy as jnp
@@ -927,27 +1028,43 @@ def _mk_join_finder(off: int, probe_fns, mode: str, shifts: list[int]):
                 shift = shifts[i - 1]
                 valid = valid & (ki >= 0) & (ki < (1 << shift))
                 k = (k << shift) | ki
-        if mode == "direct":
+        d = cell["d"]
+        if mode == "direct" and not has_cnt:
             T = keys_arr.shape[0]
             in_range = valid & (k >= 0) & (k < T)
             row = keys_arr[jnp.where(in_range, k, 0)]
             matched = in_range & (row >= 0)
             idxc = jnp.clip(row, 0, None).astype(jnp.int32)
             return idxc, DevVal("bool", matched)
-        idx = jnp.searchsorted(keys_arr, k)
-        idxc = jnp.clip(idx, 0, keys_arr.shape[0] - 1)
-        matched = (keys_arr[idxc] == k) & valid
+        if mode == "direct":
+            T = keys_arr.shape[0]
+            in_range = valid & (k >= 0) & (k < T)
+            kc = jnp.where(in_range, k, 0)
+            lo = keys_arr[kc]
+            c = cols[off + 1][kc]
+            matched = in_range & (d < c)
+            idxc = jnp.clip(lo + d, 0, b_static - 1).astype(jnp.int32)
+            return idxc, DevVal("bool", matched)
+        if dup == 1:
+            idx = jnp.searchsorted(keys_arr, k)
+            idxc = jnp.clip(idx, 0, keys_arr.shape[0] - 1)
+            matched = (keys_arr[idxc] == k) & valid
+            return idxc, DevVal("bool", matched)
+        lo = jnp.searchsorted(keys_arr, k, side="left")
+        hi = jnp.searchsorted(keys_arr, k, side="right")
+        matched = valid & (lo + d < hi)
+        idxc = jnp.clip(lo + d, 0, keys_arr.shape[0] - 1).astype(jnp.int32)
         return idxc, DevVal("bool", matched)
 
     return run
 
 
-def _mk_build_gather(off: int, ci: int, kind: str, scale: int, dictionary, finder):
+def _mk_build_gather(pay_off: int, ci: int, kind: str, scale: int, dictionary, finder):
     def run(cols, luts):
         import jax.numpy as jnp
 
         idxc, _ = finder(cols, luts)
-        arr = cols[off + 1 + ci][idxc]
+        arr = cols[pay_off + ci][idxc]
         if kind in ("i64", "money") and arr.dtype != jnp.int64:
             arr = arr.astype(jnp.int64)
         elif kind in ("code", "date") and arr.dtype != jnp.int32:
