@@ -330,12 +330,18 @@ def fetch_job_results(status: dict, config: BallistaConfig) -> pa.Table:
     from ballista_tpu.plan.physical import TaskContext
     from ballista_tpu.shuffle.reader import fetch_partition
 
+    from ballista_tpu.config import FLIGHT_PROXY, SHUFFLE_READER_FORCE_REMOTE
+
     schema = status["schema"].to_arrow() if status.get("schema") is not None else None
     locs = sorted(status.get("partitions", []), key=lambda l: (l.output_partition, l.map_partition))
     ctx = TaskContext(config)
+    # a configured Flight proxy implies executors are not client-reachable:
+    # never take the same-path local shortcut (it only holds when the client
+    # shares the executor's filesystem)
+    force = bool(config.get(SHUFFLE_READER_FORCE_REMOTE)) or bool(config.get(FLIGHT_PROXY))
     batches = []
     for loc in locs:
-        for b in fetch_partition(loc, ctx):
+        for b in fetch_partition(loc, ctx, force_remote=force):
             if b.num_rows:
                 batches.append(b)
     if not batches:

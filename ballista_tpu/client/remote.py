@@ -61,6 +61,25 @@ class RemoteSchedulerClient:
         resp = self.stub.ExecuteQuery(req, timeout=30)
         return resp.job_id
 
+    def execute_sql_push(self, sql: str, job_name: str = "", timeout: float = 600.0) -> dict:
+        """Submit + watch in ONE server-streaming rpc (execute_query_push):
+        the scheduler pushes each state change; returns the terminal status."""
+        sid = self.ensure_session()
+        req = pb.ExecuteQueryParams(sql=sql, session_id=sid, job_name=job_name)
+        req.settings.extend(self._settings())
+        last: dict | None = None
+        try:
+            for event in self.stub.ExecuteQueryPush(req, timeout=timeout):
+                if event.HasField("status"):
+                    last = decode_job_status(event.status)
+                    if last["state"] in ("successful", "failed", "cancelled"):
+                        return last
+        except grpc.RpcError as e:
+            raise GrpcError(f"ExecuteQueryPush failed: {e}") from None
+        if last is None:
+            raise ExecutionError("push stream ended without a terminal status")
+        return last
+
     def wait_for_job(self, job_id: str, timeout: float = 600.0) -> dict:
         deadline = time.time() + timeout
         while time.time() < deadline:
@@ -79,13 +98,19 @@ class RemoteSchedulerClient:
 
     def collect(self, df) -> pa.Table:
         from ballista_tpu.client.context import fetch_job_results
+        from ballista_tpu.config import PUSH_STATUS
 
-        if df.sql_text is not None:
+        if df.sql_text is not None and bool(self.config.get(PUSH_STATUS)):
+            status = self.execute_sql_push(df.sql_text)
+        elif df.sql_text is not None:
             job_id = self.execute_sql(df.sql_text)
+            status = self.wait_for_job(job_id)
         else:
             physical = df.ctx.create_physical_plan(df.plan)
             job_id = self.execute_physical(physical)
-        status = self.wait_for_job(job_id)
+            status = self.wait_for_job(job_id)
         if status["state"] != "successful":
-            raise ExecutionError(f"job {job_id} {status['state']}: {status.get('error', '')}")
+            raise ExecutionError(
+                f"job {status.get('job_id', '?')} {status['state']}: {status.get('error', '')}"
+            )
         return fetch_job_results(status, self.config)

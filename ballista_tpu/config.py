@@ -43,6 +43,8 @@ AQE_EMPTY_PROPAGATION = "ballista.planner.adaptive.empty.propagation"
 AQE_DYNAMIC_JOIN_SELECTION = "ballista.planner.adaptive.join.selection"
 GRPC_CLIENT_MAX_MESSAGE_SIZE = "ballista.grpc.client.max.message.size.bytes"
 GRPC_SERVER_MAX_MESSAGE_SIZE = "ballista.grpc.server.max.message.size.bytes"
+FLIGHT_PROXY = "ballista.client.flight.proxy"
+PUSH_STATUS = "ballista.client.push.status"
 IO_RETRIES = "ballista.io.retries.times"
 IO_RETRY_WAIT_MS = "ballista.io.retry.wait.time.ms"
 CHAOS_ENABLED = "ballista.chaos.enabled"
@@ -136,6 +138,19 @@ _ENTRIES: list[ConfigEntry] = [
     ConfigEntry(AQE_DYNAMIC_JOIN_SELECTION, "AQE: choose join strategy at runtime from actual input sizes.", bool, True),
     ConfigEntry(GRPC_CLIENT_MAX_MESSAGE_SIZE, "Client-side gRPC message ceiling.", int, 256 * 1024 * 1024, _pos),
     ConfigEntry(GRPC_SERVER_MAX_MESSAGE_SIZE, "Server-side gRPC message ceiling.", int, 256 * 1024 * 1024, _pos),
+    ConfigEntry(
+        FLIGHT_PROXY,
+        "Scheduler Flight proxy address (host:port). When set, result "
+        "partitions are fetched through the scheduler instead of directly "
+        "from executors (for clients that cannot reach executors).",
+        str, "",
+    ),
+    ConfigEntry(
+        PUSH_STATUS,
+        "Use the server-streaming execute_query_push rpc (scheduler pushes "
+        "state changes) instead of polling get_job_status.",
+        bool, False,
+    ),
     ConfigEntry(IO_RETRIES, "Shuffle fetch retry attempts.", int, 3, _nonneg),
     ConfigEntry(IO_RETRY_WAIT_MS, "Base backoff between shuffle fetch retries.", int, 100, _nonneg),
     ConfigEntry(CHAOS_ENABLED, "Fault injection: wrap leaf operators in chaos nodes.", bool, False),
@@ -205,6 +220,12 @@ class BallistaConfig:
         else:
             self._extra[key] = str(value)
         return self
+
+    def set_default_if_unset(self, key: str, value: Any) -> None:
+        """Apply a host-derived default without overriding an explicit
+        session setting (executor-side memory sizing)."""
+        if key not in self._settings:
+            self.set(key, value)
 
     def get(self, key: str) -> Any:
         if key in self._settings:

@@ -91,6 +91,7 @@ class Executor:
         self._lock = threading.Lock()
         self.tasks_run = 0
         self.tasks_failed = 0
+        self.memory_limit_per_task = 0  # bytes; set by the executor process
 
     # ------------------------------------------------------------------
 
@@ -110,6 +111,14 @@ class Executor:
 
     def execute_task(self, task: TaskDescription, config: BallistaConfig | None = None) -> TaskResult:
         cfg = config or self.default_config
+        if self.memory_limit_per_task:
+            # executor-sized spill budget (cgroup/host-aware, see
+            # executor_process.detect_memory_limit) unless the session set
+            # one explicitly — the reference's per-executor MemoryPool role
+            # (executor_process.rs:465-480)
+            from ballista_tpu.config import SORT_SHUFFLE_MEMORY_LIMIT
+
+            cfg.set_default_if_unset(SORT_SHUFFLE_MEMORY_LIMIT, self.memory_limit_per_task)
         base = TaskResult(
             task_id=task.task_id, job_id=task.job_id, stage_id=task.stage_id,
             stage_attempt=task.stage_attempt, partitions=list(task.partitions), state="failed",

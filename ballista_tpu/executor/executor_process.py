@@ -38,12 +38,37 @@ POLL_INTERVAL_S = 0.25
 DIR_TTL_CHECK_S = 300.0
 
 
+def detect_memory_limit() -> int:
+    """Container/host memory in bytes: cgroup v2 → v1 → /proc/meminfo
+    (the reference's fraction-of-cgroup/host autodetect,
+    executor_process.rs:465-480)."""
+    for path in ("/sys/fs/cgroup/memory.max", "/sys/fs/cgroup/memory/memory.limit_in_bytes"):
+        try:
+            with open(path) as f:
+                raw = f.read().strip()
+            if raw != "max":
+                v = int(raw)
+                if 0 < v < (1 << 60):  # v1 reports ~int64.max when unlimited
+                    return v
+        except (OSError, ValueError):
+            continue
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                if line.startswith("MemTotal:"):
+                    return int(line.split()[1]) * 1024
+    except OSError:
+        pass
+    return 4 * 1024**3
+
+
 class ExecutorProcess:
     def __init__(self, scheduler_addr: str, bind_host: str = "0.0.0.0",
                  external_host: str | None = None, grpc_port: int = 0,
                  flight_port: int = 0, vcores: int | None = None,
                  work_dir: str | None = None, engine: str = "cpu",
-                 policy: str = "push", work_dir_ttl_s: float = 4 * 3600):
+                 policy: str = "push", work_dir_ttl_s: float = 4 * 3600,
+                 memory_pool_bytes: int = 0, memory_fraction: float = 0.6):
         self.scheduler_addr = scheduler_addr
         self.work_dir = work_dir or tempfile.mkdtemp(prefix="ballista-tpu-executor-")
         self.policy = policy
@@ -54,10 +79,15 @@ class ExecutorProcess:
         config = BallistaConfig({EXECUTOR_ENGINE: engine})
         self.flight_server, bound_flight = start_flight_server(self.work_dir, bind_host, flight_port)
 
+        self.memory_pool_bytes = memory_pool_bytes or int(detect_memory_limit() * memory_fraction)
         self.metadata = ExecutorMetadata(
             id=str(new_executor_id()), host=host, flight_port=bound_flight, vcores=vcores
         )
         self.executor = Executor(self.work_dir, self.metadata, config=config)
+        # concurrent tasks share the pool: per-task spill budget
+        self.executor.memory_limit_per_task = max(
+            64 * 1024 * 1024, self.memory_pool_bytes // max(1, vcores)
+        )
 
         self._channel = grpc.insecure_channel(scheduler_addr)
         self._scheduler = scheduler_stub(self._channel)
@@ -203,6 +233,10 @@ def main(argv=None) -> None:
     ap.add_argument("--work-dir", default=None)
     ap.add_argument("--engine", choices=("cpu", "tpu"), default="cpu")
     ap.add_argument("--policy", choices=("push", "pull"), default="push")
+    ap.add_argument("--memory-pool-bytes", type=int, default=0,
+                    help="fixed memory pool size (0 = fraction of cgroup/host)")
+    ap.add_argument("--memory-fraction", type=float, default=0.6,
+                    help="fraction of detected cgroup/host memory for the pool")
     ap.add_argument("--log-level", default="INFO")
     args = ap.parse_args(argv)
     logging.basicConfig(level=args.log_level, format="%(asctime)s %(levelname)s %(name)s %(message)s")
@@ -210,6 +244,7 @@ def main(argv=None) -> None:
     proc = ExecutorProcess(
         args.scheduler, args.bind_host, args.external_host, args.grpc_port,
         args.flight_port, args.concurrent_tasks, args.work_dir, args.engine, args.policy,
+        memory_pool_bytes=args.memory_pool_bytes, memory_fraction=args.memory_fraction,
     )
     signal.signal(signal.SIGTERM, lambda *_: proc.shutdown())
     proc.start()

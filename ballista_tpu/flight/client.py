@@ -59,11 +59,21 @@ def _ticket(loc: PartitionLocation) -> dict:
 
 
 def fetch_partition_flight(loc: PartitionLocation, ctx: TaskContext) -> Iterator[pa.RecordBatch]:
-    addr = f"{loc.host}:{loc.flight_port}"
+    from ballista_tpu.config import FLIGHT_PROXY
+
+    proxy = str(ctx.config.get(FLIGHT_PROXY) or "")
+    if proxy:
+        # external mode (distributed_query.rs:754-783): relay through the
+        # scheduler's Flight proxy; the ticket carries the owning executor
+        addr = proxy
+        ticket = {**_ticket(loc), "host": loc.host, "flight_port": loc.flight_port}
+    else:
+        addr = f"{loc.host}:{loc.flight_port}"
+        ticket = _ticket(loc)
     client = POOL.get(addr)
     try:
         if bool(ctx.config.get(SHUFFLE_BLOCK_TRANSPORT)):
-            action = flight.Action("io_block_transport", json.dumps(_ticket(loc)).encode())
+            action = flight.Action("io_block_transport", json.dumps(ticket).encode())
             blocks = [r.body.to_pybytes() for r in client.do_action(action)]
             if not blocks:
                 return
@@ -71,7 +81,7 @@ def fetch_partition_flight(loc: PartitionLocation, ctx: TaskContext) -> Iterator
             reader = ipc.open_stream(pa.BufferReader(buf))
             yield from reader
         else:
-            t = flight.Ticket(json.dumps(_ticket(loc)).encode())
+            t = flight.Ticket(json.dumps(ticket).encode())
             for chunk in client.do_get(t):
                 yield chunk.data
     except Exception:

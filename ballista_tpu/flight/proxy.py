@@ -1,0 +1,70 @@
+"""Scheduler-side Flight result proxy.
+
+Rebuild of BallistaFlightProxyService (scheduler/src/flight_proxy_service.rs:42,114)
++ the client's FlightProxy::External mode (core/src/execution_plans/
+distributed_query.rs:754-783): clients that cannot reach executors directly
+(NAT, k8s cluster networking) fetch result partitions from the scheduler,
+which relays from the owning executor over the raw-block path.
+
+Tickets are the normal fetch tickets plus the executor's {host, flight_port}
+so the proxy knows where to relay from.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+
+import pyarrow as pa
+import pyarrow.flight as flight
+import pyarrow.ipc as ipc
+
+BLOCK_SIZE = 8 * 1024 * 1024
+
+
+def _relay_bytes(ticket: dict) -> bytes:
+    """Pull the stored IPC bytes from the owning executor (raw-block mode —
+    no decode on the proxy hop)."""
+    from ballista_tpu.flight.client import POOL
+
+    addr = f"{ticket['host']}:{ticket['flight_port']}"
+    client = POOL.get(addr)
+    try:
+        action = flight.Action("io_block_transport", json.dumps(ticket).encode())
+        return b"".join(r.body.to_pybytes() for r in client.do_action(action))
+    except Exception:
+        POOL.discard(addr)
+        raise
+
+
+class FlightResultProxy(flight.FlightServerBase):
+    def __init__(self, host: str = "0.0.0.0", port: int = 0):
+        super().__init__(f"grpc://{host}:{port}")
+
+    def do_get(self, context, ticket):
+        t = json.loads(ticket.ticket.decode())
+        buf = _relay_bytes(t)
+        if not buf:
+            return flight.RecordBatchStream(pa.table({}))
+        reader = ipc.open_stream(pa.BufferReader(buf))
+        return flight.RecordBatchStream(reader.read_all())
+
+    def do_action(self, context, action):
+        if action.type == "io_block_transport":
+            t = json.loads(action.body.to_pybytes().decode())
+            buf = _relay_bytes(t)
+            for off in range(0, len(buf), BLOCK_SIZE):
+                yield flight.Result(pa.py_buffer(buf[off : off + BLOCK_SIZE]))
+            return
+        raise flight.FlightServerError(f"unknown action {action.type}")
+
+    def list_actions(self, context):
+        return [("io_block_transport", "relay raw IPC blocks from an executor")]
+
+
+def start_flight_proxy(host: str = "0.0.0.0", port: int = 0) -> tuple[FlightResultProxy, int]:
+    server = FlightResultProxy(host, port)
+    bound = server.port
+    t = threading.Thread(target=server.serve, daemon=True, name="flight-proxy")
+    t.start()
+    return server, bound

@@ -25,6 +25,35 @@ from ballista_tpu.scheduler.server import SchedulerServer
 from ballista_tpu.version import BALLISTA_VERSION
 
 
+def _metric_percentiles(raw: list[dict]) -> list[dict]:
+    """Per-operator percentile summary across a stage's task metrics
+    (reference: api/handlers.rs:191,200 metric percentiles)."""
+    by_op: dict[tuple, list[dict]] = {}
+    for m in raw:
+        by_op.setdefault((int(m.get("depth", 0)), str(m.get("name", ""))), []).append(m)
+
+    def pct(sorted_vals, p):
+        if not sorted_vals:
+            return 0
+        i = min(len(sorted_vals) - 1, int(round(p / 100.0 * (len(sorted_vals) - 1))))
+        return sorted_vals[i]
+
+    out = []
+    for (depth, name), ms in sorted(by_op.items()):
+        elapsed = sorted(int(m.get("elapsed_ns", 0)) for m in ms)
+        rows = sorted(int(m.get("output_rows", 0)) for m in ms)
+        out.append({
+            "depth": depth, "name": name, "tasks": len(ms),
+            "output_rows_total": sum(rows),
+            "elapsed_ms_p50": pct(elapsed, 50) / 1e6,
+            "elapsed_ms_p90": pct(elapsed, 90) / 1e6,
+            "elapsed_ms_p99": pct(elapsed, 99) / 1e6,
+            "output_rows_p50": pct(rows, 50),
+            "output_rows_p99": pct(rows, 99),
+        })
+    return out
+
+
 def start_rest_api(scheduler: SchedulerServer, metrics: InMemoryMetricsCollector,
                    host: str = "0.0.0.0", port: int = 0):
     class Handler(BaseHTTPRequestHandler):
@@ -54,6 +83,7 @@ def start_rest_api(scheduler: SchedulerServer, metrics: InMemoryMetricsCollector
                     "scheduler_id": scheduler.scheduler_id,
                     "executors": len(scheduler.executors.alive_executors()),
                     "jobs": jobs,
+                    "flight_proxy_port": getattr(scheduler, "flight_proxy_port", 0),
                 })
             if p == "/api/executors":
                 out = []
@@ -89,6 +119,7 @@ def start_rest_api(scheduler: SchedulerServer, metrics: InMemoryMetricsCollector
                 stages = []
                 for sid in sorted(g.stages):
                     s = g.stages[sid]
+                    raw = g.stage_metrics.get(sid, [])
                     stages.append({
                         "stage_id": sid, "state": s.state.value, "attempt": s.attempt,
                         "partitions": s.spec.partitions,
@@ -96,7 +127,8 @@ def start_rest_api(scheduler: SchedulerServer, metrics: InMemoryMetricsCollector
                         "pending": len(s.pending), "running": len(s.running),
                         "completed": len(s.completed),
                         "plan": s.spec.plan.display(),
-                        "metrics": g.stage_metrics.get(sid, [])[:200],
+                        "metrics": raw[:200],
+                        "metric_percentiles": _metric_percentiles(raw),
                     })
                 return self._json(stages)
             m = re.match(r"^/api/job/([^/]+)/dot$", p)

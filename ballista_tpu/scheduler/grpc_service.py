@@ -58,6 +58,28 @@ class SchedulerGrpcService:
             out.status.CopyFrom(encode_job_status(status))
         return out
 
+    def ExecuteQueryPush(self, request: pb.ExecuteQueryParams, context):
+        """Server-streaming variant (grpc.rs:419): submit, then push a
+        status event on every state change until the job is terminal — no
+        client polling."""
+        import time as _time
+
+        first = self.ExecuteQuery(request, context)
+        yield pb.ExecuteQueryPushResult(job_id=first.job_id, session_id=first.session_id)
+        last_state = None
+        while context.is_active():
+            status = self.scheduler.job_status(first.job_id)
+            if status is None:
+                return
+            if status["state"] != last_state:
+                last_state = status["state"]
+                out = pb.ExecuteQueryPushResult(job_id=first.job_id, session_id=first.session_id)
+                out.status.CopyFrom(encode_job_status(status))
+                yield out
+                if last_state in ("successful", "failed", "cancelled"):
+                    return
+            _time.sleep(0.05)
+
     def CreateUpdateSession(self, request: pb.CreateSessionParams, context) -> pb.CreateSessionResult:
         sid = self.scheduler.sessions.create_or_update(
             [(kv.key, kv.value) for kv in request.settings], request.session_id
@@ -144,11 +166,22 @@ _RPCS = {
     "ExecutorStopped": (pb.ExecutorStoppedParams, pb.ExecutorStoppedResult),
 }
 
+# server-streaming rpcs (reference: execute_query_push, grpc.rs:419)
+_STREAM_RPCS = {
+    "ExecuteQueryPush": (pb.ExecuteQueryParams, pb.ExecuteQueryPushResult),
+}
+
 
 def add_scheduler_service(server: grpc.Server, service: SchedulerGrpcService) -> None:
     handlers = {}
     for name, (req_t, _resp_t) in _RPCS.items():
         handlers[name] = grpc.unary_unary_rpc_method_handler(
+            getattr(service, name),
+            request_deserializer=req_t.FromString,
+            response_serializer=lambda resp: resp.SerializeToString(),
+        )
+    for name, (req_t, _resp_t) in _STREAM_RPCS.items():
+        handlers[name] = grpc.unary_stream_rpc_method_handler(
             getattr(service, name),
             request_deserializer=req_t.FromString,
             response_serializer=lambda resp: resp.SerializeToString(),
@@ -167,6 +200,15 @@ def scheduler_stub(channel: grpc.Channel):
         setattr(
             stub, name,
             channel.unary_unary(
+                f"/{SERVICE_NAME}/{name}",
+                request_serializer=req_t.SerializeToString,
+                response_deserializer=resp_t.FromString,
+            ),
+        )
+    for name, (req_t, resp_t) in _STREAM_RPCS.items():
+        setattr(
+            stub, name,
+            channel.unary_stream(
                 f"/{SERVICE_NAME}/{name}",
                 request_serializer=req_t.SerializeToString,
                 response_deserializer=resp_t.FromString,

@@ -65,7 +65,7 @@ class GrpcTaskLauncher(TaskLauncher):
 class SchedulerProcess:
     def __init__(self, bind_host: str = "0.0.0.0", port: int = 50050,
                  task_distribution: str = "bias", executor_timeout_s: float = 180.0,
-                 rest_port: int = 0):
+                 rest_port: int = 0, flight_proxy_port: int = 0):
         self.metrics = InMemoryMetricsCollector()
         self.scheduler = SchedulerServer(
             GrpcTaskLauncher(), self.metrics, task_distribution, executor_timeout_s
@@ -83,6 +83,15 @@ class SchedulerProcess:
             self.rest_server, self.rest_port = start_rest_api(
                 self.scheduler, self.metrics, bind_host, rest_port
             )
+        self.flight_proxy = None
+        self.flight_proxy_port = 0
+        if flight_proxy_port >= 0:
+            from ballista_tpu.flight.proxy import start_flight_proxy
+
+            self.flight_proxy, self.flight_proxy_port = start_flight_proxy(
+                bind_host, flight_proxy_port
+            )
+            self.scheduler.flight_proxy_port = self.flight_proxy_port
 
     def start(self) -> None:
         self.scheduler.start()
@@ -100,6 +109,11 @@ class SchedulerProcess:
         self.grpc_server.stop(grace=2)
         if self.rest_server is not None:
             self.rest_server.shutdown()
+        if self.flight_proxy is not None:
+            try:
+                self.flight_proxy.shutdown()
+            except Exception:
+                pass
 
     def wait(self) -> None:
         try:
@@ -114,6 +128,8 @@ def main(argv=None) -> None:
     ap.add_argument("--bind-host", default="0.0.0.0")
     ap.add_argument("--port", type=int, default=50050)
     ap.add_argument("--rest-port", type=int, default=50080)
+    ap.add_argument("--flight-proxy-port", type=int, default=50051,
+                    help="Flight result proxy port (-1 disables; 0 = ephemeral)")
     ap.add_argument("--task-distribution", choices=("bias", "round-robin"), default="bias")
     ap.add_argument("--executor-timeout-seconds", type=float, default=180.0)
     ap.add_argument("--log-level", default="INFO")
@@ -123,7 +139,7 @@ def main(argv=None) -> None:
     proc = SchedulerProcess(
         args.bind_host, args.port,
         "round_robin" if args.task_distribution == "round-robin" else "bias",
-        args.executor_timeout_seconds, args.rest_port,
+        args.executor_timeout_seconds, args.rest_port, args.flight_proxy_port,
     )
     signal.signal(signal.SIGTERM, lambda *_: proc.shutdown())
     proc.start()

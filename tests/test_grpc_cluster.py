@@ -69,10 +69,64 @@ def test_rest_api(grpc_cluster, remote_ctx):
     job_id = jobs[-1]["job_id"]
     stages = json.load(urllib.request.urlopen(f"http://127.0.0.1:{port}/api/job/{job_id}/stages"))
     assert stages and "plan" in stages[0]
+    pcts = [p for s in stages for p in s.get("metric_percentiles", [])]
+    assert pcts and all("elapsed_ms_p50" in p and "tasks" in p for p in pcts)
     dot = urllib.request.urlopen(f"http://127.0.0.1:{port}/api/job/{job_id}/dot").read().decode()
     assert dot.startswith("digraph")
     metrics = urllib.request.urlopen(f"http://127.0.0.1:{port}/api/metrics").read().decode()
     assert "ballista_scheduler_jobs_completed_total" in metrics
+
+
+def test_flight_result_proxy(grpc_cluster, tpch_dir):
+    """Clients that cannot reach executors fetch results through the
+    scheduler's Flight proxy (flight_proxy_service.rs analog)."""
+    from ballista_tpu.client.context import SessionContext
+    from ballista_tpu.config import FLIGHT_PROXY
+    from ballista_tpu.testing.tpchgen import register_tpch
+
+    sched, addr = grpc_cluster
+    assert sched.flight_proxy_port > 0
+    ctx = SessionContext.remote(addr)
+    ctx.config.set(FLIGHT_PROXY, f"127.0.0.1:{sched.flight_proxy_port}")
+    register_tpch(ctx, tpch_dir)
+    out = ctx.sql(
+        "select r_name, count(*) c from nation, region "
+        "where n_regionkey = r_regionkey group by r_name order by r_name"
+    ).collect()
+    assert out.num_rows == 5
+    assert out.column("c").to_pylist() == [5, 5, 5, 5, 5]
+
+
+def test_execute_query_push(grpc_cluster, tpch_dir):
+    """Server-streaming status: submit + watch in one rpc, no polling."""
+    from ballista_tpu.client.context import SessionContext
+    from ballista_tpu.config import PUSH_STATUS
+    from ballista_tpu.testing.tpchgen import register_tpch
+
+    _, addr = grpc_cluster
+    ctx = SessionContext.remote(addr)
+    ctx.config.set(PUSH_STATUS, True)
+    register_tpch(ctx, tpch_dir)
+    out = ctx.sql("select count(*) n from nation").collect()
+    assert out.column("n").to_pylist() == [25]
+    # direct stream: terminal event carries the full status
+    client = ctx._ensure_remote()
+    status = client.execute_sql_push("select count(*) n from region")
+    assert status["state"] == "successful"
+
+
+def test_executor_memory_sizing(grpc_cluster):
+    """cgroup/host-aware memory pool drives the per-task spill budget."""
+    from ballista_tpu.config import BallistaConfig, SORT_SHUFFLE_MEMORY_LIMIT
+    from ballista_tpu.executor.executor_process import detect_memory_limit
+
+    assert detect_memory_limit() > 0
+    cfg = BallistaConfig()
+    cfg.set_default_if_unset(SORT_SHUFFLE_MEMORY_LIMIT, 123)
+    assert cfg.get(SORT_SHUFFLE_MEMORY_LIMIT) == 123
+    explicit = BallistaConfig({SORT_SHUFFLE_MEMORY_LIMIT: 999})
+    explicit.set_default_if_unset(SORT_SHUFFLE_MEMORY_LIMIT, 123)
+    assert explicit.get(SORT_SHUFFLE_MEMORY_LIMIT) == 999
 
 
 def test_wire_version_gate(grpc_cluster):
