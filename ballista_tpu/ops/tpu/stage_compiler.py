@@ -146,8 +146,9 @@ class DeviceTableCache:
         self._lock = threading.Lock()
         self._inflight: dict[tuple, threading.Event] = {}
 
-    def get(self, scan, buckets: list[int], ctx, max_bytes: int) -> DeviceTable:
-        key = self.key_of(scan)
+    def get(self, scan, buckets: list[int], ctx, max_bytes: int,
+            mesh=None) -> DeviceTable:
+        key = self.key_of(scan) + ((mesh.devices.size,) if mesh is not None else ())
         with self._lock:
             hit = self._cache.get(key)
             if hit is not None:
@@ -166,7 +167,7 @@ class DeviceTableCache:
                 raise Unsupported("peer encode failed")
             return hit
         try:
-            dt = self._load(scan, buckets, ctx)
+            dt = self._load(scan, buckets, ctx, mesh)
             with self._lock:
                 total = sum(v.nbytes for v in self._cache.values())
                 while self._cache and total + dt.nbytes > max_bytes:
@@ -188,7 +189,7 @@ class DeviceTableCache:
             return (files, tuple(scan.projection))
         return (id(scan),)
 
-    def _load(self, scan, buckets: list[int], ctx) -> DeviceTable:
+    def _load(self, scan, buckets: list[int], ctx, mesh=None) -> DeviceTable:
         import concurrent.futures as fut
 
         jax = ensure_jax()
@@ -208,6 +209,14 @@ class DeviceTableCache:
         full = pa.concat_tables(tables)
         N = next_bucket(max(max(part_rows), 1), buckets)
 
+        # multi-chip: shard the partition axis across the mesh — pad P to a
+        # multiple of the device count with empty (all-masked) partitions
+        if mesh is not None:
+            nd = mesh.devices.size
+            while len(part_rows) % nd:
+                part_rows.append(0)
+        P = len(part_rows)
+
         kinds, scales, dicts, cols_np = [], [], [], []
         for name in full.column_names:
             dc = encode_column(full.column(name))
@@ -226,8 +235,14 @@ class DeviceTableCache:
         for p, r in enumerate(part_rows):
             mask_np[p, :r] = True
 
-        cols = [jnp.asarray(c) for c in cols_np]
-        mask = jnp.asarray(mask_np)
+        if mesh is not None:
+            from jax.sharding import PartitionSpec
+
+            spec = PartitionSpec("part", None)
+        else:
+            spec = None
+        cols = [_put(mesh, c, spec) for c in cols_np]
+        mask = _put(mesh, mask_np, spec)
         nbytes = sum(c.nbytes for c in cols_np) + mask_np.nbytes
         return DeviceTable(kinds, scales, dicts, cols, mask, part_rows, nbytes)
 
@@ -311,7 +326,8 @@ class TpuStageExec(ExecutionPlan):
 
     # ------------------------------------------------------------------
 
-    def _prepare_build(self, join, jidx: int, ctx: TaskContext, table_key) -> BuildTable:
+    def _prepare_build(self, join, jidx: int, ctx: TaskContext, table_key,
+                       mesh=None) -> BuildTable:
         """Collect + encode + sort a join's build side for device probing."""
         import numpy as np
 
@@ -320,7 +336,7 @@ class TpuStageExec(ExecutionPlan):
 
         jax = ensure_jax()
         jnp = jax.numpy
-        cache_key = (table_key, self.fingerprint, jidx)
+        cache_key = (table_key, self.fingerprint, jidx, mesh.devices.size if mesh else 0)
         hit = _BUILD_CACHE.get(cache_key)
         if hit is not None:
             return hit
@@ -415,9 +431,9 @@ class TpuStageExec(ExecutionPlan):
             payloads.append(padded)
 
         bt = BuildTable(
-            mode, jnp.asarray(keys_dev), [jnp.asarray(p) for p in payloads],
+            mode, _put(mesh, keys_dev), [_put(mesh, p) for p in payloads],
             kinds, scales, dicts, len(order), device=True, dup=dup,
-            cnt=None if cnt_dev is None else jnp.asarray(cnt_dev),
+            cnt=None if cnt_dev is None else _put(mesh, cnt_dev),
         )
         bt.shifts = shifts
         _BUILD_CACHE[cache_key] = bt
@@ -431,14 +447,15 @@ class TpuStageExec(ExecutionPlan):
         jnp = jax.numpy
 
         max_bytes = int(self.config.get(TPU_MAX_DEVICE_BYTES))
-        dt = DEVICE_CACHE.get(self.scan, self.buckets, ctx, max_bytes)
+        mesh = _stage_mesh(self.config)
+        dt = DEVICE_CACHE.get(self.scan, self.buckets, ctx, max_bytes, mesh)
         if sum(dt.part_rows) < self.min_rows:
             raise Unsupported(f"only {sum(dt.part_rows)} rows (< tpu min)")
 
         table_key = DEVICE_CACHE.key_of(self.scan)
         builds: list[BuildTable] = []
         for jidx, op in enumerate(o for o in self.ops if isinstance(o, HashJoinExec)):
-            builds.append(self._prepare_build(op, jidx, ctx, table_key))
+            builds.append(self._prepare_build(op, jidx, ctx, table_key, mesh))
 
         P, N = dt.shape
         kinds = list(zip(dt.kinds, dt.scales))
@@ -457,11 +474,13 @@ class TpuStageExec(ExecutionPlan):
                 _COMPILE_CACHE[key] = cached
         fn, lowering, meta = cached
 
-        # device LUTs cached per (table, stage): zero uploads when hot
-        lut_key = (table_key, self.fingerprint)
+        # device LUTs cached per (table, stage): zero uploads when hot;
+        # replicated across the mesh so probe gathers stay local
+        lut_key = (table_key, self.fingerprint, mesh.devices.size if mesh else 0)
         luts = _LUT_CACHE.get(lut_key)
         if luts is None:
-            luts = [jnp.asarray(l) for l in lowering.build_luts(dicts, [b.dicts for b in builds])]
+            raw_luts = lowering.build_luts(dicts, [b.dicts for b in builds])
+            luts = [_put(mesh, l) for l in raw_luts]
             _LUT_CACHE[lut_key] = luts
 
         build_args = [b.flat_arrays() for b in builds]
@@ -930,6 +949,38 @@ class TpuStageExec(ExecutionPlan):
                 arrays.append(arr)
             results[p] = [pa.RecordBatch.from_arrays(arrays, schema=schema)]
         return results
+
+
+def _put(mesh, arr, spec=None):
+    """Place an array for stage execution: mesh-sharded/replicated under a
+    mesh, plain device array otherwise. The single place that decides
+    placement (memory kind, donation would go here)."""
+    jax = ensure_jax()
+    if mesh is None:
+        return jax.numpy.asarray(arr)
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    return jax.device_put(arr, NamedSharding(mesh, spec if spec is not None else PartitionSpec()))
+
+
+def _stage_mesh(config: BallistaConfig):
+    """1-D mesh over the partition axis when collective exchange is on and
+    more than one accelerator is visible: the stage kernel's inputs shard
+    by partition and XLA/GSPMD inserts the ICI collectives (psum-style
+    merges, gather for the compacted outputs) — the collective form of the
+    file shuffle for co-scheduled stages (SURVEY.md §2.5 TPU-native
+    equivalent). One executor process drives the whole slice."""
+    from ballista_tpu.config import TPU_COLLECTIVE_EXCHANGE
+
+    if not bool(config.get(TPU_COLLECTIVE_EXCHANGE)):
+        return None
+    jax = ensure_jax()
+    devs = jax.devices()
+    if len(devs) < 2:
+        return None
+    from jax.sharding import Mesh
+
+    return Mesh(np.array(devs), ("part",))
 
 
 def _segscan(jnp, values, boundary, func: str):
