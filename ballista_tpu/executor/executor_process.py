@@ -62,13 +62,78 @@ def detect_memory_limit() -> int:
     return 4 * 1024**3
 
 
+def _ensure_native_flight_binary() -> str | None:
+    """Build native/ballista-flight-server if missing. flock-serialized
+    (concurrent executors on one host must not race g++ over the same
+    output) with a negative-result marker so hosts where the build fails
+    pay the compile attempt once, not per executor start."""
+    import fcntl
+    import subprocess
+
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    native = os.path.join(repo, "native")
+    bin_path = os.path.join(native, "ballista-flight-server")
+    build = os.path.join(native, "build.sh")
+    if os.path.exists(bin_path):
+        return bin_path
+    if not os.path.exists(build):
+        return None
+    marker = os.path.join(native, ".flight_build_failed")
+    try:
+        with open(os.path.join(native, ".build.lock"), "w") as lock:
+            fcntl.flock(lock, fcntl.LOCK_EX)
+            if os.path.exists(bin_path):
+                return bin_path
+            if os.path.exists(marker):
+                return None
+            r = subprocess.run(["sh", build], capture_output=True, timeout=300, check=False)
+            if os.path.exists(bin_path):
+                return bin_path
+            with open(marker, "w") as f:
+                f.write(r.stderr.decode(errors="replace")[-2000:])
+            return None
+    except Exception:  # noqa: BLE001
+        return None
+
+
+def start_native_flight_server(work_dir: str, bind_host: str, port: int):
+    """Spawn the C++ Flight data plane (native/flight_shuffle.cpp — same
+    wire contract as flight/server.py). Returns (proc, bound_port) or None
+    when the binary is missing or fails to come up."""
+    import subprocess
+
+    bin_path = _ensure_native_flight_binary()
+    if bin_path is None:
+        return None
+    try:
+        proc = subprocess.Popen(
+            [bin_path, "--host", bind_host, "--port", str(port), "--work-dir", work_dir],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        )
+        # bounded wait for the PORT line: a wedged bind must not hang startup
+        import select
+
+        ready, _, _ = select.select([proc.stdout], [], [], 20.0)
+        if not ready:
+            proc.terminate()
+            return None
+        line = proc.stdout.readline().strip()
+        if not line.startswith("PORT "):
+            proc.terminate()
+            return None
+        return proc, int(line.split()[1])
+    except Exception:  # noqa: BLE001
+        return None
+
+
 class ExecutorProcess:
     def __init__(self, scheduler_addr: str, bind_host: str = "0.0.0.0",
                  external_host: str | None = None, grpc_port: int = 0,
                  flight_port: int = 0, vcores: int | None = None,
                  work_dir: str | None = None, engine: str = "cpu",
                  policy: str = "push", work_dir_ttl_s: float = 4 * 3600,
-                 memory_pool_bytes: int = 0, memory_fraction: float = 0.6):
+                 memory_pool_bytes: int = 0, memory_fraction: float = 0.6,
+                 flight_impl: str = "auto"):
         self.scheduler_addr = scheduler_addr
         self.work_dir = work_dir or tempfile.mkdtemp(prefix="ballista-tpu-executor-")
         self.policy = policy
@@ -77,7 +142,17 @@ class ExecutorProcess:
         host = external_host or socket.gethostname()
 
         config = BallistaConfig({EXECUTOR_ENGINE: engine})
-        self.flight_server, bound_flight = start_flight_server(self.work_dir, bind_host, flight_port)
+        self.flight_server = None
+        self.native_flight_proc = None
+        if flight_impl in ("auto", "native"):
+            native = start_native_flight_server(self.work_dir, bind_host, flight_port)
+            if native is not None:
+                self.native_flight_proc, bound_flight = native
+                log.info("native C++ flight data plane on :%d", bound_flight)
+            elif flight_impl == "native":
+                raise RuntimeError("native flight server requested but unavailable")
+        if self.native_flight_proc is None:
+            self.flight_server, bound_flight = start_flight_server(self.work_dir, bind_host, flight_port)
 
         self.memory_pool_bytes = memory_pool_bytes or int(detect_memory_limit() * memory_fraction)
         self.metadata = ExecutorMetadata(
@@ -211,7 +286,14 @@ class ExecutorProcess:
             pass
         self.service.stop()
         self.grpc_server.stop(grace=2)
-        self.flight_server.shutdown()
+        if self.flight_server is not None:
+            self.flight_server.shutdown()
+        if self.native_flight_proc is not None:
+            self.native_flight_proc.terminate()
+            try:
+                self.native_flight_proc.wait(timeout=5)
+            except Exception:  # noqa: BLE001
+                self.native_flight_proc.kill()
         self.health_server.shutdown()
 
     def wait(self) -> None:
@@ -233,6 +315,8 @@ def main(argv=None) -> None:
     ap.add_argument("--work-dir", default=None)
     ap.add_argument("--engine", choices=("cpu", "tpu"), default="cpu")
     ap.add_argument("--policy", choices=("push", "pull"), default="push")
+    ap.add_argument("--flight-server", choices=("auto", "python", "native"), default="auto",
+                    help="shuffle data plane: native C++ (preferred), python, or auto-fallback")
     ap.add_argument("--memory-pool-bytes", type=int, default=0,
                     help="fixed memory pool size (0 = fraction of cgroup/host)")
     ap.add_argument("--memory-fraction", type=float, default=0.6,
@@ -245,6 +329,7 @@ def main(argv=None) -> None:
         args.scheduler, args.bind_host, args.external_host, args.grpc_port,
         args.flight_port, args.concurrent_tasks, args.work_dir, args.engine, args.policy,
         memory_pool_bytes=args.memory_pool_bytes, memory_fraction=args.memory_fraction,
+        flight_impl=args.flight_server,
     )
     signal.signal(signal.SIGTERM, lambda *_: proc.shutdown())
     proc.start()

@@ -1,5 +1,24 @@
 #!/bin/sh
-# Build the native runtime components → native/libballista_native.so
+# Build the native runtime components:
+#   libballista_native.so   — shuffle row router (ctypes, no deps)
+#   ballista-flight-server  — C++ Flight shuffle data plane (links the
+#                             Arrow C++ shipped inside the pyarrow wheel)
 cd "$(dirname "$0")"
 g++ -O3 -march=native -shared -fPIC -o libballista_native.so row_router.cpp
 echo "built $(pwd)/libballista_native.so"
+
+PYA="$(python -c 'import os, pyarrow; print(os.path.dirname(pyarrow.__file__))')"
+AR_SO="$(ls "$PYA"/libarrow.so.* 2>/dev/null | head -1)"
+FL_SO="$(ls "$PYA"/libarrow_flight.so.* 2>/dev/null | head -1)"
+if [ -d "$PYA/include/arrow/flight" ] && [ -n "$AR_SO" ] && [ -n "$FL_SO" ]; then
+  if g++ -std=c++20 -O2 -I"$PYA/include" flight_shuffle.cpp \
+      -o ballista-flight-server \
+      -L"$PYA" -l:"$(basename "$AR_SO")" -l:"$(basename "$FL_SO")" \
+      -Wl,-rpath,"$PYA"; then
+    echo "built $(pwd)/ballista-flight-server"
+  else
+    echo "flight server build failed (python data plane remains)" >&2
+  fi
+else
+  echo "pyarrow flight headers/libs not found; skipping native flight server" >&2
+fi

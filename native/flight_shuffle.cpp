@@ -1,0 +1,243 @@
+// Native Arrow Flight shuffle server: the C++ executor data plane.
+//
+// Native rebuild of the reference's executor Flight service
+// (ballista/executor/src/flight_service.rs:61,88,243,257) serving the SAME
+// wire contract as ballista_tpu/flight/server.py, so Python and C++ servers
+// are interchangeable behind the executor:
+//   - DoGet(ticket JSON {path, layout, output_partition}): stream the
+//     partition as decoded record batches (hash layout: whole file; sort
+//     layout: byte range through the JSON index file).
+//   - DoAction("io_block_transport"): raw 8 MiB block streaming of the
+//     stored IPC bytes, no decode/re-encode (flight_service.rs:243).
+//   - DoAction("remove_job_data"): GC a job's shuffle directory.
+//
+// Links against the Arrow C++ shipped inside the pyarrow wheel (C++20).
+// Build: native/build.sh → native/ballista-flight-server.
+// Protocol: stdout prints "PORT <n>" once bound (the executor process
+// parses it), then serves until SIGTERM.
+
+#include <arrow/api.h>
+#include <arrow/buffer.h>
+#include <arrow/flight/api.h>
+#include <arrow/io/file.h>
+#include <arrow/io/memory.h>
+#include <arrow/ipc/reader.h>
+
+#include <csignal>
+#include <cstdio>
+#include <cstring>
+#include <filesystem>
+#include <fstream>
+#include <iostream>
+#include <memory>
+#include <string>
+
+namespace fl = arrow::flight;
+namespace fs = std::filesystem;
+
+static constexpr int64_t kBlockSize = 8 * 1024 * 1024;
+
+// ---- minimal JSON field extraction (tickets come from our own clients) ----
+
+static void AppendUtf8(std::string& out, unsigned cp) {
+  if (cp < 0x80) out.push_back((char)cp);
+  else if (cp < 0x800) {
+    out.push_back((char)(0xC0 | (cp >> 6)));
+    out.push_back((char)(0x80 | (cp & 0x3F)));
+  } else if (cp < 0x10000) {
+    out.push_back((char)(0xE0 | (cp >> 12)));
+    out.push_back((char)(0x80 | ((cp >> 6) & 0x3F)));
+    out.push_back((char)(0x80 | (cp & 0x3F)));
+  } else {
+    out.push_back((char)(0xF0 | (cp >> 18)));
+    out.push_back((char)(0x80 | ((cp >> 12) & 0x3F)));
+    out.push_back((char)(0x80 | ((cp >> 6) & 0x3F)));
+    out.push_back((char)(0x80 | (cp & 0x3F)));
+  }
+}
+
+static std::string JsonStr(const std::string& j, const std::string& key) {
+  auto k = "\"" + key + "\"";
+  auto p = j.find(k);
+  if (p == std::string::npos) return "";
+  p = j.find(':', p + k.size());
+  if (p == std::string::npos) return "";
+  p = j.find('"', p);
+  if (p == std::string::npos) return "";
+  auto e = p + 1;
+  std::string out;
+  while (e < j.size() && j[e] != '"') {
+    char c = j[e];
+    if (c != '\\' || e + 1 >= j.size()) {
+      out.push_back(c);
+      e++;
+      continue;
+    }
+    char esc = j[e + 1];
+    e += 2;
+    switch (esc) {
+      case 'n': out.push_back('\n'); break;
+      case 't': out.push_back('\t'); break;
+      case 'r': out.push_back('\r'); break;
+      case 'b': out.push_back('\b'); break;
+      case 'f': out.push_back('\f'); break;
+      case 'u': {
+        // \uXXXX (json.dumps default ensure_ascii) incl. surrogate pairs
+        if (e + 4 > j.size()) break;
+        unsigned cp = (unsigned)std::strtoul(j.substr(e, 4).c_str(), nullptr, 16);
+        e += 4;
+        if (cp >= 0xD800 && cp <= 0xDBFF && e + 6 <= j.size() &&
+            j[e] == '\\' && j[e + 1] == 'u') {
+          unsigned lo = (unsigned)std::strtoul(j.substr(e + 2, 4).c_str(), nullptr, 16);
+          if (lo >= 0xDC00 && lo <= 0xDFFF) {
+            cp = 0x10000 + ((cp - 0xD800) << 10) + (lo - 0xDC00);
+            e += 6;
+          }
+        }
+        AppendUtf8(out, cp);
+        break;
+      }
+      default: out.push_back(esc); break;  // \" \\ \/ and friends
+    }
+  }
+  return out;
+}
+
+static long long JsonInt(const std::string& j, const std::string& key, long long dflt) {
+  auto k = "\"" + key + "\"";
+  auto p = j.find(k);
+  if (p == std::string::npos) return dflt;
+  p = j.find(':', p + k.size());
+  if (p == std::string::npos) return dflt;
+  p++;
+  while (p < j.size() && (j[p] == ' ' || j[p] == '\t')) p++;
+  return std::strtoll(j.c_str() + p, nullptr, 10);
+}
+
+// index file: {"<partition>": [offset, length, ...], ...}
+static bool IndexRange(const std::string& index_json, long long part,
+                       long long* offset, long long* length) {
+  auto key = "\"" + std::to_string(part) + "\"";
+  auto p = index_json.find(key);
+  if (p == std::string::npos) return false;
+  p = index_json.find('[', p);
+  if (p == std::string::npos) return false;
+  char* end = nullptr;
+  *offset = std::strtoll(index_json.c_str() + p + 1, &end, 10);
+  while (*end == ',' || *end == ' ') end++;
+  *length = std::strtoll(end, nullptr, 10);
+  return true;
+}
+
+// twin of ballista_tpu/shuffle/paths.py::index_path — "x.arrow" → "x.idx"
+static std::string IndexPath(const std::string& data_path) {
+  const std::string suffix = ".arrow";
+  if (data_path.size() > suffix.size() &&
+      data_path.compare(data_path.size() - suffix.size(), suffix.size(), suffix) == 0)
+    return data_path.substr(0, data_path.size() - suffix.size()) + ".idx";
+  return data_path + ".idx";
+}
+
+static arrow::Result<std::shared_ptr<arrow::Buffer>> ReadRange(const std::string& ticket_json) {
+  std::string path = JsonStr(ticket_json, "path");
+  std::string layout = JsonStr(ticket_json, "layout");
+  if (layout.rfind("sort", 0) == 0) {
+    std::ifstream idx(IndexPath(path));
+    if (!idx)
+      // missing index is an ERROR (lost output → FetchFailed/ResultLost
+      // recovery on the reducer), matching the python server's behavior
+      return arrow::Status::IOError("shuffle index not found: ", IndexPath(path));
+    std::string index_json((std::istreambuf_iterator<char>(idx)),
+                           std::istreambuf_iterator<char>());
+    long long offset = 0, length = 0;
+    if (!IndexRange(index_json, JsonInt(ticket_json, "output_partition", 0), &offset, &length))
+      return arrow::Buffer::FromString("");  // partition absent = empty (contract)
+    ARROW_ASSIGN_OR_RAISE(auto f, arrow::io::ReadableFile::Open(path));
+    return f->ReadAt(offset, length);
+  }
+  ARROW_ASSIGN_OR_RAISE(auto f, arrow::io::ReadableFile::Open(path));
+  ARROW_ASSIGN_OR_RAISE(auto size, f->GetSize());
+  return f->Read(size);
+}
+
+class ShuffleServer : public fl::FlightServerBase {
+ public:
+  explicit ShuffleServer(std::string work_dir) : work_dir_(std::move(work_dir)) {}
+
+  arrow::Status DoGet(const fl::ServerCallContext&, const fl::Ticket& request,
+                      std::unique_ptr<fl::FlightDataStream>* stream) override {
+    ARROW_ASSIGN_OR_RAISE(auto buf, ReadRange(request.ticket));
+    if (buf->size() == 0) {
+      auto schema = arrow::schema({});
+      ARROW_ASSIGN_OR_RAISE(
+          auto reader, arrow::RecordBatchReader::Make({}, schema));
+      *stream = std::make_unique<fl::RecordBatchStream>(reader);
+      return arrow::Status::OK();
+    }
+    auto source = std::make_shared<arrow::io::BufferReader>(buf);
+    ARROW_ASSIGN_OR_RAISE(auto reader, arrow::ipc::RecordBatchStreamReader::Open(source));
+    *stream = std::make_unique<fl::RecordBatchStream>(reader);
+    return arrow::Status::OK();
+  }
+
+  arrow::Status DoAction(const fl::ServerCallContext&, const fl::Action& action,
+                         std::unique_ptr<fl::ResultStream>* result) override {
+    std::string body = action.body ? action.body->ToString() : "";
+    if (action.type == "io_block_transport") {
+      ARROW_ASSIGN_OR_RAISE(auto buf, ReadRange(body));
+      std::vector<fl::Result> results;
+      for (int64_t off = 0; off < buf->size(); off += kBlockSize) {
+        auto len = std::min(kBlockSize, buf->size() - off);
+        results.push_back(fl::Result{arrow::SliceBuffer(buf, off, len)});
+      }
+      *result = std::make_unique<fl::SimpleResultStream>(std::move(results));
+      return arrow::Status::OK();
+    }
+    if (action.type == "remove_job_data") {
+      std::string job = JsonStr(body, "job_id");
+      if (!job.empty() && !work_dir_.empty()) {
+        std::error_code ec;
+        fs::remove_all(fs::path(work_dir_) / job, ec);  // best-effort GC
+      }
+      std::vector<fl::Result> results;
+      results.push_back(fl::Result{arrow::Buffer::FromString("ok")});
+      *result = std::make_unique<fl::SimpleResultStream>(std::move(results));
+      return arrow::Status::OK();
+    }
+    return arrow::Status::Invalid("unknown action ", action.type);
+  }
+
+  arrow::Status ListActions(const fl::ServerCallContext&,
+                            std::vector<fl::ActionType>* actions) override {
+    *actions = {{"io_block_transport", "raw IPC block stream"},
+                {"remove_job_data", "GC a job's shuffle files"}};
+    return arrow::Status::OK();
+  }
+
+ private:
+  std::string work_dir_;
+};
+
+int main(int argc, char** argv) {
+  std::string host = "0.0.0.0", work_dir;
+  int port = 0;
+  for (int i = 1; i < argc - 1; i++) {
+    if (!std::strcmp(argv[i], "--port")) port = std::atoi(argv[++i]);
+    else if (!std::strcmp(argv[i], "--host")) host = argv[++i];
+    else if (!std::strcmp(argv[i], "--work-dir")) work_dir = argv[++i];
+  }
+  auto loc_res = fl::Location::ForGrpcTcp(host, port);
+  if (!loc_res.ok()) { std::cerr << loc_res.status().ToString() << "\n"; return 1; }
+  ShuffleServer server(work_dir);
+  fl::FlightServerOptions options(*loc_res);
+  auto st = server.Init(options);
+  if (!st.ok()) { std::cerr << st.ToString() << "\n"; return 1; }
+  // the executor process parses this line for the bound port
+  std::printf("PORT %d\n", server.port());
+  std::fflush(stdout);
+  st = server.SetShutdownOnSignals({SIGTERM, SIGINT});
+  if (!st.ok()) { std::cerr << st.ToString() << "\n"; return 1; }
+  st = server.Serve();
+  if (!st.ok()) { std::cerr << st.ToString() << "\n"; return 1; }
+  return 0;
+}

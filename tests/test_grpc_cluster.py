@@ -77,6 +77,22 @@ def test_rest_api(grpc_cluster, remote_ctx):
     assert "ballista_scheduler_jobs_completed_total" in metrics
 
 
+def test_native_data_plane_forced_remote(grpc_cluster, tpch_dir, tpch_ref_tables):
+    """Force every shuffle fetch over Flight (no local fast path): sort-
+    layout partition reads go through the executors' native C++ servers."""
+    from ballista_tpu.client.context import SessionContext
+    from ballista_tpu.config import SHUFFLE_READER_FORCE_REMOTE
+    from ballista_tpu.testing.tpchgen import register_tpch
+
+    _, addr = grpc_cluster
+    ctx = SessionContext.remote(addr)
+    ctx.config.set(SHUFFLE_READER_FORCE_REMOTE, True)
+    register_tpch(ctx, tpch_dir)
+    eng = ctx.sql(tpch_query(3)).collect()
+    problems = compare_results(eng, run_reference(3, tpch_ref_tables), 3)
+    assert not problems, "\n".join(problems)
+
+
 def test_flight_result_proxy(grpc_cluster, tpch_dir):
     """Clients that cannot reach executors fetch results through the
     scheduler's Flight proxy (flight_proxy_service.rs analog)."""

@@ -60,3 +60,101 @@ def test_split_batch_by_partition_roundtrip():
         assert 0 <= p < 5
         seen.extend(sub.column(0).to_pylist())
     assert sorted(seen) == list(range(100))
+
+
+# ---------------------------------------------------------------------------
+# native C++ Flight shuffle server (native/flight_shuffle.cpp)
+
+
+@pytest.fixture(scope="module")
+def native_flight(tmp_path_factory):
+    from ballista_tpu.executor.executor_process import start_native_flight_server
+
+    work = str(tmp_path_factory.mktemp("native-flight"))
+    started = start_native_flight_server(work, "127.0.0.1", 0)
+    if started is None:
+        pytest.skip("native flight server unavailable (no arrow headers?)")
+    proc, port = started
+    yield work, port
+    proc.terminate()
+    proc.wait(timeout=5)
+
+
+def _write_shuffle_files(work):
+    import io
+    import json
+    import os
+
+    import pyarrow.ipc as ipc
+
+    batch = pa.record_batch({
+        "a": pa.array(range(100), pa.int64()),
+        "s": pa.array([f"x{i % 7}" for i in range(100)]),
+    })
+    d = os.path.join(work, "jobn", "1", "0")
+    os.makedirs(d, exist_ok=True)
+    hash_file = os.path.join(d, "data-t1.arrow")
+    with open(hash_file, "wb") as f:
+        with ipc.new_stream(f, batch.schema) as w:
+            w.write_batch(batch)
+    from ballista_tpu.shuffle import paths as shuffle_paths
+
+    sort_file = os.path.join(d, "sorted-t1.arrow")
+    index = {}
+    with open(sort_file, "wb") as f:
+        for pid in (0, 3):
+            start = f.tell()
+            buf = io.BytesIO()
+            with ipc.new_stream(buf, batch.schema) as w:
+                w.write_batch(batch.slice(pid * 10, 10))
+            f.write(buf.getvalue())
+            index[str(pid)] = [start, f.tell() - start, 10, f.tell() - start]
+    # the PRODUCTION index filename convention (x.arrow -> x.idx) — the C++
+    # server must agree with shuffle/paths.py, not with a test-local name
+    with open(shuffle_paths.index_path(sort_file), "w") as f:
+        json.dump(index, f)
+    return batch, hash_file, sort_file
+
+
+def test_native_flight_wire_contract(native_flight):
+    """The C++ data plane must serve the exact contract of the python
+    server: do_get (hash + sort layouts, missing → empty), raw-block
+    do_action, and job GC."""
+    import json
+    import os
+
+    import pyarrow.flight as flight
+    import pyarrow.ipc as ipc
+
+    work, port = native_flight
+    batch, hash_file, sort_file = _write_shuffle_files(work)
+    client = flight.FlightClient(f"grpc://127.0.0.1:{port}")
+
+    t = flight.Ticket(json.dumps({"path": hash_file, "layout": "hash", "output_partition": 0}).encode())
+    tbl = client.do_get(t).read_all()
+    assert tbl.num_rows == 100 and tbl.column("a").to_pylist() == list(range(100))
+
+    t = flight.Ticket(json.dumps({"path": sort_file, "layout": "sort", "output_partition": 3}).encode())
+    tbl = client.do_get(t).read_all()
+    assert tbl.column("a").to_pylist() == list(range(30, 40))
+
+    t = flight.Ticket(json.dumps({"path": sort_file, "layout": "sort", "output_partition": 9}).encode())
+    assert client.do_get(t).read_all().num_rows == 0
+
+    # a MISSING index file must be an error (FetchFailed/ResultLost fuel),
+    # never a silent empty result
+    t = flight.Ticket(json.dumps(
+        {"path": sort_file + ".gone.arrow", "layout": "sort", "output_partition": 0}
+    ).encode())
+    with pytest.raises(flight.FlightError):
+        client.do_get(t).read_all()
+
+    action = flight.Action(
+        "io_block_transport",
+        json.dumps({"path": sort_file, "layout": "sort", "output_partition": 0}).encode(),
+    )
+    raw = b"".join(r.body.to_pybytes() for r in client.do_action(action))
+    assert ipc.open_stream(pa.BufferReader(raw)).read_all().column("a").to_pylist() == list(range(10))
+
+    list(client.do_action(flight.Action("remove_job_data", json.dumps({"job_id": "jobn"}).encode())))
+    assert not os.path.exists(os.path.join(work, "jobn"))
