@@ -91,6 +91,22 @@ class SessionContext:
             # ship the registration with the session so remote planning sees it
             self.config.set(f"ballista.catalog.table.{name.lower()}", provider.path)
 
+    def register_udf(self, name: str, fn, return_type) -> None:
+        """Register a scalar UDF for this session (BallistaFunctionRegistry
+        analog). Local execution resolves it immediately; for remote
+        clusters the defining module is recorded in the session config and
+        imported by executors (functions ship by reference, like the
+        reference's code-registered function sets)."""
+        from ballista_tpu import udf
+
+        u = udf.register_udf(name, fn, return_type)
+        if u.module:
+            existing = self.config.get(udf.UDF_MODULES) or ""
+            mods = [m for m in existing.split(",") if m]
+            if u.module not in mods:
+                mods.append(u.module)
+                self.config.set(udf.UDF_MODULES, ",".join(mods))
+
     def register_parquet(self, name: str, path: str) -> None:
         self.catalog.register(name, ParquetTable(path))
         # ship the registration with the session so remote planning sees it

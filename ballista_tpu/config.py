@@ -212,8 +212,9 @@ class BallistaConfig:
         entry = VALID_ENTRIES.get(key)
         if entry is not None:
             self._settings[key] = entry.parse(value)
-        elif key.startswith("ballista.catalog."):
-            # open namespace: table registrations shipped with the session
+        elif key.startswith("ballista.catalog.") or key.startswith("ballista.udf."):
+            # open namespaces: table registrations / UDF module references
+            # shipped with the session
             self._extra[key] = str(value)
         elif key.startswith("ballista."):
             raise ConfigurationError(f"unknown config key: {key}")

@@ -48,6 +48,7 @@ from ballista_tpu.plan.logical import (
     TableScan,
     Union,
     Values,
+    Window,
 )
 from ballista_tpu.plan.physical import (
     AggDesc,
@@ -107,6 +108,8 @@ class PhysicalPlanner:
                 ]
                 return ProjectionExec(right_first, order, node.schema)
             return CrossJoinExec(left, right, node.schema)
+        if isinstance(node, Window):
+            return self._plan_window(node)
         if isinstance(node, Sort):
             child = self._plan(node.input)
             # large full sorts scale out via the dynamic range-repartition
@@ -194,6 +197,43 @@ class PhysicalPlanner:
         return ParquetScanExec(node.schema, partitions, proj_names, node.filters, node.table_name)
 
     # ------------------------------------------------------------------
+
+    def _plan_window(self, node: Window) -> ExecutionPlan:
+        """Window partition-key groups must be partition-local: windows
+        sharing PARTITION BY keys stack over one exchange; differing key
+        sets chain (each WindowExec appends its __win columns).
+
+        The reference gets this layout from DataFusion's
+        BoundedWindowAggExec + its repartition rules; here the hash
+        exchange doubles as the distributed stage boundary."""
+        from ballista_tpu.plan.physical import WindowExec
+
+        child = self._plan(node.input)
+        groups: dict[tuple, list] = {}
+        order: list[tuple] = []
+        for w in node.window_exprs:
+            key = tuple(str(e) for e in w.partition_by)
+            if key not in groups:
+                groups[key] = []
+                order.append(key)
+            groups[key].append(w)
+
+        from ballista_tpu.plan.schema import DFField, DFSchema
+
+        cur = child
+        cur_fields = list(node.input.schema.fields)
+        for key in order:
+            ws = groups[key]
+            pby = list(ws[0].partition_by)
+            if pby and cur.output_partition_count() > 1:
+                cur = RepartitionExec(cur, "hash", self.shuffle_partitions, pby)
+            elif not pby and cur.output_partition_count() > 1:
+                cur = CoalescePartitionsExec(cur)
+            for w in ws:
+                i = node.window_exprs.index(w)
+                cur_fields.append(DFField(f"__win{i}", w.data_type(node.input.schema)))
+            cur = WindowExec(cur, ws, DFSchema(list(cur_fields)))
+        return cur
 
     def _plan_aggregate(self, node: Aggregate) -> ExecutionPlan:
         child = self._plan(node.input)

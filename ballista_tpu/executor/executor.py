@@ -111,6 +111,9 @@ class Executor:
 
     def execute_task(self, task: TaskDescription, config: BallistaConfig | None = None) -> TaskResult:
         cfg = config or self.default_config
+        from ballista_tpu import udf
+
+        udf.load_modules(cfg.get(udf.UDF_MODULES))
         if self.memory_limit_per_task:
             # executor-sized spill budget (cgroup/host-aware, see
             # executor_process.detect_memory_limit) unless the session set

@@ -296,6 +296,19 @@ class ScalarFnOp(PhysicalExpr):
             return pc.floor(a[0])
         if n == "coalesce":
             return pc.coalesce(*a)
+        from ballista_tpu import udf
+
+        u = udf.resolve(n)
+        if u is not None:
+            arrays = [
+                x if not isinstance(x, pa.Scalar)
+                else pa.array([x.as_py()] * batch.num_rows, x.type)
+                for x in a
+            ]
+            out = u.fn(*arrays)
+            if not isinstance(out, (pa.Array, pa.ChunkedArray, pa.Scalar)):
+                out = pa.array(out, u.return_type)
+            return out
         raise ExecutionError(f"unknown scalar function {n}")
 
 

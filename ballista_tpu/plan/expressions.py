@@ -470,10 +470,64 @@ class ScalarFunction(Expr):
             return pa.null()
         if n == "date_trunc":
             return pa.date32()
+        from ballista_tpu import udf
+
+        u = udf.resolve(n)
+        if u is not None:
+            return u.return_type
         raise PlanningError(f"unknown scalar function {n}")
 
     def __str__(self) -> str:
         return f"{self.name}({', '.join(map(str, self.args))})"
+
+
+WINDOW_FUNCS = ("row_number", "rank", "dense_rank", "lag", "lead",
+                "sum", "avg", "min", "max", "count")
+
+
+@dataclass(frozen=True)
+class WindowFunction(Expr):
+    """fn(args) OVER (PARTITION BY ... ORDER BY ...).
+
+    Frame semantics follow SQL defaults: with ORDER BY, aggregates run
+    RANGE UNBOUNDED PRECEDING..CURRENT ROW (peers share); without, the
+    whole partition."""
+
+    func: str  # one of WINDOW_FUNCS
+    args: tuple  # aggregates: (expr,) or (); lag/lead: (expr[, offset[, default]])
+    partition_by: tuple = ()
+    order_by: tuple = ()  # SortKey tuple
+
+    def children(self) -> list["Expr"]:
+        return list(self.args) + list(self.partition_by) + [k.expr for k in self.order_by]
+
+    def with_children(self, c: list["Expr"]) -> "Expr":
+        na = len(self.args)
+        np_ = len(self.partition_by)
+        keys = tuple(
+            SortKey(e, k.ascending, k.nulls_first)
+            for e, k in zip(c[na + np_:], self.order_by)
+        )
+        return WindowFunction(self.func, tuple(c[:na]), tuple(c[na:na + np_]), keys)
+
+    def data_type(self, schema: DFSchema) -> pa.DataType:
+        if self.func in ("row_number", "rank", "dense_rank", "count"):
+            return pa.int64()
+        if self.func == "avg":
+            return pa.float64()
+        t = self.args[0].data_type(schema)
+        if self.func == "sum" and pa.types.is_integer(t):
+            return pa.int64()
+        return t
+
+    def __str__(self) -> str:
+        a = ", ".join(map(str, self.args))
+        parts = []
+        if self.partition_by:
+            parts.append("PARTITION BY " + ", ".join(map(str, self.partition_by)))
+        if self.order_by:
+            parts.append("ORDER BY " + ", ".join(map(str, self.order_by)))
+        return f"{self.func}({a}) OVER ({' '.join(parts)})"
 
 
 AGG_FUNCS = ("sum", "avg", "min", "max", "count", "count_distinct")

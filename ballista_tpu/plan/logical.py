@@ -181,6 +181,32 @@ class CrossJoin(LogicalPlan):
 
 
 @dataclass
+class Window(LogicalPlan):
+    """Appends one column per window expression (named __win{i}) to the
+    input; the projection above references them by name."""
+
+    input: LogicalPlan
+    window_exprs: list[Expr]  # WindowFunction nodes
+
+    def __post_init__(self):
+        from ballista_tpu.plan.schema import DFField
+
+        fields = list(self.input.schema.fields)
+        for i, e in enumerate(self.window_exprs):
+            fields.append(DFField(f"__win{i}", e.data_type(self.input.schema)))
+        self.schema = DFSchema(fields)
+
+    def children(self) -> list[LogicalPlan]:
+        return [self.input]
+
+    def with_children(self, c: list[LogicalPlan]) -> "LogicalPlan":
+        return Window(c[0], self.window_exprs)
+
+    def node_str(self) -> str:
+        return f"Window: {', '.join(map(str, self.window_exprs))}"
+
+
+@dataclass
 class Sort(LogicalPlan):
     input: LogicalPlan
     keys: list[SortKey]

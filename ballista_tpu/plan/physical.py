@@ -813,6 +813,54 @@ def _sort_table(tbl: pa.Table, df_schema: DFSchema, keys: list[SortKey]) -> pa.T
     return tbl.take(idx)
 
 
+class WindowExec(ExecutionPlan):
+    """Computes window expressions, appending __win{i} columns.
+
+    Contract: rows sharing a window PARTITION BY key never span physical
+    partitions (the planner hash-repartitions on those keys, or coalesces
+    to one partition when there are none), so partitions are independent.
+    """
+
+    def __init__(self, input: ExecutionPlan, window_exprs: list, df_schema: DFSchema):
+        super().__init__(df_schema)
+        self.input = input
+        self.window_exprs = window_exprs
+
+    def children(self):
+        return [self.input]
+
+    def with_children(self, c):
+        return WindowExec(c[0], self.window_exprs, self.df_schema)
+
+    def output_partition_count(self) -> int:
+        return self.input.output_partition_count()
+
+    def node_str(self) -> str:
+        return f"WindowExec: [{', '.join(map(str, self.window_exprs))}]"
+
+    def execute(self, partition, ctx):
+        return self._timed(self._run(partition, ctx))
+
+    def _run(self, partition, ctx):
+        from ballista_tpu.ops.cpu.window import compute_windows
+
+        batches = [b for b in self.input.execute(partition, ctx) if b.num_rows]
+        if not batches:
+            yield _empty_batch(self.schema())
+            return
+        tbl = _concat(batches, self.input.schema())
+        batch = tbl.combine_chunks().to_batches()[0] if tbl.num_rows else None
+        if batch is None:
+            yield _empty_batch(self.schema())
+            return
+        wins = compute_windows(batch, self.window_exprs, self.input.df_schema)
+        arrays = [batch.column(i) for i in range(batch.num_columns)] + wins
+        out = pa.RecordBatch.from_arrays(arrays, schema=self.schema())
+        n = out.num_rows
+        for off in range(0, n, ctx.batch_size):
+            yield out.slice(off, min(ctx.batch_size, n - off))
+
+
 class SortExec(ExecutionPlan):
     def __init__(self, input: ExecutionPlan, keys: list[SortKey], fetch: Optional[int] = None):
         super().__init__(input.df_schema)

@@ -37,6 +37,7 @@ from ballista_tpu.plan.expressions import (
     Not,
     ScalarFunction,
     SortKey,
+    WindowFunction,
 )
 from ballista_tpu.plan.physical import (
     AggDesc,
@@ -55,6 +56,7 @@ from ballista_tpu.plan.physical import (
     ProjectionExec,
     RepartitionExec,
     SortExec,
+    WindowExec,
     SortPreservingMergeExec,
     UnionExec,
 )
@@ -199,6 +201,14 @@ def encode_expr(e: Expr) -> pb.ExprProto:
         out.scalar_fn.name = e.name
         for a in e.args:
             out.scalar_fn.args.append(encode_expr(a))
+    elif isinstance(e, WindowFunction):
+        out.window_fn.func = e.func
+        for a in e.args:
+            out.window_fn.args.append(encode_expr(a))
+        for pe in e.partition_by:
+            out.window_fn.partition_by.append(encode_expr(pe))
+        for k in e.order_by:
+            out.window_fn.order_by.append(encode_sort_key(k))
     elif isinstance(e, AggregateFunction):
         out.agg_fn.func = e.func
         out.agg_fn.distinct = e.distinct
@@ -252,6 +262,13 @@ def decode_expr(p: pb.ExprProto) -> Expr:
         return Case(branches, els)
     if which == "scalar_fn":
         return ScalarFunction(p.scalar_fn.name, tuple(decode_expr(a) for a in p.scalar_fn.args))
+    if which == "window_fn":
+        return WindowFunction(
+            p.window_fn.func,
+            tuple(decode_expr(a) for a in p.window_fn.args),
+            tuple(decode_expr(a) for a in p.window_fn.partition_by),
+            tuple(decode_sort_key(k) for k in p.window_fn.order_by),
+        )
     if which == "agg_fn":
         arg = None if p.agg_fn.no_arg else decode_expr(p.agg_fn.arg)
         return AggregateFunction(p.agg_fn.func, arg, p.agg_fn.distinct)
@@ -315,9 +332,12 @@ def encode_plan(plan: ExecutionPlan) -> pb.PhysicalPlanNode:
         n = out.memory_scan
         n.schema.CopyFrom(encode_schema(plan.df_schema))
         sink = io.BytesIO()
+        from ballista_tpu.plan.physical import _align_batch
+
         with ipc.new_stream(sink, plan.schema()) as w:
             for b in plan.batches:
-                w.write_batch(b)
+                # stored batches may be wider than the (pruned) scan schema
+                w.write_batch(_align_batch(b, plan.schema()))
         n.arrow_ipc = sink.getvalue()
         n.partitions = plan.partitions
     elif isinstance(plan, FilterExec):
@@ -366,6 +386,12 @@ def encode_plan(plan: ExecutionPlan) -> pb.PhysicalPlanNode:
         for k in plan.keys:
             n.keys.append(encode_sort_key(k))
         n.fetch = -1 if plan.fetch is None else plan.fetch
+    elif isinstance(plan, WindowExec):
+        n = out.window
+        n.input.CopyFrom(encode_plan(plan.input))
+        for w in plan.window_exprs:
+            n.window_exprs.append(encode_expr(w))
+        n.schema.CopyFrom(encode_schema(plan.df_schema))
     elif isinstance(plan, SortExec):
         n = out.sort
         n.input.CopyFrom(encode_plan(plan.input))
@@ -477,6 +503,12 @@ def decode_plan(p: pb.PhysicalPlanNode) -> ExecutionPlan:
         return CrossJoinExec(
             decode_plan(p.cross_join.left), decode_plan(p.cross_join.right),
             decode_schema(p.cross_join.schema),
+        )
+    if which == "window":
+        return WindowExec(
+            decode_plan(p.window.input),
+            [decode_expr(w) for w in p.window.window_exprs],
+            decode_schema(p.window.schema),
         )
     if which == "sort":
         n = p.sort

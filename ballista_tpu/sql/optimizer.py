@@ -58,6 +58,7 @@ from ballista_tpu.plan.logical import (
     TableScan,
     Union,
     transform_plan_up,
+    Window,
 )
 
 
@@ -679,6 +680,15 @@ def _prune(plan: LogicalPlan, required: list[Column]) -> LogicalPlan:
     if isinstance(plan, Sort):
         needed = _dedup(required + _expr_cols([k.expr for k in plan.keys]))
         return Sort(_prune(plan.input, needed), plan.keys, plan.fetch)
+    if isinstance(plan, Window):
+        win_cols = _expr_cols([
+            e for w in plan.window_exprs
+            for e in (list(w.args) + list(w.partition_by) + [k.expr for k in w.order_by])
+        ])
+        # __win{i} outputs are produced here, not read from the child
+        passthrough = [c for c in required if not c.name.startswith("__win")]
+        needed = _dedup(passthrough + win_cols)
+        return Window(_prune(plan.input, needed), plan.window_exprs)
     if isinstance(plan, (Limit, Distinct)):
         if isinstance(plan, Distinct):
             required = [Column(f.name, f.qualifier) for f in plan.schema]

@@ -37,6 +37,8 @@ from ballista_tpu.plan.expressions import (
     ScalarFunction,
     ScalarSubquery,
     SortKey,
+    WINDOW_FUNCS,
+    WindowFunction,
 )
 from ballista_tpu.sql.ast import (
     CreateExternalTable,
@@ -646,19 +648,19 @@ class Parser:
             if self.peek().kind == "op" and self.peek().value == "*":
                 self.next()
                 self.expect_punct(")")
-                return AggregateFunction("count", None)
+                return self._maybe_window(AggregateFunction("count", None))
             if self.accept_kw("DISTINCT"):
                 arg = self.parse_expr()
                 self.expect_punct(")")
-                return AggregateFunction("count_distinct", arg, True)
+                return self._maybe_window(AggregateFunction("count_distinct", arg, True))
             arg = self.parse_expr()
             self.expect_punct(")")
-            return AggregateFunction("count", arg)
+            return self._maybe_window(AggregateFunction("count", arg))
         if up in AGGREGATES:
             distinct = self.accept_kw("DISTINCT")
             arg = self.parse_expr()
             self.expect_punct(")")
-            return AggregateFunction(up.lower(), arg, distinct)
+            return self._maybe_window(AggregateFunction(up.lower(), arg, distinct))
         args: list[Expr] = []
         if not (self.peek().kind == "punct" and self.peek().value == ")"):
             args.append(self.parse_expr())
@@ -670,7 +672,41 @@ class Parser:
             canonical = name.lower()
         if canonical == "strpos" and up == "POSITION":
             args = [args[1], args[0]] if len(args) == 2 else args
-        return ScalarFunction(canonical, tuple(args))
+        return self._maybe_window(ScalarFunction(canonical, tuple(args)))
+
+    def _maybe_window(self, fn: Expr) -> Expr:
+        """fn(...) OVER (PARTITION BY ... ORDER BY ...) → WindowFunction."""
+        if not self.accept_kw("OVER"):
+            if isinstance(fn, ScalarFunction) and fn.name in (
+                "row_number", "rank", "dense_rank", "lag", "lead"
+            ):
+                raise SqlParseError(f"{fn.name}() requires an OVER clause")
+            return fn
+        self.expect_punct("(")
+        partition_by: list[Expr] = []
+        if self.accept_kw("PARTITION"):
+            self.expect_kw("BY")
+            partition_by.append(self.parse_expr())
+            while self.accept_punct(","):
+                partition_by.append(self.parse_expr())
+        order_by: list[SortKey] = []
+        if self.peek().is_kw("ORDER"):
+            order_by = self._parse_order_by()
+        self.expect_punct(")")
+        if isinstance(fn, AggregateFunction):
+            if fn.distinct or fn.func == "count_distinct":
+                raise SqlParseError("DISTINCT window aggregates are unsupported")
+            func = fn.func
+            args: tuple = (fn.arg,) if fn.arg is not None else ()
+        elif isinstance(fn, ScalarFunction) and fn.name in WINDOW_FUNCS:
+            func, args = fn.name, fn.args
+        else:
+            raise SqlParseError(f"{fn} is not a window function")
+        if func in ("lag", "lead") and not (1 <= len(args) <= 3):
+            raise SqlParseError(f"{func} takes 1-3 arguments, got {len(args)}")
+        if func in ("row_number", "rank", "dense_rank") and args:
+            raise SqlParseError(f"{func} takes no arguments")
+        return WindowFunction(func, args, tuple(partition_by), tuple(order_by))
 
 
 def _num(s: str):

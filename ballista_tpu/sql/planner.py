@@ -28,6 +28,7 @@ from ballista_tpu.plan.expressions import (
     Literal,
     ScalarSubquery,
     SortKey,
+    WindowFunction,
     collect_columns,
     split_conjunction,
     transform_expr,
@@ -46,6 +47,7 @@ from ballista_tpu.plan.logical import (
     SubqueryAlias,
     TableScan,
     Union,
+    Window,
 )
 from ballista_tpu.sql.ast import DerivedTable, JoinClause, SelectStmt, TableName
 
@@ -115,6 +117,23 @@ class SqlPlanner:
             plan = agg
             if having is not None:
                 plan = Filter(plan, rewrite(having))
+
+        # window functions compute over the (post-aggregation) input; each
+        # unique window expr becomes a __win{i} column the projection reads
+        window_exprs = _collect_windows(projections)
+        if window_exprs:
+            win = Window(plan, window_exprs)
+
+            def rewrite_win(e: Expr) -> Expr:
+                def repl(x: Expr) -> Expr:
+                    if isinstance(x, WindowFunction):
+                        return Column(f"__win{window_exprs.index(x)}")
+                    return x
+
+                return transform_expr(e, repl)
+
+            projections = [rewrite_win(p) for p in projections]
+            plan = win
 
         proj = Projection(plan, projections)
         plan = proj
@@ -203,6 +222,23 @@ class SqlPlanner:
 
 
 # -- helpers ----------------------------------------------------------------
+
+
+def _collect_windows(exprs: list[Expr]) -> list[Expr]:
+    """Unique WindowFunction expressions, in first-appearance order."""
+    seen: list[Expr] = []
+
+    def walk(e: Expr) -> None:
+        if isinstance(e, WindowFunction):
+            if e not in seen:
+                seen.append(e)
+            return
+        for c in e.children():
+            walk(c)
+
+    for e in exprs:
+        walk(e)
+    return seen
 
 
 def _collect_aggs(exprs: list[Expr]) -> list[Expr]:

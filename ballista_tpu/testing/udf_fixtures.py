@@ -1,0 +1,20 @@
+"""Importable UDFs used by tests (and as the pattern for user UDF modules):
+importing this module registers its functions, which is how executors
+re-materialize session UDFs shipped by reference (ballista_tpu/udf.py)."""
+
+import pyarrow as pa
+import pyarrow.compute as pc
+
+from ballista_tpu import udf
+
+
+def double_it(a: pa.Array) -> pa.Array:
+    return pc.multiply(pc.cast(a, pa.int64()), 2)
+
+
+def shout(s: pa.Array) -> pa.Array:
+    return pc.binary_join_element_wise(pc.utf8_upper(s), "!", "")
+
+
+udf.register_udf("double_it", double_it, pa.int64())
+udf.register_udf("shout", shout, pa.string())

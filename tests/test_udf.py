@@ -1,0 +1,52 @@
+"""Scalar UDF registry (BallistaFunctionRegistry analog, core/src/registry.rs)."""
+
+import pyarrow as pa
+import pyarrow.compute as pc
+
+
+def test_udf_local_sql():
+    from ballista_tpu.client.context import SessionContext
+
+    ctx = SessionContext()
+    ctx.register_arrow_table("t", pa.table({"x": [1, 2, 3], "s": ["a", "b", "c"]}))
+
+    def triple(a):
+        return pc.multiply(pc.cast(a, pa.int64()), 3)
+
+    ctx.register_udf("triple", triple, pa.int64())
+    out = ctx.sql("select triple(x) t3 from t where triple(x) > 3 order by t3").collect()
+    assert out.column("t3").to_pylist() == [6, 9]
+
+
+def test_udf_ships_module_to_remote_cluster(tmp_path):
+    """UDFs from an importable module run on real remote executors: the
+    session config carries the module name, executors import it."""
+    import time
+
+    from ballista_tpu import udf as udf_mod
+    from ballista_tpu.client.context import SessionContext
+    from ballista_tpu.executor.executor_process import ExecutorProcess
+    from ballista_tpu.scheduler.process import SchedulerProcess
+    from ballista_tpu.testing.udf_fixtures import double_it, shout
+
+    sched = SchedulerProcess(bind_host="127.0.0.1", port=0, rest_port=-1, flight_proxy_port=-1)
+    sched.start()
+    addr = f"127.0.0.1:{sched.port}"
+    ex = ExecutorProcess(addr, bind_host="127.0.0.1", external_host="127.0.0.1", vcores=2)
+    ex.start()
+    time.sleep(0.2)
+    try:
+        import pyarrow.parquet as pq
+
+        ctx = SessionContext.remote(addr)
+        pq.write_table(pa.table({"x": [5, 6], "s": ["hey", "yo"]}), str(tmp_path / "t.parquet"))
+        ctx.register_parquet("t", str(tmp_path / "t.parquet"))
+        ctx.register_udf("double_it", double_it, pa.int64())
+        ctx.register_udf("shout", shout, pa.string())
+        assert "udf_fixtures" in (ctx.config.get(udf_mod.UDF_MODULES) or "")
+        out = ctx.sql("select double_it(x) d, shout(s) u from t order by d").collect()
+        assert out.column("d").to_pylist() == [10, 12]
+        assert out.column("u").to_pylist() == ["HEY!", "YO!"]
+    finally:
+        ex.shutdown()
+        sched.shutdown()
