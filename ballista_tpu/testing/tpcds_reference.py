@@ -1,0 +1,181 @@
+"""pandas oracle for the TPC-DS query subset (benchmarks/tpcds/queries).
+
+Mirrors testing/reference.py's role for TPC-H: an independent computation
+of each query used by --verify and the test suite. Sort-prefix comparison
+semantics: rows are compared on the ORDER BY prefix columns; full-row sets
+must match.
+"""
+
+from __future__ import annotations
+
+import datetime as dt
+import os
+
+import numpy as np
+import pandas as pd
+import pyarrow.parquet as pq
+
+from ballista_tpu.testing.tpcdsgen import TPCDS_TABLES
+
+
+def load_tables(data_dir: str) -> dict[str, pd.DataFrame]:
+    out = {}
+    for t in TPCDS_TABLES:
+        out[t] = pq.read_table(os.path.join(data_dir, t)).to_pandas()
+    return out
+
+
+def run_reference(q: int, t: dict[str, pd.DataFrame]) -> pd.DataFrame:
+    ss, dd, it = t["store_sales"], t["date_dim"], t["item"]
+    if q == 3:
+        m = ss.merge(dd, left_on="ss_sold_date_sk", right_on="d_date_sk")
+        m = m.merge(it[it.i_manufact_id == 128], left_on="ss_item_sk", right_on="i_item_sk")
+        m = m[m.d_moy == 11]
+        g = m.groupby(["d_year", "i_brand_id", "i_brand"], as_index=False).agg(
+            sum_agg=("ss_ext_sales_price", "sum"))
+        g = g.rename(columns={"i_brand_id": "brand_id", "i_brand": "brand"})
+        return g.sort_values(["d_year", "sum_agg", "brand_id"],
+                             ascending=[True, False, True]).head(100).reset_index(drop=True)
+    if q == 7:
+        cd, pr = t["customer_demographics"], t["promotion"]
+        m = ss.merge(dd[dd.d_year == 2000], left_on="ss_sold_date_sk", right_on="d_date_sk")
+        m = m.merge(it, left_on="ss_item_sk", right_on="i_item_sk")
+        cdf = cd[(cd.cd_gender == "M") & (cd.cd_marital_status == "S")
+                 & (cd.cd_education_status == "College")]
+        m = m.merge(cdf, left_on="ss_cdemo_sk", right_on="cd_demo_sk")
+        prf = pr[(pr.p_channel_email == "N") | (pr.p_channel_event == "N")]
+        m = m.merge(prf, left_on="ss_promo_sk", right_on="p_promo_sk")
+        g = m.groupby("i_item_id", as_index=False).agg(
+            agg1=("ss_quantity", "mean"), agg2=("ss_list_price", "mean"),
+            agg3=("ss_coupon_amt", "mean"), agg4=("ss_sales_price", "mean"))
+        return g.sort_values("i_item_id").head(100).reset_index(drop=True)
+    if q == 19:
+        cu, ca, st = t["customer"], t["customer_address"], t["store"]
+        m = ss.merge(dd[(dd.d_moy == 11) & (dd.d_year == 1998)],
+                     left_on="ss_sold_date_sk", right_on="d_date_sk")
+        m = m.merge(it[it.i_manager_id == 8], left_on="ss_item_sk", right_on="i_item_sk")
+        m = m.merge(cu, left_on="ss_customer_sk", right_on="c_customer_sk")
+        m = m.merge(ca, left_on="c_current_addr_sk", right_on="ca_address_sk")
+        m = m.merge(st, left_on="ss_store_sk", right_on="s_store_sk")
+        m = m[m.ca_state != m.s_state]
+        g = m.groupby(["i_brand_id", "i_brand", "i_manufact_id"], as_index=False).agg(
+            ext_price=("ss_ext_sales_price", "sum"))
+        g = g.rename(columns={"i_brand_id": "brand_id", "i_brand": "brand"})
+        return g.sort_values(["ext_price", "brand_id", "i_manufact_id"],
+                             ascending=[False, True, True]).head(100).reset_index(drop=True)
+    if q in (42, 52, 55):
+        mgr = {42: 1, 52: 1, 55: 28}[q]
+        year = {42: 2000, 52: 2000, 55: 1999}[q]
+        m = ss.merge(dd[(dd.d_moy == 11) & (dd.d_year == year)],
+                     left_on="ss_sold_date_sk", right_on="d_date_sk")
+        m = m.merge(it[it.i_manager_id == mgr], left_on="ss_item_sk", right_on="i_item_sk")
+        if q == 42:
+            g = m.groupby(["d_year", "i_category_id", "i_category"], as_index=False).agg(
+                total=("ss_ext_sales_price", "sum"))
+            return g.sort_values(["total", "d_year", "i_category_id", "i_category"],
+                                 ascending=[False, True, True, True]).head(100).reset_index(drop=True)
+        g = m.groupby((["d_year"] if q == 52 else []) + ["i_brand_id", "i_brand"],
+                      as_index=False).agg(ext_price=("ss_ext_sales_price", "sum"))
+        g = g.rename(columns={"i_brand_id": "brand_id", "i_brand": "brand"})
+        if q == 52:
+            return g.sort_values(["d_year", "ext_price", "brand_id"],
+                                 ascending=[True, False, True]).head(100).reset_index(drop=True)
+        return g.sort_values(["ext_price", "brand_id"],
+                             ascending=[False, True]).head(100).reset_index(drop=True)
+    if q == 68:
+        cu, ca, st, hd = t["customer"], t["customer_address"], t["store"], t["household_demographics"]
+        m = ss.merge(dd[(dd.d_dom.between(1, 2)) & (dd.d_year.isin([1999, 2000, 2001]))],
+                     left_on="ss_sold_date_sk", right_on="d_date_sk")
+        m = m.merge(st[st.s_city.isin(["Midway", "Fairview"])],
+                    left_on="ss_store_sk", right_on="s_store_sk")
+        m = m.merge(hd[(hd.hd_dep_count == 4) | (hd.hd_vehicle_count == 3)],
+                    left_on="ss_hdemo_sk", right_on="hd_demo_sk")
+        m = m.merge(ca, left_on="ss_addr_sk", right_on="ca_address_sk")
+        dn = m.groupby(["ss_ticket_number", "ss_customer_sk", "ss_addr_sk", "ca_city"],
+                       as_index=False).agg(extended_price=("ss_ext_sales_price", "sum"),
+                                           list_price=("ss_ext_list_price", "sum"),
+                                           extended_tax=("ss_ext_tax", "sum"))
+        dn = dn.rename(columns={"ca_city": "bought_city"})
+        dn = dn.merge(cu, left_on="ss_customer_sk", right_on="c_customer_sk")
+        dn = dn.merge(ca, left_on="c_current_addr_sk", right_on="ca_address_sk")
+        dn = dn[dn.ca_city != dn.bought_city]
+        out = dn[["c_last_name", "c_first_name", "ca_city", "bought_city", "ss_ticket_number",
+                  "extended_price", "extended_tax", "list_price"]]
+        return out.sort_values(["c_last_name", "ss_ticket_number"]).head(100).reset_index(drop=True)
+    if q == 73:
+        cu, st, hd = t["customer"], t["store"], t["household_demographics"]
+        m = ss.merge(dd[(dd.d_dom.between(1, 2)) & (dd.d_year.isin([1999, 2000, 2001]))],
+                     left_on="ss_sold_date_sk", right_on="d_date_sk")
+        m = m.merge(st[st.s_county.isin(["Williamson County", "Walker County"])],
+                    left_on="ss_store_sk", right_on="s_store_sk")
+        m = m.merge(hd[((hd.hd_buy_potential == ">10000") | (hd.hd_buy_potential == "Unknown"))
+                       & (hd.hd_vehicle_count > 0)],
+                    left_on="ss_hdemo_sk", right_on="hd_demo_sk")
+        dj = m.groupby(["ss_ticket_number", "ss_customer_sk"], as_index=False).agg(
+            cnt=("ss_ticket_number", "size"))
+        dj = dj[dj.cnt.between(1, 5)]
+        dj = dj.merge(cu, left_on="ss_customer_sk", right_on="c_customer_sk")
+        out = dj[["c_last_name", "c_first_name", "c_customer_sk", "ss_ticket_number", "cnt"]]
+        out = out.rename(columns={"c_customer_sk": "c_salutation"})
+        return out.sort_values(["cnt", "c_last_name"],
+                               ascending=[False, True]).head(100).reset_index(drop=True)
+    if q == 96:
+        td, st, hd = t["time_dim"], t["store"], t["household_demographics"]
+        m = ss.merge(td[(td.t_hour == 20) & (td.t_minute >= 30)],
+                     left_on="ss_sold_time_sk", right_on="t_time_sk")
+        m = m.merge(hd[hd.hd_dep_count == 7], left_on="ss_hdemo_sk", right_on="hd_demo_sk")
+        m = m.merge(st[st.s_store_name == "store 1"], left_on="ss_store_sk", right_on="s_store_sk")
+        return pd.DataFrame({"cnt": [len(m)]})
+    if q == 98:
+        m = ss.merge(it[it.i_category.isin(["Sports", "Books", "Home"])],
+                     left_on="ss_item_sk", right_on="i_item_sk")
+        lo, hi = dt.date(1999, 2, 22), dt.date(1999, 3, 24)
+        dsel = dd[(dd.d_date >= lo) & (dd.d_date <= hi)]
+        m = m.merge(dsel, left_on="ss_sold_date_sk", right_on="d_date_sk")
+        g = m.groupby(["i_item_id", "i_item_desc", "i_category", "i_class", "i_current_price"],
+                      as_index=False).agg(itemrevenue=("ss_ext_sales_price", "sum"))
+        class_tot = g.groupby("i_class")["itemrevenue"].transform("sum")
+        g["revenueratio"] = g.itemrevenue * 100.0 / class_tot
+        return g.sort_values(["i_category", "i_class", "i_item_id", "i_item_desc", "revenueratio"]
+                             ).head(100).reset_index(drop=True)
+    raise ValueError(f"no oracle for q{q}")
+
+
+# queries whose LIMIT can cut through ties: only the ORDER BY key columns
+# are deterministic, so the comparison restricts to them
+TIE_KEYS = {73: ["cnt", "c_last_name"]}
+
+
+def compare_results(engine_table, ref: pd.DataFrame, q: int) -> list[str]:
+    """Column-by-column comparison after aligning on a full sort. For
+    queries in TIE_KEYS, compares the ORDER BY key multiset only (rows
+    beyond the keys are tie-broken arbitrarily by any conforming engine)."""
+    problems = []
+    out = engine_table.to_pandas()
+    if len(out.columns) != len(ref.columns):
+        return [f"q{q}: column count {len(out.columns)} != {len(ref.columns)}"]
+    if len(out) != len(ref):
+        return [f"q{q}: row count {len(out)} != {len(ref)}"]
+    if len(ref) == 0:
+        return []
+    r = ref.copy()
+    r.columns = list(out.columns)  # positional: engine aliases win
+    if q in TIE_KEYS:
+        keys = TIE_KEYS[q]
+        out = out[keys]
+        r = r[keys]
+    o = out.sort_values(list(out.columns), kind="stable").reset_index(drop=True)
+    r = r.sort_values(list(r.columns), kind="stable").reset_index(drop=True)
+    for c in o.columns:
+        a, b = o[c].values, r[c].values
+        try:
+            if np.asarray(a).dtype.kind == "f" or np.asarray(b).dtype.kind == "f":
+                ok = np.allclose(np.asarray(a, float), np.asarray(b, float),
+                                 rtol=1e-6, atol=1e-6, equal_nan=True)
+            else:
+                ok = (a == b).all()
+        except (TypeError, ValueError):
+            ok = list(a) == list(b)
+        if not ok:
+            problems.append(f"q{q}: column {c} mismatch")
+    return problems

@@ -1,0 +1,211 @@
+"""Seeded synthetic TPC-DS data generator (core retail-sales tables).
+
+Schema-faithful (column names/types the query set references) rebuild of
+the reference's tpcds benchmark data leg (benchmarks/src/bin/tpcds.rs uses
+externally generated data; zero-egress here, so we generate). Value
+distributions are simplified but seeded and referentially intact: every
+store_sales foreign key resolves, dates cover 1998-2002 with proper
+year/moy/dom breakdowns.
+
+Scale: `scale=1.0` ≈ 300k store_sales rows (tunable; the point is plan
+shape + correctness, perf scaling comes from --scale).
+"""
+
+from __future__ import annotations
+
+import datetime as dt
+import os
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+BRANDS = [f"brand#{i}" for i in range(1, 61)]
+CATEGORIES = ["Sports", "Books", "Home", "Electronics", "Jewelry", "Men", "Women",
+              "Music", "Children", "Shoes"]
+CLASSES = [f"class#{i}" for i in range(1, 31)]
+CITIES = ["Midway", "Fairview", "Oak Grove", "Five Points", "Centerville",
+          "Liberty", "Pleasant Hill", "Union", "Salem", "Georgetown"]
+COUNTIES = [f"{c} County" for c in ("Williamson", "Walker", "Ziebach", "Daviess",
+                                    "Barrow", "Franklin", "Luce", "Richland")]
+STATES = ["TN", "TX", "SD", "IN", "GA", "OH", "MI", "MT", "CA", "NY"]
+BUY_POTENTIAL = [">10000", "5001-10000", "1001-5000", "501-1000", "0-500", "Unknown"]
+EDUCATION = ["Primary", "Secondary", "College", "2 yr Degree", "4 yr Degree",
+             "Advanced Degree", "Unknown"]
+DAY_NAMES = ["Sunday", "Monday", "Tuesday", "Wednesday", "Thursday", "Friday", "Saturday"]
+
+
+def generate_tpcds(out_dir: str, scale: float = 1.0, seed: int = 17,
+                   files_per_table: int = 2) -> None:
+    rng = np.random.default_rng(seed)
+    n_sales = max(int(300_000 * scale), 1_000)
+    n_items = max(int(2_000 * scale**0.5), 200)
+    n_customers = max(int(10_000 * scale**0.5), 500)
+    n_addresses = max(n_customers // 2, 250)
+    n_stores = max(int(12 * scale**0.5), 6)
+    n_cd = 1920  # cross of demographics like the spec
+    n_hd = 720
+    n_promos = 30
+
+    # ---- date_dim: calendar 1998-01-01 .. 2002-12-31 --------------------
+    start = dt.date(1998, 1, 1)
+    days = (dt.date(2002, 12, 31) - start).days + 1
+    dates = [start + dt.timedelta(days=i) for i in range(days)]
+    date_dim = pa.table({
+        "d_date_sk": pa.array(range(2450815, 2450815 + days), pa.int64()),
+        "d_date": pa.array(dates, pa.date32()),
+        "d_year": pa.array([d.year for d in dates], pa.int64()),
+        "d_moy": pa.array([d.month for d in dates], pa.int64()),
+        "d_dom": pa.array([d.day for d in dates], pa.int64()),
+        "d_qoy": pa.array([(d.month - 1) // 3 + 1 for d in dates], pa.int64()),
+        "d_day_name": pa.array([DAY_NAMES[d.isoweekday() % 7] for d in dates]),
+    })
+
+    # ---- time_dim --------------------------------------------------------
+    secs = np.arange(0, 86400, 60)  # minute granularity keeps it small
+    time_dim = pa.table({
+        "t_time_sk": pa.array(secs, pa.int64()),
+        "t_hour": pa.array(secs // 3600, pa.int64()),
+        "t_minute": pa.array((secs % 3600) // 60, pa.int64()),
+    })
+
+    # ---- item ------------------------------------------------------------
+    brand_ids = rng.integers(1, 1000, n_items)
+    cat_ids = rng.integers(0, len(CATEGORIES), n_items)
+    class_ids = rng.integers(0, len(CLASSES), n_items)
+    item = pa.table({
+        "i_item_sk": pa.array(range(1, n_items + 1), pa.int64()),
+        "i_item_id": pa.array([f"AAAAAAAA{i:08d}" for i in range(1, n_items + 1)]),
+        "i_item_desc": pa.array([f"item description {i}" for i in range(1, n_items + 1)]),
+        "i_brand_id": pa.array(brand_ids, pa.int64()),
+        "i_brand": pa.array([BRANDS[b % len(BRANDS)] for b in brand_ids]),
+        "i_category_id": pa.array(cat_ids + 1, pa.int64()),
+        "i_category": pa.array([CATEGORIES[c] for c in cat_ids]),
+        "i_class_id": pa.array(class_ids + 1, pa.int64()),
+        "i_class": pa.array([CLASSES[c] for c in class_ids]),
+        "i_manufact_id": pa.array(rng.integers(1, 1000, n_items), pa.int64()),
+        "i_manager_id": pa.array(rng.integers(1, 100, n_items), pa.int64()),
+        "i_current_price": pa.array(np.round(rng.uniform(0.5, 300, n_items), 2)),
+    })
+
+    # ---- store -----------------------------------------------------------
+    store = pa.table({
+        "s_store_sk": pa.array(range(1, n_stores + 1), pa.int64()),
+        "s_store_id": pa.array([f"AAAAAAAA{i:04d}BAAA" for i in range(1, n_stores + 1)]),
+        "s_store_name": pa.array([f"store {i}" for i in range(1, n_stores + 1)]),
+        "s_number_employees": pa.array(rng.integers(200, 300, n_stores), pa.int64()),
+        "s_city": pa.array(rng.choice(CITIES, n_stores)),
+        "s_county": pa.array(rng.choice(COUNTIES, n_stores)),
+        "s_state": pa.array(rng.choice(STATES, n_stores)),
+        "s_zip": pa.array([f"{z:05d}" for z in rng.integers(10000, 99999, n_stores)]),
+        "s_gmt_offset": pa.array(rng.choice([-5.0, -6.0, -7.0, -8.0], n_stores)),
+    })
+
+    # ---- demographics ----------------------------------------------------
+    cd_idx = np.arange(n_cd)
+    customer_demographics = pa.table({
+        "cd_demo_sk": pa.array(cd_idx + 1, pa.int64()),
+        "cd_gender": pa.array(np.where(cd_idx % 2 == 0, "M", "F")),
+        "cd_marital_status": pa.array([["M", "S", "D", "W", "U"][i % 5] for i in cd_idx]),
+        "cd_education_status": pa.array([EDUCATION[i % len(EDUCATION)] for i in cd_idx]),
+    })
+    hd_idx = np.arange(n_hd)
+    household_demographics = pa.table({
+        "hd_demo_sk": pa.array(hd_idx + 1, pa.int64()),
+        "hd_buy_potential": pa.array([BUY_POTENTIAL[i % len(BUY_POTENTIAL)] for i in hd_idx]),
+        "hd_dep_count": pa.array(hd_idx % 10, pa.int64()),
+        "hd_vehicle_count": pa.array(hd_idx % 5, pa.int64()),
+    })
+
+    # ---- customer_address / customer ------------------------------------
+    customer_address = pa.table({
+        "ca_address_sk": pa.array(range(1, n_addresses + 1), pa.int64()),
+        "ca_city": pa.array(rng.choice(CITIES, n_addresses)),
+        "ca_county": pa.array(rng.choice(COUNTIES, n_addresses)),
+        "ca_state": pa.array(rng.choice(STATES, n_addresses)),
+        "ca_zip": pa.array([f"{z:05d}" for z in rng.integers(10000, 99999, n_addresses)]),
+        "ca_country": pa.array(["United States"] * n_addresses),
+        "ca_gmt_offset": pa.array(rng.choice([-5.0, -6.0, -7.0, -8.0], n_addresses)),
+    })
+    customer = pa.table({
+        "c_customer_sk": pa.array(range(1, n_customers + 1), pa.int64()),
+        "c_customer_id": pa.array([f"AAAAAAAA{i:08d}" for i in range(1, n_customers + 1)]),
+        "c_first_name": pa.array([f"First{i % 997}" for i in range(1, n_customers + 1)]),
+        "c_last_name": pa.array([f"Last{i % 499}" for i in range(1, n_customers + 1)]),
+        "c_current_addr_sk": pa.array(rng.integers(1, n_addresses + 1, n_customers), pa.int64()),
+        "c_current_cdemo_sk": pa.array(rng.integers(1, n_cd + 1, n_customers), pa.int64()),
+        "c_current_hdemo_sk": pa.array(rng.integers(1, n_hd + 1, n_customers), pa.int64()),
+        "c_birth_country": pa.array(["UNITED STATES"] * n_customers),
+    })
+
+    # ---- promotion -------------------------------------------------------
+    promotion = pa.table({
+        "p_promo_sk": pa.array(range(1, n_promos + 1), pa.int64()),
+        "p_channel_email": pa.array(["N" if i % 3 else "Y" for i in range(n_promos)]),
+        "p_channel_event": pa.array(["N" if i % 2 else "Y" for i in range(n_promos)]),
+    })
+
+    # ---- store_sales (the fact table) -----------------------------------
+    qty = rng.integers(1, 101, n_sales)
+    wholesale = np.round(rng.uniform(1, 100, n_sales), 2)
+    list_price = np.round(wholesale * rng.uniform(1.0, 2.0, n_sales), 2)
+    sales_price = np.round(list_price * rng.uniform(0.3, 1.0, n_sales), 2)
+    ext_sales = np.round(sales_price * qty, 2)
+    ext_list = np.round(list_price * qty, 2)
+    ext_wholesale = np.round(wholesale * qty, 2)
+    ext_discount = np.round(ext_list - ext_sales, 2)
+    ext_tax = np.round(ext_sales * 0.06, 2)
+    coupon = np.where(rng.random(n_sales) < 0.1, np.round(ext_sales * 0.1, 2), 0.0)
+    net_paid = np.round(ext_sales - coupon, 2)
+    net_profit = np.round(net_paid - ext_wholesale, 2)
+    store_sales = pa.table({
+        "ss_sold_date_sk": pa.array(rng.integers(2450815, 2450815 + days, n_sales), pa.int64()),
+        "ss_sold_time_sk": pa.array(rng.choice(secs, n_sales), pa.int64()),
+        "ss_item_sk": pa.array(rng.integers(1, n_items + 1, n_sales), pa.int64()),
+        "ss_customer_sk": pa.array(rng.integers(1, n_customers + 1, n_sales), pa.int64()),
+        "ss_cdemo_sk": pa.array(rng.integers(1, n_cd + 1, n_sales), pa.int64()),
+        "ss_hdemo_sk": pa.array(rng.integers(1, n_hd + 1, n_sales), pa.int64()),
+        "ss_addr_sk": pa.array(rng.integers(1, n_addresses + 1, n_sales), pa.int64()),
+        "ss_store_sk": pa.array(rng.integers(1, n_stores + 1, n_sales), pa.int64()),
+        "ss_promo_sk": pa.array(rng.integers(1, n_promos + 1, n_sales), pa.int64()),
+        "ss_ticket_number": pa.array(rng.integers(1, n_sales // 3 + 2, n_sales), pa.int64()),
+        "ss_quantity": pa.array(qty, pa.int64()),
+        "ss_wholesale_cost": pa.array(wholesale),
+        "ss_list_price": pa.array(list_price),
+        "ss_sales_price": pa.array(sales_price),
+        "ss_ext_discount_amt": pa.array(ext_discount),
+        "ss_ext_sales_price": pa.array(ext_sales),
+        "ss_ext_wholesale_cost": pa.array(ext_wholesale),
+        "ss_ext_list_price": pa.array(ext_list),
+        "ss_ext_tax": pa.array(ext_tax),
+        "ss_coupon_amt": pa.array(coupon),
+        "ss_net_paid": pa.array(net_paid),
+        "ss_net_profit": pa.array(net_profit),
+    })
+
+    tables = {
+        "date_dim": date_dim, "time_dim": time_dim, "item": item, "store": store,
+        "customer": customer, "customer_address": customer_address,
+        "customer_demographics": customer_demographics,
+        "household_demographics": household_demographics,
+        "promotion": promotion, "store_sales": store_sales,
+    }
+    for name, tbl in tables.items():
+        d = os.path.join(out_dir, name)
+        os.makedirs(d, exist_ok=True)
+        nfiles = files_per_table if name == "store_sales" else 1
+        rows_per = (tbl.num_rows + nfiles - 1) // nfiles
+        for i in range(nfiles):
+            part = tbl.slice(i * rows_per, rows_per)
+            pq.write_table(part, os.path.join(d, f"part-{i}.parquet"))
+
+
+TPCDS_TABLES = [
+    "date_dim", "time_dim", "item", "store", "customer", "customer_address",
+    "customer_demographics", "household_demographics", "promotion", "store_sales",
+]
+
+
+def register_tpcds(ctx, data_dir: str) -> None:
+    for t in TPCDS_TABLES:
+        ctx.register_parquet(t, os.path.join(data_dir, t))

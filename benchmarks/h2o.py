@@ -1,0 +1,186 @@
+"""h2o db-benchmark harness (groupby + join sets).
+
+Rebuild of the reference's benchmarks/db-benchmark scripts: generates the
+standard G1 groupby table / J1 join tables, runs the h2o query set through
+the engine, and verifies against pandas.
+
+  python benchmarks/h2o.py groupby --rows 1000000 [--engine cpu|tpu] [--verify]
+  python benchmarks/h2o.py join    --rows 1000000 [--verify]
+
+q6 (median/sd) and q9 (corr) need aggregates outside the engine's set and
+are reported as skipped — the same subset public h2o runs mark for engines
+without those aggregates.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import pyarrow as pa
+
+GROUPBY_QUERIES = {
+    "q1": "select id1, sum(v1) as v1 from x group by id1",
+    "q2": "select id1, id2, sum(v1) as v1 from x group by id1, id2",
+    "q3": "select id3, sum(v1) as v1, avg(v3) as v3 from x group by id3",
+    "q4": "select id4, avg(v1) as v1, avg(v2) as v2, avg(v3) as v3 from x group by id4",
+    "q5": "select id6, sum(v1) as v1, sum(v2) as v2, sum(v3) as v3 from x group by id6",
+    "q7": "select id3, max(v1) - min(v2) as range_v1_v2 from x group by id3",
+    "q8": (
+        "select id6, v3 from ("
+        "select id6, v3, row_number() over (partition by id6 order by v3 desc) rn "
+        "from x) t where rn <= 2"
+    ),
+    "q10": (
+        "select id1, id2, id3, id4, id5, id6, sum(v3) as v3, count(*) as cnt "
+        "from x group by id1, id2, id3, id4, id5, id6"
+    ),
+}
+SKIPPED = {"q6": "median/sd aggregates", "q9": "corr aggregate"}
+
+JOIN_QUERIES = {
+    "j1": "select x.id1 as xid1, small.id1, x.v1, small.v2 from x, small where x.id1 = small.id1",
+    "j2": "select x.id2 as xid2, medium.id2, x.v1, medium.v2 from x, medium where x.id2 = medium.id2",
+    "j3": "select x.id3 as xid3, big.id3, x.v1, big.v2 from x, big where x.id3 = big.id3",
+}
+
+
+def gen_groupby(rows: int, k: int = 100) -> pa.Table:
+    rng = np.random.default_rng(42)
+    return pa.table({
+        "id1": np.char.add("id", rng.integers(1, k + 1, rows).astype(str)),
+        "id2": np.char.add("id", rng.integers(1, k + 1, rows).astype(str)),
+        "id3": np.char.add("id", rng.integers(1, rows // 10 + 2, rows).astype(str)),
+        "id4": rng.integers(1, k + 1, rows),
+        "id5": rng.integers(1, k + 1, rows),
+        "id6": rng.integers(1, rows // 10 + 2, rows),
+        "v1": rng.integers(1, 6, rows),
+        "v2": rng.integers(1, 16, rows),
+        "v3": np.round(rng.uniform(0, 100, rows), 6),
+    })
+
+
+def gen_join(rows: int) -> dict[str, pa.Table]:
+    rng = np.random.default_rng(43)
+    x = pa.table({
+        "id1": rng.integers(1, rows // 1_000 + 2, rows),
+        "id2": rng.integers(1, rows // 100 + 2, rows),
+        "id3": rng.integers(1, rows // 10 + 2, rows),
+        "v1": np.round(rng.uniform(0, 100, rows), 6),
+    })
+    small = pa.table({
+        "id1": np.arange(1, rows // 1_000 + 2),
+        "v2": np.round(rng.uniform(0, 100, rows // 1_000 + 1), 6),
+    })
+    medium = pa.table({
+        "id2": np.arange(1, rows // 100 + 2),
+        "v2": np.round(rng.uniform(0, 100, rows // 100 + 1), 6),
+    })
+    big = pa.table({
+        "id3": np.arange(1, rows // 10 + 2),
+        "v2": np.round(rng.uniform(0, 100, rows // 10 + 1), 6),
+    })
+    return {"x": x, "small": small, "medium": medium, "big": big}
+
+
+def _verify_groupby(name: str, out, x: pa.Table) -> str | None:
+    df = x.to_pandas()
+    o = out.to_pandas()
+    if name == "q1":
+        e = df.groupby("id1", as_index=False).agg(v1=("v1", "sum"))
+    elif name == "q2":
+        e = df.groupby(["id1", "id2"], as_index=False).agg(v1=("v1", "sum"))
+    elif name == "q3":
+        e = df.groupby("id3", as_index=False).agg(v1=("v1", "sum"), v3=("v3", "mean"))
+    elif name == "q4":
+        e = df.groupby("id4", as_index=False).agg(v1=("v1", "mean"), v2=("v2", "mean"), v3=("v3", "mean"))
+    elif name == "q5":
+        e = df.groupby("id6", as_index=False).agg(v1=("v1", "sum"), v2=("v2", "sum"), v3=("v3", "sum"))
+    elif name == "q7":
+        e = df.groupby("id3", as_index=False).agg(mx=("v1", "max"), mn=("v2", "min"))
+        e["range_v1_v2"] = e.mx - e.mn
+        e = e[["id3", "range_v1_v2"]]
+    elif name == "q8":
+        s = df.sort_values("v3", ascending=False).groupby("id6").head(2)
+        e = s[["id6", "v3"]]
+    elif name == "q10":
+        e = df.groupby(["id1", "id2", "id3", "id4", "id5", "id6"], as_index=False).agg(
+            v3=("v3", "sum"), cnt=("v3", "size")
+        )
+    else:
+        return None
+    if len(o) != len(e):
+        return f"{name}: row count {len(o)} != {len(e)}"
+    o2 = o.sort_values(list(o.columns)).reset_index(drop=True)
+    e2 = e.sort_values(list(e.columns)).reset_index(drop=True)
+    for c in e2.columns:
+        a, b = o2[c].values, e2[c].values
+        if a.dtype.kind == "f" or b.dtype.kind == "f":
+            if not np.allclose(a.astype(float), b.astype(float), rtol=1e-9, atol=1e-9):
+                return f"{name}: column {c} mismatch"
+        elif not (a == b).all():
+            return f"{name}: column {c} mismatch"
+    return None
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description="h2o db-benchmark harness")
+    ap.add_argument("mode", choices=("groupby", "join"))
+    ap.add_argument("--rows", type=int, default=1_000_000)
+    ap.add_argument("--engine", choices=("cpu", "tpu"), default="cpu")
+    ap.add_argument("--partitions", type=int, default=8)
+    ap.add_argument("--verify", action="store_true")
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args()
+
+    from ballista_tpu.client.context import SessionContext
+    from ballista_tpu.config import BallistaConfig, EXECUTOR_ENGINE
+
+    ctx = SessionContext(BallistaConfig({EXECUTOR_ENGINE: args.engine}))
+    results = []
+    if args.mode == "groupby":
+        x = gen_groupby(args.rows)
+        ctx.register_arrow_table("x", x, partitions=args.partitions)
+        for name, sql in GROUPBY_QUERIES.items():
+            t0 = time.time()
+            out = ctx.sql(sql).collect()
+            dt = time.time() - t0
+            entry = {"query": name, "time_s": round(dt, 3), "out_rows": out.num_rows}
+            if args.verify:
+                problem = _verify_groupby(name, out, x)
+                entry["verified"] = problem is None
+                if problem:
+                    entry["problem"] = problem
+            results.append(entry)
+        for name, why in SKIPPED.items():
+            results.append({"query": name, "skipped": why})
+    else:
+        tables = gen_join(args.rows)
+        for name, tbl in tables.items():
+            ctx.register_arrow_table(name, tbl, partitions=args.partitions if name == "x" else 1)
+        xx = tables["x"].to_pandas() if args.verify else None
+        for name, sql in JOIN_QUERIES.items():
+            t0 = time.time()
+            out = ctx.sql(sql).collect()
+            dt = time.time() - t0
+            entry = {"query": name, "time_s": round(dt, 3), "out_rows": out.num_rows}
+            if args.verify:
+                other = {"j1": "small", "j2": "medium", "j3": "big"}[name]
+                key = {"j1": "id1", "j2": "id2", "j3": "id3"}[name]
+                e = xx.merge(tables[other].to_pandas(), on=key)
+                entry["verified"] = out.num_rows == len(e)
+                if not entry["verified"]:
+                    entry["problem"] = f"rows {out.num_rows} != {len(e)}"
+            results.append(entry)
+
+    print(json.dumps(results) if args.json else "\n".join(map(str, results)))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,164 @@
+"""Standalone shuffle micro-benchmark.
+
+Rebuild of benchmarks/src/bin/shuffle_bench.rs + benches/sort_shuffle.rs:
+profiles the shuffle writer in isolation — hash layout vs sort-consolidated
+layout, native C++ row router vs numpy fallback — and the reader's local
+and raw-block Flight paths, without a scheduler in the way.
+
+  python benchmarks/shuffle_bench.py [--rows 2000000] [--partitions 16]
+      [--layout sort|hash|both] [--read local|flight|none] [--json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import pyarrow as pa
+
+
+def make_batches(rows: int, batch_size: int = 64 * 1024) -> list[pa.RecordBatch]:
+    rng = np.random.default_rng(7)
+    out = []
+    for off in range(0, rows, batch_size):
+        n = min(batch_size, rows - off)
+        out.append(pa.record_batch({
+            "k": pa.array(rng.integers(0, 1 << 30, n)),
+            "v": pa.array(rng.integers(0, 1000, n)),
+            "price": pa.array(np.round(rng.uniform(0, 1000, n), 2)),
+            "s": pa.array(rng.choice(["alpha", "beta", "gamma", "delta"], n)),
+        }))
+    return out
+
+
+def run_write(batches, work_dir: str, partitions: int, sort_shuffle: bool, ctx):
+    from ballista_tpu.plan.expressions import Column
+    from ballista_tpu.plan.physical import MemoryScanExec
+    from ballista_tpu.plan.schema import DFSchema
+    from ballista_tpu.shuffle.writer import ShuffleWriterExec
+
+    schema = DFSchema.from_arrow(batches[0].schema)
+    scan = MemoryScanExec(schema, batches, partitions=1)
+    writer = ShuffleWriterExec(
+        scan, "bench-job", 1, partitions, [Column("k")], sort_shuffle=sort_shuffle
+    )
+    t0 = time.time()
+    metas = []
+    for b in writer.execute(0, ctx):
+        metas.append(b)
+    dt = time.time() - t0
+    total_bytes = sum(
+        os.path.getsize(os.path.join(root, f))
+        for root, _, files in os.walk(work_dir) for f in files
+    )
+    return dt, total_bytes
+
+
+def run_read(work_dir: str, partitions: int, layout: str, mode: str, ctx, rows: int):
+    from ballista_tpu.shuffle import paths
+    from ballista_tpu.shuffle.reader import fetch_partition
+    from ballista_tpu.shuffle.types import PartitionLocation, PartitionStats
+
+    locs = []
+    stage_dir = os.path.join(work_dir, "bench-job", "1")
+    for root, _, files in os.walk(stage_dir):
+        for f in files:
+            if f.endswith(".idx"):
+                continue
+            path = os.path.join(root, f)
+            if layout == "sort":
+                # consolidated file: one location per output partition
+                for p in range(partitions):
+                    locs.append(PartitionLocation(
+                        map_partition=0, job_id="bench-job", stage_id=1,
+                        output_partition=p, executor_id="e", host="127.0.0.1",
+                        flight_port=0, path=path, layout=layout,
+                        stats=PartitionStats(0, 0, 0),
+                    ))
+            else:
+                # hash layout: the directory name IS the output partition
+                p = int(os.path.basename(root))
+                locs.append(PartitionLocation(
+                    map_partition=0, job_id="bench-job", stage_id=1,
+                    output_partition=p, executor_id="e", host="127.0.0.1",
+                    flight_port=0, path=path, layout=layout,
+                    stats=PartitionStats(0, 0, 0),
+                ))
+    t0 = time.time()
+    got = 0
+    server = None
+    try:
+        if mode == "flight":
+            from ballista_tpu.flight.server import start_flight_server
+
+            server, port = start_flight_server(work_dir, "127.0.0.1", 0)
+            locs = [
+                PartitionLocation(**{**l.__dict__, "flight_port": port, "path": l.path})
+                for l in locs
+            ]
+            for l in locs:
+                for b in fetch_partition(l, ctx, force_remote=True):
+                    got += b.num_rows
+        else:
+            for l in locs:
+                for b in fetch_partition(l, ctx):
+                    got += b.num_rows
+    finally:
+        if server is not None:
+            server.shutdown()
+    dt = time.time() - t0
+    assert got == rows, f"read {got} rows, expected {rows}"
+    return dt
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description="shuffle writer/reader micro-benchmark")
+    ap.add_argument("--rows", type=int, default=2_000_000)
+    ap.add_argument("--partitions", type=int, default=16)
+    ap.add_argument("--layout", choices=("sort", "hash", "both"), default="both")
+    ap.add_argument("--read", choices=("local", "flight", "none"), default="local")
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args()
+
+    from ballista_tpu.config import BallistaConfig, SORT_SHUFFLE_ENABLED
+    from ballista_tpu.plan.physical import TaskContext
+
+    batches = make_batches(args.rows)
+    results = []
+    layouts = ("sort", "hash") if args.layout == "both" else (args.layout,)
+    for layout in layouts:
+        work = tempfile.mkdtemp(prefix=f"shuffle-bench-{layout}-")
+        cfg = BallistaConfig({SORT_SHUFFLE_ENABLED: layout == "sort"})
+        ctx = TaskContext(cfg, work_dir=work)
+        wt, nbytes = run_write(batches, work, args.partitions, layout == "sort", ctx)
+        entry = {
+            "layout": layout, "rows": args.rows, "partitions": args.partitions,
+            "write_s": round(wt, 3),
+            "write_rows_per_s": int(args.rows / wt),
+            "bytes": nbytes,
+            "files": sum(len(fs) for _, _, fs in os.walk(work)),
+        }
+        if args.read != "none":
+            rt = run_read(work, args.partitions, layout, args.read, ctx, args.rows)
+            entry[f"read_{args.read}_s"] = round(rt, 3)
+            entry[f"read_{args.read}_rows_per_s"] = int(args.rows / rt)
+        results.append(entry)
+        shutil.rmtree(work, ignore_errors=True)
+
+    if args.json:
+        print(json.dumps(results))
+    else:
+        for r in results:
+            print(r)
+
+
+if __name__ == "__main__":
+    main()

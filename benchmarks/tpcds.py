@@ -1,0 +1,98 @@
+"""TPC-DS benchmark runner (rebuild of benchmarks/src/bin/tpcds.rs).
+
+Query subset: the retail-sales queries answerable from the generated core
+tables (see ballista_tpu/testing/tpcdsgen.py). Modes mirror tpch.py:
+
+  python benchmarks/tpcds.py data --scale 1 --out /tmp/tpcds_sf1
+  python benchmarks/tpcds.py run --data /tmp/tpcds_sf1 [--query 3] \
+      [--engine cpu|tpu] [--mode local|standalone] [--iterations 1] [--verify]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+QUERIES = [3, 7, 19, 42, 52, 55, 68, 73, 96, 98]
+
+
+def q_path(n: int) -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)), "tpcds", "queries", f"q{n}.sql")
+
+
+def cmd_data(args) -> None:
+    from ballista_tpu.testing.tpcdsgen import generate_tpcds
+
+    t0 = time.time()
+    generate_tpcds(args.out, scale=args.scale, files_per_table=args.files)
+    print(f"generated tpcds scale={args.scale} at {args.out} in {time.time() - t0:.1f}s")
+
+
+def cmd_run(args) -> None:
+    from ballista_tpu.client.context import SessionContext
+    from ballista_tpu.config import BallistaConfig, EXECUTOR_ENGINE
+    from ballista_tpu.testing.tpcdsgen import register_tpcds
+
+    cfg = BallistaConfig({EXECUTOR_ENGINE: args.engine})
+    if args.mode == "standalone":
+        ctx = SessionContext.standalone(cfg)
+    else:
+        ctx = SessionContext(cfg)
+    register_tpcds(ctx, args.data)
+
+    ref_tables = None
+    if args.verify:
+        from ballista_tpu.testing.tpcds_reference import load_tables
+
+        ref_tables = load_tables(args.data)
+
+    queries = [args.query] if args.query else QUERIES
+    results = []
+    for q in queries:
+        sql = open(q_path(q)).read()
+        times = []
+        out = None
+        for _ in range(args.iterations):
+            t0 = time.time()
+            out = ctx.sql(sql).collect()
+            times.append(time.time() - t0)
+        entry = {"query": f"q{q}", "time_s": round(min(times), 3), "rows": out.num_rows}
+        if args.verify:
+            from ballista_tpu.testing.tpcds_reference import compare_results, run_reference
+
+            problems = compare_results(out, run_reference(q, ref_tables), q)
+            entry["verified"] = not problems
+            if problems:
+                entry["problems"] = problems
+        results.append(entry)
+        print(entry, file=sys.stderr)
+    print(json.dumps(results))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description="TPC-DS benchmark")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    d = sub.add_parser("data")
+    d.add_argument("--scale", type=float, default=1.0)
+    d.add_argument("--out", required=True)
+    d.add_argument("--files", type=int, default=2)
+    d.set_defaults(fn=cmd_data)
+    r = sub.add_parser("run")
+    r.add_argument("--data", required=True)
+    r.add_argument("--query", type=int, default=None)
+    r.add_argument("--engine", choices=("cpu", "tpu"), default="cpu")
+    r.add_argument("--mode", choices=("local", "standalone"), default="local")
+    r.add_argument("--iterations", type=int, default=1)
+    r.add_argument("--verify", action="store_true")
+    r.set_defaults(fn=cmd_run)
+    args = ap.parse_args()
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()
