@@ -18,6 +18,7 @@ stage lifecycle  UNRESOLVED → RESOLVED → RUNNING → SUCCESSFUL | FAILED
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from dataclasses import dataclass, field
@@ -29,8 +30,13 @@ from ballista_tpu.scheduler.planner import QueryStage, remove_unresolved_shuffle
 from ballista_tpu.shuffle.reader import ShuffleReaderExec
 from ballista_tpu.shuffle.types import PartitionLocation
 
+log = logging.getLogger(__name__)
+
 MAX_STAGE_ATTEMPTS = 4
 MAX_TASK_FAILURES = 4
+# runtime broadcast decisions apply this safety factor to the configured
+# planner threshold (see _try_broadcast_elision / aqe SelectJoinRule)
+ELISION_MARGIN = 8
 
 
 class StageState(Enum):
@@ -224,7 +230,111 @@ class ExecutionGraph:
             events.append("job_finished")
             return
         for out_id in self.output_links.get(stage.stage_id, []):
-            self._try_resolve(self.stages[out_id])
+            consumer = self.stages[out_id]
+            self._try_broadcast_elision(consumer)
+            self._try_resolve(consumer)
+
+    def _try_broadcast_elision(self, stage: ExecutionStage) -> None:
+        """Incremental AQE replanning (AdaptivePlanner::replan_stages analog,
+        state/aqe/planner.rs:304): when a partitioned join's BUILD input just
+        finished tiny while the PROBE-side hash shuffle hasn't started, the
+        remaining plan is replanned — the join becomes CollectLeft over a
+        broadcast build, and the probe stage's hash writer is rewritten to a
+        passthrough, ELIDING the probe-side shuffle entirely. This is the
+        win resolution-time rewrites cannot reach: by resolution the probe
+        rows have already been hashed, bucketed, and written."""
+        from ballista_tpu.config import (
+            AQE_DYNAMIC_JOIN_SELECTION,
+            BROADCAST_JOIN_ROWS_THRESHOLD,
+            PLANNER_ADAPTIVE_ENABLED,
+        )
+        from ballista_tpu.plan.physical import HashJoinExec
+        from ballista_tpu.shuffle.reader import UnresolvedShuffleExec
+        from ballista_tpu.shuffle.writer import ShuffleWriterExec
+
+        if stage.state is not StageState.UNRESOLVED:
+            return
+        if not (
+            bool(self.config.get(PLANNER_ADAPTIVE_ENABLED))
+            and bool(self.config.get(AQE_DYNAMIC_JOIN_SELECTION))
+        ):
+            return
+        # deliberately conservative: runtime elision rewrites TWO stages, so
+        # it only fires well below the planner's broadcast threshold (matches
+        # the resolution-time SelectJoinRule's margin in aqe/rules.py)
+        threshold = int(self.config.get(BROADCAST_JOIN_ROWS_THRESHOLD)) // ELISION_MARGIN
+
+        def passthrough(writer: ShuffleWriterExec) -> ShuffleWriterExec:
+            return ShuffleWriterExec(
+                writer.input, self.job_id, writer.stage_id, 0, [], sort_shuffle=False
+            )
+
+        def rewrite(node):
+            changed = False
+            kids = node.children()
+            if kids:
+                new_kids = []
+                for c in kids:
+                    nc, ch = rewrite(c)
+                    new_kids.append(nc)
+                    changed = changed or ch
+                if changed:
+                    node = node.with_children(new_kids)
+            if (
+                isinstance(node, HashJoinExec)
+                and node.mode == "partitioned"
+                and node.join_type in ("inner", "right", "right_semi", "right_anti")
+                and isinstance(node.left, UnresolvedShuffleExec)
+                and isinstance(node.right, UnresolvedShuffleExec)
+                and node.left.stage_id != node.right.stage_id
+            ):
+                build = self.stages.get(node.left.stage_id)
+                probe = self.stages.get(node.right.stage_id)
+                if build is None or probe is None or build.state is not StageState.SUCCESSFUL:
+                    return node, changed
+                if (
+                    probe.running or probe.completed
+                    or probe.state not in (StageState.UNRESOLVED, StageState.RESOLVED)
+                    or probe.spec.plan.output_partitions <= 0
+                ):
+                    return node, changed  # probe started (or already passthrough)
+                rows = sum(loc.stats.num_rows for loc in build.output_locations())
+                if rows > threshold:
+                    return node, changed
+                probe.spec.plan = passthrough(probe.spec.plan)
+                probe.spec.output_partitions = probe.spec.partitions
+                if probe.resolved_plan is not None:
+                    probe.resolved_plan = passthrough(probe.resolved_plan)
+                build.spec.broadcast = True
+                new_left = UnresolvedShuffleExec(
+                    build.stage_id, node.left.df_schema, node.left.output_partitions,
+                    broadcast=True,
+                )
+                new_right = UnresolvedShuffleExec(
+                    probe.stage_id, node.right.df_schema, probe.spec.partitions,
+                    broadcast=False,
+                )
+                log.info(
+                    "incremental AQE: build stage %d finished with %d rows → "
+                    "CollectLeft broadcast; probe stage %d hash shuffle elided "
+                    "(passthrough, %d partitions)",
+                    build.stage_id, rows, probe.stage_id, probe.spec.partitions,
+                )
+                return (
+                    HashJoinExec(
+                        new_left, new_right, node.on, node.join_type, node.filter,
+                        "collect_left", node.df_schema,
+                    ),
+                    True,
+                )
+            return node, changed
+
+        new_plan, changed = rewrite(stage.spec.plan)
+        if changed:
+            stage.spec.plan = new_plan
+            stage.spec.partitions = new_plan.input.output_partition_count()
+            stage.pending = list(range(stage.spec.partitions))
+            stage.effective_partitions = stage.spec.partitions
 
     def _try_resolve(self, stage: ExecutionStage) -> None:
         if stage.state is not StageState.UNRESOLVED:
