@@ -65,10 +65,18 @@ class GrpcTaskLauncher(TaskLauncher):
 class SchedulerProcess:
     def __init__(self, bind_host: str = "0.0.0.0", port: int = 50050,
                  task_distribution: str = "bias", executor_timeout_s: float = 180.0,
-                 rest_port: int = 0, flight_proxy_port: int = 0):
+                 rest_port: int = 0, flight_proxy_port: int = 0,
+                 job_state_dir: str | None = None, scheduler_id: str = "scheduler-0",
+                 force_recover: bool = False):
         self.metrics = InMemoryMetricsCollector()
+        job_state = None
+        if job_state_dir:
+            from ballista_tpu.scheduler.state.job_state import FileJobState
+
+            job_state = FileJobState(job_state_dir)
         self.scheduler = SchedulerServer(
-            GrpcTaskLauncher(), self.metrics, task_distribution, executor_timeout_s
+            GrpcTaskLauncher(), self.metrics, task_distribution, executor_timeout_s,
+            scheduler_id=scheduler_id, job_state=job_state,
         )
         self.grpc_server = grpc.server(futures.ThreadPoolExecutor(max_workers=32))
         self.service = SchedulerGrpcService(self.scheduler)
@@ -83,6 +91,7 @@ class SchedulerProcess:
             self.rest_server, self.rest_port = start_rest_api(
                 self.scheduler, self.metrics, bind_host, rest_port
             )
+        self.force_recover = force_recover
         self.flight_proxy = None
         self.flight_proxy_port = 0
         if flight_proxy_port >= 0:
@@ -95,6 +104,9 @@ class SchedulerProcess:
 
     def start(self) -> None:
         self.scheduler.start()
+        recovered = self.scheduler.recover_jobs(force=self.force_recover)
+        if recovered:
+            log.info("recovered %d persisted jobs: %s", len(recovered), recovered)
         self.grpc_server.start()
         threading.Thread(target=self._expiry_loop, daemon=True, name="executor-expiry").start()
         log.info("scheduler up: grpc=%d rest=%s", self.port, self.rest_port or "off")
@@ -130,6 +142,12 @@ def main(argv=None) -> None:
     ap.add_argument("--rest-port", type=int, default=50080)
     ap.add_argument("--flight-proxy-port", type=int, default=50051,
                     help="Flight result proxy port (-1 disables; 0 = ephemeral)")
+    ap.add_argument("--job-state-dir", default=None,
+                    help="persist job graphs here for fail-over recovery")
+    ap.add_argument("--scheduler-id", default="scheduler-0")
+    ap.add_argument("--force-recover", action="store_true",
+                    help="adopt persisted jobs even if owned by another scheduler id "
+                         "(standby takeover after the owner died)")
     ap.add_argument("--task-distribution", choices=("bias", "round-robin"), default="bias")
     ap.add_argument("--executor-timeout-seconds", type=float, default=180.0)
     ap.add_argument("--log-level", default="INFO")
@@ -140,6 +158,8 @@ def main(argv=None) -> None:
         args.bind_host, args.port,
         "round_robin" if args.task_distribution == "round-robin" else "bias",
         args.executor_timeout_seconds, args.rest_port, args.flight_proxy_port,
+        job_state_dir=args.job_state_dir, scheduler_id=args.scheduler_id,
+        force_recover=args.force_recover,
     )
     signal.signal(signal.SIGTERM, lambda *_: proc.shutdown())
     proc.start()

@@ -59,11 +59,15 @@ class SchedulerServer:
                  metrics: SchedulerMetricsCollector | None = None,
                  task_distribution: str = "bias",
                  executor_timeout_s: float = 180.0,
-                 scheduler_id: str = "scheduler-0"):
+                 scheduler_id: str = "scheduler-0",
+                 job_state=None):
+        from ballista_tpu.scheduler.state.job_state import InMemoryJobState
+
         self.scheduler_id = scheduler_id
         self.executors = ExecutorManager(task_distribution, executor_timeout_s)
         self.sessions = SessionManager()
         self.jobs: dict[str, ExecutionGraph] = {}
+        self.job_state = job_state or InMemoryJobState()
         self.launcher = launcher
         self.metrics = metrics or NoopMetricsCollector()
         self._events: "queue.Queue[Event]" = queue.Queue(maxsize=10_000)
@@ -155,6 +159,11 @@ class SchedulerServer:
             graph = ExecutionGraph(job_id, old.job_name if old else "", session_id, stages, cfg)
             with self._jobs_lock:
                 self.jobs[job_id] = graph
+            if self.job_state.acquire(job_id, self.scheduler_id):
+                self.job_state.save_graph(graph)
+            else:
+                # never clobber a peer's checkpoint on an id collision
+                log.warning("job %s is owned by another scheduler; not persisting", job_id)
             self.metrics.record_planning_ms(job_id, (time.time() - t0) * 1000)
             self.post(Event("revive"))
         except BaseException as e:  # noqa: BLE001
@@ -244,6 +253,11 @@ class SchedulerServer:
                 r.locations, r.error, r.retryable, r.metrics,
                 r.fetch_failed_executor_id, r.fetch_failed_stage_id,
             )
+            if events:
+                # checkpoint the graph at every stage/terminal transition:
+                # the durable unit is the materialized shuffle output, so a
+                # recovering scheduler resumes from the last finished stage
+                self.job_state.save_graph(g)
             for ev in events:
                 if ev == "job_finished":
                     self.metrics.record_completed(g.job_id, time.time() - g.queued_at)
@@ -282,6 +296,7 @@ class SchedulerServer:
             g = self.jobs.get(job_id)
         if g is not None:
             g.cancel()
+            self.job_state.save_graph(g)  # terminal transition: checkpoint
             self.metrics.record_cancelled(job_id)
             self._notify(job_id)
 
@@ -320,3 +335,34 @@ class SchedulerServer:
     def clean_job_data(self, job_id: str) -> None:
         with self._jobs_lock:
             self.jobs.pop(job_id, None)
+        self.job_state.remove_job(job_id)
+
+    # -- fail-over recovery ------------------------------------------------
+
+    def recover_jobs(self, force: bool = False) -> list[str]:
+        """Adopt persisted job graphs (scheduler restart / standby takeover).
+        Successful stages resume from their materialized shuffle outputs;
+        mid-flight work recomputes. Jobs owned by a LIVE peer are skipped
+        unless force (the reference's JobAcquired/JobReleased arbitration,
+        cluster/mod.rs:221)."""
+        recovered = []
+        for job_id in self.job_state.list_jobs():
+            with self._jobs_lock:
+                if job_id in self.jobs:
+                    continue
+            if not self.job_state.acquire(job_id, self.scheduler_id, force=force):
+                log.info("job %s owned by another scheduler; skipping", job_id)
+                continue
+            g = self.job_state.load_graph(job_id)
+            if g is None:
+                continue
+            with self._jobs_lock:
+                self.jobs[job_id] = g
+            # re-register the session so later planning/launches see the
+            # job's settings (the graph proto carries the config snapshot)
+            self.sessions.create_or_update(g.config.to_key_value_pairs(), g.session_id)
+            recovered.append(job_id)
+            log.info("recovered job %s (status=%s)", job_id, g.status.value)
+        if recovered:
+            self.post(Event("revive"))
+        return recovered

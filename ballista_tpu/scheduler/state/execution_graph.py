@@ -486,6 +486,8 @@ class ExecutionGraph:
                 job_id=self.job_id, job_name=self.job_name,
                 session_id=self.session_id, status=self.status.value,
             )
+            for k, v in self.config.to_key_value_pairs():
+                out.settings.add(key=k, value=v)
             for sid in sorted(self.stages):
                 s = self.stages[sid]
                 sp = out.stages.add()
@@ -507,6 +509,12 @@ class ExecutionGraph:
         from ballista_tpu.scheduler.planner import QueryStage
         from ballista_tpu.serde import decode_location, decode_plan
 
+        if config is None and proto.settings:
+            # recovery must resume under the job's session settings, not
+            # defaults (task slicing / AQE thresholds would silently change)
+            config = BallistaConfig.from_key_value_pairs(
+                [(kv.key, kv.value) for kv in proto.settings]
+            )
         stages = []
         links: dict[int, list[int]] = {}
         for sp in proto.stages:
