@@ -1,0 +1,190 @@
+"""Terminal cluster monitor (TUI).
+
+Rebuild of ballista-cli's ratatui monitor (ballista-cli/src/tui/, ~10 kLoC
+hexagonal Rust) as a compact curses app over the scheduler REST API: live
+jobs / executors / per-job stage tables with metric percentiles, job
+cancellation, and drill-down. The domain/render split keeps everything
+below `run_tui` testable without a terminal.
+
+  python -m ballista_tpu.cli.tui --host 127.0.0.1 --rest-port 50080
+  keys: Tab switch pane · j/k move · Enter stages · c cancel · q quit
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+import urllib.request
+
+
+class RestClient:
+    def __init__(self, base: str):
+        self.base = base.rstrip("/")
+
+    def _get(self, path: str):
+        with urllib.request.urlopen(f"{self.base}{path}", timeout=5) as r:
+            return json.load(r)
+
+    def state(self) -> dict:
+        return self._get("/api/state")
+
+    def jobs(self) -> list[dict]:
+        return self._get("/api/jobs")
+
+    def executors(self) -> list[dict]:
+        return self._get("/api/executors")
+
+    def stages(self, job_id: str) -> list[dict]:
+        return self._get(f"/api/job/{job_id}/stages")
+
+    def cancel(self, job_id: str) -> None:
+        req = urllib.request.Request(f"{self.base}/api/job/{job_id}/cancel", method="POST")
+        urllib.request.urlopen(req, timeout=5).read()
+
+
+# ---------------------------------------------------------------- rendering
+
+
+def fmt_duration(start_s, end_s) -> str:
+    if not start_s:
+        return "-"
+    end = end_s or time.time()
+    s = max(0.0, end - start_s)
+    return f"{s:.1f}s" if s < 120 else f"{s / 60:.1f}m"
+
+
+def render_header(state: dict) -> str:
+    return (
+        f" ballista_tpu {state.get('version', '?')} · scheduler {state.get('scheduler_id', '?')}"
+        f" · executors {state.get('executors', 0)} · jobs {state.get('jobs', 0)}"
+    )
+
+
+def render_jobs(jobs: list[dict], selected: int, width: int = 120) -> list[str]:
+    lines = [f" {'JOB':<12} {'NAME':<16} {'STATE':<11} {'STAGES':<8} {'ELAPSED':<8}"]
+    for i, j in enumerate(jobs):
+        stages = f"{j.get('completed_stages', 0)}/{j.get('total_stages', 0)}"
+        row = (
+            f" {j.get('job_id', '')[:12]:<12} {j.get('job_name', '')[:16]:<16} "
+            f"{j.get('state', ''):<11} {stages:<8} "
+            f"{fmt_duration(j.get('queued_at'), j.get('ended_at')):<8}"
+        )
+        lines.append((">" if i == selected else " ") + row[1:width])
+    return lines
+
+
+def render_executors(execs: list[dict], selected: int, width: int = 120) -> list[str]:
+    lines = [f" {'EXECUTOR':<16} {'HOST':<18} {'GRPC':<6} {'FLIGHT':<7} {'SLOTS':<9} {'SEEN':<6}"]
+    now = time.time()
+    for i, e in enumerate(execs):
+        slots = f"{e.get('free_slots', 0)}/{e.get('total_slots', 0)}"
+        seen = f"{now - e.get('last_seen', now):.0f}s"
+        row = (
+            f" {e.get('id', '')[:16]:<16} {e.get('host', '')[:18]:<18} "
+            f"{e.get('grpc_port', 0):<6} {e.get('flight_port', 0):<7} {slots:<9} {seen:<6}"
+        )
+        lines.append((">" if i == selected else " ") + row[1:width])
+    return lines
+
+
+def render_stages(stages: list[dict], width: int = 120) -> list[str]:
+    lines = [f" {'STAGE':<6} {'STATE':<11} {'TASKS':<16} {'TOP OPERATORS (p50 ms)':<60}"]
+    for s in stages:
+        tasks = f"{s.get('completed', 0)}✓ {s.get('running', 0)}▶ {s.get('pending', 0)}·"
+        pcts = s.get("metric_percentiles", [])
+        tops = sorted(pcts, key=lambda p: -p.get("elapsed_ms_p50", 0))[:2]
+        ops = "; ".join(
+            f"{p['name'].split(':')[0]} {p.get('elapsed_ms_p50', 0):.1f}" for p in tops
+        )
+        lines.append(
+            f" {s.get('stage_id', 0):<6} {s.get('state', ''):<11} {tasks:<16} {ops[:60]:<60}"[:width]
+        )
+    return lines
+
+
+# ------------------------------------------------------------------ the app
+
+
+def run_tui(base_url: str, refresh_s: float = 1.0) -> None:  # pragma: no cover
+    import curses
+
+    client = RestClient(base_url)
+
+    def app(scr):
+        curses.curs_set(0)
+        scr.timeout(int(refresh_s * 1000))
+        pane = 0  # 0 jobs, 1 executors
+        sel = 0
+        drill: str | None = None
+        msg = ""
+        while True:
+            try:
+                state = client.state()
+                jobs = client.jobs()
+                execs = client.executors()
+            except Exception as e:  # noqa: BLE001
+                scr.erase()
+                scr.addstr(0, 0, f" cannot reach scheduler: {e} (q quits)")
+                scr.refresh()
+                if scr.getch() in (ord("q"), 27):
+                    return
+                continue
+            h, w = scr.getmaxyx()
+            scr.erase()
+            scr.addstr(0, 0, render_header(state)[: w - 1], curses.A_BOLD)
+            if drill is not None:
+                try:
+                    body = render_stages(client.stages(drill), w - 1)
+                except Exception:  # noqa: BLE001
+                    body = [" job gone"]
+                scr.addstr(1, 0, f" stages of {drill} (Esc back)"[: w - 1], curses.A_UNDERLINE)
+                for i, line in enumerate(body[: h - 3]):
+                    scr.addstr(2 + i, 0, line[: w - 1])
+            else:
+                rows = jobs if pane == 0 else execs
+                sel = max(0, min(sel, len(rows) - 1))
+                body = render_jobs(jobs, sel, w - 1) if pane == 0 else render_executors(execs, sel, w - 1)
+                title = " [Jobs] Executors " if pane == 0 else " Jobs [Executors] "
+                scr.addstr(1, 0, title[: w - 1], curses.A_UNDERLINE)
+                for i, line in enumerate(body[: h - 3]):
+                    scr.addstr(2 + i, 0, line[: w - 1])
+            if msg:
+                scr.addstr(h - 1, 0, msg[: w - 1], curses.A_REVERSE)
+                msg = ""
+            scr.refresh()
+            ch = scr.getch()
+            if ch in (ord("q"),):
+                return
+            if ch == 27:  # Esc
+                drill = None
+            elif ch == ord("\t"):
+                pane, sel = 1 - pane, 0
+            elif ch in (ord("j"), curses.KEY_DOWN):
+                sel += 1
+            elif ch in (ord("k"), curses.KEY_UP):
+                sel = max(0, sel - 1)
+            elif ch in (curses.KEY_ENTER, 10, 13) and pane == 0 and jobs:
+                drill = jobs[min(sel, len(jobs) - 1)]["job_id"]
+            elif ch == ord("c") and pane == 0 and jobs:
+                jid = jobs[min(sel, len(jobs) - 1)]["job_id"]
+                try:
+                    client.cancel(jid)
+                    msg = f" cancel requested for {jid}"
+                except Exception as e:  # noqa: BLE001
+                    msg = f" cancel failed: {e}"
+
+    curses.wrapper(app)
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(description="ballista_tpu cluster monitor (TUI)")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--rest-port", type=int, default=50080)
+    ap.add_argument("--refresh", type=float, default=1.0)
+    args = ap.parse_args(argv)
+    run_tui(f"http://{args.host}:{args.rest_port}", args.refresh)
+
+
+if __name__ == "__main__":
+    main()

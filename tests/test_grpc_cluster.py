@@ -169,3 +169,20 @@ def test_cancel_job(remote_ctx, grpc_cluster):
     client.cancel_job(job_id)
     status = client.wait_for_job(job_id, timeout=30)
     assert status["state"] in ("cancelled", "successful")  # may finish first
+
+
+def test_tui_rest_client_against_live_scheduler(grpc_cluster, remote_ctx):
+    from ballista_tpu.cli.tui import RestClient, render_jobs, render_stages
+
+    sched, _ = grpc_cluster
+    remote_ctx.sql("select count(*) from region").collect()
+    c = RestClient(f"http://127.0.0.1:{sched.rest_port}")
+    assert c.state()["executors"] == 2
+    jobs = c.jobs()
+    assert jobs and jobs[-1]["state"] == "successful"
+    assert c.executors()
+    st = c.stages(jobs[-1]["job_id"])
+    assert st and "metric_percentiles" in st[0]
+    # the render layer digests live payloads
+    assert len(render_jobs(jobs, 0)) == len(jobs) + 1
+    assert len(render_stages(st)) == len(st) + 1

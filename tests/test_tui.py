@@ -1,0 +1,23 @@
+"""TUI cluster monitor: render layer + REST client against a live scheduler."""
+
+import time
+
+
+def test_render_layers():
+    from ballista_tpu.cli.tui import render_executors, render_header, render_jobs, render_stages
+
+    hdr = render_header({"version": "0.1.0", "scheduler_id": "s0", "executors": 2, "jobs": 1})
+    assert "s0" in hdr and "executors 2" in hdr
+    jobs = [{"job_id": "abc123", "job_name": "q1", "state": "running",
+             "completed_stages": 1, "total_stages": 3, "queued_at": time.time() - 5}]
+    out = render_jobs(jobs, 0)
+    assert "abc123" in out[1] and out[1].startswith(">")
+    execs = [{"id": "e1", "host": "h", "grpc_port": 1, "flight_port": 2,
+              "free_slots": 3, "total_slots": 4, "last_seen": time.time()}]
+    out = render_executors(execs, 0)
+    assert "3/4" in out[1]
+    stages = [{"stage_id": 1, "state": "successful", "completed": 4, "running": 0,
+               "pending": 0, "metric_percentiles": [
+                   {"name": "SortExec: x", "elapsed_ms_p50": 3.2}]}]
+    out = render_stages(stages)
+    assert "SortExec" in out[1]
