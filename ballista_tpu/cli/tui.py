@@ -40,7 +40,8 @@ class RestClient:
 
     def cancel(self, job_id: str) -> None:
         req = urllib.request.Request(f"{self.base}/api/job/{job_id}/cancel", method="POST")
-        urllib.request.urlopen(req, timeout=5).read()
+        with urllib.request.urlopen(req, timeout=5) as r:
+            r.read()
 
 
 # ---------------------------------------------------------------- rendering
@@ -132,6 +133,15 @@ def run_tui(base_url: str, refresh_s: float = 1.0) -> None:  # pragma: no cover
                 continue
             h, w = scr.getmaxyx()
             scr.erase()
+            if h < 4 or w < 20:
+                try:
+                    scr.addstr(0, 0, "window too small"[: max(0, w - 1)])
+                except curses.error:
+                    pass
+                scr.refresh()
+                if scr.getch() == ord("q"):
+                    return
+                continue
             scr.addstr(0, 0, render_header(state)[: w - 1], curses.A_BOLD)
             if drill is not None:
                 try:
@@ -158,6 +168,15 @@ def run_tui(base_url: str, refresh_s: float = 1.0) -> None:  # pragma: no cover
                 return
             if ch == 27:  # Esc
                 drill = None
+            elif drill is not None:
+                # drilled view: only cancel (of the DRILLED job) is live —
+                # list navigation would silently move a hidden selection
+                if ch == ord("c"):
+                    try:
+                        client.cancel(drill)
+                        msg = f" cancel requested for {drill}"
+                    except Exception as e:  # noqa: BLE001
+                        msg = f" cancel failed: {e}"
             elif ch == ord("\t"):
                 pane, sel = 1 - pane, 0
             elif ch in (ord("j"), curses.KEY_DOWN):
