@@ -65,6 +65,7 @@ TPU_HASH_TABLE_LOAD = "ballista.tpu.hash.table.load.factor"
 TPU_ALLOW_F32_MONEY = "ballista.tpu.allow.f32.money"
 TPU_MIN_ROWS = "ballista.tpu.min.rows"
 TPU_COLLECTIVE_EXCHANGE = "ballista.tpu.collective.exchange"
+TPU_PALLAS = "ballista.tpu.pallas.enabled"
 
 
 @dataclass(frozen=True)
@@ -182,6 +183,12 @@ _ENTRIES: list[ConfigEntry] = [
     ConfigEntry(TPU_HASH_TABLE_LOAD, "Open-addressing hash table load factor for device joins/aggs.", float, 0.5, lambda v: 0.0 < v <= 0.9),
     ConfigEntry(TPU_ALLOW_F32_MONEY, "Allow lossy float32 for decimal columns (faster, inexact).", bool, False),
     ConfigEntry(TPU_MIN_ROWS, "Below this many input rows a stage stays on cpu (compile cost dominates).", int, 8192, _nonneg),
+    ConfigEntry(
+        TPU_PALLAS,
+        "Use the fused Pallas masked-group-reduction kernel for float "
+        "aggregates (f32 sums / i32 counts; exact int64 money stays on XLA).",
+        bool, False,
+    ),
     ConfigEntry(
         TPU_COLLECTIVE_EXCHANGE,
         "Use ICI collectives (shard_map all_to_all) instead of file shuffle for "

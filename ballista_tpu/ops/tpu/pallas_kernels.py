@@ -1,0 +1,104 @@
+"""Pallas TPU kernels for the hot aggregation op.
+
+`masked_group_reduce`: the fused form of the unrolled aggregation path in
+stage_compiler.py — one pass over each [P, N] value lane computing ALL G
+per-group masked sums and counts from VMEM tiles, instead of materializing
+G masked copies for XLA to reduce. Grid = (partition, row-block); output
+blocks are revisited across row-blocks and accumulated in place (the
+standard Pallas reduction pattern, pallas_guide.md).
+
+Scope follows TPU arithmetic reality: f32 sums + i32 counts (the VPU's
+native widths). The exact int64-cents money path stays on the XLA
+reduction; this kernel serves float aggregates and the lossy
+`ballista.tpu.allow.f32.money` mode. Gated by
+`ballista.tpu.pallas.enabled`; on CPU backends the kernel runs in
+interpreter mode so tests cover the exact same code path.
+"""
+
+from __future__ import annotations
+
+import functools
+
+GROUP_LANES = 128  # output tile width (one VPU lane row); G must fit
+
+
+def _on_cpu() -> bool:
+    from ballista_tpu.ops.tpu.runtime import ensure_jax
+
+    jax = ensure_jax()
+    try:
+        return jax.devices()[0].platform == "cpu"
+    except Exception:  # noqa: BLE001
+        return True
+
+
+@functools.lru_cache(maxsize=32)
+def _build(P: int, N: int, block_n: int, G: int, interpret: bool):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    def kernel(vals_ref, gid_ref, mask_ref, sums_ref, cnts_ref):
+        j = pl.program_id(1)
+
+        @pl.when(j == 0)
+        def _init():
+            sums_ref[...] = jnp.zeros_like(sums_ref)
+            cnts_ref[...] = jnp.zeros_like(cnts_ref)
+
+        v = vals_ref[0, :]
+        g = gid_ref[0, :]
+        m = mask_ref[0, :] != 0
+        # static unroll over groups: each iteration is one VPU masked
+        # reduction; XLA-in-pallas fuses the compares with the sums
+        sums = jnp.stack(
+            [jnp.sum(jnp.where(m & (g == gg), v, 0.0)) for gg in range(G)]
+        )
+        cnts = jnp.stack(
+            [jnp.sum((m & (g == gg)).astype(jnp.int32)) for gg in range(G)]
+        )
+        pad = GROUP_LANES - G
+        sums_ref[0, :] += jnp.pad(sums, (0, pad))
+        cnts_ref[0, :] += jnp.pad(cnts, (0, pad))
+
+    grid = (P, N // block_n)
+    fn = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_n), lambda i, j: (i, j)),
+            pl.BlockSpec((1, block_n), lambda i, j: (i, j)),
+            pl.BlockSpec((1, block_n), lambda i, j: (i, j)),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, GROUP_LANES), lambda i, j: (i, 0)),
+            pl.BlockSpec((1, GROUP_LANES), lambda i, j: (i, 0)),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((P, GROUP_LANES), jnp.float32),
+            jax.ShapeDtypeStruct((P, GROUP_LANES), jnp.int32),
+        ),
+        interpret=interpret,
+    )
+    return jax.jit(fn)
+
+
+def masked_group_reduce(vals, gid, mask, num_groups: int, block_n: int = 2048):
+    """Per-(partition, group) masked (sum, count) over [P, N] lanes.
+
+    vals: f32 [P, N]; gid: i32 [P, N]; mask: bool [P, N].
+    Returns (sums f32 [P, G], counts i32 [P, G]).
+    """
+    import jax.numpy as jnp
+
+    if num_groups > GROUP_LANES:
+        raise ValueError(f"num_groups {num_groups} > {GROUP_LANES}")
+    P, N = vals.shape
+    bn = min(block_n, N)
+    while N % bn:
+        bn //= 2
+    fn = _build(P, N, bn, num_groups, interpret=_on_cpu())
+    sums, cnts = fn(
+        vals.astype(jnp.float32), gid.astype(jnp.int32), mask.astype(jnp.int32)
+    )
+    return sums[:, :num_groups], cnts[:, :num_groups]

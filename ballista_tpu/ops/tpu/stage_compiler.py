@@ -626,6 +626,12 @@ class TpuStageExec(ExecutionPlan):
 
         lane_sets = ctx.lane_sets
         lane_cells = ctx.lane_cells
+        from ballista_tpu.config import TPU_PALLAS
+        from ballista_tpu.ops.tpu.pallas_kernels import GROUP_LANES
+
+        # the pallas kernel is single-device (no shard_map wrapping yet):
+        # under a collective-exchange mesh the XLA path handles sharding
+        use_pallas = bool(self.config.get(TPU_PALLAS)) and _stage_mesh(self.config) is None
 
         def raw(cols, luts, mask, build_args):
             # keep [P, N]: partitions are the leading axis, reductions run
@@ -649,17 +655,61 @@ class TpuStageExec(ExecutionPlan):
                     for gf, psz in zip(group_fns, pad_sizes):
                         codes = gf(cols, luts).arr.astype(jnp.int32)
                         gid = codes if gid is None else gid * psz + codes
-                    gmasks = [m & (gid == g) for g in range(G)]
                 else:
-                    gmasks = [m]
+                    gid = None
+                vs = [af(cols, luts) if af is not None else None for af in agg_fns]
+                # fused Pallas path: one VMEM pass per float value lane
+                # computing ALL G masked sums + counts (exact int64 money
+                # stays on the XLA reductions below)
+                pallas_ok = (
+                    use_pallas and gid is not None and aggs and G <= GROUP_LANES
+                    and all(
+                        d.func in ("count", "count_all")
+                        or (d.func == "sum" and v is not None and v.kind == "f64")
+                        for d, v in zip(aggs, vs)
+                    )
+                )
+                if pallas_ok:
+                    from ballista_tpu.ops.tpu.pallas_kernels import masked_group_reduce
+
+                    # sums first: every sum's kernel call also yields the
+                    # counts, so count aggs never need a dedicated pass
+                    sum_results: dict[int, object] = {}
+                    counts = None
+                    for i_, (d, v) in enumerate(zip(aggs, vs)):
+                        if d.func == "sum":
+                            arr = jnp.broadcast_to(v.arr, mask.shape)
+                            s, c = masked_group_reduce(arr, gid, m, G)
+                            sum_results[i_] = s
+                            counts = c if counts is None else counts
+                    if counts is None:  # count-only aggregation
+                        _, counts = masked_group_reduce(
+                            jnp.zeros(mask.shape, jnp.float32), gid, m, G
+                        )
+                    outs_lane = []
+                    out_meta = []
+                    for i_, d in enumerate(aggs):
+                        if d.func in ("count", "count_all"):
+                            outs_lane.append(counts.astype(jnp.int64))
+                            out_meta.append(("i64", 0))
+                        else:
+                            outs_lane.append(sum_results[i_].astype(jnp.float64))
+                            out_meta.append(("f64", 0))
+                    presence_lane = counts
+                    meta_holder["out"] = out_meta
+                    if outs is None:
+                        outs, presence = outs_lane, presence_lane
+                    else:
+                        outs = [p_ + c_ for p_, c_ in zip(outs, outs_lane)]
+                        presence = presence + presence_lane
+                    continue
+                gmasks = [m & (gid == g) for g in range(G)] if gid is not None else [m]
                 outs_lane = []
                 out_meta = []
-                for d, af in zip(aggs, agg_fns):
-                    if af is None:
-                        v = None
+                for d, v in zip(aggs, vs):
+                    if v is None:
                         out_meta.append(("i64", 0))
                     else:
-                        v = af(cols, luts)
                         out_meta.append(("i64", 0) if d.func == "count" else (v.kind, v.scale))
                     cols_out = []
                     for gm in gmasks:
