@@ -28,7 +28,9 @@ POLL_INTERVAL_S = 0.1
 class RemoteSchedulerClient:
     def __init__(self, scheduler_url: str, config: BallistaConfig):
         addr = scheduler_url.replace("df://", "").replace("grpc://", "")
-        self.channel = grpc.insecure_channel(addr)
+        from ballista_tpu.utils.grpc_util import create_channel
+
+        self.channel = create_channel(addr, config)
         self.stub = scheduler_stub(self.channel)
         self.config = config
         self.session_id: str = ""

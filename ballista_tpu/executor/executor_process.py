@@ -164,13 +164,19 @@ class ExecutorProcess:
             64 * 1024 * 1024, self.memory_pool_bytes // max(1, vcores)
         )
 
-        self._channel = grpc.insecure_channel(scheduler_addr)
+        from ballista_tpu.utils.grpc_util import create_channel
+
+        self._channel = create_channel(scheduler_addr, config)
         self._scheduler = scheduler_stub(self._channel)
         self._stopping = threading.Event()
         self._pending_status: list = []
         self._status_lock = threading.Lock()
 
-        self.grpc_server = grpc.server(futures.ThreadPoolExecutor(max_workers=8))
+        from ballista_tpu.utils.grpc_util import server_options
+
+        self.grpc_server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=8), options=server_options(config)
+        )
         self.service = ExecutorGrpcService(self.executor, self._send_status, self.shutdown)
         add_executor_service(self.grpc_server, self.service)
         self.grpc_port = self.grpc_server.add_insecure_port(f"{bind_host}:{grpc_port}")

@@ -41,7 +41,9 @@ class GrpcTaskLauncher(TaskLauncher):
         with self._lock:
             s = self._stubs.get(addr)
             if s is None:
-                s = executor_stub(grpc.insecure_channel(addr))
+                from ballista_tpu.utils.grpc_util import create_channel
+
+                s = executor_stub(create_channel(addr))
                 self._stubs[addr] = s
             return s
 
@@ -78,7 +80,11 @@ class SchedulerProcess:
             GrpcTaskLauncher(), self.metrics, task_distribution, executor_timeout_s,
             scheduler_id=scheduler_id, job_state=job_state,
         )
-        self.grpc_server = grpc.server(futures.ThreadPoolExecutor(max_workers=32))
+        from ballista_tpu.utils.grpc_util import server_options
+
+        self.grpc_server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=32), options=server_options()
+        )
         self.service = SchedulerGrpcService(self.scheduler)
         add_scheduler_service(self.grpc_server, self.service)
         self.port = self.grpc_server.add_insecure_port(f"{bind_host}:{port}")

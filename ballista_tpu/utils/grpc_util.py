@@ -1,0 +1,46 @@
+"""gRPC channel/server construction with tuned options.
+
+Rebuild of GrpcClientConfig / GrpcServerConfig +
+create_grpc_client_endpoint / create_grpc_server
+(core/src/utils.rs:59,133,308,344): message-size ceilings and keepalive
+applied consistently everywhere a channel or server is built, driven by
+the same `ballista.grpc.*` session keys the reference uses.
+"""
+
+from __future__ import annotations
+
+import grpc
+
+from ballista_tpu.config import (
+    GRPC_CLIENT_MAX_MESSAGE_SIZE,
+    GRPC_SERVER_MAX_MESSAGE_SIZE,
+    BallistaConfig,
+)
+
+KEEPALIVE_MS = 30_000
+KEEPALIVE_TIMEOUT_MS = 10_000
+
+
+def client_options(config: BallistaConfig | None = None) -> list[tuple]:
+    n = int((config or BallistaConfig()).get(GRPC_CLIENT_MAX_MESSAGE_SIZE))
+    return [
+        ("grpc.max_send_message_length", n),
+        ("grpc.max_receive_message_length", n),
+        ("grpc.keepalive_time_ms", KEEPALIVE_MS),
+        ("grpc.keepalive_timeout_ms", KEEPALIVE_TIMEOUT_MS),
+        ("grpc.keepalive_permit_without_calls", 1),
+    ]
+
+
+def server_options(config: BallistaConfig | None = None) -> list[tuple]:
+    n = int((config or BallistaConfig()).get(GRPC_SERVER_MAX_MESSAGE_SIZE))
+    return [
+        ("grpc.max_send_message_length", n),
+        ("grpc.max_receive_message_length", n),
+        ("grpc.keepalive_time_ms", KEEPALIVE_MS),
+        ("grpc.keepalive_timeout_ms", KEEPALIVE_TIMEOUT_MS),
+    ]
+
+
+def create_channel(addr: str, config: BallistaConfig | None = None) -> grpc.Channel:
+    return grpc.insecure_channel(addr, options=client_options(config))
