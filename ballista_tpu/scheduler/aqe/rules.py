@@ -80,7 +80,8 @@ class InputStageStats:
 
 
 def apply_aqe(plan: ExecutionPlan, input_stats: dict[int, InputStageStats],
-              config: BallistaConfig) -> tuple[ExecutionPlan, int | None]:
+              config: BallistaConfig,
+              stage_partitions: int | None = None) -> tuple[ExecutionPlan, int | None]:
     """Rewrite a freshly-resolved stage plan using actual input statistics.
 
     `plan` has concrete ShuffleReaderExec leaves tagged with their source
@@ -104,8 +105,14 @@ def apply_aqe(plan: ExecutionPlan, input_stats: dict[int, InputStageStats],
         s for s in input_stats.values() if not s.broadcast and len(s.bucket_bytes) > 1
     ]
     readers = _hash_readers(plan)
-    if hash_inputs and readers and all(
-        len(r.partition_locations) == len(hash_inputs[0].bucket_bytes) for r in readers
+    k_in = len(hash_inputs[0].bucket_bytes) if hash_inputs else 0
+    # coalescing regroups reader partition lists IN PLACE of the stage's
+    # partition indexing — only sound when the stage's partitions ARE the
+    # readers' (a Union stage concatenates branch partition ranges, so its
+    # indexing is not reader-aligned; never coalesce it)
+    aligned = stage_partitions is None or stage_partitions == k_in
+    if hash_inputs and readers and aligned and all(
+        len(r.partition_locations) == k_in for r in readers
     ):
         k = len(hash_inputs[0].bucket_bytes)
         combined = [0] * k

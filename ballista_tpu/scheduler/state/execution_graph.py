@@ -365,7 +365,7 @@ class ExecutionGraph:
                 bucket_bytes=buckets,
                 broadcast=inp.spec.broadcast,
             )
-        plan, new_parts = apply_aqe(plan, stats, self.config)
+        plan, new_parts = apply_aqe(plan, stats, self.config, stage.spec.partitions)
         stage.resolved_plan = plan
         if new_parts is not None and new_parts < stage.spec.partitions:
             stage.pending = list(range(new_parts))

@@ -41,6 +41,9 @@ class SelectStmt:
     from_tables: list[Any] = field(default_factory=list)  # comma-separated refs
     where: Optional[Expr] = None
     group_by: list[Any] = field(default_factory=list)  # Expr | int ordinal
+    # ROLLUP/CUBE/GROUPING SETS: list of grouping sets (each a list of
+    # indices into group_by); None = plain GROUP BY
+    grouping_sets: Optional[list[list[int]]] = None
     having: Optional[Expr] = None
     order_by: list[SortKey] = field(default_factory=list)
     limit: Optional[int] = None

@@ -119,7 +119,10 @@ class Parser:
         if t.kind == "ident":
             return t.value
         # allow non-reserved keywords as identifiers in a few positions
-        if t.kind == "kw" and t.value in ("DATE", "YEAR", "FIRST", "LAST", "ALL", "TABLES"):
+        if t.kind == "kw" and t.value in (
+            "DATE", "YEAR", "FIRST", "LAST", "ALL", "TABLES",
+            "ROLLUP", "CUBE", "GROUPING", "SETS",
+        ):
             return t.value.lower()
         raise SqlParseError(f"expected identifier, got {t.kind} {t.value!r} at {t.pos}")
 
@@ -243,13 +246,53 @@ class Parser:
         if self.peek().is_kw("GROUP"):
             self.next()
             self.expect_kw("BY")
-            while True:
-                if self.peek().kind == "number":
-                    stmt.group_by.append(int(self.next().value))
-                else:
-                    stmt.group_by.append(self.parse_expr())
-                if not self.accept_punct(","):
-                    break
+            if self.accept_kw("ROLLUP"):
+                self.expect_punct("(")
+                stmt.group_by = self._parse_group_exprs()
+                self.expect_punct(")")
+                k = len(stmt.group_by)
+                stmt.grouping_sets = [list(range(i)) for i in range(k, -1, -1)]
+            elif self.accept_kw("CUBE"):
+                self.expect_punct("(")
+                stmt.group_by = self._parse_group_exprs()
+                self.expect_punct(")")
+                k = len(stmt.group_by)
+                if k > 5:
+                    raise SqlParseError("CUBE over more than 5 keys")
+                stmt.grouping_sets = [
+                    [i for i in range(k) if m & (1 << i)] for m in range(2**k - 1, -1, -1)
+                ]
+            elif self.accept_kw("GROUPING"):
+                self.expect_kw("SETS")
+                self.expect_punct("(")
+                sets: list[list[int]] = []
+                order: list = []
+                while True:
+                    self.expect_punct("(")
+                    one: list[int] = []
+                    if not (self.peek().kind == "punct" and self.peek().value == ")"):
+                        while True:
+                            e = self.parse_expr()
+                            if e not in order:
+                                order.append(e)
+                            one.append(order.index(e))
+                            if not self.accept_punct(","):
+                                break
+                    self.expect_punct(")")
+                    sets.append(one)
+                    if not self.accept_punct(","):
+                        break
+                self.expect_punct(")")
+                stmt.group_by = order
+                stmt.grouping_sets = sets
+            else:
+                while True:
+                    if self.peek().kind == "number":
+                        stmt.group_by.append(int(self.next().value))
+                    else:
+                        stmt.group_by.append(self.parse_expr())
+                    if not self.accept_punct(","):
+                        break
         if self.accept_kw("HAVING"):
             stmt.having = self.parse_expr()
         if self.peek().is_kw("ORDER"):
@@ -257,6 +300,12 @@ class Parser:
         if self.peek().is_kw("LIMIT"):
             stmt.limit, stmt.offset = self._parse_limit()
         return stmt
+
+    def _parse_group_exprs(self) -> list:
+        out = [self.parse_expr()]
+        while self.accept_punct(","):
+            out.append(self.parse_expr())
+        return out
 
     def _parse_order_by(self) -> list[SortKey]:
         self.expect_kw("ORDER")
@@ -494,8 +543,14 @@ class Parser:
             return -v
         raise SqlParseError(f"expected literal, got {t.value!r} at {t.pos}")
 
+    SOFT_KEYWORDS = ("ROLLUP", "CUBE", "GROUPING", "SETS")
+
     def _parse_primary(self) -> Expr:
         t = self.peek()
+        if t.kind == "kw" and t.value in self.SOFT_KEYWORDS:
+            # contextual keywords: valid column/table names outside GROUP BY
+            self.next()
+            return self._parse_ident_expr_from(t.value.lower())
         if t.kind == "punct" and t.value == "(":
             self.next()
             if self.peek().is_kw("SELECT", "WITH"):
@@ -632,7 +687,9 @@ class Parser:
         return ty
 
     def _parse_ident_expr(self) -> Expr:
-        name = self.next().value
+        return self._parse_ident_expr_from(self.next().value)
+
+    def _parse_ident_expr_from(self, name: str) -> Expr:
         # function call?
         if self.peek().kind == "punct" and self.peek().value == "(":
             return self._parse_function(name)

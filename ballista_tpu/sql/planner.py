@@ -110,33 +110,42 @@ class SqlPlanner:
 
         agg_funcs = _collect_aggs(projections + ([having] if having is not None else []))
 
-        if group_exprs or agg_funcs:
-            agg = Aggregate(plan, group_exprs, agg_funcs)
-            rewrite = lambda e: _rewrite_post_agg(e, group_exprs, agg_funcs)
-            projections = [rewrite(p) for p in projections]
-            plan = agg
-            if having is not None:
-                plan = Filter(plan, rewrite(having))
+        if stmt.grouping_sets is not None:
+            if _collect_windows(projections):
+                raise PlanningError("window functions over GROUPING SETS are unsupported")
+            plan = self._plan_grouping_sets(
+                plan, stmt.grouping_sets, group_exprs, agg_funcs, projections, having
+            )
+            # first branch's projection stands in for ORDER BY resolution
+            proj = plan.inputs[0]
+        else:
+            if group_exprs or agg_funcs:
+                agg = Aggregate(plan, group_exprs, agg_funcs)
+                rewrite = lambda e: _rewrite_post_agg(e, group_exprs, agg_funcs)
+                projections = [rewrite(p) for p in projections]
+                plan = agg
+                if having is not None:
+                    plan = Filter(plan, rewrite(having))
 
-        # window functions compute over the (post-aggregation) input; each
-        # unique window expr becomes a __win{i} column the projection reads
-        window_exprs = _collect_windows(projections)
-        if window_exprs:
-            win = Window(plan, window_exprs)
+            # window functions compute over the (post-aggregation) input;
+            # each unique window expr becomes a __win{i} column
+            window_exprs = _collect_windows(projections)
+            if window_exprs:
+                win = Window(plan, window_exprs)
 
-            def rewrite_win(e: Expr) -> Expr:
-                def repl(x: Expr) -> Expr:
-                    if isinstance(x, WindowFunction):
-                        return Column(f"__win{window_exprs.index(x)}")
-                    return x
+                def rewrite_win(e: Expr) -> Expr:
+                    def repl(x: Expr) -> Expr:
+                        if isinstance(x, WindowFunction):
+                            return Column(f"__win{window_exprs.index(x)}")
+                        return x
 
-                return transform_expr(e, repl)
+                    return transform_expr(e, repl)
 
-            projections = [rewrite_win(p) for p in projections]
-            plan = win
+                projections = [rewrite_win(p) for p in projections]
+                plan = win
 
-        proj = Projection(plan, projections)
-        plan = proj
+            proj = Projection(plan, projections)
+            plan = proj
 
         if stmt.distinct:
             plan = Distinct(plan)
@@ -159,6 +168,49 @@ class SqlPlanner:
                 plan.__post_init__()
             plan = Limit(plan, stmt.limit, stmt.offset)
         return plan
+
+    def _plan_grouping_sets(self, plan: LogicalPlan, sets: list[list[int]],
+                            group_exprs: list[Expr], agg_funcs: list[Expr],
+                            projections: list[Expr], having) -> Union:
+        """ROLLUP/CUBE/GROUPING SETS lowering: one Aggregate branch per
+        grouping set, grouped-out keys projected as typed NULLs, branches
+        UNION ALLed (the standard expansion; DataFusion lowers the same
+        way behind the reference)."""
+        from ballista_tpu.plan.expressions import Cast
+
+        branches: list[LogicalPlan] = []
+        for s in sets:
+            set_exprs = [group_exprs[i] for i in s]
+            dropped = [g for i, g in enumerate(group_exprs) if i not in s]
+
+            def null_out(e: Expr) -> Expr:
+                # only the OUTPUT keys become NULL: aggregate arguments keep
+                # seeing real values (SQL grouping-sets semantics), and the
+                # agg subtree must stay structurally identical for
+                # _rewrite_post_agg to match it
+                if isinstance(e, AggregateFunction):
+                    return e
+                for d in dropped:
+                    if e == d:
+                        return Cast(Literal(None), d.data_type(plan.schema))
+                kids = e.children()
+                if kids:
+                    new_kids = [null_out(k) for k in kids]
+                    if new_kids != kids:
+                        return e.with_children(new_kids)
+                return e
+
+            node: LogicalPlan = Aggregate(plan, set_exprs, agg_funcs)
+            if having is not None:
+                node = Filter(node, _rewrite_post_agg(null_out(having), set_exprs, agg_funcs))
+            branch_projs: list[Expr] = []
+            for p in projections:
+                name = p.name if isinstance(p, Alias) else p.output_name()
+                pe = _rewrite_post_agg(null_out(p.expr if isinstance(p, Alias) else p),
+                                       set_exprs, agg_funcs)
+                branch_projs.append(Alias(pe, name))
+            branches.append(Projection(node, branch_projs))
+        return Union(branches, all=True)
 
     def _resolve_order_expr(self, e: Expr, proj: Projection, cte_env) -> Expr:
         out_schema = proj.schema

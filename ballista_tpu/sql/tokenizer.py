@@ -22,7 +22,7 @@ KEYWORDS = {
     "LAST", "WITH", "DATE", "INTERVAL", "EXTRACT", "SUBSTRING", "FOR",
     "VALUES", "EXPLAIN", "ANALYZE", "VERBOSE", "CREATE", "EXTERNAL", "TABLE",
     "STORED", "LOCATION", "DROP", "SHOW", "TABLES", "COLUMNS", "SET", "SEMI",
-    "ANTI", "NATURAL", "OVER", "PARTITION",
+    "ANTI", "NATURAL", "OVER", "PARTITION", "ROLLUP", "CUBE", "GROUPING", "SETS",
 }
 
 

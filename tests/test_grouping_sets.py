@@ -1,0 +1,137 @@
+"""ROLLUP / CUBE / GROUPING SETS lowering (one Aggregate branch per set,
+typed-NULL fill, UNION ALL) — local, distributed, and TPC-DS shaped."""
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+
+@pytest.fixture()
+def ctx():
+    from ballista_tpu.client.context import SessionContext
+
+    rng = np.random.default_rng(2)
+    n = 8_000
+    tbl = pa.table({
+        "a": rng.choice(["x", "y", "z"], n),
+        "b": rng.choice(["p", "q"], n),
+        "v": rng.integers(1, 100, n),
+    })
+    c = SessionContext()
+    c.register_arrow_table("t", tbl, partitions=3)
+    c._tbl = tbl
+    return c
+
+
+def test_rollup(ctx):
+    out = ctx.sql(
+        "select a, b, sum(v) s, count(*) c from t group by rollup(a, b)"
+    ).collect().to_pandas()
+    df = ctx._tbl.to_pandas()
+    n_full = len(df.groupby(["a", "b"]))
+    assert len(out) == n_full + df.a.nunique() + 1
+    tot = out[out.a.isna() & out.b.isna()]
+    assert tot.s.tolist() == [df.v.sum()] and tot.c.tolist() == [len(df)]
+    bya = out[out.a.notna() & out.b.isna()].sort_values("a")
+    exp = df.groupby("a")["v"].sum()
+    assert bya.s.tolist() == exp.tolist()
+
+
+def test_cube_and_grouping_sets(ctx):
+    df = ctx._tbl.to_pandas()
+    cube = ctx.sql("select a, b, sum(v) s from t group by cube(a, b)").collect()
+    assert cube.num_rows == len(df.groupby(["a", "b"])) + df.a.nunique() + df.b.nunique() + 1
+    gs = ctx.sql(
+        "select a, b, sum(v) s from t group by grouping sets ((a), (b))"
+    ).collect().to_pandas()
+    assert len(gs) == df.a.nunique() + df.b.nunique()
+    byb = gs[gs.a.isna()].sort_values("b")
+    assert byb.s.tolist() == df.groupby("b")["v"].sum().tolist()
+
+
+def test_rollup_having_and_order(ctx):
+    df = ctx._tbl.to_pandas()
+    out = ctx.sql(
+        "select a, b, sum(v) s from t group by rollup(a, b) "
+        "having sum(v) > 100 order by s desc limit 3"
+    ).collect().to_pandas()
+    assert out.s.tolist()[0] == df.v.sum()  # grand total ranks first
+    assert (out.s.values[:-1] >= out.s.values[1:]).all()
+
+
+def test_rollup_distributed_standalone(tmp_path):
+    import pyarrow.parquet as pq
+
+    from ballista_tpu.client.context import SessionContext
+
+    rng = np.random.default_rng(3)
+    n = 5_000
+    tbl = pa.table({
+        "a": rng.choice(["x", "y"], n),
+        "b": rng.choice(["p", "q", "r"], n),
+        "v": rng.integers(1, 50, n),
+    })
+    pq.write_table(tbl, str(tmp_path / "t.parquet"))
+    ctx = SessionContext.standalone()
+    ctx.register_parquet("t", str(tmp_path / "t.parquet"))
+    out = ctx.sql("select a, b, sum(v) s from t group by rollup(a, b)").collect().to_pandas()
+    df = tbl.to_pandas()
+    assert len(out) == len(df.groupby(["a", "b"])) + df.a.nunique() + 1
+    assert out[out.a.isna()].s.tolist() == [df.v.sum()]
+
+
+def test_tpcds_q36_shaped_rollup(tmp_path_factory):
+    """TPC-DS q36 shape (minus its rank window): gross-margin rollup over
+    category/class with date+item joins."""
+    from ballista_tpu.client.context import SessionContext
+    from ballista_tpu.testing.tpcdsgen import generate_tpcds, register_tpcds
+
+    d = str(tmp_path_factory.mktemp("tpcds36"))
+    generate_tpcds(d, scale=0.05, seed=17)
+    ctx = SessionContext()
+    register_tpcds(ctx, d)
+    out = ctx.sql(
+        "SELECT sum(ss_net_profit) / sum(ss_ext_sales_price) AS gross_margin, "
+        "       i_category, i_class "
+        "FROM store_sales, date_dim, item "
+        "WHERE d_date_sk = ss_sold_date_sk AND i_item_sk = ss_item_sk AND d_year = 2001 "
+        "GROUP BY ROLLUP(i_category, i_class) "
+        "ORDER BY gross_margin LIMIT 100"
+    ).collect().to_pandas()
+    import pyarrow.parquet as pq
+
+    ss = pq.read_table(f"{d}/store_sales").to_pandas()
+    dd = pq.read_table(f"{d}/date_dim").to_pandas()
+    it = pq.read_table(f"{d}/item").to_pandas()
+    m = ss.merge(dd[dd.d_year == 2001], left_on="ss_sold_date_sk", right_on="d_date_sk")
+    m = m.merge(it, left_on="ss_item_sk", right_on="i_item_sk")
+    full = m.groupby(["i_category", "i_class"])
+    expected_rows = len(full) + m.i_category.nunique() + 1
+    assert len(out) == min(100, expected_rows)
+    total = out[out.i_category.isna()]
+    assert np.allclose(
+        total.gross_margin.values, [m.ss_net_profit.sum() / m.ss_ext_sales_price.sum()]
+    )
+
+
+def test_aggregate_over_grouping_key(ctx):
+    """Aggregate args must keep real values even when their column is a
+    grouped-out key (only the OUTPUT key becomes NULL)."""
+    df = ctx._tbl.to_pandas()
+    out = ctx.sql(
+        "select a, sum(v) s, count(*) c from t group by rollup(a)"
+    ).collect().to_pandas()
+    tot = out[out.a.isna()]
+    assert tot.s.tolist() == [df.v.sum()]
+
+
+def test_soft_keywords_stay_identifiers():
+    from ballista_tpu.client.context import SessionContext
+
+    ctx = SessionContext()
+    ctx.register_arrow_table("t2", pa.table({"sets": [1, 2], "cube": [3, 4], "rollup": [5, 6]}))
+    out = ctx.sql("select sets, cube, rollup from t2 order by sets").collect().to_pandas()
+    assert out.sets.tolist() == [1, 2]
+    assert out["cube"].tolist() == [3, 4]
+    assert out["rollup"].tolist() == [5, 6]
