@@ -171,6 +171,8 @@ def _window_agg(batch, w, schema, fr: _Frame, n, out_type):
     else:  # count(*)
         arr = None
         valid = np.ones(n, dtype=bool)
+    if w.frame is not None:
+        return _rows_frame_agg(w, fr, arr, valid, n, out_type)
     last = _peer_last(fr.new_peer, n)
 
     if w.func == "count":
@@ -217,6 +219,79 @@ def _window_agg(batch, w, schema, fr: _Frame, n, out_type):
         cexcl = ccum[seg_start] - valid[seg_start]
         mask_sorted = (ccum[last] - cexcl) == 0
 
+    out = np.empty(n, dtype=out_sorted.dtype)
+    out[fr.idx] = out_sorted
+    mask = np.empty(n, dtype=bool)
+    mask[fr.idx] = mask_sorted
+    return pa.array(out, out_type, mask=mask)
+
+
+def _rows_frame_agg(w, fr: _Frame, arr, valid, n, out_type):
+    """Explicit ROWS BETWEEN frames: per-row [lo, hi] windows clipped to the
+    partition; sums/counts via prefix differences, min/max via per-row
+    slices (frames are exact row offsets — no peer sharing)."""
+    _, start, end = w.frame
+    arange = np.arange(n, dtype=np.int64)
+    lo = fr.seg_start if start is None else np.maximum(fr.seg_start, arange + start)
+    hi = fr.seg_end if end is None else np.minimum(fr.seg_end, arange + end)
+    # frames wholly before/after the partition are EMPTY (0 / NULL) — decide
+    # before clamping, or boundary rows would be dragged into range
+    empty = hi < lo
+    lo = np.clip(lo, fr.seg_start, fr.seg_end)
+    hi = np.clip(hi, fr.seg_start, fr.seg_end)
+
+    vcum = np.concatenate([[0], np.cumsum(valid.astype(np.int64))])
+    counts = np.where(empty, 0, vcum[np.clip(hi, 0, n - 1) + 1] - vcum[np.clip(lo, 0, n - 1)])
+
+    if w.func == "count":
+        out = np.empty(n, dtype=np.int64)
+        out[fr.idx] = counts
+        return pa.array(out, out_type)
+
+    vals = arr.to_numpy(zero_copy_only=False)
+    as_float = pa.types.is_floating(out_type) or w.func == "avg"
+    if w.func in ("sum", "avg"):
+        v = np.asarray(vals, dtype=np.float64 if as_float else np.int64)
+        v = np.where(valid, v, 0)
+        csum = np.concatenate([[0], np.cumsum(v)])
+        sums = np.where(empty, 0, csum[np.clip(hi, 0, n - 1) + 1] - csum[np.clip(lo, 0, n - 1)])
+        if w.func == "avg":
+            out_sorted = np.where(counts > 0, sums / np.maximum(counts, 1), np.nan)
+        else:
+            out_sorted = sums
+    else:  # min / max: vectorized per SEGMENT (accumulate for one-sided
+        # frames, sentinel-padded sliding windows for bounded ones)
+        is_f = np.issubdtype(np.asarray(vals).dtype, np.floating) or pa.types.is_floating(out_type)
+        v = np.asarray(vals, dtype=np.float64 if is_f else np.int64)
+        sentinel = (np.inf if w.func == "min" else -np.inf) if is_f else (
+            np.iinfo(np.int64).max if w.func == "min" else np.iinfo(np.int64).min
+        )
+        v = np.where(valid, v, sentinel)
+        red = np.minimum if w.func == "min" else np.maximum
+        out_sorted = np.full(n, sentinel, dtype=v.dtype)
+        starts = np.flatnonzero(fr.new_part)
+        seg_bounds = np.r_[starts, n]
+        for si in range(len(starts)):
+            s0, s1 = int(seg_bounds[si]), int(seg_bounds[si + 1])
+            seg = v[s0:s1]
+            local = np.arange(len(seg))
+            if start is None and end is None:
+                out_sorted[s0:s1] = red.reduce(seg)
+            elif start is None:  # running extreme up to hi
+                acc = red.accumulate(seg)
+                out_sorted[s0:s1] = acc[np.clip(hi[s0:s1] - s0, 0, len(seg) - 1)]
+            elif end is None:  # extreme from lo to segment end
+                racc = red.accumulate(seg[::-1])[::-1]
+                out_sorted[s0:s1] = racc[np.clip(lo[s0:s1] - s0, 0, len(seg) - 1)]
+            else:
+                width = end - start + 1
+                if width >= 1:
+                    pad = np.full(width - 1, sentinel, dtype=v.dtype)
+                    padded = np.concatenate([pad, seg, pad])
+                    sw = np.lib.stride_tricks.sliding_window_view(padded, width)
+                    idxs = np.clip(local + start + (width - 1), 0, len(sw) - 1)
+                    out_sorted[s0:s1] = red.reduce(sw[idxs], axis=1)
+    mask_sorted = counts == 0
     out = np.empty(n, dtype=out_sorted.dtype)
     out[fr.idx] = out_sorted
     mask = np.empty(n, dtype=bool)

@@ -487,16 +487,19 @@ WINDOW_FUNCS = ("row_number", "rank", "dense_rank", "lag", "lead",
 
 @dataclass(frozen=True)
 class WindowFunction(Expr):
-    """fn(args) OVER (PARTITION BY ... ORDER BY ...).
+    """fn(args) OVER (PARTITION BY ... ORDER BY ... [frame]).
 
-    Frame semantics follow SQL defaults: with ORDER BY, aggregates run
-    RANGE UNBOUNDED PRECEDING..CURRENT ROW (peers share); without, the
-    whole partition."""
+    Default frame follows SQL: with ORDER BY, aggregates run RANGE
+    UNBOUNDED PRECEDING..CURRENT ROW (peers share); without, the whole
+    partition. `frame` = ("rows", start, end) for explicit ROWS frames:
+    offsets relative to the current row (negative = preceding, None =
+    unbounded in that direction)."""
 
     func: str  # one of WINDOW_FUNCS
     args: tuple  # aggregates: (expr,) or (); lag/lead: (expr[, offset[, default]])
     partition_by: tuple = ()
     order_by: tuple = ()  # SortKey tuple
+    frame: tuple | None = None  # ("rows", start|None, end|None)
 
     def children(self) -> list["Expr"]:
         return list(self.args) + list(self.partition_by) + [k.expr for k in self.order_by]
@@ -508,7 +511,9 @@ class WindowFunction(Expr):
             SortKey(e, k.ascending, k.nulls_first)
             for e, k in zip(c[na + np_:], self.order_by)
         )
-        return WindowFunction(self.func, tuple(c[:na]), tuple(c[na:na + np_]), keys)
+        return WindowFunction(
+            self.func, tuple(c[:na]), tuple(c[na:na + np_]), keys, self.frame
+        )
 
     def data_type(self, schema: DFSchema) -> pa.DataType:
         if self.func in ("row_number", "rank", "dense_rank", "count"):
@@ -527,6 +532,17 @@ class WindowFunction(Expr):
             parts.append("PARTITION BY " + ", ".join(map(str, self.partition_by)))
         if self.order_by:
             parts.append("ORDER BY " + ", ".join(map(str, self.order_by)))
+        if self.frame is not None:
+            def b(v, side):
+                if v is None:
+                    return f"UNBOUNDED {side}"
+                if v == 0:
+                    return "CURRENT ROW"
+                return f"{-v} PRECEDING" if v < 0 else f"{v} FOLLOWING"
+
+            parts.append(
+                f"ROWS BETWEEN {b(self.frame[1], 'PRECEDING')} AND {b(self.frame[2], 'FOLLOWING')}"
+            )
         return f"{self.func}({a}) OVER ({' '.join(parts)})"
 
 

@@ -209,6 +209,12 @@ def encode_expr(e: Expr) -> pb.ExprProto:
             out.window_fn.partition_by.append(encode_expr(pe))
         for k in e.order_by:
             out.window_fn.order_by.append(encode_sort_key(k))
+        if e.frame is not None:
+            out.window_fn.has_frame = True
+            out.window_fn.start_unbounded = e.frame[1] is None
+            out.window_fn.frame_start = e.frame[1] or 0
+            out.window_fn.end_unbounded = e.frame[2] is None
+            out.window_fn.frame_end = e.frame[2] or 0
     elif isinstance(e, AggregateFunction):
         out.agg_fn.func = e.func
         out.agg_fn.distinct = e.distinct
@@ -263,11 +269,19 @@ def decode_expr(p: pb.ExprProto) -> Expr:
     if which == "scalar_fn":
         return ScalarFunction(p.scalar_fn.name, tuple(decode_expr(a) for a in p.scalar_fn.args))
     if which == "window_fn":
+        frame = None
+        if p.window_fn.has_frame:
+            frame = (
+                "rows",
+                None if p.window_fn.start_unbounded else p.window_fn.frame_start,
+                None if p.window_fn.end_unbounded else p.window_fn.frame_end,
+            )
         return WindowFunction(
             p.window_fn.func,
             tuple(decode_expr(a) for a in p.window_fn.args),
             tuple(decode_expr(a) for a in p.window_fn.partition_by),
             tuple(decode_sort_key(k) for k in p.window_fn.order_by),
+            frame,
         )
     if which == "agg_fn":
         arg = None if p.agg_fn.no_arg else decode_expr(p.agg_fn.arg)

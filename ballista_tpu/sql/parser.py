@@ -749,6 +749,7 @@ class Parser:
         order_by: list[SortKey] = []
         if self.peek().is_kw("ORDER"):
             order_by = self._parse_order_by()
+        frame = self._maybe_frame()
         self.expect_punct(")")
         if isinstance(fn, AggregateFunction):
             if fn.distinct or fn.func == "count_distinct":
@@ -763,7 +764,63 @@ class Parser:
             raise SqlParseError(f"{func} takes 1-3 arguments, got {len(args)}")
         if func in ("row_number", "rank", "dense_rank") and args:
             raise SqlParseError(f"{func} takes no arguments")
-        return WindowFunction(func, args, tuple(partition_by), tuple(order_by))
+        if frame is not None and func not in ("sum", "avg", "min", "max", "count"):
+            raise SqlParseError(f"{func} does not take a frame clause")
+        return WindowFunction(func, args, tuple(partition_by), tuple(order_by), frame)
+
+    def _maybe_frame(self):
+        """ROWS BETWEEN <bound> AND <bound> (contextual words — ROWS /
+        UNBOUNDED / PRECEDING / FOLLOWING lex as identifiers, so columns
+        with those names stay usable). RANGE frames with offsets are
+        unsupported; the default RANGE UNBOUNDED..CURRENT is frame=None."""
+        t = self.peek()
+        word = t.value.upper() if t.kind == "ident" else ""
+        if word not in ("ROWS", "RANGE"):
+            return None
+        self.next()
+        if word == "RANGE":
+            raise SqlParseError("explicit RANGE frames are unsupported (use ROWS)")
+
+        def bound(is_start: bool) -> int | None:
+            b = self.next()
+            w = b.value.upper() if b.kind in ("ident", "number") else b.value
+            if w == "UNBOUNDED":
+                side = self.next().value.upper()
+                # direction is positional: only UNBOUNDED PRECEDING can open
+                # a frame, only UNBOUNDED FOLLOWING can close one
+                want = "PRECEDING" if is_start else "FOLLOWING"
+                if side != want:
+                    raise SqlParseError(
+                        f"UNBOUNDED {side} is invalid as a frame "
+                        f"{'start' if is_start else 'end'} (expected {want})"
+                    )
+                return None
+            if w == "CURRENT":
+                nxt = self.next()
+                if nxt.value.upper() != "ROW":
+                    raise SqlParseError("expected ROW after CURRENT")
+                return 0
+            if b.kind == "number":
+                side = self.next().value.upper()
+                try:
+                    off = int(b.value)
+                except ValueError:
+                    raise SqlParseError(f"frame offset must be an integer, got {b.value!r}") from None
+                if side == "PRECEDING":
+                    return -off
+                if side == "FOLLOWING":
+                    return off
+                raise SqlParseError("expected PRECEDING/FOLLOWING after frame offset")
+            raise SqlParseError(f"bad frame bound {b.value!r}")
+
+        if self.accept_kw("BETWEEN"):
+            start = bound(True)
+            self.expect_kw("AND")
+            end = bound(False)
+        else:
+            start = bound(True)
+            end = 0  # single-bound form: <bound> .. CURRENT ROW
+        return ("rows", start, end)
 
 
 def _num(s: str):

@@ -179,3 +179,68 @@ def test_window_pruning_reads_only_needed_columns():
     ctx.register_arrow_table("t", pa.table({"a": [1], "b": [2], "c": [3], "d": [4]}))
     opt = ctx.optimize(ctx.sql("select a, row_number() over (order by a) rn from t").plan)
     assert "projection=[a]" in opt.display()
+
+
+def test_rows_frames(ctx):
+    """Explicit ROWS BETWEEN frames: moving aggregates match pandas rolling."""
+    out = ctx.sql(
+        "select g, v, w, "
+        "sum(v) over (partition by g order by v, w rows between 2 preceding and current row) mv, "
+        "avg(w) over (partition by g order by v, w rows between 1 preceding and 1 following) ctr, "
+        "min(v) over (partition by g order by v, w rows between unbounded preceding and current row) mn, "
+        "count(*) over (partition by g order by v, w rows between current row and unbounded following) rem "
+        "from t order by g, v, w"
+    ).collect().to_pandas()
+    df = ctx._tbl.to_pandas().sort_values(["g", "v", "w"], kind="stable").reset_index(drop=True)
+    gb = df.groupby("g")
+    mv = gb["v"].rolling(3, min_periods=1).sum().reset_index(drop=True)
+    ctr = gb["w"].rolling(3, min_periods=1, center=True).mean().reset_index(drop=True)
+    mn = gb["v"].cummin().reset_index(drop=True)
+    rem = gb.cumcount(ascending=False) + 1
+    assert (out.mv.values == mv.values).all()
+    assert np.allclose(out.ctr.values, ctr.values)
+    assert (out.mn.values == mn.values).all()
+    assert (out.rem.values == rem.values).all()
+
+
+def test_rows_frame_proto_roundtrip(ctx):
+    from ballista_tpu.serde import decode_plan, encode_plan
+
+    phys = ctx.create_physical_plan(ctx.sql(
+        "select g, sum(v) over (partition by g order by v "
+        "rows between 3 preceding and 1 following) s from t"
+    ).plan)
+    rt = decode_plan(encode_plan(phys))
+    assert rt.display() == phys.display()
+    assert "ROWS BETWEEN 3 PRECEDING AND 1 FOLLOWING" in phys.display()
+
+
+def test_frame_words_stay_identifiers():
+    from ballista_tpu.client.context import SessionContext
+
+    ctx2 = SessionContext()
+    ctx2.register_arrow_table("t3", pa.table({"rows": [1, 2], "current": [3, 4]}))
+    out = ctx2.sql("select rows, current from t3 order by rows").collect().to_pandas()
+    assert out["rows"].tolist() == [1, 2]
+
+
+def test_empty_frames_and_invalid_bounds():
+    from ballista_tpu.client.context import SessionContext
+    from ballista_tpu.errors import SqlParseError
+
+    ctx = SessionContext()
+    ctx.register_arrow_table("t5", pa.table({"v": [1, 2, 3, 4, 5]}))
+    out = ctx.sql(
+        "select v, count(*) over (order by v rows between 5 preceding and 3 preceding) c, "
+        "sum(v) over (order by v rows between 2 following and 4 following) s "
+        "from t5 order by v"
+    ).collect().to_pandas()
+    assert out.c.tolist() == [0, 0, 0, 1, 2]
+    assert out.s.tolist()[0] == 12 and pd.isna(out.s.tolist()[4])
+    for bad in (
+        "rows between current row and unbounded preceding",
+        "rows between unbounded following and current row",
+        "rows between 1.5 preceding and current row",
+    ):
+        with pytest.raises(SqlParseError):
+            ctx.sql(f"select sum(v) over (order by v {bad}) s from t5").collect()
