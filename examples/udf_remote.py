@@ -1,0 +1,29 @@
+"""Scalar UDFs shipped by reference to a real cluster.
+
+UDFs defined in an importable module re-register on executors via
+`ballista.udf.modules` (see ballista_tpu/udf.py). Run a scheduler +
+executor first:
+
+    python -m ballista_tpu.scheduler --port 50050 &
+    python -m ballista_tpu.executor --scheduler localhost:50050 &
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+from ballista_tpu.client.context import SessionContext
+from ballista_tpu.testing.udf_fixtures import double_it, shout
+
+addr = sys.argv[1] if len(sys.argv) > 1 else "localhost:50050"
+pq.write_table(pa.table({"x": [5, 6], "s": ["hey", "yo"]}), "/tmp/udf_demo.parquet")
+
+ctx = SessionContext.remote(addr)
+ctx.register_parquet("t", "/tmp/udf_demo.parquet")
+ctx.register_udf("double_it", double_it, pa.int64())
+ctx.register_udf("shout", shout, pa.string())
+print(ctx.sql("select double_it(x) d, shout(s) u from t order by d").collect().to_pandas())

@@ -1,0 +1,52 @@
+"""Window functions + ROLLUP through the distributed standalone cluster."""
+
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+from ballista_tpu.client.context import SessionContext
+
+rng = np.random.default_rng(0)
+n = 100_000
+sales = pa.table({
+    "region": rng.choice(["emea", "amer", "apac"], n),
+    "rep": rng.choice([f"rep{i}" for i in range(20)], n),
+    "amount": np.round(rng.uniform(10, 5000, n), 2),
+})
+path = os.path.join(tempfile.mkdtemp(), "sales.parquet")
+pq.write_table(sales, path)
+
+ctx = SessionContext.standalone()
+ctx.register_parquet("sales", path)
+
+print("-- top 3 reps per region (window ranking over a hash exchange) --")
+print(ctx.sql("""
+    SELECT region, rep, total FROM (
+        SELECT region, rep, sum(amount) AS total,
+               rank() OVER (PARTITION BY region ORDER BY sum(amount) DESC) AS r
+        FROM sales GROUP BY region, rep
+    ) t WHERE r <= 3 ORDER BY region, total DESC
+""").collect().to_pandas())
+
+print("-- rollup subtotals --")
+print(ctx.sql("""
+    SELECT region, rep, sum(amount) AS total
+    FROM sales GROUP BY ROLLUP(region, rep)
+    ORDER BY region, rep LIMIT 10
+""").collect().to_pandas())
+
+print("-- 7-row moving average --")
+print(ctx.sql("""
+    SELECT region, amount,
+           avg(amount) OVER (PARTITION BY region ORDER BY amount
+                             ROWS BETWEEN 6 PRECEDING AND CURRENT ROW) AS ma
+    FROM sales ORDER BY region, amount LIMIT 5
+""").collect().to_pandas())
+ctx.shutdown()
+print("OK")
