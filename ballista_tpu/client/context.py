@@ -91,6 +91,11 @@ class SessionContext:
             # ship the registration with the session so remote planning sees it
             self.config.set(f"ballista.catalog.table.{name.lower()}", provider.path)
 
+    def _has_memory_tables(self) -> bool:
+        from ballista_tpu.plan.provider import MemoryTable
+
+        return any(isinstance(p, MemoryTable) for p in self.catalog.tables.values())
+
     def register_udf(self, name: str, fn, return_type) -> None:
         """Register a scalar UDF for this session (BallistaFunctionRegistry
         analog). Local execution resolves it immediately; for remote
@@ -283,9 +288,13 @@ class DataFrame:
         session_id = scheduler.sessions.create_or_update(
             self.ctx.config.to_key_value_pairs(), str(self.ctx.session_id)
         )
-        if self.sql_text is not None:
+        if self.sql_text is not None and not self.ctx._has_memory_tables():
             job_id = scheduler.submit_sql(self.sql_text, session_id)
         else:
+            # in-memory tables can't be re-resolved from SQL on the
+            # scheduler: plan CLIENT-side and submit the physical plan
+            # (MemoryScanNode ships the batches as IPC bytes) — the
+            # reference's BallistaQueryPlanner flow
             physical = self.ctx.create_physical_plan(self.plan)
             job_id = scheduler.submit_physical_plan(physical, session_id)
         status = scheduler.wait_for_job(job_id)

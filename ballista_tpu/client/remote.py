@@ -102,12 +102,16 @@ class RemoteSchedulerClient:
         from ballista_tpu.client.context import fetch_job_results
         from ballista_tpu.config import PUSH_STATUS
 
-        if df.sql_text is not None and bool(self.config.get(PUSH_STATUS)):
+        sql_ok = df.sql_text is not None and not df.ctx._has_memory_tables()
+        if sql_ok and bool(self.config.get(PUSH_STATUS)):
             status = self.execute_sql_push(df.sql_text)
-        elif df.sql_text is not None:
+        elif sql_ok:
             job_id = self.execute_sql(df.sql_text)
             status = self.wait_for_job(job_id)
         else:
+            # memory tables can't be re-resolved from SQL on the scheduler:
+            # plan client-side, ship the physical plan (MemoryScanNode
+            # carries the batches as IPC bytes)
             physical = df.ctx.create_physical_plan(df.plan)
             job_id = self.execute_physical(physical)
             status = self.wait_for_job(job_id)

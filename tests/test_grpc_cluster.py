@@ -186,3 +186,20 @@ def test_tui_rest_client_against_live_scheduler(grpc_cluster, remote_ctx):
     # the render layer digests live payloads
     assert len(render_jobs(jobs, 0)) == len(jobs) + 1
     assert len(render_stages(st)) == len(st) + 1
+
+
+def test_memory_tables_over_remote_cluster(grpc_cluster):
+    """In-memory tables work against a REAL cluster: the client plans and
+    ships the physical plan with MemoryScanNode IPC bytes (the reference's
+    BallistaQueryPlanner flow)."""
+    import pyarrow as pa
+
+    from ballista_tpu.client.context import SessionContext
+
+    _, addr = grpc_cluster
+    ctx = SessionContext.remote(addr)
+    ctx.register_arrow_table("mem", pa.table({"x": [1, 2, 3, 4], "g": ["a", "b", "a", "b"]}),
+                             partitions=2)
+    out = ctx.sql("select g, sum(x) s, count(*) c from mem group by g order by g").collect()
+    assert out.column("s").to_pylist() == [4, 6]
+    assert out.column("c").to_pylist() == [2, 2]
