@@ -201,13 +201,18 @@ class Parser:
                     break
         stmt = self._parse_select_body()
         stmt.ctes = ctes
-        # set operations
+        # set operations: chain via nested set_op fields on the RHS so
+        # a UNION ALL b UNION ALL c keeps all three branches (homogeneous
+        # chains are associative; planner flattens them)
+        cur = stmt
         while self.peek().is_kw("UNION"):
             self.next()
             all_ = self.accept_kw("ALL")
-            rhs = self._parse_select_body()
-            stmt.set_op = ("union_all" if all_ else "union", rhs)
-            stmt = self._wrap_setop(stmt)
+            # standard SQL: union branches take no bare ORDER BY/LIMIT —
+            # trailing clauses bind to the whole chain
+            rhs = self._parse_select_body(allow_order=False)
+            cur.set_op = ("union_all" if all_ else "union", rhs)
+            cur = rhs
         # trailing ORDER BY / LIMIT of a set operation
         if self.peek().is_kw("ORDER") and not stmt.order_by:
             stmt.order_by = self._parse_order_by()
@@ -215,10 +220,7 @@ class Parser:
             stmt.limit, stmt.offset = self._parse_limit()
         return stmt
 
-    def _wrap_setop(self, stmt: SelectStmt) -> SelectStmt:
-        return stmt  # chain is stored via nested set_op fields
-
-    def _parse_select_body(self) -> SelectStmt:
+    def _parse_select_body(self, allow_order: bool = True) -> SelectStmt:
         self.expect_kw("SELECT")
         stmt = SelectStmt()
         stmt.distinct = self.accept_kw("DISTINCT")
@@ -295,9 +297,9 @@ class Parser:
                         break
         if self.accept_kw("HAVING"):
             stmt.having = self.parse_expr()
-        if self.peek().is_kw("ORDER"):
+        if allow_order and self.peek().is_kw("ORDER"):
             stmt.order_by = self._parse_order_by()
-        if self.peek().is_kw("LIMIT"):
+        if allow_order and self.peek().is_kw("LIMIT"):
             stmt.limit, stmt.offset = self._parse_limit()
         return stmt
 

@@ -60,18 +60,38 @@ class SqlPlanner:
         cte_env = dict(cte_env or {})
         for name, sub in stmt.ctes:
             cte_env[name] = self.plan_query(sub, cte_env)
-        plan = self._plan_select(stmt, cte_env)
+        # a trailing ORDER BY / LIMIT of a set-operation chain orders the
+        # WHOLE union, not the first branch — defer them past the Union
+        defer = stmt.set_op is not None
+        plan = self._plan_select(stmt, cte_env, defer_order=defer)
         if stmt.set_op is not None:
             op, rhs = stmt.set_op
             rhs_plan = self.plan_query(rhs, cte_env)
             plan = Union([plan, rhs_plan], all=(op == "union_all"))
             if op == "union":
                 plan = Distinct(plan)
+            if stmt.order_by:
+                keys = []
+                for sk in stmt.order_by:
+                    e = sk.expr
+                    if isinstance(e, Literal) and isinstance(e.value, int):
+                        e = Column(plan.schema.field(e.value - 1).name)
+                    keys.append(SortKey(e, sk.ascending, sk.nulls_first))
+                plan = Sort(plan, keys, fetch=None)
+            if stmt.limit is not None or stmt.offset:
+                if isinstance(plan, Sort):
+                    plan = replace(
+                        plan,
+                        fetch=(stmt.limit + stmt.offset) if stmt.limit is not None else None,
+                    )
+                    plan.__post_init__()
+                plan = Limit(plan, stmt.limit, stmt.offset)
         return plan
 
     # ------------------------------------------------------------------
 
-    def _plan_select(self, stmt: SelectStmt, cte_env: dict[str, LogicalPlan]) -> LogicalPlan:
+    def _plan_select(self, stmt: SelectStmt, cte_env: dict[str, LogicalPlan],
+                     defer_order: bool = False) -> LogicalPlan:
         # FROM
         if stmt.from_tables:
             plan = self._plan_table_ref(stmt.from_tables[0], cte_env)
@@ -149,6 +169,9 @@ class SqlPlanner:
 
         if stmt.distinct:
             plan = Distinct(plan)
+
+        if defer_order:
+            return plan  # union chain: ORDER BY/LIMIT applied above the Union
 
         # ORDER BY against projection output
         if stmt.order_by:

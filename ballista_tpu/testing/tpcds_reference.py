@@ -138,6 +138,23 @@ def run_reference(q: int, t: dict[str, pd.DataFrame]) -> pd.DataFrame:
         g["revenueratio"] = g.itemrevenue * 100.0 / class_tot
         return g.sort_values(["i_category", "i_class", "i_item_id", "i_item_desc", "revenueratio"]
                              ).head(100).reset_index(drop=True)
+    if q == 33:
+        ca = t["customer_address"]
+        out_frames = []
+        for fact, date_col, item_col, addr_col, price_col in (
+            (t["store_sales"], "ss_sold_date_sk", "ss_item_sk", "ss_addr_sk", "ss_ext_sales_price"),
+            (t["catalog_sales"], "cs_sold_date_sk", "cs_item_sk", "cs_bill_addr_sk", "cs_ext_sales_price"),
+            (t["web_sales"], "ws_sold_date_sk", "ws_item_sk", "ws_bill_addr_sk", "ws_ext_sales_price"),
+        ):
+            m = fact.merge(dd[(dd.d_year == 1999) & (dd.d_moy == 3)],
+                           left_on=date_col, right_on="d_date_sk")
+            m = m.merge(it[it.i_category == "Books"], left_on=item_col, right_on="i_item_sk")
+            m = m.merge(ca[ca.ca_gmt_offset == -5.0], left_on=addr_col, right_on="ca_address_sk")
+            g = m.groupby("i_manufact_id", as_index=False).agg(total_sales=(price_col, "sum"))
+            out_frames.append(g)
+        allc = pd.concat(out_frames, ignore_index=True)
+        g = allc.groupby("i_manufact_id", as_index=False).agg(total_sales=("total_sales", "sum"))
+        return g.sort_values(["total_sales", "i_manufact_id"]).head(100).reset_index(drop=True)
     raise ValueError(f"no oracle for q{q}")
 
 

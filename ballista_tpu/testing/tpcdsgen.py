@@ -183,17 +183,38 @@ def generate_tpcds(out_dir: str, scale: float = 1.0, seed: int = 17,
         "ss_net_profit": pa.array(net_profit),
     })
 
+    # ---- catalog_sales / web_sales (cross-channel queries) ---------------
+    def channel_fact(prefix: str, rows: int, seed_off: int) -> pa.Table:
+        r = np.random.default_rng(seed + seed_off)
+        cqty = r.integers(1, 101, rows)
+        cprice = np.round(r.uniform(1, 200, rows), 2)
+        ext = np.round(cprice * cqty, 2)
+        return pa.table({
+            f"{prefix}_sold_date_sk": pa.array(r.integers(2450815, 2450815 + days, rows), pa.int64()),
+            f"{prefix}_item_sk": pa.array(r.integers(1, n_items + 1, rows), pa.int64()),
+            f"{prefix}_bill_customer_sk": pa.array(r.integers(1, n_customers + 1, rows), pa.int64()),
+            f"{prefix}_bill_addr_sk": pa.array(r.integers(1, n_addresses + 1, rows), pa.int64()),
+            f"{prefix}_quantity": pa.array(cqty, pa.int64()),
+            f"{prefix}_sales_price": pa.array(cprice),
+            f"{prefix}_ext_sales_price": pa.array(ext),
+            f"{prefix}_net_profit": pa.array(np.round(ext * r.uniform(-0.2, 0.4, rows), 2)),
+        })
+
+    catalog_sales = channel_fact("cs", max(n_sales // 2, 500), 101)
+    web_sales = channel_fact("ws", max(n_sales // 4, 500), 202)
+
     tables = {
         "date_dim": date_dim, "time_dim": time_dim, "item": item, "store": store,
         "customer": customer, "customer_address": customer_address,
         "customer_demographics": customer_demographics,
         "household_demographics": household_demographics,
         "promotion": promotion, "store_sales": store_sales,
+        "catalog_sales": catalog_sales, "web_sales": web_sales,
     }
     for name, tbl in tables.items():
         d = os.path.join(out_dir, name)
         os.makedirs(d, exist_ok=True)
-        nfiles = files_per_table if name == "store_sales" else 1
+        nfiles = files_per_table if name.endswith("_sales") else 1
         rows_per = (tbl.num_rows + nfiles - 1) // nfiles
         for i in range(nfiles):
             part = tbl.slice(i * rows_per, rows_per)
@@ -203,6 +224,7 @@ def generate_tpcds(out_dir: str, scale: float = 1.0, seed: int = 17,
 TPCDS_TABLES = [
     "date_dim", "time_dim", "item", "store", "customer", "customer_address",
     "customer_demographics", "household_demographics", "promotion", "store_sales",
+    "catalog_sales", "web_sales",
 ]
 
 

@@ -72,3 +72,37 @@ def test_projection_pushdown(tpch_ctx):
     opt = tpch_ctx.optimize(tpch_ctx.sql("select l_orderkey from lineitem").plan)
     text = opt.display()
     assert "projection=[l_orderkey]" in text
+
+
+def test_union_chain_keeps_all_branches_and_defers_order():
+    """3-way UNION ALL chains keep every branch, and a trailing ORDER
+    BY/LIMIT binds to the WHOLE union, not a branch."""
+    import pyarrow as pa
+
+    from ballista_tpu.client.context import SessionContext
+
+    ctx = SessionContext()
+    ctx.register_arrow_table("t", pa.table({"v": [5, 1, 9]}))
+    ctx.register_arrow_table("u", pa.table({"v": [7, 3]}))
+    out = ctx.sql(
+        "select v from t union all select v from u union all select v from u "
+        "order by v limit 4"
+    ).collect().to_pandas()
+    assert out.v.tolist() == [1, 3, 3, 5]
+
+
+def test_union_chain_keeps_all_branches_and_defers_order():
+    """3-way UNION ALL chains keep every branch, and a trailing ORDER
+    BY/LIMIT binds to the WHOLE union, not a branch."""
+    import pyarrow as pa
+
+    from ballista_tpu.client.context import SessionContext
+
+    ctx = SessionContext()
+    ctx.register_arrow_table("t", pa.table({"v": [5, 1, 9]}))
+    ctx.register_arrow_table("u", pa.table({"v": [7, 3]}))
+    out = ctx.sql(
+        "select v from t union all select v from u union all select v from u "
+        "order by v limit 4"
+    ).collect().to_pandas()
+    assert out.v.tolist() == [1, 3, 3, 5]
