@@ -34,13 +34,28 @@ def decode_executor_metadata(p: pb.ExecutorMetadataProto) -> ExecutorMetadata:
     )
 
 
+def _encoded_plan_bytes(t: TaskDescription) -> bytes:
+    """Stage-plan encode cache (reference: TaskManager's optional
+    stage-plan cache, state/task_manager.rs): tasks of one stage attempt
+    share one plan object — encode once, not once per task. Memoized ON
+    the plan object so the cache's lifetime is the plan's (replanned/
+    retried stages build new plan objects and re-encode; no id() aliasing).
+    Plans are never mutated after task hand-out begins (AQE rewrites
+    happen at resolution, before the first task is popped)."""
+    hit = getattr(t.plan, "_encoded_task_plan", None)
+    if hit is None:
+        hit = encode_plan(t.plan).SerializeToString()
+        t.plan._encoded_task_plan = hit
+    return hit
+
+
 def encode_task_definition(t: TaskDescription) -> pb.TaskDefinitionProto:
     out = pb.TaskDefinitionProto(
         task_id=t.task_id, job_id=t.job_id, stage_id=t.stage_id,
         stage_attempt=t.stage_attempt, session_id=t.session_id,
     )
     out.partitions.extend(t.partitions)
-    out.plan.CopyFrom(encode_plan(t.plan))
+    out.plan.ParseFromString(_encoded_plan_bytes(t))
     return out
 
 
