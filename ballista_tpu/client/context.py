@@ -328,6 +328,23 @@ class DataFrame:
                     )
             types.append("analyzed_plan (distributed)")
             plans.append("\n".join(lines))
+        elif self.plan.analyze and self.ctx.mode == "remote":
+            # remote EXPLAIN ANALYZE: submit the physical plan, then fetch
+            # per-stage operator metrics over the GetJobMetrics rpc
+            client = self.ctx._ensure_remote()
+            job_id = client.execute_physical(physical)
+            client.wait_for_job(job_id)
+            metrics = client.job_metrics(job_id)
+            lines = []
+            for sp in metrics.stages:
+                lines.append(f"stage {sp.stage_id}:")
+                for m in list(sp.metrics)[:100]:
+                    lines.append(
+                        f"  {'  ' * m.depth}{m.name}: rows={m.output_rows} "
+                        f"elapsed_ms={m.elapsed_ns / 1e6:.2f}"
+                    )
+            types.append("analyzed_plan (distributed)")
+            plans.append("\n".join(lines))
         elif self.plan.analyze:
             self.ctx.execute_collect(physical)
             from ballista_tpu.plan.physical import collect_metrics

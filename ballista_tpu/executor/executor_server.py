@@ -31,6 +31,7 @@ class ExecutorGrpcService:
         self.status_sender = status_sender
         self.shutdown_cb = shutdown_cb
         self._queue: "queue.Queue" = queue.Queue()
+        self._config_cache: dict = {}
         self._workers: list[threading.Thread] = []
         self._running = True
         for i in range(max(1, executor.metadata.vcores)):
@@ -57,15 +58,27 @@ class ExecutorGrpcService:
     # -- rpcs ----------------------------------------------------------------
 
     def LaunchMultiTask(self, request: pb.LaunchMultiTaskParams, context) -> pb.LaunchMultiTaskResult:
-        from ballista_tpu.config import BallistaConfig
-
         for tp in request.tasks:
             task = decode_task_definition(tp)
-            cfg = BallistaConfig.from_key_value_pairs(
-                [(kv.key, kv.value) for kv in tp.props], scrub_restricted=True
-            )
+            cfg = self._session_config([(kv.key, kv.value) for kv in tp.props])
             self._queue.put((task, cfg))
         return pb.LaunchMultiTaskResult(success=True)
+
+    def _session_config(self, pairs: list[tuple[str, str]]):
+        """Session-scoped config cache (reference: SessionRuntimeCache,
+        executor/src/runtime_cache.rs): concurrent tasks of one session
+        share one parsed BallistaConfig instead of re-parsing the KV set
+        per task. Bounded; keyed on the exact KV tuple."""
+        from ballista_tpu.config import BallistaConfig
+
+        key = tuple(pairs)
+        cfg = self._config_cache.get(key)
+        if cfg is None:
+            cfg = BallistaConfig.from_key_value_pairs(list(pairs), scrub_restricted=True)
+            if len(self._config_cache) >= 32:
+                self._config_cache.pop(next(iter(self._config_cache)))
+            self._config_cache[key] = cfg
+        return cfg
 
     def StopExecutor(self, request: pb.StopExecutorParams, context) -> pb.StopExecutorResult:
         log.info("stop requested (force=%s): %s", request.force, request.reason)

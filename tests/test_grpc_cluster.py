@@ -203,3 +203,14 @@ def test_memory_tables_over_remote_cluster(grpc_cluster):
     out = ctx.sql("select g, sum(x) s, count(*) c from mem group by g order by g").collect()
     assert out.column("s").to_pylist() == [4, 6]
     assert out.column("c").to_pylist() == [2, 2]
+
+
+def test_remote_explain_analyze(grpc_cluster, remote_ctx):
+    """EXPLAIN ANALYZE in remote mode renders per-stage operator metrics
+    fetched over GetJobMetrics (DistributedExplainAnalyzeExec analog)."""
+    out = remote_ctx.sql(
+        "explain analyze select n_regionkey, count(*) from nation group by n_regionkey"
+    ).collect()
+    plans = dict(zip(out.column("plan_type").to_pylist(), out.column("plan").to_pylist()))
+    body = plans.get("analyzed_plan (distributed)", "")
+    assert "stage" in body and "elapsed_ms" in body, plans
