@@ -331,9 +331,15 @@ class DataFrame:
         elif self.plan.analyze and self.ctx.mode == "remote":
             # remote EXPLAIN ANALYZE: submit the physical plan, then fetch
             # per-stage operator metrics over the GetJobMetrics rpc
+            from ballista_tpu.errors import ExecutionError
+
             client = self.ctx._ensure_remote()
             job_id = client.execute_physical(physical)
-            client.wait_for_job(job_id)
+            status = client.wait_for_job(job_id)
+            if status["state"] != "successful":
+                raise ExecutionError(
+                    f"job {job_id} {status['state']}: {status.get('error', '')}"
+                )
             metrics = client.job_metrics(job_id)
             lines = []
             for sp in metrics.stages:

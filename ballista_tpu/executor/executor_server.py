@@ -78,7 +78,9 @@ class ExecutorGrpcService:
             if len(self._config_cache) >= 32:
                 self._config_cache.pop(next(iter(self._config_cache)))
             self._config_cache[key] = cfg
-        return cfg
+        # hand out a copy: tasks apply per-task defaults (executor memory
+        # budget) and must never mutate the shared cached entry
+        return cfg.copy()
 
     def StopExecutor(self, request: pb.StopExecutorParams, context) -> pb.StopExecutorResult:
         log.info("stop requested (force=%s): %s", request.force, request.reason)

@@ -65,11 +65,16 @@ class SqlPlanner:
         defer = stmt.set_op is not None
         plan = self._plan_select(stmt, cte_env, defer_order=defer)
         if stmt.set_op is not None:
-            op, rhs = stmt.set_op
-            rhs_plan = self.plan_query(rhs, cte_env)
-            plan = Union([plan, rhs_plan], all=(op == "union_all"))
-            if op == "union":
-                plan = Distinct(plan)
+            # LEFT-associative chain walk: `a UNION ALL b UNION c` dedups
+            # the whole accumulated left side, never just a branch
+            cur = stmt
+            while cur.set_op is not None:
+                op, rhs = cur.set_op
+                rhs_plan = self._plan_select(rhs, cte_env, defer_order=True)
+                plan = Union([plan, rhs_plan], all=(op == "union_all"))
+                if op == "union":
+                    plan = Distinct(plan)
+                cur = rhs
             if stmt.order_by:
                 keys = []
                 for sk in stmt.order_by:

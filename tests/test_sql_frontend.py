@@ -91,18 +91,22 @@ def test_union_chain_keeps_all_branches_and_defers_order():
     assert out.v.tolist() == [1, 3, 3, 5]
 
 
-def test_union_chain_keeps_all_branches_and_defers_order():
-    """3-way UNION ALL chains keep every branch, and a trailing ORDER
-    BY/LIMIT binds to the WHOLE union, not a branch."""
+def test_mixed_union_chain_left_associative():
+    """a UNION ALL b UNION c dedups the whole left side; a UNION b UNION
+    ALL c keeps the trailing duplicates (SQL left associativity)."""
     import pyarrow as pa
 
     from ballista_tpu.client.context import SessionContext
 
     ctx = SessionContext()
-    ctx.register_arrow_table("t", pa.table({"v": [5, 1, 9]}))
-    ctx.register_arrow_table("u", pa.table({"v": [7, 3]}))
+    ctx.register_arrow_table("t", pa.table({"v": [1]}))
+    ctx.register_arrow_table("u", pa.table({"v": [1]}))
+    ctx.register_arrow_table("w", pa.table({"v": [2, 2]}))
     out = ctx.sql(
-        "select v from t union all select v from u union all select v from u "
-        "order by v limit 4"
+        "select v from t union all select v from u union select v from w order by v"
     ).collect().to_pandas()
-    assert out.v.tolist() == [1, 3, 3, 5]
+    assert out.v.tolist() == [1, 2]
+    out2 = ctx.sql(
+        "select v from t union select v from u union all select v from w order by v"
+    ).collect().to_pandas()
+    assert out2.v.tolist() == [1, 2, 2]
