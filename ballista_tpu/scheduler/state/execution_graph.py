@@ -373,7 +373,15 @@ class ExecutionGraph:
         stage.state = StageState.RESOLVED
 
     def _build_reader(self, inp: ExecutionStage) -> ShuffleReaderExec:
-        locs = inp.output_locations()
+        # deterministic location order: completed.values() is task-ARRIVAL
+        # order, which varies run to run (and between two evaluations of
+        # the same subtree in one query, e.g. a CTE referenced twice).
+        # Float aggregation is order-sensitive, so downstream merges must
+        # see a stable order or q15-style self-equality comparisons break.
+        locs = sorted(
+            inp.output_locations(),
+            key=lambda l: (l.output_partition, l.map_partition, l.path),
+        )
         k = inp.spec.output_partitions
         by_output: list[list[PartitionLocation]] = [[] for _ in range(max(1, k))]
         for l in locs:
