@@ -45,6 +45,9 @@ GRPC_CLIENT_MAX_MESSAGE_SIZE = "ballista.grpc.client.max.message.size.bytes"
 GRPC_SERVER_MAX_MESSAGE_SIZE = "ballista.grpc.server.max.message.size.bytes"
 FLIGHT_PROXY = "ballista.client.flight.proxy"
 PUSH_STATUS = "ballista.client.push.status"
+GRPC_TLS_CA = "ballista.grpc.tls.ca.path"
+GRPC_TLS_CERT = "ballista.grpc.tls.cert.path"
+GRPC_TLS_KEY = "ballista.grpc.tls.key.path"
 IO_RETRIES = "ballista.io.retries.times"
 IO_RETRY_WAIT_MS = "ballista.io.retry.wait.time.ms"
 CHAOS_ENABLED = "ballista.chaos.enabled"
@@ -151,6 +154,23 @@ _ENTRIES: list[ConfigEntry] = [
         "Use the server-streaming execute_query_push rpc (scheduler pushes "
         "state changes) instead of polling get_job_status.",
         bool, False,
+    ),
+    ConfigEntry(
+        GRPC_TLS_CA,
+        "CA certificate (PEM) used to verify gRPC peers; presence turns on "
+        "TLS for outbound control-plane channels.",
+        str, "",
+    ),
+    ConfigEntry(
+        GRPC_TLS_CERT,
+        "This party's certificate chain (PEM) presented on gRPC connections "
+        "(mTLS client auth when dialing, server identity when listening).",
+        str, "",
+    ),
+    ConfigEntry(
+        GRPC_TLS_KEY,
+        "Private key (PEM) matching ballista.grpc.tls.cert.path.",
+        str, "",
     ),
     ConfigEntry(IO_RETRIES, "Shuffle fetch retry attempts.", int, 3, _nonneg),
     ConfigEntry(IO_RETRY_WAIT_MS, "Base backoff between shuffle fetch retries.", int, 100, _nonneg),

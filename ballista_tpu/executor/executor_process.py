@@ -133,7 +133,9 @@ class ExecutorProcess:
                  work_dir: str | None = None, engine: str = "cpu",
                  policy: str = "push", work_dir_ttl_s: float = 4 * 3600,
                  memory_pool_bytes: int = 0, memory_fraction: float = 0.6,
-                 flight_impl: str = "auto"):
+                 flight_impl: str = "auto",
+                 tls_cert: str | None = None, tls_key: str | None = None,
+                 tls_ca: str | None = None):
         self.scheduler_addr = scheduler_addr
         self.work_dir = work_dir or tempfile.mkdtemp(prefix="ballista-tpu-executor-")
         self.policy = policy
@@ -142,6 +144,12 @@ class ExecutorProcess:
         host = external_host or socket.gethostname()
 
         config = BallistaConfig({EXECUTOR_ENGINE: engine})
+        if tls_ca:
+            from ballista_tpu.config import GRPC_TLS_CA, GRPC_TLS_CERT, GRPC_TLS_KEY
+
+            config.set(GRPC_TLS_CA, tls_ca)
+            config.set(GRPC_TLS_CERT, tls_cert or "")
+            config.set(GRPC_TLS_KEY, tls_key or "")
         self.flight_server = None
         self.native_flight_proc = None
         if flight_impl in ("auto", "native"):
@@ -179,7 +187,12 @@ class ExecutorProcess:
         )
         self.service = ExecutorGrpcService(self.executor, self._send_status, self.shutdown)
         add_executor_service(self.grpc_server, self.service)
-        self.grpc_port = self.grpc_server.add_insecure_port(f"{bind_host}:{grpc_port}")
+        from ballista_tpu.utils.grpc_util import bind_server_port
+
+        self.grpc_port = bind_server_port(
+            self.grpc_server, f"{bind_host}:{grpc_port}", tls_cert, tls_key,
+            tls_ca if tls_cert else None,
+        )
         self.metadata.grpc_port = self.grpc_port
 
         from ballista_tpu.executor.health import start_health_server
@@ -321,6 +334,10 @@ def main(argv=None) -> None:
     ap.add_argument("--work-dir", default=None)
     ap.add_argument("--engine", choices=("cpu", "tpu"), default="cpu")
     ap.add_argument("--policy", choices=("push", "pull"), default="push")
+    ap.add_argument("--tls-cert", default=None, help="server certificate chain (PEM)")
+    ap.add_argument("--tls-key", default=None, help="server private key (PEM)")
+    ap.add_argument("--tls-ca", default=None,
+                    help="CA for verifying the scheduler and requiring client certs (mTLS)")
     ap.add_argument("--flight-server", choices=("auto", "python", "native"), default="auto",
                     help="shuffle data plane: native C++ (preferred), python, or auto-fallback")
     ap.add_argument("--memory-pool-bytes", type=int, default=0,
@@ -336,6 +353,7 @@ def main(argv=None) -> None:
         args.flight_port, args.concurrent_tasks, args.work_dir, args.engine, args.policy,
         memory_pool_bytes=args.memory_pool_bytes, memory_fraction=args.memory_fraction,
         flight_impl=args.flight_server,
+        tls_cert=args.tls_cert, tls_key=args.tls_key, tls_ca=args.tls_ca,
     )
     signal.signal(signal.SIGTERM, lambda *_: proc.shutdown())
     proc.start()

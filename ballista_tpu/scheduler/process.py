@@ -33,9 +33,10 @@ class GrpcTaskLauncher(TaskLauncher):
     """Push mode: LaunchMultiTask to the executor's gRPC endpoint
     (reference: executor_manager.rs:406)."""
 
-    def __init__(self):
+    def __init__(self, tls_config=None):
         self._stubs: dict[str, object] = {}
         self._lock = threading.Lock()
+        self._tls_config = tls_config  # BallistaConfig carrying tls paths
 
     def _stub_for(self, addr: str):
         with self._lock:
@@ -43,7 +44,7 @@ class GrpcTaskLauncher(TaskLauncher):
             if s is None:
                 from ballista_tpu.utils.grpc_util import create_channel
 
-                s = executor_stub(create_channel(addr))
+                s = executor_stub(create_channel(addr, self._tls_config))
                 self._stubs[addr] = s
             return s
 
@@ -69,15 +70,31 @@ class SchedulerProcess:
                  task_distribution: str = "bias", executor_timeout_s: float = 180.0,
                  rest_port: int = 0, flight_proxy_port: int = 0,
                  job_state_dir: str | None = None, scheduler_id: str = "scheduler-0",
-                 force_recover: bool = False):
+                 force_recover: bool = False,
+                 tls_cert: str | None = None, tls_key: str | None = None,
+                 tls_client_ca: str | None = None):
         self.metrics = InMemoryMetricsCollector()
         job_state = None
         if job_state_dir:
             from ballista_tpu.scheduler.state.job_state import FileJobState
 
             job_state = FileJobState(job_state_dir)
+        launcher_tls = None
+        if tls_client_ca or tls_cert:
+            from ballista_tpu.config import (
+                GRPC_TLS_CA,
+                GRPC_TLS_CERT,
+                GRPC_TLS_KEY,
+                BallistaConfig,
+            )
+
+            launcher_tls = BallistaConfig({
+                GRPC_TLS_CA: tls_client_ca or "",
+                GRPC_TLS_CERT: tls_cert or "",
+                GRPC_TLS_KEY: tls_key or "",
+            })
         self.scheduler = SchedulerServer(
-            GrpcTaskLauncher(), self.metrics, task_distribution, executor_timeout_s,
+            GrpcTaskLauncher(launcher_tls), self.metrics, task_distribution, executor_timeout_s,
             scheduler_id=scheduler_id, job_state=job_state,
         )
         from ballista_tpu.utils.grpc_util import server_options
@@ -87,7 +104,12 @@ class SchedulerProcess:
         )
         self.service = SchedulerGrpcService(self.scheduler)
         add_scheduler_service(self.grpc_server, self.service)
-        self.port = self.grpc_server.add_insecure_port(f"{bind_host}:{port}")
+        from ballista_tpu.utils.grpc_util import bind_server_port
+
+        self.tls = (tls_cert, tls_key, tls_client_ca)
+        self.port = bind_server_port(
+            self.grpc_server, f"{bind_host}:{port}", tls_cert, tls_key, tls_client_ca
+        )
         self._stopping = threading.Event()
         self.rest_server = None
         self.rest_port = 0
@@ -151,6 +173,10 @@ def main(argv=None) -> None:
     ap.add_argument("--job-state-dir", default=None,
                     help="persist job graphs here for fail-over recovery")
     ap.add_argument("--scheduler-id", default="scheduler-0")
+    ap.add_argument("--tls-cert", default=None, help="server certificate chain (PEM) — enables TLS")
+    ap.add_argument("--tls-key", default=None, help="server private key (PEM)")
+    ap.add_argument("--tls-client-ca", default=None,
+                    help="CA to verify client certs (enables mTLS; also used to dial executors)")
     ap.add_argument("--force-recover", action="store_true",
                     help="adopt persisted jobs even if owned by another scheduler id "
                          "(standby takeover after the owner died)")
@@ -166,6 +192,7 @@ def main(argv=None) -> None:
         args.executor_timeout_seconds, args.rest_port, args.flight_proxy_port,
         job_state_dir=args.job_state_dir, scheduler_id=args.scheduler_id,
         force_recover=args.force_recover,
+        tls_cert=args.tls_cert, tls_key=args.tls_key, tls_client_ca=args.tls_client_ca,
     )
     signal.signal(signal.SIGTERM, lambda *_: proc.shutdown())
     proc.start()

@@ -14,6 +14,9 @@ import grpc
 from ballista_tpu.config import (
     GRPC_CLIENT_MAX_MESSAGE_SIZE,
     GRPC_SERVER_MAX_MESSAGE_SIZE,
+    GRPC_TLS_CA,
+    GRPC_TLS_CERT,
+    GRPC_TLS_KEY,
     BallistaConfig,
 )
 
@@ -42,5 +45,39 @@ def server_options(config: BallistaConfig | None = None) -> list[tuple]:
     ]
 
 
+def _read(path: str | None) -> bytes | None:
+    if not path:
+        return None
+    with open(path, "rb") as f:
+        return f.read()
+
+
 def create_channel(addr: str, config: BallistaConfig | None = None) -> grpc.Channel:
-    return grpc.insecure_channel(addr, options=client_options(config))
+    """TLS when the session carries a CA (ballista.grpc.tls.ca.path);
+    cert+key additionally enable mTLS client auth. Plaintext otherwise —
+    the reference's GrpcClientConfig TLS switch (core/src/utils.rs:59)."""
+    cfg = config or BallistaConfig()
+    ca = _read(str(cfg.get(GRPC_TLS_CA) or ""))
+    if ca:
+        creds = grpc.ssl_channel_credentials(
+            root_certificates=ca,
+            private_key=_read(str(cfg.get(GRPC_TLS_KEY) or "")),
+            certificate_chain=_read(str(cfg.get(GRPC_TLS_CERT) or "")),
+        )
+        return grpc.secure_channel(addr, creds, options=client_options(cfg))
+    return grpc.insecure_channel(addr, options=client_options(cfg))
+
+
+def bind_server_port(server: grpc.Server, bind: str,
+                     tls_cert: str | None = None, tls_key: str | None = None,
+                     tls_client_ca: str | None = None) -> int:
+    """add_secure_port when a server cert is configured (client CA →
+    REQUIRED client certs = mTLS); add_insecure_port otherwise."""
+    if tls_cert and tls_key:
+        creds = grpc.ssl_server_credentials(
+            [(_read(tls_key), _read(tls_cert))],
+            root_certificates=_read(tls_client_ca),
+            require_client_auth=bool(tls_client_ca),
+        )
+        return server.add_secure_port(bind, creds)
+    return server.add_insecure_port(bind)
