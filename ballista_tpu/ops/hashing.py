@@ -69,6 +69,15 @@ def _int64_encoding(arr: pa.Array) -> tuple[np.ndarray, np.ndarray | None]:
     raise TypeError(f"unhashable key type {t}")
 
 
+def fnv1a_str(s: str) -> int:
+    """Scalar FNV-1a over utf8 bytes — the per-dictionary-entry twin of
+    _fnv1a_segments, used to build device hash LUTs for string keys."""
+    h = 0xCBF29CE484222325
+    for b in s.encode("utf-8"):
+        h = ((h ^ b) * 0x100000001B3) & 0xFFFFFFFFFFFFFFFF
+    return h
+
+
 def _fnv1a_segments(data: np.ndarray, offsets: np.ndarray) -> np.ndarray:
     """FNV-1a per segment. Vectorized over fixed byte positions: iterate
     max_len times over a (n,) lane, cheap because strings are short keys."""
@@ -104,18 +113,25 @@ def partition_indices(arrays: list[pa.Array], num_partitions: int) -> np.ndarray
     return (hash_arrays(arrays) % np.uint64(num_partitions)).astype(np.int64)
 
 
-def split_batch_by_partition(batch: pa.RecordBatch, key_arrays: list[pa.Array], k: int):
+def split_batch_by_partition(batch: pa.RecordBatch, key_arrays: list[pa.Array], k: int,
+                             precomputed_pids: np.ndarray | None = None):
     """Route a batch's rows into K partition sub-batches in one pass.
 
     Uses the native C++ router (hash + counting-sort grouping, then a single
-    Arrow take + zero-copy slices) when available; numpy otherwise.
+    Arrow take + zero-copy slices) when available; numpy otherwise. When the
+    producer already computed partition ids (device-side routing: the TPU
+    stage emits a __pid column via the jax hash twin), they feed the router
+    directly — pid < k, so routing on h=pid with h%k is the identity.
     Yields (partition_id, sub_batch) for non-empty partitions.
     """
     from ballista_tpu.ops import native
 
-    h = native.hash_arrays_native(key_arrays)
-    if h is None:
-        h = hash_arrays(key_arrays)
+    if precomputed_pids is not None:
+        h = precomputed_pids.astype(np.uint64)
+    else:
+        h = native.hash_arrays_native(key_arrays)
+        if h is None:
+            h = hash_arrays(key_arrays)
     routed = native.route_native(h, k)
     if routed is not None:
         _, bounds, order = routed

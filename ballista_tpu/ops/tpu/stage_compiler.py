@@ -262,6 +262,11 @@ class TpuStageExec(ExecutionPlan):
         self.buckets = config.shape_buckets()
         self.fallback_count = 0
         self.tpu_count = 0
+        # device-side shuffle routing: (output-schema key indices, K) set by
+        # the engine when the parent shuffle writer hash-partitions on group
+        # columns; the sorted path then emits a __pid column
+        self.emit_pid: tuple[list[int], int] | None = None
+        self.pid_emitted = 0
         self._results: dict[int, list[pa.RecordBatch]] | None = None
         self._results_lock = threading.Lock()
         # structural fingerprint: identical stages across queries share XLA
@@ -462,10 +467,11 @@ class TpuStageExec(ExecutionPlan):
         dicts = dt.dicts
         dtypes = tuple(str(c.dtype) for c in dt.cols)
 
+        emit_key = (tuple(self.emit_pid[0]), self.emit_pid[1]) if self.emit_pid else None
         key = (
             self.fingerprint, P, N, tuple(kinds), dtypes,
             tuple(_pow2(len(d)) if d else 0 for d in dicts),
-            tuple(b.shape_key() for b in builds),
+            tuple(b.shape_key() for b in builds), emit_key,
         )
         with _COMPILE_LOCK:
             cached = _COMPILE_CACHE.get(key)
@@ -476,7 +482,7 @@ class TpuStageExec(ExecutionPlan):
 
         # device LUTs cached per (table, stage): zero uploads when hot;
         # replicated across the mesh so probe gathers stay local
-        lut_key = (table_key, self.fingerprint, mesh.devices.size if mesh else 0)
+        lut_key = (table_key, self.fingerprint, mesh.devices.size if mesh else 0, emit_key)
         luts = _LUT_CACHE.get(lut_key)
         if luts is None:
             raw_luts = lowering.build_luts(dicts, [b.dicts for b in builds])
@@ -610,16 +616,21 @@ class TpuStageExec(ExecutionPlan):
             # across tables with equal shapes/dict sizes; dict CONTENTS are
             # resolved at decode time, never baked into the cached meta)
             key_slots: list = []
+            key_premeta: list = []  # (kind, scale, dict, slot) | None, PRE-trace
             for g in agg.group_exprs:
                 gc = g.expr if isinstance(g, Alias) else g
                 slot = None
+                gmeta = None
                 if isinstance(gc, Column):
                     i = cur_schema.index_of(gc.name, gc.qualifier)
                     gmeta = ctx.env_meta[i]
                     if gmeta is not None:
                         slot = gmeta[3]
                 key_slots.append(slot)
-            return self._compile_sorted(dt, ctx, P, N, builds, group_fns, agg_fns, key_slots)
+                key_premeta.append(gmeta)
+            return self._compile_sorted(
+                dt, ctx, P, N, builds, group_fns, agg_fns, key_slots, key_premeta
+            )
 
         meta_holder: dict = {}
         aggs = agg.aggs
@@ -752,7 +763,8 @@ class TpuStageExec(ExecutionPlan):
         return jitted, ctx, meta
 
     def _compile_sorted(self, dt: DeviceTable, ctx: Lowering, P: int, N: int,
-                        builds: list[BuildTable], group_fns, agg_fns, key_slots):
+                        builds: list[BuildTable], group_fns, agg_fns, key_slots,
+                        key_premeta):
         """Sort-based segmented reduction for large/int group domains.
 
         The TPU has no fast random scatter, so hash aggregation is out; the
@@ -776,6 +788,33 @@ class TpuStageExec(ExecutionPlan):
         M = P * N * len(lane_sets)
         C = min(_pow2(M), 1 << 22)
         meta_holder: dict = {}
+        # device-side shuffle routing: emit a __pid column over the
+        # compacted output rows (bit-exact twin of ops/hashing.py — string
+        # keys hash via per-dictionary FNV LUTs)
+        emit_keys: list[int] | None = None
+        emit_k = 0
+        emit_luts: dict[int, int] = {}
+        if self.emit_pid is not None:
+            idxs, emit_k = self.emit_pid
+            if all(0 <= i < len(group_fns) for i in idxs) and emit_k > 0:
+                emit_keys = list(idxs)
+                # LUTs MUST register before tracing: lut specs are frozen
+                # when the jitted fn lowers, so trace-time add_lut would
+                # index past the traced argument list
+                from ballista_tpu.ops.hashing import fnv1a_str
+
+                for ki in emit_keys:
+                    pm = key_premeta[ki]
+                    if pm is None:
+                        emit_keys = None
+                        break
+                    if pm[0] == "code":
+                        emit_luts[ki] = ctx.add_lut(
+                            pm[3],
+                            lambda dic: np.array(
+                                [fnv1a_str(x) for x in (dic or [])], dtype=np.uint64
+                            ),
+                        )
 
         def raw(cols, luts, mask, build_args):
             cols = list(cols) + [a for b in build_args for a in b]
@@ -884,6 +923,25 @@ class TpuStageExec(ExecutionPlan):
                     # would difference two near-equal whole-table totals
                     # (catastrophic cancellation for small late segments)
                     agg_outs.append(compact(_segscan(jnp, sv, boundary, d.func)))
+
+            if emit_keys is not None:
+                from ballista_tpu.ops.tpu.kernels import hash64, hash_combine_jax
+
+                h = jnp.zeros((C,), jnp.uint64)
+                for ki in emit_keys:
+                    kind, scale, slot = meta_holder["key_meta"][ki]
+                    arr = key_outs[ki]
+                    if kind == "code":
+                        enc = luts[emit_luts[ki]][arr]
+                    elif kind == "money":
+                        f = arr.astype(jnp.float64) / (10.0 ** scale)
+                        f = jnp.where(f == 0.0, 0.0, f)  # -0.0 normalizes
+                        enc = jax.lax.bitcast_convert_type(f, jnp.uint64)
+                    else:  # i64 / date / bool — value-preserving int64 bits
+                        enc = arr.astype(jnp.int64).astype(jnp.uint64)
+                    h = hash_combine_jax(h, hash64(enc))
+                pid = (h % jnp.uint64(emit_k)).astype(jnp.int32)
+                return tuple(key_outs) + tuple(agg_outs) + (pid, n_seg)
             return tuple(key_outs) + tuple(agg_outs) + (n_seg,)
 
         jitted = jax.jit(raw)
@@ -900,6 +958,7 @@ class TpuStageExec(ExecutionPlan):
             "mode": "sorted",
             "out": meta_holder["out"],
             "key_meta": meta_holder["key_meta"],
+            "emit_pid": emit_keys is not None,
             "C": C,
         }
         return jitted, ctx, meta
@@ -923,8 +982,14 @@ class TpuStageExec(ExecutionPlan):
         results = {p: [_empty_batch(schema)] for p in range(P)}
         if n == 0:
             return results
+        pid_out = None
+        data_outs = outs[:-1]
+        if meta.get("emit_pid"):
+            pid_out = data_outs[-1]
+            data_outs = data_outs[:-1]
         cp = min(_pow2(n), C)  # sliced fetch: pay for actual groups only
-        host = jax.device_get([o[:cp] for o in outs[:-1]])
+        host = jax.device_get([o[:cp] for o in data_outs])
+        pid_host = jax.device_get(pid_out[:cp]) if pid_out is not None else None
         arrays: list[pa.Array] = []
         for kv, (kind, scale, slot), f in zip(host[:n_keys], key_meta, schema):
             vals = kv[:n]
@@ -956,6 +1021,14 @@ class TpuStageExec(ExecutionPlan):
             if arr.type != f.type:
                 arr = arr.cast(f.type)
             arrays.append(arr)
+        if pid_host is not None:
+            # device-routed shuffle: ship the partition ids alongside; the
+            # shuffle writer consumes and drops the __pid column
+            arrays.append(pa.array(pid_host[:n].astype(np.int32), pa.int32()))
+            out_schema = pa.schema(list(schema) + [pa.field("__pid", pa.int32())])
+            self.pid_emitted += 1
+            results[0] = [pa.RecordBatch.from_arrays(arrays, schema=out_schema)]
+            return results
         results[0] = [pa.RecordBatch.from_arrays(arrays, schema=schema)]
         return results
 

@@ -169,8 +169,19 @@ class ShuffleWriterExec(ExecutionPlan):
         for b in self.input.execute(map_partition, ctx):
             if b.num_rows == 0:
                 continue
-            key_arrays = [evaluate_to_array(kb, b) for kb in bound]
-            for k, part in split_batch_by_partition(b, key_arrays, K):
+            pids = None
+            if getattr(self, "device_routed", False) and "__pid" in b.schema.names:
+                # device-side routing: the TPU stage already hashed rows to
+                # partitions (bit-exact twin); consume and drop the column.
+                # Gated on the engine-set flag so a user column named __pid
+                # is never misinterpreted.
+                i = b.schema.get_field_index("__pid")
+                pids = b.column(i).to_numpy(zero_copy_only=False).astype(np.uint64)
+                b = b.select([n for n in b.schema.names if n != "__pid"])
+                key_arrays = []
+            else:
+                key_arrays = [evaluate_to_array(kb, b) for kb in bound]
+            for k, part in split_batch_by_partition(b, key_arrays, K, precomputed_pids=pids):
                 buckets[k].append(part)
                 bucket_rows[k] += part.num_rows
                 bucket_batches[k] += 1

@@ -347,3 +347,64 @@ def test_pallas_fused_aggregation_path():
         list(phys.execute(p, tc))
     assert sum(s.tpu_count for s in stages) >= 1
     assert sum(s.fallback_count for s in stages) == 0
+
+
+def test_device_side_shuffle_routing(tmp_path):
+    """ROADMAP device-side shuffle write: the sorted path emits a __pid
+    column (bit-exact hash twin), the shuffle writer consumes it instead of
+    host hashing, and written buckets match host routing exactly."""
+    import glob
+    import json
+
+    import pyarrow.ipc as ipc
+    import pyarrow.parquet as pq
+
+    import ballista_tpu.ops.tpu.stage_compiler as sc
+    from ballista_tpu.client.context import SessionContext
+    from ballista_tpu.engine.tpu_engine import maybe_compile_tpu
+    from ballista_tpu.ops.hashing import partition_indices
+    from ballista_tpu.plan.physical import TaskContext
+    from ballista_tpu.scheduler.planner import DistributedPlanner
+    from ballista_tpu.shuffle import paths as sp
+
+    rng = np.random.default_rng(5)
+    n = 30_000
+    pq.write_table(pa.table({
+        "k": rng.integers(0, 5000, n),
+        "v": rng.integers(1, 100, n),
+    }), str(tmp_path / "t.parquet"))
+    cfg = BallistaConfig({EXECUTOR_ENGINE: "tpu", TPU_MIN_ROWS: 0})
+    ctx = SessionContext(cfg)
+    ctx.register_parquet("t", str(tmp_path / "t.parquet"))
+    sql = "select k, sum(v) s from t where v > 10 group by k"
+    phys = ctx.create_physical_plan(ctx.sql(sql).plan)
+    stages = DistributedPlanner("jpid").plan_query_stages(phys)
+    stage1 = stages[0]
+    compiled = maybe_compile_tpu(stage1.plan, cfg)
+    tpu = [nd for nd in _walk(compiled) if isinstance(nd, sc.TpuStageExec)]
+    assert tpu and tpu[0].emit_pid is not None
+
+    work = str(tmp_path / "work")
+    tc = TaskContext(cfg, task_id="t0", work_dir=work)
+    for p in range(stage1.partitions):
+        list(compiled.execute(p, tc))
+    assert tpu[0].pid_emitted >= 1
+    assert tpu[0].fallback_count == 0
+
+    checked = 0
+    for f in glob.glob(f"{work}/jpid/1/*.arrow"):
+        idx = json.load(open(sp.index_path(f)))
+        for pid_s, entry in idx.items():
+            off, length = entry[0], entry[1]
+            with open(f, "rb") as fh:
+                fh.seek(off)
+                buf = fh.read(length)
+            tblx = ipc.open_stream(pa.BufferReader(buf)).read_all()
+            assert "__pid" not in tblx.column_names
+            if tblx.num_rows:
+                host = partition_indices(
+                    [tblx.column("k").combine_chunks()], stage1.output_partitions
+                )
+                assert (host == int(pid_s)).all()
+                checked += 1
+    assert checked > 0
