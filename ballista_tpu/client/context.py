@@ -27,6 +27,7 @@ from ballista_tpu.plan.provider import Catalog, MemoryTable, ParquetTable, Table
 from ballista_tpu.sql.ast import (
     CreateExternalTable,
     DropTable,
+    ShowColumns,
     ExplainStmt,
     SelectStmt,
     SetVariable,
@@ -136,6 +137,20 @@ class SessionContext:
         if isinstance(stmt, DropTable):
             self.deregister_table(stmt.name)
             return DataFrame._empty(self, f"dropped table {stmt.name}")
+        if isinstance(stmt, ShowColumns):
+            provider = self.catalog.get(stmt.table)
+            if provider is None:
+                raise PlanningError(f"table not found: {stmt.table}")
+            from ballista_tpu.plan.logical import TableScan
+            from ballista_tpu.plan.provider import MemoryTable as MT
+
+            sch = provider.df_schema()
+            tbl = pa.table({
+                "column_name": pa.array([f.name for f in sch]),
+                "data_type": pa.array([str(f.dtype) for f in sch]),
+                "is_nullable": pa.array(["YES" if f.nullable else "NO" for f in sch]),
+            })
+            return DataFrame(self, TableScan("columns", MT.from_table(tbl)))
         if isinstance(stmt, ShowTables):
             tbl = pa.table({"table_name": pa.array(self.catalog.names())})
             from ballista_tpu.plan.logical import TableScan

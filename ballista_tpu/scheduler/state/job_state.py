@@ -53,10 +53,16 @@ class InMemoryJobState(JobStateStore):
 
 
 class FileJobState(JobStateStore):
-    def __init__(self, state_dir: str):
+    # a live owner refreshes its markers on every checkpoint; an owner file
+    # untouched for longer than this is considered dead and may be adopted
+    # without --force (the lease expiry the reference stubs)
+    LEASE_S = 600.0
+
+    def __init__(self, state_dir: str, lease_s: float | None = None):
         self.dir = state_dir
         os.makedirs(state_dir, exist_ok=True)
         self._lock = threading.Lock()
+        self.lease_s = self.LEASE_S if lease_s is None else lease_s
 
     def _graph_path(self, job_id: str) -> str:
         return os.path.join(self.dir, f"{job_id}.graph")
@@ -69,6 +75,11 @@ class FileJobState(JobStateStore):
 
         data = graph.to_proto().SerializeToString()
         path = self._graph_path(graph.job_id)
+        # refresh the ownership lease alongside the checkpoint
+        try:
+            os.utime(self._owner_path(graph.job_id))
+        except OSError:
+            pass
         with self._lock:
             # unique tmp name: two scheduler PROCESSES (forced takeover with
             # a partitioned old owner) must never interleave into one file
@@ -127,10 +138,19 @@ class FileJobState(JobStateStore):
                 return self.acquire(job_id, scheduler_id, force)
             if owner == scheduler_id:
                 return True
-            if force:
+            try:
+                import time as _time
+
+                stale = (_time.time() - os.path.getmtime(path)) > self.lease_s
+            except OSError:
+                stale = True
+            if force or stale:
                 with open(path, "w") as f:
                     f.write(scheduler_id)
-                log.info("job %s ownership forced from %s to %s", job_id, owner, scheduler_id)
+                log.info(
+                    "job %s ownership %s from %s to %s", job_id,
+                    "forced" if force else "adopted (lease expired)", owner, scheduler_id,
+                )
                 return True
             return False
 

@@ -73,6 +73,11 @@ class DropTable:
 
 
 @dataclass
+class ShowColumns:
+    table: str
+
+
+@dataclass
 class ShowTables:
     pass
 

@@ -44,6 +44,7 @@ from ballista_tpu.sql.ast import (
     CreateExternalTable,
     DerivedTable,
     DropTable,
+    ShowColumns,
     ExplainStmt,
     JoinClause,
     SelectStmt,
@@ -152,8 +153,16 @@ class Parser:
             return DropTable(self.expect_ident(), if_exists)
         if t.is_kw("SHOW"):
             self.next()
+            if self.accept_kw("COLUMNS"):
+                # SHOW COLUMNS FROM t
+                if not self.accept_kw("FROM"):
+                    self.expect_kw("IN")
+                return ShowColumns(self.expect_ident())
             self.expect_kw("TABLES")
             return ShowTables()
+        if t.kind == "ident" and t.value.upper() == "DESCRIBE":
+            self.next()
+            return ShowColumns(self.expect_ident())
         if t.is_kw("SET"):
             self.next()
             key = self._parse_dotted_name()

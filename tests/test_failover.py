@@ -130,3 +130,17 @@ def test_corrupt_graph_quarantined_not_fatal(tmp_path):
     assert store.load_graph("badjob") is None
     assert os.path.exists(os.path.join(str(tmp_path), "badjob.graph.bad"))
     assert "badjob" not in store.list_jobs()
+
+
+def test_owner_lease_expiry(tmp_path):
+    """A dead owner's lease expires: standby adopts without force; live
+    owners keep refreshing the lease on every checkpoint."""
+    import time
+
+    from ballista_tpu.scheduler.state.job_state import FileJobState
+
+    s = FileJobState(str(tmp_path), lease_s=0.3)
+    assert s.acquire("j", "dead-sched")
+    assert not s.acquire("j", "standby")
+    time.sleep(0.4)
+    assert s.acquire("j", "standby")

@@ -110,3 +110,17 @@ def test_mixed_union_chain_left_associative():
         "select v from t union select v from u union all select v from w order by v"
     ).collect().to_pandas()
     assert out2.v.tolist() == [1, 2, 2]
+
+
+def test_show_columns_and_describe():
+    import pyarrow as pa
+
+    from ballista_tpu.client.context import SessionContext
+
+    ctx = SessionContext()
+    ctx.register_arrow_table("t", pa.table({"a": [1], "b": ["x"]}))
+    out = ctx.sql("show columns from t").collect().to_pandas()
+    assert out.column_name.tolist() == ["a", "b"]
+    assert out.data_type.tolist()[0].startswith("int")
+    out2 = ctx.sql("describe t").collect().to_pandas()
+    assert out2.column_name.tolist() == ["a", "b"]
