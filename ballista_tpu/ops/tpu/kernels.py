@@ -134,6 +134,56 @@ class Lowering:
 LoweredFn = Callable[[list, list], DevVal]  # (cols, luts) -> DevVal
 
 
+def _string_expr_fn(e: Expr, ctx: "Lowering"):
+    """Recognize pure string-function trees over ONE dictionary column
+    (substr/upper/lower/trim with literal args). Returns (base Column,
+    col_index, str→str fn) — predicates over such trees compose into the
+    column's host-side dictionary LUT, so strings never reach the device
+    (the substring(c_phone,..) IN (...) pattern of q22 and TPC-DS)."""
+    if isinstance(e, Alias):
+        return _string_expr_fn(e.expr, ctx)
+    if isinstance(e, Column):
+        i = ctx.col_index(e)
+        if ctx.kinds[i][0] == "code":
+            return e, i, lambda s: s
+        return None
+    if isinstance(e, ScalarFunction) and e.name in ("substr", "upper", "lower", "trim"):
+        inner = _string_expr_fn(e.args[0], ctx) if e.args else None
+        if inner is None:
+            return None
+        col, i, f = inner
+        extra = e.args[1:]
+        if not all(isinstance(a, Literal) for a in extra):
+            return None
+        vals = [a.value for a in extra]
+        name = e.name
+        if name in ("upper", "lower", "trim") and extra:
+            # BTRIM(col, chars) etc. — semantics we don't model: stay on cpu
+            return None
+        if name == "substr":
+            if not vals or not all(isinstance(v, int) for v in vals):
+                return None
+            if vals[0] < 1:
+                return None  # SQL start<1 clamps; python would wrap
+            start = vals[0] - 1
+            end = start + vals[1] if len(vals) > 1 else None
+
+            def g(s, f=f, start=start, end=end):
+                t = f(s)
+                return t[start:end] if end is not None else t[start:]
+        elif name == "upper":
+            def g(s, f=f):
+                return f(s).upper()
+        elif name == "lower":
+            def g(s, f=f):
+                return f(s).lower()
+        else:  # trim
+            def g(s, f=f):
+                return f(s).strip()
+        return col, i, g
+    return None
+
+
 def lower_expr(e: Expr, ctx: Lowering) -> LoweredFn:
     jnp_mod = None  # resolved lazily inside closures
 
@@ -169,18 +219,17 @@ def lower_expr(e: Expr, ctx: Lowering) -> LoweredFn:
         # string equality over dictionary columns → host LUT, device gather
         if e.op in ("=", "<>"):
             for a, b in ((e.left, e.right), (e.right, e.left)):
-                if (
-                    isinstance(a, Column)
-                    and isinstance(b, Literal)
-                    and isinstance(b.value, str)
-                ):
-                    i = ctx.col_index(a)
-                    if ctx.kinds[i][0] == "code":
-                        src = lower_expr(a, ctx)
+                if isinstance(b, Literal) and isinstance(b.value, str):
+                    hit = _string_expr_fn(a, ctx)
+                    if hit is not None:
+                        col, i, sfn = hit
+                        src = lower_expr(col, ctx)
                         val = b.value
                         li = ctx.add_lut(
                             ctx.slots[i],
-                            lambda dic, val=val: np.array([x == val for x in dic], dtype=bool),
+                            lambda dic, val=val, sfn=sfn: np.array(
+                                [sfn(x) == val for x in dic], dtype=bool
+                            ),
                         )
                         neg = e.op == "<>"
 
@@ -227,27 +276,32 @@ def lower_expr(e: Expr, ctx: Lowering) -> LoweredFn:
         return run
 
     if isinstance(e, InList):
+        # string-fn trees over a code column compose into the dictionary LUT
+        hit = _string_expr_fn(e.expr, ctx)
+        if hit is not None and all(isinstance(v, str) for v in e.values):
+            col, i, sfn = hit
+            src = lower_expr(col, ctx)
+            values = set(e.values)
+            li = ctx.add_lut(
+                ctx.slots[i],
+                lambda dic, values=values, sfn=sfn: np.array(
+                    [sfn(x) in values for x in dic], dtype=bool
+                ),
+            )
+            neg = e.negated
+
+            def run(cols, luts):
+                codes = src(cols, luts).arr
+                out = luts[li][codes]
+                return DevVal("bool", ~out if neg else out)
+
+            return run
         inner = lower_expr(e.expr, ctx)
-        # resolved per-dictionary at closure build; only code/i64 supported
         if isinstance(e.expr, (Column, Alias)):
             col = e.expr.expr if isinstance(e.expr, Alias) else e.expr
             i = ctx.col_index(col)
             kind, _ = ctx.kinds[i]
             src = inner
-            if kind == "code":
-                values = set(e.values)
-                li = ctx.add_lut(
-                    ctx.slots[i],
-                    lambda dic, values=values: np.array([x in values for x in dic], dtype=bool),
-                )
-                neg = e.negated
-
-                def run(cols, luts):
-                    codes = src(cols, luts).arr
-                    out = luts[li][codes]
-                    return DevVal("bool", ~out if neg else out)
-
-                return run
             if kind in ("i64", "date"):
                 vals = list(e.values)
                 neg = e.negated

@@ -408,3 +408,26 @@ def test_device_side_shuffle_routing(tmp_path):
                 assert (host == int(pid_s)).all()
                 checked += 1
     assert checked > 0
+
+
+def test_q22_string_fn_filter_on_device(tpu_ctx, tpch_ref_tables):
+    """substring(c_phone,..) IN (...) composes into the dictionary LUT:
+    q22's scalar-subquery stage runs on device with a correct result."""
+    import ballista_tpu.ops.tpu.stage_compiler as sc
+    from ballista_tpu.engine.tpu_engine import maybe_compile_tpu
+    from ballista_tpu.plan.physical import TaskContext
+
+    eng = tpu_ctx.sql(tpch_query(22)).collect()
+    problems = compare_results(eng, run_reference(22, tpch_ref_tables), 22)
+    assert not problems, "\n".join(problems)
+
+    phys = maybe_compile_tpu(
+        tpu_ctx.create_physical_plan(tpu_ctx.sql(tpch_query(22)).plan), tpu_ctx.config
+    )
+    stages = [n for n in _walk(phys) if isinstance(n, sc.TpuStageExec)]
+    assert stages
+    ctx = TaskContext(tpu_ctx.config)
+    for p in range(phys.output_partition_count()):
+        list(phys.execute(p, ctx))
+    assert sum(s.tpu_count for s in stages) >= 1
+    assert sum(s.fallback_count for s in stages) == 0
