@@ -27,6 +27,7 @@ from ballista_tpu.serde_control import encode_task_definition
 log = logging.getLogger(__name__)
 
 EXPIRY_CHECK_S = 15.0
+RESUBMIT_CHECK_S = 3.0
 
 
 class GrpcTaskLauncher(TaskLauncher):
@@ -140,8 +141,12 @@ class SchedulerProcess:
         log.info("scheduler up: grpc=%d rest=%s", self.port, self.rest_port or "off")
 
     def _expiry_loop(self) -> None:
-        while not self._stopping.wait(EXPIRY_CHECK_S):
-            self.scheduler.check_expired_executors()
+        ticks = 0
+        while not self._stopping.wait(RESUBMIT_CHECK_S):
+            ticks += 1
+            self.scheduler.resubmit_stuck_jobs()
+            if ticks % int(EXPIRY_CHECK_S / RESUBMIT_CHECK_S) == 0:
+                self.scheduler.check_expired_executors()
 
     def shutdown(self) -> None:
         self._stopping.set()

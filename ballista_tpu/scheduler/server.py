@@ -289,6 +289,21 @@ class SchedulerServer:
             log.warning("executor %s expired (no heartbeat)", eid)
             self.post(Event("executor_lost", eid))
 
+    def resubmit_stuck_jobs(self) -> None:
+        """ballista.scheduler.job.resubmit.interval.ms: periodically re-offer
+        jobs holding runnable-but-unscheduled tasks (missed offers, executors
+        that freed slots without an event, scale-out while idle) — the
+        reference's job-resubmit behavior for jobs that couldn't schedule."""
+        from ballista_tpu.config import JOB_RESUBMIT_INTERVAL_MS
+
+        with self._jobs_lock:
+            running = [g for g in self.jobs.values() if g.status is JobState.RUNNING]
+        for g in running:
+            interval = int(g.config.get(JOB_RESUBMIT_INTERVAL_MS))
+            if interval > 0 and g.available_task_count() > 0:
+                self.post(Event("revive"))
+                return
+
     # -- job control ---------------------------------------------------------------------
 
     def _cancel_job(self, job_id: str) -> None:
