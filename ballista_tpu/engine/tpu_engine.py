@@ -107,9 +107,11 @@ def _match_chain(node: ExecutionPlan):
         if (
             isinstance(cur, HashJoinExec)
             and cur.mode == "collect_left"
-            and cur.join_type == "inner"
+            and cur.join_type in ("inner", "right_semi", "right_anti")
             and cur.filter is None
         ):
+            # inner: build-column gathers join the chain; right_semi/right_anti
+            # emit probe rows only — the match mask IS the filter
             ops.append(cur)
             cur = cur.right  # probe side continues the device chain
             continue

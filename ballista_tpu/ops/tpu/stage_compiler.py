@@ -385,7 +385,9 @@ class TpuStageExec(ExecutionPlan):
                 shifts.append(shift)
         uniq, counts = np.unique(key_np, return_counts=True)
         dup = int(counts.max())
-        if dup > MAX_JOIN_DUP:
+        if dup > MAX_JOIN_DUP and join.join_type not in ("right_semi", "right_anti"):
+            # semi/anti probes only test membership — multiplicity never
+            # unrolls lanes, so any dup count is fine there
             raise Unsupported(f"build key multiplicity {dup} > {MAX_JOIN_DUP}")
 
         max_key = int(key_np.max())
@@ -424,16 +426,20 @@ class TpuStageExec(ExecutionPlan):
             mode = "sorted"
 
         kinds, scales, dicts, payloads = [], [], [], []
-        for name in batch.schema.names:
-            dc = encode_column(batch.column(batch.schema.get_field_index(name)))
-            if dc is None:
-                raise Unsupported(f"unencodable build column {name}")
-            kinds.append(dc.kind)
-            scales.append(dc.scale)
-            dicts.append(dc.dictionary)
-            padded = np.zeros(B, dtype=dc.data.dtype)
-            padded[: len(order)] = dc.data[order]
-            payloads.append(padded)
+        if join.join_type not in ("right_semi", "right_anti"):
+            # membership-only joins never gather build columns: skip payload
+            # encode/upload entirely (an unencodable non-key column must not
+            # knock a semi join off the device)
+            for name in batch.schema.names:
+                dc = encode_column(batch.column(batch.schema.get_field_index(name)))
+                if dc is None:
+                    raise Unsupported(f"unencodable build column {name}")
+                kinds.append(dc.kind)
+                scales.append(dc.scale)
+                dicts.append(dc.dictionary)
+                padded = np.zeros(B, dtype=dc.data.dtype)
+                padded[: len(order)] = dc.data[order]
+                payloads.append(padded)
 
         bt = BuildTable(
             mode, _put(mesh, keys_dev), [_put(mesh, p) for p in payloads],
@@ -524,6 +530,7 @@ class TpuStageExec(ExecutionPlan):
             filter_fns.append(lower_expr(f, ctx))
 
         lane_cells = [{"d": 0} for _ in builds]
+        lane_dups: list[int] = []  # per build: lanes to unroll (1 for semi/anti)
         jidx = 0
         for op in self.ops:
             _bind_env(ctx, cur_schema)
@@ -536,7 +543,20 @@ class TpuStageExec(ExecutionPlan):
                 pay_off = off + (2 if bt.cnt is not None else 1)
                 probe_fns = [lower_expr(r, ctx) for (_, r) in op.on]
                 finder = _mk_join_finder(off, probe_fns, bt, lane_cells[jidx])
+                if op.join_type in ("right_semi", "right_anti"):
+                    # membership only: the match mask filters probe rows
+                    # (EXISTS / NOT IN after decorrelation) — no build
+                    # columns, no expansion lanes, schema unchanged
+                    neg = op.join_type == "right_anti"
+                    filter_fns.append(
+                        lambda cols, luts, _f=finder, _n=neg:
+                        DevVal("bool", ~_f(cols, luts)[1].arr if _n else _f(cols, luts)[1].arr)
+                    )
+                    lane_dups.append(1)
+                    jidx += 1
+                    continue
                 filter_fns.append(lambda cols, luts, _f=finder: _f(cols, luts)[1])
+                lane_dups.append(bt.dup)
                 build_fns = [
                     _mk_build_gather(pay_off, ci, bt.kinds[ci], bt.scales[ci], bt.dicts[ci], finder)
                     for ci in range(len(bt.payloads))
@@ -563,7 +583,7 @@ class TpuStageExec(ExecutionPlan):
                 raise Unsupported(f"op {type(op).__name__}")
         _bind_env(ctx, cur_schema)
         ctx.stage_filter_fns = filter_fns  # shared with the sorted path
-        lane_sets = list(itertools.product(*[range(b.dup) for b in builds]))
+        lane_sets = list(itertools.product(*[range(d) for d in lane_dups]))
         if len(lane_sets) > MAX_JOIN_DUP:
             raise Unsupported(f"{len(lane_sets)} expansion-join lanes > {MAX_JOIN_DUP}")
         ctx.lane_sets = lane_sets

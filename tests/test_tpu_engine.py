@@ -431,3 +431,53 @@ def test_q22_string_fn_filter_on_device(tpu_ctx, tpch_ref_tables):
         list(phys.execute(p, ctx))
     assert sum(s.tpu_count for s in stages) >= 1
     assert sum(s.fallback_count for s in stages) == 0
+
+
+def test_semi_and_anti_joins_on_device(tmp_path):
+    """IN / NOT IN subqueries (decorrelated to right_semi / right_anti
+    collect_left joins) run on device: the probe's match mask is the
+    filter — no build gathers, no expansion lanes, duplicate membership
+    keys fine."""
+    import pyarrow.parquet as pq
+
+    import ballista_tpu.ops.tpu.stage_compiler as sc
+    from ballista_tpu.client.context import SessionContext
+    from ballista_tpu.engine.tpu_engine import maybe_compile_tpu
+    from ballista_tpu.plan.physical import TaskContext
+
+    rng = np.random.default_rng(8)
+    n = 30_000
+    pq.write_table(pa.table({
+        "k": rng.integers(0, 5000, n), "g": rng.choice(["a", "b", "c"], n),
+        "v": rng.integers(1, 100, n),
+    }), str(tmp_path / "fact.parquet"))
+    # duplicate count 20 > MAX_JOIN_DUP: membership joins must not trip the
+    # expansion-lane cap (semi/anti never unroll lanes)
+    pq.write_table(
+        pa.table({"mk": np.repeat(rng.choice(5000, 800, replace=False), 20)}),
+        str(tmp_path / "member.parquet"),
+    )
+    cfg = BallistaConfig({EXECUTOR_ENGINE: "tpu", TPU_MIN_ROWS: 0})
+    ctx = SessionContext(cfg)
+    ctx.register_parquet("fact", str(tmp_path / "fact.parquet"))
+    ctx.register_parquet("member", str(tmp_path / "member.parquet"))
+    f = pq.read_table(str(tmp_path / "fact.parquet")).to_pandas()
+    m = set(pq.read_table(str(tmp_path / "member.parquet")).to_pandas().mk)
+    for sql, sel in [
+        ("select g, sum(v) s, count(*) c from fact where k in (select mk from member) "
+         "group by g order by g", f[f.k.isin(m)]),
+        ("select g, sum(v) s, count(*) c from fact where k not in (select mk from member) "
+         "group by g order by g", f[~f.k.isin(m)]),
+    ]:
+        out = ctx.sql(sql).collect().to_pandas()
+        g = sel.groupby("g").agg(s=("v", "sum"), c=("v", "size")).reset_index().sort_values("g")
+        assert out.s.tolist() == g.s.tolist()
+        assert out.c.tolist() == g.c.tolist()
+        phys = maybe_compile_tpu(ctx.create_physical_plan(ctx.sql(sql).plan), cfg)
+        stages = [nd for nd in _walk(phys) if isinstance(nd, sc.TpuStageExec)]
+        assert stages
+        tc = TaskContext(cfg)
+        for p in range(phys.output_partition_count()):
+            list(phys.execute(p, tc))
+        assert sum(s.tpu_count for s in stages) >= 1
+        assert sum(s.fallback_count for s in stages) == 0
