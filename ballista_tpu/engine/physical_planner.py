@@ -446,11 +446,25 @@ _EST_CACHE: dict[int, float] = {}
 
 
 def estimate_rows(node: LogicalPlan) -> float:
+    # id()-keyed memo MUST validate identity: CPython recycles addresses,
+    # so a freed plan node's id can alias a new node and return a stale
+    # estimate (observed as join-mode flapping between runs). The weakref
+    # proves the cached entry belongs to THIS object.
+    import weakref
+
     key = id(node)
-    if key in _EST_CACHE:
-        return _EST_CACHE[key]
+    hit = _EST_CACHE.get(key)
+    if hit is not None and hit[1]() is node:
+        return hit[0]
     v = _estimate(node)
-    _EST_CACHE[key] = v
+    try:
+        ref = weakref.ref(node)
+    except TypeError:  # un-weakrefable: skip caching
+        return v
+    if len(_EST_CACHE) > 4096:
+        for k in [k for k, (_, r) in _EST_CACHE.items() if r() is None]:
+            _EST_CACHE.pop(k, None)
+    _EST_CACHE[key] = (v, ref)
     return v
 
 

@@ -187,7 +187,10 @@ class DeviceTableCache:
                 for p in scan.partitions
             )
             return (files, tuple(scan.projection))
-        return (id(scan),)
+        token = getattr(scan, "mem_token", None)
+        if token is not None:
+            return ("mem", token)  # monotonic: never aliases like id() does
+        return ("obj", id(scan), id(type(scan)))
 
     def _load(self, scan, buckets: list[int], ctx, mesh=None) -> DeviceTable:
         import concurrent.futures as fut

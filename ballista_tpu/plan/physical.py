@@ -258,11 +258,16 @@ def _align_batch(batch: pa.RecordBatch, schema: pa.Schema) -> pa.RecordBatch:
     return pa.RecordBatch.from_arrays(cols, schema=schema)
 
 
+_MEM_SCAN_COUNTER = iter(range(1, 1 << 62))
+
+
 class MemoryScanExec(ExecutionPlan):
     def __init__(self, df_schema: DFSchema, batches: list[pa.RecordBatch], partitions: int = 1):
         super().__init__(df_schema)
         self.batches = batches
         self.partitions = max(1, partitions)
+        # collision-free cache identity (id() recycles addresses)
+        self.mem_token = next(_MEM_SCAN_COUNTER)
 
     def output_partition_count(self) -> int:
         return self.partitions

@@ -157,14 +157,19 @@ class FetchGovernor:
         self.total.release()
 
 
-_GOV_CACHE: dict[int, FetchGovernor] = {}
+_GOV_CACHE: dict[tuple, FetchGovernor] = {}
 _GOV_LOCK = threading.Lock()
 
 
 def _governor(ctx: TaskContext) -> FetchGovernor:
     from ballista_tpu.config import SHUFFLE_READER_MAX_BYTES
 
-    key = id(ctx.config)
+    # limits-derived key (id() aliases recycled addresses across configs)
+    key = (
+        int(ctx.config.get(SHUFFLE_READER_MAX_REQUESTS)),
+        int(ctx.config.get(SHUFFLE_READER_MAX_PER_ADDR)),
+        int(ctx.config.get(SHUFFLE_READER_MAX_BYTES)),
+    )
     with _GOV_LOCK:
         g = _GOV_CACHE.get(key)
         if g is None:
