@@ -136,13 +136,11 @@ class SqlPlanner:
         agg_funcs = _collect_aggs(projections + ([having] if having is not None else []))
 
         if stmt.grouping_sets is not None:
-            if _collect_windows(projections):
-                raise PlanningError("window functions over GROUPING SETS are unsupported")
             plan = self._plan_grouping_sets(
                 plan, stmt.grouping_sets, group_exprs, agg_funcs, projections, having
             )
             # first branch's projection stands in for ORDER BY resolution
-            proj = plan.inputs[0]
+            proj = plan.inputs[0] if isinstance(plan, Union) else plan
         else:
             if group_exprs or agg_funcs:
                 agg = Aggregate(plan, group_exprs, agg_funcs)
@@ -199,23 +197,36 @@ class SqlPlanner:
 
     def _plan_grouping_sets(self, plan: LogicalPlan, sets: list[list[int]],
                             group_exprs: list[Expr], agg_funcs: list[Expr],
-                            projections: list[Expr], having) -> Union:
+                            projections: list[Expr], having) -> LogicalPlan:
         """ROLLUP/CUBE/GROUPING SETS lowering: one Aggregate branch per
         grouping set, grouped-out keys projected as typed NULLs, branches
         UNION ALLed (the standard expansion; DataFusion lowers the same
         way behind the reference)."""
-        from ballista_tpu.plan.expressions import Cast
+        from ballista_tpu.plan.expressions import Cast, ScalarFunction
+
+        # window exprs compute AFTER the union (over all grouping-set rows);
+        # inside branches they are replaced by their (aggregate) inputs'
+        # outputs, referenced by name post-union
+        window_exprs = _collect_windows(projections)
 
         branches: list[LogicalPlan] = []
         for s in sets:
             set_exprs = [group_exprs[i] for i in s]
             dropped = [g for i, g in enumerate(group_exprs) if i not in s]
 
-            def null_out(e: Expr) -> Expr:
-                # only the OUTPUT keys become NULL: aggregate arguments keep
-                # seeing real values (SQL grouping-sets semantics), and the
-                # agg subtree must stay structurally identical for
-                # _rewrite_post_agg to match it
+            def per_branch(e: Expr) -> Expr:
+                # grouping(col): 1 when col is grouped-out in this set
+                # (constant per branch — the SQL grouping() marker fn);
+                # grouped-out OUTPUT keys become typed NULLs; aggregate
+                # arguments keep seeing real values and agg subtrees stay
+                # structurally identical for _rewrite_post_agg to match
+                if isinstance(e, ScalarFunction) and e.name == "grouping" and len(e.args) == 1:
+                    arg = e.args[0]
+                    if any(_group_key_matches(arg, d) for d in dropped):
+                        return Literal(1)
+                    if any(_group_key_matches(arg, g) for g in group_exprs):
+                        return Literal(0)
+                    raise PlanningError(f"grouping({arg}) is not a GROUP BY expression")
                 if isinstance(e, AggregateFunction):
                     return e
                 for d in dropped:
@@ -223,22 +234,89 @@ class SqlPlanner:
                         return Cast(Literal(None), d.data_type(plan.schema))
                 kids = e.children()
                 if kids:
-                    new_kids = [null_out(k) for k in kids]
+                    new_kids = [per_branch(k) for k in kids]
                     if new_kids != kids:
                         return e.with_children(new_kids)
                 return e
 
             node: LogicalPlan = Aggregate(plan, set_exprs, agg_funcs)
             if having is not None:
-                node = Filter(node, _rewrite_post_agg(null_out(having), set_exprs, agg_funcs))
+                node = Filter(node, _rewrite_post_agg(per_branch(having), set_exprs, agg_funcs))
             branch_projs: list[Expr] = []
             for p in projections:
                 name = p.name if isinstance(p, Alias) else p.output_name()
-                pe = _rewrite_post_agg(null_out(p.expr if isinstance(p, Alias) else p),
-                                       set_exprs, agg_funcs)
+                inner = p.expr if isinstance(p, Alias) else p
+                if window_exprs:
+                    inner = _strip_windows(inner)
+                pe = _rewrite_post_agg(per_branch(inner), set_exprs, agg_funcs)
                 branch_projs.append(Alias(pe, name))
             branches.append(Projection(node, branch_projs))
-        return Union(branches, all=True)
+        out: LogicalPlan = Union(branches, all=True)
+
+        if window_exprs:
+            # rebuild the window exprs against the UNION output (aggregate
+            # and grouped-key references resolve by projection name), wrap a
+            # Window node, and project the final select list
+            name_of = {}
+            for p in projections:
+                name = p.name if isinstance(p, Alias) else p.output_name()
+                inner = p.expr if isinstance(p, Alias) else p
+                name_of[str(_strip_windows(inner))] = name
+
+            def to_union_cols(e: Expr) -> Expr:
+                key = str(e)
+                if key in name_of:
+                    return Column(name_of[key])
+                # unresolvable aggregate/grouping markers must error BEFORE
+                # child remapping could disguise them as evaluable exprs
+                if isinstance(e, AggregateFunction) or (
+                    isinstance(e, ScalarFunction) and e.name == "grouping"
+                ):
+                    raise PlanningError(
+                        f"window input {e} must appear in the SELECT list when "
+                        "windowing over GROUPING SETS"
+                    )
+                kids = e.children()
+                if kids:
+                    nk = [to_union_cols(k) for k in kids]
+                    if nk != kids:
+                        return e.with_children(nk)
+                return e
+
+            uwindows = []
+            for w in window_exprs:
+                uwindows.append(WindowFunction(
+                    w.func,
+                    tuple(to_union_cols(a) for a in w.args),
+                    tuple(to_union_cols(pb) for pb in w.partition_by),
+                    tuple(SortKey(to_union_cols(k.expr), k.ascending, k.nulls_first)
+                          for k in w.order_by),
+                    w.frame,
+                ))
+            win = Window(out, uwindows)
+            final_projs: list[Expr] = []
+            for p in projections:
+                name = p.name if isinstance(p, Alias) else p.output_name()
+                inner = p.expr if isinstance(p, Alias) else p
+
+                def repl(x: Expr) -> Expr:
+                    if isinstance(x, WindowFunction):
+                        return Column(f"__win{window_exprs.index(x)}")
+                    return x
+
+                mapped = transform_expr(inner, repl)
+                # non-window parts now reference the union columns by name
+                def nonwin(x: Expr) -> Expr:
+                    key = str(x)
+                    if key in name_of and not isinstance(x, Column):
+                        return Column(name_of[key])
+                    return x
+
+                mapped = transform_expr(mapped, nonwin)
+                _assert_fully_resolved(mapped)
+                final_projs.append(Alias(mapped, name))
+            out = Projection(win, final_projs)
+        return out
 
     def _resolve_order_expr(self, e: Expr, proj: Projection, cte_env) -> Expr:
         out_schema = proj.schema
@@ -302,6 +380,48 @@ class SqlPlanner:
 
 
 # -- helpers ----------------------------------------------------------------
+
+
+def _group_key_matches(arg: Expr, key: Expr) -> bool:
+    """grouping() argument vs a GROUP BY expression: structural equality,
+    with qualifier-tolerant Column matching (grouping(t.a) vs GROUP BY a)."""
+    if arg == key:
+        return True
+    if isinstance(arg, Column) and isinstance(key, Column) and arg.name == key.name:
+        return arg.qualifier is None or key.qualifier is None or arg.qualifier == key.qualifier
+    return False
+
+
+def _assert_fully_resolved(e: Expr) -> None:
+    """Post-union projections must not retain aggregate/grouping nodes —
+    they are only evaluable inside the per-set branches."""
+    from ballista_tpu.plan.expressions import ScalarFunction
+
+    if isinstance(e, AggregateFunction) or (
+        isinstance(e, ScalarFunction) and e.name == "grouping"
+    ):
+        raise PlanningError(
+            f"{e} must appear in the SELECT list to be referenced alongside a "
+            "window over GROUPING SETS"
+        )
+    for c in e.children():
+        _assert_fully_resolved(c)
+
+
+def _strip_windows(e: Expr) -> Expr:
+    """Inside grouping-set branches a window expr contributes nothing —
+    replace with a typed NULL placeholder (the post-union Window recomputes
+    the real value; the final projection overwrites this column)."""
+    from ballista_tpu.plan.expressions import Cast
+
+    def repl(x: Expr) -> Expr:
+        if isinstance(x, WindowFunction):
+            import pyarrow as _pa
+
+            return Cast(Literal(None), _pa.float64())
+        return x
+
+    return transform_expr(e, repl)
 
 
 def _collect_windows(exprs: list[Expr]) -> list[Expr]:

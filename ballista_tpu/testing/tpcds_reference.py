@@ -138,6 +138,32 @@ def run_reference(q: int, t: dict[str, pd.DataFrame]) -> pd.DataFrame:
         g["revenueratio"] = g.itemrevenue * 100.0 / class_tot
         return g.sort_values(["i_category", "i_class", "i_item_id", "i_item_desc", "revenueratio"]
                              ).head(100).reset_index(drop=True)
+    if q == 36:
+        st = t["store"]
+        m = ss.merge(dd[dd.d_year == 2001], left_on="ss_sold_date_sk", right_on="d_date_sk")
+        m = m.merge(it, left_on="ss_item_sk", right_on="i_item_sk")
+        m = m.merge(st[st.s_state.isin(["TN", "TX", "SD", "IN", "GA", "OH", "MI", "MT"])],
+                    left_on="ss_store_sk", right_on="s_store_sk")
+
+        def gm(g):
+            return g.ss_net_profit.sum() / g.ss_ext_sales_price.sum()
+
+        rows = []
+        full = m.groupby(["i_category", "i_class"])
+        for (cat, cls), g in full:
+            rows.append((gm(g), cat, cls, 0))
+        for cat, g in m.groupby("i_category"):
+            rows.append((gm(g), cat, None, 1))
+        rows.append((gm(m), None, None, 2))
+        out = pd.DataFrame(rows, columns=["gross_margin", "i_category", "i_class", "lochierarchy"])
+        out["rank_within_parent"] = (
+            out.groupby("lochierarchy")["gross_margin"].rank(method="min").astype(int)
+        )
+        out = out.sort_values(
+            ["lochierarchy", "i_category", "i_class"],
+            ascending=[False, True, True], na_position="first",
+        ).head(100).reset_index(drop=True)
+        return out
     if q == 33:
         ca = t["customer_address"]
         out_frames = []
@@ -184,13 +210,21 @@ def compare_results(engine_table, ref: pd.DataFrame, q: int) -> list[str]:
     o = out.sort_values(list(out.columns), kind="stable").reset_index(drop=True)
     r = r.sort_values(list(r.columns), kind="stable").reset_index(drop=True)
     for c in o.columns:
-        a, b = o[c].values, r[c].values
+        sa, sb = o[c], r[c]
+        na_a, na_b = pd.isna(sa).values, pd.isna(sb).values
+        a, b = sa.values, sb.values
         try:
-            if np.asarray(a).dtype.kind == "f" or np.asarray(b).dtype.kind == "f":
-                ok = np.allclose(np.asarray(a, float), np.asarray(b, float),
-                                 rtol=1e-6, atol=1e-6, equal_nan=True)
+            if not (na_a == na_b).all():
+                ok = False
+            elif np.asarray(a).dtype.kind == "f" or np.asarray(b).dtype.kind == "f":
+                ok = np.allclose(
+                    np.asarray(a, float), np.asarray(b, float),
+                    rtol=1e-6, atol=1e-6, equal_nan=True,
+                )
             else:
-                ok = (a == b).all()
+                # nulls already matched positionally; compare the rest
+                # (None vs np.nan representations must not differ)
+                ok = (a[~na_a] == b[~na_b]).all()
         except (TypeError, ValueError):
             ok = list(a) == list(b)
         if not ok:

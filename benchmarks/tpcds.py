@@ -18,7 +18,7 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-QUERIES = [3, 7, 19, 33, 42, 52, 55, 68, 73, 96, 98]
+QUERIES = [3, 7, 19, 33, 36, 42, 52, 55, 68, 73, 96, 98]
 
 
 def q_path(n: int) -> str:

@@ -1,0 +1,14 @@
+SELECT sum(ss_net_profit) / sum(ss_ext_sales_price) AS gross_margin,
+       i_category, i_class,
+       grouping(i_category) + grouping(i_class) AS lochierarchy,
+       rank() OVER (PARTITION BY grouping(i_category) + grouping(i_class)
+                    ORDER BY sum(ss_net_profit) / sum(ss_ext_sales_price) ASC) AS rank_within_parent
+FROM store_sales, date_dim, item, store
+WHERE d_year = 2001
+  AND d_date_sk = ss_sold_date_sk
+  AND i_item_sk = ss_item_sk
+  AND s_store_sk = ss_store_sk
+  AND s_state IN ('TN', 'TX', 'SD', 'IN', 'GA', 'OH', 'MI', 'MT')
+GROUP BY ROLLUP(i_category, i_class)
+ORDER BY lochierarchy DESC, i_category, i_class
+LIMIT 100;

@@ -135,3 +135,22 @@ def test_soft_keywords_stay_identifiers():
     assert out.sets.tolist() == [1, 2]
     assert out["cube"].tolist() == [3, 4]
     assert out["rollup"].tolist() == [5, 6]
+
+
+def test_grouping_fn_and_rank_over_rollup(ctx):
+    """grouping() markers + window functions computed over the whole
+    grouping-sets union (TPC-DS q36 shape)."""
+    out = ctx.sql(
+        "select sum(v) s, a, b, grouping(a) + grouping(b) lvl, "
+        "rank() over (partition by grouping(a) + grouping(b) order by sum(v)) r "
+        "from t group by rollup(a, b) order by lvl desc, a, b"
+    ).collect().to_pandas()
+    df = ctx._tbl.to_pandas()
+    assert set(out.lvl) == {0, 1, 2}
+    top = out[out.lvl == 2]
+    assert top.s.tolist() == [df.v.sum()] and top.r.tolist() == [1]
+    lvl1 = out[out.lvl == 1].sort_values("r")
+    exp = df.groupby("a")["v"].sum().sort_values()
+    assert lvl1.s.tolist() == exp.tolist()
+    n_full = len(df.groupby(["a", "b"]))
+    assert sorted(out[out.lvl == 0].r.tolist()) == list(range(1, n_full + 1))

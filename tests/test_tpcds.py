@@ -27,7 +27,7 @@ def _query(n: int) -> str:
     return open(os.path.join(root, "benchmarks", "tpcds", "queries", f"q{n}.sql")).read()
 
 
-@pytest.mark.parametrize("q", [3, 7, 19, 33, 42, 52, 55, 68, 73, 96, 98])
+@pytest.mark.parametrize("q", [3, 7, 19, 33, 36, 42, 52, 55, 68, 73, 96, 98])
 def test_tpcds_local(q, tpcds_dir, tpcds_ref):
     from ballista_tpu.client.context import SessionContext
     from ballista_tpu.testing.tpcds_reference import compare_results, run_reference
