@@ -367,6 +367,13 @@ class DataFrame:
             types.append("analyzed_plan (distributed)")
             plans.append("\n".join(lines))
         elif self.plan.analyze:
+            # compile FIRST so the analyzed tree is the executed tree —
+            # execute_collect compiles a local copy, which would leave the
+            # displayed plan with empty metrics (and hide the TPU stages)
+            if str(self.ctx.config.get(EXECUTOR_ENGINE)) == "tpu":
+                from ballista_tpu.engine.tpu_engine import maybe_compile_tpu
+
+                physical = maybe_compile_tpu(physical, self.ctx.config)
             self.ctx.execute_collect(physical)
             from ballista_tpu.plan.physical import collect_metrics
 

@@ -290,7 +290,12 @@ class TpuStageExec(ExecutionPlan):
         return self.scan.output_partition_count()
 
     def node_str(self) -> str:
-        return f"TpuStageExec: [{self.partial_agg.node_str()}] ops={len(self.ops)}"
+        # live counters surface in EXPLAIN ANALYZE / stage metrics so
+        # operators can SEE whether the device path ran or fell back
+        extra = ""
+        if self.tpu_count or self.fallback_count:
+            extra = f" device_runs={self.tpu_count} cpu_fallbacks={self.fallback_count}"
+        return f"TpuStageExec: [{self.partial_agg.node_str()}] ops={len(self.ops)}{extra}"
 
     def execute(self, partition: int, ctx: TaskContext) -> Iterator[pa.RecordBatch]:
         return self._timed(iter(self._run(partition, ctx)))

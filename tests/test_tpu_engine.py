@@ -481,3 +481,12 @@ def test_semi_and_anti_joins_on_device(tmp_path):
             list(phys.execute(p, tc))
         assert sum(s.tpu_count for s in stages) >= 1
         assert sum(s.fallback_count for s in stages) == 0
+
+
+def test_explain_analyze_shows_device_counters(tpu_ctx):
+    """EXPLAIN ANALYZE with engine=tpu analyzes the COMPILED tree: the
+    TpuStageExec appears with its device/fallback counters."""
+    out = tpu_ctx.sql("explain analyze " + tpch_query(6)).collect().to_pandas()
+    body = out[out.plan_type.str.startswith("analyzed")].plan.iloc[0]
+    assert "TpuStageExec" in body
+    assert "device_runs=1" in body and "cpu_fallbacks=0" in body
