@@ -206,3 +206,24 @@ def test_shuffle_writer_reader_roundtrip(tmp_path):
             for b in reader.execute(p, TaskContext(BallistaConfig())):
                 seen.extend(b.column(0).to_pylist())
         assert sorted(seen) == list(range(100)), f"sort_shuffle={sort_shuffle}"
+
+
+def test_sort_shuffle_spill_path(tmp_path, tpch_dir, tpch_ref_tables):
+    """A tiny sort-shuffle memory limit forces per-bucket spills + the
+    consolidation merge; results stay correct through a standalone cluster
+    (reference: sort_shuffle spill.rs / SpillManager)."""
+    from ballista_tpu.client.context import SessionContext
+    from ballista_tpu.config import BallistaConfig, SORT_SHUFFLE_MEMORY_LIMIT
+    from ballista_tpu.testing.tpchgen import register_tpch
+
+    from .conftest import tpch_query
+
+    cfg = BallistaConfig({SORT_SHUFFLE_MEMORY_LIMIT: 16 * 1024})  # ~everything spills
+    ctx = SessionContext.standalone(cfg, num_executors=1, vcores=2)
+    register_tpch(ctx, tpch_dir)
+    try:
+        eng = ctx.sql(tpch_query(3)).collect()
+        problems = compare_results(eng, run_reference(3, tpch_ref_tables), 3)
+        assert not problems, "\n".join(problems)
+    finally:
+        ctx.shutdown()
