@@ -214,3 +214,27 @@ def test_remote_explain_analyze(grpc_cluster, remote_ctx):
     plans = dict(zip(out.column("plan_type").to_pylist(), out.column("plan").to_pylist()))
     body = plans.get("analyzed_plan (distributed)", "")
     assert "stage" in body and "elapsed_ms" in body, plans
+
+
+def test_concurrent_sessions_and_jobs(grpc_cluster, tpch_dir, tpch_ref_tables):
+    """8 clients submit simultaneously: scheduler state (event loop, graph
+    registry, session manager, slot accounting) stays consistent and every
+    result is correct."""
+    import concurrent.futures as fut
+
+    from ballista_tpu.client.context import SessionContext
+    from ballista_tpu.testing.tpchgen import register_tpch
+
+    _, addr = grpc_cluster
+    queries = [1, 3, 6, 12, 14, 19, 6, 1]
+
+    def run_one(q):
+        ctx = SessionContext.remote(addr)
+        register_tpch(ctx, tpch_dir)
+        out = ctx.sql(tpch_query(q)).collect()
+        return q, compare_results(out, run_reference(q, tpch_ref_tables), q)
+
+    with fut.ThreadPoolExecutor(max_workers=8) as pool:
+        results = list(pool.map(run_one, queries))
+    bad = [(q, p) for q, p in results if p]
+    assert not bad, bad
