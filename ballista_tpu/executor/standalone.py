@@ -43,7 +43,7 @@ class InProcessTaskLauncher(TaskLauncher):
 class StandaloneCluster:
     def __init__(self, num_executors: int = 1, vcores: int = 4,
                  work_dir: str | None = None, config: BallistaConfig | None = None,
-                 with_flight: bool = True):
+                 with_flight: bool = True, engine_factory=None):
         self.work_dir = work_dir or tempfile.mkdtemp(prefix="ballista-tpu-")
         self.flight_server = None
         flight_port = 0
@@ -55,7 +55,10 @@ class StandaloneCluster:
         for _ in range(num_executors):
             meta = ExecutorMetadata(id=str(new_executor_id()), vcores=vcores,
                                     host="localhost", flight_port=flight_port)
-            self.executors[meta.id] = Executor(self.work_dir, meta, config=config)
+            # engine_factory: the ExecutionEngine extension seam
+            # (execution_engine.rs:51) for library embedders
+            eng = engine_factory() if engine_factory is not None else None
+            self.executors[meta.id] = Executor(self.work_dir, meta, config=config, engine=eng)
         self.launcher = InProcessTaskLauncher(self.executors)
         self.scheduler = SchedulerServer(self.launcher)
         self.scheduler.start()

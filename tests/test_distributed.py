@@ -213,10 +213,8 @@ def test_sort_shuffle_spill_path(tmp_path, tpch_dir, tpch_ref_tables):
     consolidation merge; results stay correct through a standalone cluster
     (reference: sort_shuffle spill.rs / SpillManager)."""
     from ballista_tpu.client.context import SessionContext
-    from ballista_tpu.config import BallistaConfig, SORT_SHUFFLE_MEMORY_LIMIT
+    from ballista_tpu.config import SORT_SHUFFLE_MEMORY_LIMIT
     from ballista_tpu.testing.tpchgen import register_tpch
-
-    from .conftest import tpch_query
 
     cfg = BallistaConfig({SORT_SHUFFLE_MEMORY_LIMIT: 16 * 1024})  # ~everything spills
     ctx = SessionContext.standalone(cfg, num_executors=1, vcores=2)
