@@ -73,6 +73,13 @@ class DropTable:
 
 
 @dataclass
+class ValuesClause:
+    rows: list  # list of literal rows
+    alias: str = "__values__"
+    column_names: list = None  # optional t(c1, c2, ...) renames
+
+
+@dataclass
 class ShowColumns:
     table: str
 

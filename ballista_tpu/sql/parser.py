@@ -45,6 +45,7 @@ from ballista_tpu.sql.ast import (
     DerivedTable,
     DropTable,
     ShowColumns,
+    ValuesClause,
     ExplainStmt,
     JoinClause,
     SelectStmt,
@@ -412,6 +413,23 @@ class Parser:
 
     def _parse_table_factor(self) -> Any:
         if self.accept_punct("("):
+            if self.peek().is_kw("VALUES"):
+                vc = self._parse_values()
+                self.expect_punct(")")
+                alias = None
+                cols = None
+                if self.accept_kw("AS"):
+                    alias = self.expect_ident().lower()
+                elif self.peek().kind == "ident":
+                    alias = self.next().value.lower()
+                if alias and self.accept_punct("("):
+                    cols = [self.expect_ident().lower()]
+                    while self.accept_punct(","):
+                        cols.append(self.expect_ident().lower())
+                    self.expect_punct(")")
+                vc.alias = alias or vc.alias
+                vc.column_names = cols
+                return vc
             sub = self.parse_query()
             self.expect_punct(")")
             alias = None
@@ -427,6 +445,33 @@ class Parser:
         elif self.peek().kind == "ident":
             alias = self.next().value.lower()
         return TableName(name, alias)
+
+    def _parse_values(self):
+        self.expect_kw("VALUES")
+        rows = []
+        while True:
+            self.expect_punct("(")
+            row = [self._parse_literal_value()]
+            while self.accept_punct(","):
+                row.append(self._parse_literal_value())
+            self.expect_punct(")")
+            rows.append(row)
+            if not self.accept_punct(","):
+                break
+        if any(len(r) != len(rows[0]) for r in rows):
+            raise SqlParseError("VALUES rows have differing arities")
+        return ValuesClause(rows)
+
+    def _parse_literal_value(self):
+        e = self.parse_expr()
+        lit = e
+        neg = False
+        if isinstance(lit, Negative):
+            lit, neg = lit.expr, True
+        if not isinstance(lit, Literal):
+            raise SqlParseError(f"VALUES entries must be literals, got {e}")
+        v = lit.value
+        return -v if neg else v
 
     # -- expressions (Pratt) -------------------------------------------------
 

@@ -351,6 +351,23 @@ class SqlPlanner:
             return TableScan(ref.name, provider, alias=ref.alias)
         if isinstance(ref, DerivedTable):
             return SubqueryAlias(self.plan_query(ref.select, cte_env), ref.alias)
+        from ballista_tpu.sql.ast import ValuesClause
+
+        if isinstance(ref, ValuesClause):
+            from ballista_tpu.plan.logical import Values
+
+            node: LogicalPlan = Values(ref.rows)
+            if ref.column_names:
+                if len(ref.column_names) != len(node.schema.fields):
+                    raise PlanningError(
+                        f"VALUES arity {len(node.schema.fields)} != column list "
+                        f"{len(ref.column_names)}"
+                    )
+                node = Projection(node, [
+                    Alias(Column(f.name), cn)
+                    for f, cn in zip(node.schema.fields, ref.column_names)
+                ])
+            return SubqueryAlias(node, ref.alias)
         if isinstance(ref, JoinClause):
             left = self._plan_table_ref(ref.left, cte_env)
             right = self._plan_table_ref(ref.right, cte_env)

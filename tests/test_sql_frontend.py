@@ -124,3 +124,26 @@ def test_show_columns_and_describe():
     assert out.data_type.tolist()[0].startswith("int")
     out2 = ctx.sql("describe t").collect().to_pandas()
     assert out2.column_name.tolist() == ["a", "b"]
+
+
+def test_values_table_refs():
+    """(VALUES ...) [AS] t(cols) as a table factor, incl. joins against it."""
+    import pyarrow as pa
+
+    from ballista_tpu.client.context import SessionContext
+
+    ctx = SessionContext()
+    out = ctx.sql(
+        "select a, b from (values (1, 'x'), (2, 'y'), (3, 'z')) AS t(a, b) "
+        "where a >= 2 order by a desc"
+    ).collect().to_pandas()
+    assert out.a.tolist() == [3, 2]
+    assert out.b.tolist() == ["z", "y"]
+    ctx.register_arrow_table("u", pa.table({"k": [1, 2, 3]}))
+    out2 = ctx.sql(
+        "select k from u, (values (2), (3)) v(m) where k = m order by k"
+    ).collect().to_pandas()
+    assert out2.k.tolist() == [2, 3]
+    # default column names
+    out3 = ctx.sql("select column1 from (values (7)) t").collect().to_pandas()
+    assert out3.column1.tolist() == [7]
