@@ -462,17 +462,6 @@ class Parser:
             raise SqlParseError("VALUES rows have differing arities")
         return ValuesClause(rows)
 
-    def _parse_literal_value(self):
-        e = self.parse_expr()
-        lit = e
-        neg = False
-        if isinstance(lit, Negative):
-            lit, neg = lit.expr, True
-        if not isinstance(lit, Literal):
-            raise SqlParseError(f"VALUES entries must be literals, got {e}")
-        v = lit.value
-        return -v if neg else v
-
     # -- expressions (Pratt) -------------------------------------------------
 
     def parse_expr(self) -> Expr:
@@ -596,6 +585,8 @@ class Parser:
             return _dt.date.fromisoformat(s.value)
         if t.kind == "op" and t.value == "-":
             v = self._parse_literal_value()
+            if not isinstance(v, (int, float)) or isinstance(v, bool):
+                raise SqlParseError(f"cannot negate literal {v!r} at {t.pos}")
             return -v
         raise SqlParseError(f"expected literal, got {t.value!r} at {t.pos}")
 

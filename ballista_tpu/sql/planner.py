@@ -356,6 +356,32 @@ class SqlPlanner:
         if isinstance(ref, ValuesClause):
             from ballista_tpu.plan.logical import Values
 
+            # schema derives from the FIRST row: later rows must agree (a
+            # clean error beats an opaque ArrowInvalid at execution); None
+            # is compatible with anything
+            first = ref.rows[0]
+            for r in ref.rows[1:]:
+                for a, b in zip(first, r):
+                    if a is None or b is None:
+                        continue
+                    ta = float if isinstance(a, float) else type(a)
+                    tb = float if isinstance(b, float) else type(b)
+                    if isinstance(a, bool) != isinstance(b, bool) or (
+                        ta is not tb and not ({ta, tb} == {int, float})
+                    ):
+                        raise PlanningError(
+                            f"VALUES rows mix types: {a!r} vs {b!r}"
+                        )
+                    if {ta, tb} == {int, float}:
+                        raise PlanningError(
+                            f"VALUES rows mix int and float ({a!r} vs {b!r}); "
+                            "write consistent numeric literals"
+                        )
+            if any(v is None for v in first):
+                raise PlanningError(
+                    "NULL in the first VALUES row leaves its column untyped; "
+                    "put a typed value first"
+                )
             node: LogicalPlan = Values(ref.rows)
             if ref.column_names:
                 if len(ref.column_names) != len(node.schema.fields):

@@ -147,3 +147,24 @@ def test_values_table_refs():
     # default column names
     out3 = ctx.sql("select column1 from (values (7)) t").collect().to_pandas()
     assert out3.column1.tolist() == [7]
+
+
+def test_values_edge_cases_clean_errors():
+    import pyarrow as pa
+
+    import pytest
+
+    from ballista_tpu.client.context import SessionContext
+    from ballista_tpu.errors import PlanningError, SqlParseError
+
+    ctx = SessionContext()
+    out = ctx.sql(
+        "select * from (values (1, 'x'), (-2, 'y')) t(a, b) order by a"
+    ).collect().to_pandas()
+    assert out.a.tolist() == [-2, 1]
+    with pytest.raises(PlanningError):
+        ctx.sql("select * from (values (1), (2.5)) t").collect()
+    with pytest.raises(SqlParseError):
+        ctx.sql("select * from (values (-'x')) t").collect()
+    with pytest.raises(PlanningError):
+        ctx.sql("select * from (values (null), (1)) t").collect()
