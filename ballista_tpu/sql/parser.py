@@ -215,13 +215,17 @@ class Parser:
         # a UNION ALL b UNION ALL c keeps all three branches (homogeneous
         # chains are associative; planner flattens them)
         cur = stmt
-        while self.peek().is_kw("UNION"):
-            self.next()
+        while self.peek().is_kw("UNION", "EXCEPT", "INTERSECT"):
+            kw = self.next().value
             all_ = self.accept_kw("ALL")
-            # standard SQL: union branches take no bare ORDER BY/LIMIT —
+            if kw != "UNION" and all_:
+                raise SqlParseError(f"{kw} ALL is unsupported (set semantics only)")
+            # standard SQL: set-op branches take no bare ORDER BY/LIMIT —
             # trailing clauses bind to the whole chain
             rhs = self._parse_select_body(allow_order=False)
-            cur.set_op = ("union_all" if all_ else "union", rhs)
+            op = {"UNION": "union_all" if all_ else "union",
+                  "EXCEPT": "except", "INTERSECT": "intersect"}[kw]
+            cur.set_op = (op, rhs)
             cur = rhs
         # trailing ORDER BY / LIMIT of a set operation
         if self.peek().is_kw("ORDER") and not stmt.order_by:

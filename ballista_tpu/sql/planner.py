@@ -67,14 +67,29 @@ class SqlPlanner:
         if stmt.set_op is not None:
             # LEFT-associative chain walk: `a UNION ALL b UNION c` dedups
             # the whole accumulated left side, never just a branch
+            # collect the chain, then apply SQL precedence: INTERSECT
+            # binds tighter than UNION/EXCEPT
+            chain = [(None, plan)]
             cur = stmt
             while cur.set_op is not None:
                 op, rhs = cur.set_op
-                rhs_plan = self._plan_select(rhs, cte_env, defer_order=True)
-                plan = Union([plan, rhs_plan], all=(op == "union_all"))
-                if op == "union":
-                    plan = Distinct(plan)
+                chain.append((op, self._plan_select(rhs, cte_env, defer_order=True)))
                 cur = rhs
+            terms: list[tuple[str | None, LogicalPlan]] = []
+            for op, p_ in chain:
+                if op == "intersect" and terms:
+                    lop, lp = terms[-1]
+                    terms[-1] = (lop, self._set_op_join(lp, p_, "intersect"))
+                else:
+                    terms.append((op, p_))
+            plan = terms[0][1]
+            for op, p_ in terms[1:]:
+                if op in ("union", "union_all"):
+                    plan = Union([plan, p_], all=(op == "union_all"))
+                    if op == "union":
+                        plan = Distinct(plan)
+                else:  # except
+                    plan = self._set_op_join(plan, p_, "except")
             if stmt.order_by:
                 keys = []
                 for sk in stmt.order_by:
@@ -194,6 +209,52 @@ class SqlPlanner:
                 plan.__post_init__()
             plan = Limit(plan, stmt.limit, stmt.offset)
         return plan
+
+    def _set_op_join(self, left: LogicalPlan, right: LogicalPlan, op: str) -> LogicalPlan:
+        """INTERSECT = distinct left SEMI-joined to right on every column;
+        EXCEPT = distinct left ANTI-joined. Keys are null-safe: each column
+        contributes (IS NULL flag, COALESCE(col, typed default)) so NULLs
+        compare equal per SQL set semantics without sentinel collisions."""
+        import datetime as _dt
+
+        import pyarrow as _pa
+
+        from ballista_tpu.plan.expressions import IsNull, ScalarFunction
+
+        if len(left.schema.fields) != len(right.schema.fields):
+            raise PlanningError(f"{op.upper()} arity mismatch")
+        for side in (left, right):
+            names = [f.name for f in side.schema.fields]
+            if len(set(names)) != len(names):
+                raise PlanningError(
+                    f"{op.upper()} requires distinct output column names; "
+                    f"alias the duplicates ({names})"
+                )
+
+        def default_for(t):
+            if _pa.types.is_floating(t):
+                return Literal(0.0)
+            if _pa.types.is_integer(t):
+                return Literal(0)
+            if _pa.types.is_boolean(t):
+                return Literal(False)
+            if _pa.types.is_date(t):
+                return Literal(_dt.date(1970, 1, 1))
+            return Literal("")
+
+        lw = SubqueryAlias(Distinct(left), "__setl")
+        rw = SubqueryAlias(right, "__setr")
+        on = []
+        for lf, rf in zip(lw.schema.fields, rw.schema.fields):
+            lc, rc = Column(lf.name, "__setl"), Column(rf.name, "__setr")
+            on.append((IsNull(lc), IsNull(rc)))
+            on.append((ScalarFunction("coalesce", (lc, default_for(lf.dtype))),
+                       ScalarFunction("coalesce", (rc, default_for(rf.dtype)))))
+        jt = "left_semi" if op == "intersect" else "left_anti"
+        joined = Join(lw, rw, on, jt, None)
+        return Projection(joined, [
+            Alias(Column(f.name, "__setl"), f.name) for f in lw.schema.fields
+        ])
 
     def _plan_grouping_sets(self, plan: LogicalPlan, sets: list[list[int]],
                             group_exprs: list[Expr], agg_funcs: list[Expr],

@@ -23,6 +23,7 @@ KEYWORDS = {
     "VALUES", "EXPLAIN", "ANALYZE", "VERBOSE", "CREATE", "EXTERNAL", "TABLE",
     "STORED", "LOCATION", "DROP", "SHOW", "TABLES", "COLUMNS", "SET", "SEMI",
     "ANTI", "NATURAL", "OVER", "PARTITION", "ROLLUP", "CUBE", "GROUPING", "SETS",
+    "EXCEPT", "INTERSECT",
 }
 
 

@@ -168,3 +168,78 @@ def test_values_edge_cases_clean_errors():
         ctx.sql("select * from (values (-'x')) t").collect()
     with pytest.raises(PlanningError):
         ctx.sql("select * from (values (null), (1)) t").collect()
+
+
+def test_except_and_intersect():
+    """Set-semantics EXCEPT / INTERSECT (semi/anti-join lowering over all
+    columns, distinct left side), incl. multi-column and through the
+    distributed standalone path."""
+    import pyarrow as pa
+
+    from ballista_tpu.client.context import SessionContext
+
+    ctx = SessionContext()
+    ctx.register_arrow_table("t", pa.table({"v": [1, 2, 2, 3, 4]}))
+    ctx.register_arrow_table("u", pa.table({"v": [2, 4, 5]}))
+    out = ctx.sql("select v from t intersect select v from u order by v").collect().to_pandas()
+    assert out.v.tolist() == [2, 4]
+    out2 = ctx.sql("select v from t except select v from u order by v").collect().to_pandas()
+    assert out2.v.tolist() == [1, 3]
+    ctx.register_arrow_table("a2", pa.table({"x": [1, 1, 2], "y": ["p", "q", "p"]}))
+    ctx.register_arrow_table("b2", pa.table({"x": [1, 2], "y": ["q", "p"]}))
+    out3 = ctx.sql(
+        "select x, y from a2 intersect select x, y from b2 order by x, y"
+    ).collect().to_pandas()
+    assert out3.x.tolist() == [1, 2] and out3.y.tolist() == ["q", "p"]
+
+
+def test_intersect_distributed(tmp_path):
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from ballista_tpu.client.context import SessionContext
+
+    rng = np.random.default_rng(6)
+    pq.write_table(pa.table({"k": rng.integers(0, 500, 5000)}), str(tmp_path / "a.parquet"))
+    pq.write_table(pa.table({"k": rng.integers(250, 750, 5000)}), str(tmp_path / "b.parquet"))
+    ctx = SessionContext.standalone()
+    ctx.register_parquet("a", str(tmp_path / "a.parquet"))
+    ctx.register_parquet("b", str(tmp_path / "b.parquet"))
+    try:
+        out = ctx.sql("select k from a intersect select k from b order by k").collect().to_pandas()
+        import pandas as pd
+
+        ka = set(pq.read_table(str(tmp_path / "a.parquet")).to_pandas().k)
+        kb = set(pq.read_table(str(tmp_path / "b.parquet")).to_pandas().k)
+        assert out.k.tolist() == sorted(ka & kb)
+    finally:
+        ctx.shutdown()
+
+
+def test_set_op_precedence_and_null_semantics():
+    """INTERSECT binds tighter than UNION/EXCEPT; NULLs compare equal in
+    set operations; duplicate output names raise a clean error."""
+    import pyarrow as pa
+
+    import pytest
+
+    from ballista_tpu.client.context import SessionContext
+    from ballista_tpu.errors import PlanningError
+
+    ctx = SessionContext()
+    ctx.register_arrow_table("t", pa.table({"v": [1]}))
+    ctx.register_arrow_table("u", pa.table({"v": [2]}))
+    ctx.register_arrow_table("w", pa.table({"v": [2]}))
+    out = ctx.sql(
+        "select v from t union select v from u intersect select v from w order by v"
+    ).collect().to_pandas()
+    assert out.v.tolist() == [1, 2]  # t UNION (u INTERSECT w)
+    ctx.register_arrow_table("n1", pa.table({"v": pa.array([1, None], pa.int64())}))
+    ctx.register_arrow_table("n2", pa.table({"v": pa.array([None], pa.int64())}))
+    i = ctx.sql("select v from n1 intersect select v from n2").collect().to_pandas()
+    assert i.v.isna().tolist() == [True]
+    e = ctx.sql("select v from n1 except select v from n2").collect().to_pandas()
+    assert e.v.tolist() == [1]
+    with pytest.raises(PlanningError):
+        ctx.sql("select v, v from t intersect select v, v from u").collect()
