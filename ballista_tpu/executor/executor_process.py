@@ -74,20 +74,37 @@ def _ensure_native_flight_binary() -> str | None:
     native = os.path.join(repo, "native")
     bin_path = os.path.join(native, "ballista-flight-server")
     build = os.path.join(native, "build.sh")
-    if os.path.exists(bin_path):
+    src = os.path.join(native, "flight_shuffle.cpp")
+
+    def fresh() -> bool:
+        try:
+            return os.path.getmtime(bin_path) >= os.path.getmtime(src)
+        except OSError:
+            return False
+
+    if os.path.exists(bin_path) and fresh():
         return bin_path
     if not os.path.exists(build):
         return None
     marker = os.path.join(native, ".flight_build_failed")
+
+    def marker_current() -> bool:
+        # a failure marker older than the source is void: the code changed
+        # since that build failed, so the compile deserves another attempt
+        try:
+            return os.path.getmtime(marker) >= os.path.getmtime(src)
+        except OSError:
+            return False
+
     try:
         with open(os.path.join(native, ".build.lock"), "w") as lock:
             fcntl.flock(lock, fcntl.LOCK_EX)
-            if os.path.exists(bin_path):
+            if os.path.exists(bin_path) and fresh():
                 return bin_path
-            if os.path.exists(marker):
+            if marker_current():
                 return None
             r = subprocess.run(["sh", build], capture_output=True, timeout=300, check=False)
-            if os.path.exists(bin_path):
+            if os.path.exists(bin_path) and fresh():
                 return bin_path
             with open(marker, "w") as f:
                 f.write(r.stderr.decode(errors="replace")[-2000:])
@@ -152,7 +169,14 @@ class ExecutorProcess:
             config.set(GRPC_TLS_KEY, tls_key or "")
         self.flight_server = None
         self.native_flight_proc = None
-        if flight_impl in ("auto", "native"):
+        # With mTLS configured the data plane must not stay plaintext: the
+        # native C++ server has no TLS support yet, so TLS forces the Python
+        # Flight server, which serves with the same certificates + required
+        # client verification as the control plane.
+        flight_tls = bool(tls_cert and tls_key)
+        if flight_impl == "native" and flight_tls:
+            raise RuntimeError("native flight server does not support TLS; use flight_impl=python")
+        if flight_impl in ("auto", "native") and not flight_tls:
             native = start_native_flight_server(self.work_dir, bind_host, flight_port)
             if native is not None:
                 self.native_flight_proc, bound_flight = native
@@ -160,7 +184,10 @@ class ExecutorProcess:
             elif flight_impl == "native":
                 raise RuntimeError("native flight server requested but unavailable")
         if self.native_flight_proc is None:
-            self.flight_server, bound_flight = start_flight_server(self.work_dir, bind_host, flight_port)
+            self.flight_server, bound_flight = start_flight_server(
+                self.work_dir, bind_host, flight_port,
+                tls_cert=tls_cert, tls_key=tls_key, tls_client_ca=tls_ca,
+            )
 
         self.memory_pool_bytes = memory_pool_bytes or int(detect_memory_limit() * memory_fraction)
         self.metadata = ExecutorMetadata(

@@ -98,9 +98,15 @@ class ExecutorGrpcService:
         import shutil
         import os
 
-        from ballista_tpu.shuffle.paths import job_dir
+        from ballista_tpu.shuffle.paths import contained_path, job_dir, validate_job_id
 
-        d = job_dir(self.executor.work_dir, request.job_id)
+        try:
+            job_id = validate_job_id(request.job_id)
+            d = contained_path(self.executor.work_dir, job_dir(self.executor.work_dir, job_id))
+        except (ValueError, PermissionError) as e:
+            import grpc
+
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
         if os.path.isdir(d):
             shutil.rmtree(d, ignore_errors=True)
         self.executor.clear_cancellations(request.job_id)

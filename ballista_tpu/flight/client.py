@@ -27,18 +27,36 @@ class ClientPool:
         self._clients: dict[str, flight.FlightClient] = {}
         self._lock = threading.Lock()
 
-    def get(self, addr: str) -> flight.FlightClient:
+    def get(self, addr: str, tls: tuple[str, str | None, str | None] | None = None) -> flight.FlightClient:
+        """tls = (ca_path, cert_path, key_path): dial grpc+tls, presenting a
+        client certificate when given (mTLS data plane). Pool entries are
+        keyed on (addr, tls) so callers with different transports to one
+        address never share a client."""
+        key = (addr, tls)
         with self._lock:
-            c = self._clients.get(addr)
+            c = self._clients.get(key)
             if c is None:
-                c = flight.FlightClient(f"grpc://{addr}")
-                self._clients[addr] = c
+                if tls:
+                    ca, cert, key = tls
+                    kwargs = {}
+                    with open(ca, "rb") as f:
+                        kwargs["tls_root_certs"] = f.read()
+                    if cert and key:
+                        with open(cert, "rb") as f:
+                            kwargs["cert_chain"] = f.read()
+                        with open(key, "rb") as f:
+                            kwargs["private_key"] = f.read()
+                    c = flight.FlightClient(f"grpc+tls://{addr}", **kwargs)
+                else:
+                    c = flight.FlightClient(f"grpc://{addr}")
+                self._clients[key] = c
             return c
 
     def discard(self, addr: str) -> None:
         with self._lock:
-            c = self._clients.pop(addr, None)
-        if c is not None:
+            doomed = [k for k in self._clients if k[0] == addr]
+            clients = [self._clients.pop(k) for k in doomed]
+        for c in clients:
             try:
                 c.close()
             except Exception:
@@ -58,6 +76,16 @@ def _ticket(loc: PartitionLocation) -> dict:
     }
 
 
+def _session_tls(config) -> tuple[str, str | None, str | None] | None:
+    from ballista_tpu.config import GRPC_TLS_CA, GRPC_TLS_CERT, GRPC_TLS_KEY
+
+    ca = str(config.get(GRPC_TLS_CA) or "")
+    if not ca:
+        return None
+    return (ca, str(config.get(GRPC_TLS_CERT) or "") or None,
+            str(config.get(GRPC_TLS_KEY) or "") or None)
+
+
 def fetch_partition_flight(loc: PartitionLocation, ctx: TaskContext) -> Iterator[pa.RecordBatch]:
     from ballista_tpu.config import FLIGHT_PROXY
 
@@ -70,7 +98,7 @@ def fetch_partition_flight(loc: PartitionLocation, ctx: TaskContext) -> Iterator
     else:
         addr = f"{loc.host}:{loc.flight_port}"
         ticket = _ticket(loc)
-    client = POOL.get(addr)
+    client = POOL.get(addr, tls=_session_tls(ctx.config))
     try:
         if bool(ctx.config.get(SHUFFLE_BLOCK_TRANSPORT)):
             action = flight.Action("io_block_transport", json.dumps(ticket).encode())

@@ -22,13 +22,15 @@ import pyarrow.ipc as ipc
 BLOCK_SIZE = 8 * 1024 * 1024
 
 
-def _relay_bytes(ticket: dict) -> bytes:
+def _relay_bytes(ticket: dict, relay_tls: tuple[str, str | None, str | None] | None) -> bytes:
     """Pull the stored IPC bytes from the owning executor (raw-block mode —
-    no decode on the proxy hop)."""
+    no decode on the proxy hop). In a TLS cluster the proxy dials executors
+    with the scheduler's own credentials (the executors' data plane requires
+    client certs)."""
     from ballista_tpu.flight.client import POOL
 
     addr = f"{ticket['host']}:{ticket['flight_port']}"
-    client = POOL.get(addr)
+    client = POOL.get(addr, tls=relay_tls)
     try:
         action = flight.Action("io_block_transport", json.dumps(ticket).encode())
         return b"".join(r.body.to_pybytes() for r in client.do_action(action))
@@ -38,12 +40,29 @@ def _relay_bytes(ticket: dict) -> bytes:
 
 
 class FlightResultProxy(flight.FlightServerBase):
-    def __init__(self, host: str = "0.0.0.0", port: int = 0):
-        super().__init__(f"grpc://{host}:{port}")
+    def __init__(self, host: str = "0.0.0.0", port: int = 0,
+                 tls_cert: str | None = None, tls_key: str | None = None,
+                 tls_client_ca: str | None = None):
+        kwargs = {}
+        scheme = "grpc"
+        if tls_cert and tls_key:
+            scheme = "grpc+tls"
+            with open(tls_cert, "rb") as f:
+                cert = f.read()
+            with open(tls_key, "rb") as f:
+                key = f.read()
+            kwargs["tls_certificates"] = [(cert, key)]
+            if tls_client_ca:
+                with open(tls_client_ca, "rb") as f:
+                    kwargs["root_certificates"] = f.read()
+                kwargs["verify_client"] = True
+        super().__init__(f"{scheme}://{host}:{port}", **kwargs)
+        # executor-side dial credentials: (ca, cert, key)
+        self.relay_tls = (tls_client_ca, tls_cert, tls_key) if (tls_client_ca and tls_cert) else None
 
     def do_get(self, context, ticket):
         t = json.loads(ticket.ticket.decode())
-        buf = _relay_bytes(t)
+        buf = _relay_bytes(t, self.relay_tls)
         if not buf:
             return flight.RecordBatchStream(pa.table({}))
         reader = ipc.open_stream(pa.BufferReader(buf))
@@ -52,7 +71,7 @@ class FlightResultProxy(flight.FlightServerBase):
     def do_action(self, context, action):
         if action.type == "io_block_transport":
             t = json.loads(action.body.to_pybytes().decode())
-            buf = _relay_bytes(t)
+            buf = _relay_bytes(t, self.relay_tls)
             for off in range(0, len(buf), BLOCK_SIZE):
                 yield flight.Result(pa.py_buffer(buf[off : off + BLOCK_SIZE]))
             return
@@ -62,8 +81,11 @@ class FlightResultProxy(flight.FlightServerBase):
         return [("io_block_transport", "relay raw IPC blocks from an executor")]
 
 
-def start_flight_proxy(host: str = "0.0.0.0", port: int = 0) -> tuple[FlightResultProxy, int]:
-    server = FlightResultProxy(host, port)
+def start_flight_proxy(host: str = "0.0.0.0", port: int = 0,
+                       tls_cert: str | None = None, tls_key: str | None = None,
+                       tls_client_ca: str | None = None) -> tuple[FlightResultProxy, int]:
+    server = FlightResultProxy(host, port, tls_cert=tls_cert, tls_key=tls_key,
+                               tls_client_ca=tls_client_ca)
     bound = server.port
     t = threading.Thread(target=server.serve, daemon=True, name="flight-proxy")
     t.start()

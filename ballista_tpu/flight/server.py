@@ -11,7 +11,14 @@ Rebuild of ballista/executor/src/flight_service.rs:
   decodes the stream once.
 
 Tickets are JSON: {path, layout, output_partition} — the location fields a
-PartitionLocation already carries.
+PartitionLocation already carries. The server does NOT trust the ticket
+path: it is resolved and required to live under this executor's work dir
+(the reference rebuilds paths server-side from structured fields for the
+same reason), and job ids in GC actions are validated against traversal.
+
+TLS: when the executor's control plane is configured with mTLS, the same
+certificates secure the Flight listener (tls_certificates + client CA with
+required verification) — the data plane is not left plaintext on 0.0.0.0.
 """
 
 from __future__ import annotations
@@ -30,8 +37,8 @@ from ballista_tpu.shuffle.types import PartitionLocation
 BLOCK_SIZE = 8 * 1024 * 1024
 
 
-def _read_range(ticket: dict) -> bytes:
-    path = ticket["path"]
+def _read_range(ticket: dict, work_dir: str) -> bytes:
+    path = paths.contained_path(work_dir, ticket["path"])
     if paths.is_sort_layout(ticket.get("layout", "hash")):
         with open(paths.index_path(path)) as f:
             index = json.load(f)
@@ -47,17 +54,33 @@ def _read_range(ticket: dict) -> bytes:
 
 
 class BallistaFlightServer(flight.FlightServerBase):
-    def __init__(self, host: str = "0.0.0.0", port: int = 0, work_dir: str = ""):
-        location = f"grpc://{host}:{port}"
-        super().__init__(location)
+    def __init__(self, host: str = "0.0.0.0", port: int = 0, work_dir: str = "",
+                 tls_cert: str | None = None, tls_key: str | None = None,
+                 tls_client_ca: str | None = None):
+        kwargs = {}
+        scheme = "grpc"
+        if tls_cert and tls_key:
+            scheme = "grpc+tls"
+            with open(tls_cert, "rb") as f:
+                cert = f.read()
+            with open(tls_key, "rb") as f:
+                key = f.read()
+            kwargs["tls_certificates"] = [(cert, key)]
+            if tls_client_ca:
+                with open(tls_client_ca, "rb") as f:
+                    kwargs["root_certificates"] = f.read()
+                kwargs["verify_client"] = True
+        super().__init__(f"{scheme}://{host}:{port}", **kwargs)
         self.work_dir = work_dir
         self.host = host
 
     def do_get(self, context, ticket):
         t = json.loads(ticket.ticket.decode())
-        buf = _read_range(t)
+        try:
+            buf = _read_range(t, self.work_dir)
+        except PermissionError as e:
+            raise flight.FlightUnauthorizedError(str(e))
         if not buf:
-            schema = pa.schema([])
             return flight.RecordBatchStream(pa.table({}))
         reader = ipc.open_stream(pa.BufferReader(buf))
         table = reader.read_all()
@@ -66,7 +89,10 @@ class BallistaFlightServer(flight.FlightServerBase):
     def do_action(self, context, action):
         if action.type == "io_block_transport":
             t = json.loads(action.body.to_pybytes().decode())
-            buf = _read_range(t)
+            try:
+                buf = _read_range(t, self.work_dir)
+            except PermissionError as e:
+                raise flight.FlightUnauthorizedError(str(e))
             for off in range(0, len(buf), BLOCK_SIZE):
                 yield flight.Result(pa.py_buffer(buf[off : off + BLOCK_SIZE]))
             return
@@ -74,7 +100,11 @@ class BallistaFlightServer(flight.FlightServerBase):
             t = json.loads(action.body.to_pybytes().decode())
             import shutil
 
-            d = paths.job_dir(self.work_dir, t["job_id"])
+            try:
+                job_id = paths.validate_job_id(t["job_id"])
+                d = paths.contained_path(self.work_dir, paths.job_dir(self.work_dir, job_id))
+            except (ValueError, PermissionError) as e:
+                raise flight.FlightUnauthorizedError(str(e))
             if os.path.isdir(d):
                 shutil.rmtree(d, ignore_errors=True)
             yield flight.Result(pa.py_buffer(b"ok"))
@@ -85,8 +115,12 @@ class BallistaFlightServer(flight.FlightServerBase):
         return [("io_block_transport", "raw IPC block stream"), ("remove_job_data", "GC a job's shuffle files")]
 
 
-def start_flight_server(work_dir: str, host: str = "0.0.0.0", port: int = 0) -> tuple[BallistaFlightServer, int]:
-    server = BallistaFlightServer(host, port, work_dir)
+def start_flight_server(work_dir: str, host: str = "0.0.0.0", port: int = 0,
+                        tls_cert: str | None = None, tls_key: str | None = None,
+                        tls_client_ca: str | None = None) -> tuple[BallistaFlightServer, int]:
+    server = BallistaFlightServer(host, port, work_dir,
+                                  tls_cert=tls_cert, tls_key=tls_key,
+                                  tls_client_ca=tls_client_ca)
     bound = server.port
     t = threading.Thread(target=server.serve, daemon=True, name="flight-server")
     t.start()

@@ -44,17 +44,32 @@ def _int64_encoding(arr: pa.Array) -> tuple[np.ndarray, np.ndarray | None]:
     if pa.types.is_dictionary(t):
         arr = arr.cast(t.value_type)
         return _int64_encoding(arr)
+    # For every fixed-width branch: fill nulls BEFORE to_numpy. A nullable
+    # array round-trips through float64 in to_numpy, which both loses int64
+    # precision past 2^53 and (without an astype) would bit-reinterpret
+    # float64 as uint64 — breaking the cross-engine wire contract with the
+    # native router (ops/native.py fills then converts exactly). Null slots
+    # are overridden to _NULL_TAG by the mask downstream, so the fill value
+    # never reaches a hash.
+    import pyarrow.compute as pc
+
     if pa.types.is_integer(t):
-        vals = arr.cast(pa.int64(), safe=False).to_numpy(zero_copy_only=False)
+        filled = pc.fill_null(arr, 0) if arr.null_count else arr
+        vals = filled.cast(pa.int64(), safe=False).to_numpy(zero_copy_only=False)
         return vals.astype(np.int64, copy=False).view(np.uint64), mask
     if pa.types.is_date(t):
-        vals = arr.cast(pa.int32(), safe=False).cast(pa.int64()).to_numpy(zero_copy_only=False)
-        return vals.view(np.uint64), mask
+        # date32 is days-int32, date64 is ms-int64; Arrow has no date64→int32
+        as_int = arr.cast(pa.int32() if pa.types.is_date32(t) else pa.int64(), safe=False)
+        filled = pc.fill_null(as_int, 0) if arr.null_count else as_int
+        vals = filled.cast(pa.int64()).to_numpy(zero_copy_only=False)
+        return vals.astype(np.int64, copy=False).view(np.uint64), mask
     if pa.types.is_boolean(t):
-        vals = arr.cast(pa.int64()).to_numpy(zero_copy_only=False)
-        return vals.view(np.uint64), mask
+        filled = pc.fill_null(arr, False) if arr.null_count else arr
+        vals = filled.cast(pa.int64()).to_numpy(zero_copy_only=False)
+        return vals.astype(np.int64, copy=False).view(np.uint64), mask
     if pa.types.is_floating(t):
-        vals = arr.cast(pa.float64()).to_numpy(zero_copy_only=False)
+        filled = pc.fill_null(arr, 0.0) if arr.null_count else arr
+        vals = filled.cast(pa.float64()).to_numpy(zero_copy_only=False)
         # normalize -0.0 to 0.0 so equal keys hash equal
         vals = np.where(vals == 0.0, 0.0, vals)
         return vals.view(np.uint64), mask

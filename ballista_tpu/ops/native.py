@@ -83,7 +83,8 @@ def hash_arrays_native(arrays: list[pa.Array]) -> np.ndarray | None:
         if pa.types.is_integer(t) or pa.types.is_boolean(t):
             import pyarrow.compute as pc
 
-            filled = pc.fill_null(arr, 0) if arr.null_count else arr
+            fill = False if pa.types.is_boolean(t) else 0
+            filled = pc.fill_null(arr, fill) if arr.null_count else arr
             v = np.ascontiguousarray(
                 filled.cast(pa.int64(), safe=False).to_numpy(zero_copy_only=False).astype(np.int64)
             )
@@ -91,7 +92,7 @@ def hash_arrays_native(arrays: list[pa.Array]) -> np.ndarray | None:
         elif pa.types.is_date(t):
             import pyarrow.compute as pc
 
-            as_int = arr.cast(pa.int32(), safe=False)
+            as_int = arr.cast(pa.int32() if pa.types.is_date32(t) else pa.int64(), safe=False)
             filled = pc.fill_null(as_int, 0) if arr.null_count else as_int
             v = np.ascontiguousarray(
                 filled.cast(pa.int64()).to_numpy(zero_copy_only=False).astype(np.int64)

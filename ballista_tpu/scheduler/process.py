@@ -127,7 +127,8 @@ class SchedulerProcess:
             from ballista_tpu.flight.proxy import start_flight_proxy
 
             self.flight_proxy, self.flight_proxy_port = start_flight_proxy(
-                bind_host, flight_proxy_port
+                bind_host, flight_proxy_port,
+                tls_cert=tls_cert, tls_key=tls_key, tls_client_ca=tls_client_ca,
             )
             self.scheduler.flight_proxy_port = self.flight_proxy_port
 

@@ -9,9 +9,11 @@ unit, SURVEY.md §5), anything mid-flight recomputes.
 
 `FileJobState` is the reference's memory-only backend taken one step
 further: a directory of `{job_id}.graph` protos plus `{job_id}.owner`
-ownership markers (JobAcquired/JobReleased, cluster/mod.rs:221). Ownership
-acquire is atomic via O_CREAT|O_EXCL; a scheduler taking over a dead
-owner's jobs passes `force=True` (operator decision or lease expiry).
+ownership markers (JobAcquired/JobReleased, cluster/mod.rs:221). Every
+ownership read-check-write runs under a per-job flock, so acquire, lease
+takeover, and release are mutually atomic; a scheduler taking over a live
+owner's jobs passes `force=True` (operator decision), expired leases are
+adopted without it.
 """
 
 from __future__ import annotations
@@ -123,43 +125,61 @@ class FileJobState(JobStateStore):
                 pass
             return None
 
+    def _owner_lock(self, job_id: str):
+        """Exclusive flock on a per-job sidecar file. EVERY ownership
+        read-check-write (fresh acquire, takeover, release) runs under it —
+        a takeover's os.replace must not clobber a concurrent fresh acquire,
+        and two standbys adopting one expired lease must see each other."""
+        import contextlib
+        import fcntl
+
+        lock_path = self._owner_path(job_id) + ".lock"
+
+        @contextlib.contextmanager
+        def held():
+            with open(lock_path, "w") as lockf:
+                fcntl.flock(lockf, fcntl.LOCK_EX)
+                try:
+                    yield
+                finally:
+                    fcntl.flock(lockf, fcntl.LOCK_UN)
+
+        return held()
+
     def acquire(self, job_id: str, scheduler_id: str, force: bool = False) -> bool:
+        import tempfile
+        import time as _time
+
         path = self._owner_path(job_id)
-        try:
-            fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
-            with os.fdopen(fd, "w") as f:
-                f.write(scheduler_id)
-            return True  # JobAcquired
-        except FileExistsError:
+        with self._owner_lock(job_id):
             try:
                 with open(path) as f:
                     owner = f.read().strip()
-            except FileNotFoundError:
-                return self.acquire(job_id, scheduler_id, force)
-            if owner == scheduler_id:
-                return True
-            try:
-                import time as _time
-
                 stale = (_time.time() - os.path.getmtime(path)) > self.lease_s
             except OSError:
-                stale = True
-            if force or stale:
-                with open(path, "w") as f:
-                    f.write(scheduler_id)
+                owner, stale = "", True
+            if owner == scheduler_id:
+                return True
+            if owner and not stale and not force:
+                return False
+            fd, tmp = tempfile.mkstemp(dir=self.dir, suffix=".owner.tmp")
+            with os.fdopen(fd, "w") as f:
+                f.write(scheduler_id)
+            os.replace(tmp, path)  # JobAcquired
+            if owner:
                 log.info(
                     "job %s ownership %s from %s to %s", job_id,
                     "forced" if force else "adopted (lease expired)", owner, scheduler_id,
                 )
-                return True
-            return False
+            return True
 
     def release(self, job_id: str, scheduler_id: str) -> None:
         path = self._owner_path(job_id)
-        try:
-            with open(path) as f:
-                if f.read().strip() != scheduler_id:
-                    return
-            os.remove(path)  # JobReleased
-        except FileNotFoundError:
-            pass
+        with self._owner_lock(job_id):
+            try:
+                with open(path) as f:
+                    if f.read().strip() != scheduler_id:
+                        return
+                os.remove(path)  # JobReleased
+            except FileNotFoundError:
+                pass

@@ -38,3 +38,27 @@ def is_sort_layout(layout: str) -> bool:
 
 def job_dir(work_dir: str, job_id: str) -> str:
     return os.path.join(work_dir, job_id)
+
+
+def validate_job_id(job_id: str) -> str:
+    """Reject job ids that could escape the work dir when joined into a
+    filesystem path (data-plane actions take the id from the wire)."""
+    if not job_id or job_id in (".", "..") or "/" in job_id or "\\" in job_id or "\x00" in job_id:
+        raise ValueError(f"invalid job id {job_id!r}")
+    return job_id
+
+
+def contained_path(work_dir: str, path: str) -> str:
+    """Resolve `path` and require it to live under `work_dir`.
+
+    The Flight data plane receives file paths inside tickets (they are the
+    location fields a PartitionLocation carries); the server must not trust
+    them to stay inside its own shuffle directory — the reference builds
+    paths server-side from structured fields for the same reason
+    (executor/src/flight_service.rs). Raises PermissionError on escape.
+    """
+    root = os.path.realpath(work_dir)
+    resolved = os.path.realpath(path)
+    if resolved != root and not resolved.startswith(root + os.sep):
+        raise PermissionError(f"path {path!r} escapes work dir {work_dir!r}")
+    return resolved

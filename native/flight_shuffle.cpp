@@ -138,8 +138,36 @@ static std::string IndexPath(const std::string& data_path) {
   return data_path + ".idx";
 }
 
-static arrow::Result<std::shared_ptr<arrow::Buffer>> ReadRange(const std::string& ticket_json) {
+// The ticket path comes off the wire; it must not read outside the
+// executor's own shuffle directory (twin of shuffle/paths.py
+// contained_path — the reference builds paths server-side from structured
+// ticket fields for the same reason, executor/src/flight_service.rs).
+static arrow::Status CheckContained(const std::string& work_dir, const std::string& path) {
+  if (work_dir.empty()) return arrow::Status::Invalid("server has no work dir; refusing reads");
+  std::error_code ec;
+  fs::path root = fs::weakly_canonical(fs::path(work_dir), ec);
+  if (ec) return arrow::Status::IOError("bad work dir: ", work_dir);
+  fs::path resolved = fs::weakly_canonical(fs::path(path), ec);
+  if (ec) return arrow::Status::IOError("bad path: ", path);
+  auto root_s = root.string();
+  auto res_s = resolved.string();
+  if (res_s != root_s &&
+      (res_s.size() <= root_s.size() + 1 || res_s.compare(0, root_s.size(), root_s) != 0 ||
+       res_s[root_s.size()] != fs::path::preferred_separator))
+    return arrow::Status::Invalid("path escapes work dir: ", path);
+  return arrow::Status::OK();
+}
+
+static bool ValidJobId(const std::string& job) {
+  if (job.empty() || job == "." || job == "..") return false;
+  return job.find('/') == std::string::npos && job.find('\\') == std::string::npos &&
+         job.find('\0') == std::string::npos;
+}
+
+static arrow::Result<std::shared_ptr<arrow::Buffer>> ReadRange(const std::string& ticket_json,
+                                                               const std::string& work_dir) {
   std::string path = JsonStr(ticket_json, "path");
+  ARROW_RETURN_NOT_OK(CheckContained(work_dir, path));
   std::string layout = JsonStr(ticket_json, "layout");
   if (layout.rfind("sort", 0) == 0) {
     std::ifstream idx(IndexPath(path));
@@ -166,7 +194,7 @@ class ShuffleServer : public fl::FlightServerBase {
 
   arrow::Status DoGet(const fl::ServerCallContext&, const fl::Ticket& request,
                       std::unique_ptr<fl::FlightDataStream>* stream) override {
-    ARROW_ASSIGN_OR_RAISE(auto buf, ReadRange(request.ticket));
+    ARROW_ASSIGN_OR_RAISE(auto buf, ReadRange(request.ticket, work_dir_));
     if (buf->size() == 0) {
       auto schema = arrow::schema({});
       ARROW_ASSIGN_OR_RAISE(
@@ -184,7 +212,7 @@ class ShuffleServer : public fl::FlightServerBase {
                          std::unique_ptr<fl::ResultStream>* result) override {
     std::string body = action.body ? action.body->ToString() : "";
     if (action.type == "io_block_transport") {
-      ARROW_ASSIGN_OR_RAISE(auto buf, ReadRange(body));
+      ARROW_ASSIGN_OR_RAISE(auto buf, ReadRange(body, work_dir_));
       std::vector<fl::Result> results;
       for (int64_t off = 0; off < buf->size(); off += kBlockSize) {
         auto len = std::min(kBlockSize, buf->size() - off);
@@ -195,7 +223,8 @@ class ShuffleServer : public fl::FlightServerBase {
     }
     if (action.type == "remove_job_data") {
       std::string job = JsonStr(body, "job_id");
-      if (!job.empty() && !work_dir_.empty()) {
+      if (!ValidJobId(job)) return arrow::Status::Invalid("invalid job id: ", job);
+      if (!work_dir_.empty()) {
         std::error_code ec;
         fs::remove_all(fs::path(work_dir_) / job, ec);  // best-effort GC
       }

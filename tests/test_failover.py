@@ -144,3 +144,40 @@ def test_owner_lease_expiry(tmp_path):
     assert not s.acquire("j", "standby")
     time.sleep(0.4)
     assert s.acquire("j", "standby")
+
+
+def test_concurrent_takeover_single_winner(tmp_path):
+    """Two standbys adopting the same expired lease: exactly one wins
+    (CAS takeover under an flock — regression for the non-atomic rewrite)."""
+    import threading
+
+    from ballista_tpu.scheduler.state.job_state import FileJobState
+
+    import os
+    import time
+
+    st = FileJobState(str(tmp_path), lease_s=60.0)
+    assert st.acquire("jobx", "dead-owner")
+    # backdate the dead owner's marker past the lease
+    marker = st._owner_path("jobx")
+    past = time.time() - 3600
+    os.utime(marker, (past, past))
+
+    results = {}
+    barrier = threading.Barrier(8)
+
+    def adopt(sid):
+        barrier.wait()
+        results[sid] = st.acquire("jobx", sid)
+
+    threads = [threading.Thread(target=adopt, args=(f"s{i}",)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert sum(results.values()) == 1, results
+    winner = next(s for s, ok in results.items() if ok)
+    # idempotent re-acquire by the winner; losers still refused
+    assert st.acquire("jobx", winner)
+    loser = next(s for s in results if s != winner)
+    assert not st.acquire("jobx", loser)

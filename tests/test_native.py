@@ -1,6 +1,9 @@
 """Native C++ row router: bit parity with the numpy hasher and routing
 correctness. Skipped when no compiler/lib is available."""
 
+import json
+import os
+
 import numpy as np
 import pyarrow as pa
 import pytest
@@ -158,3 +161,65 @@ def test_native_flight_wire_contract(native_flight):
 
     list(client.do_action(flight.Action("remove_job_data", json.dumps({"job_id": "jobn"}).encode())))
     assert not os.path.exists(os.path.join(work, "jobn"))
+
+
+# -- data-plane containment (both server impls share the wire contract) ----
+
+
+@pytest.fixture(scope="module")
+def python_flight(tmp_path_factory):
+    from ballista_tpu.flight.server import start_flight_server
+
+    work = str(tmp_path_factory.mktemp("py-flight"))
+    server, port = python_flight_handle = start_flight_server(work, "127.0.0.1", 0)
+    yield work, port
+    server.shutdown()
+
+
+def _assert_contained(work, port):
+    import pyarrow.flight as flight
+
+    client = flight.FlightClient(f"grpc://127.0.0.1:{port}")
+    # a secret OUTSIDE the work dir must not be readable through any path
+    secret = os.path.join(os.path.dirname(work), "secret-" + os.path.basename(work))
+    os.makedirs(secret, exist_ok=True)
+    secret_file = os.path.join(secret, "creds.arrow")
+    with open(secret_file, "wb") as f:
+        f.write(b"hunter2")
+    rejected = (flight.FlightError, pa.ArrowInvalid)  # status mapping differs per impl
+    for path in (secret_file, os.path.join(work, "..", os.path.basename(secret), "creds.arrow")):
+        t = flight.Ticket(json.dumps({"path": path, "layout": "hash", "output_partition": 0}).encode())
+        with pytest.raises(rejected):
+            list(client.do_get(t))
+        a = flight.Action("io_block_transport", json.dumps(
+            {"path": path, "layout": "hash", "output_partition": 0}).encode())
+        with pytest.raises(rejected):
+            list(client.do_action(a))
+    # job-id traversal must not delete outside the work dir
+    for bad in ("../" + os.path.basename(secret), "..", "a/b", ""):
+        a = flight.Action("remove_job_data", json.dumps({"job_id": bad}).encode())
+        with pytest.raises(rejected):
+            list(client.do_action(a))
+    assert os.path.exists(secret_file)
+    # contained reads still work
+    d = os.path.join(work, "jobc", "1", "0")
+    os.makedirs(d, exist_ok=True)
+    batch = pa.record_batch({"x": pa.array([1, 2, 3], pa.int64())})
+    inside = os.path.join(d, "data-t1.arrow")
+    with open(inside, "wb") as f:
+        import pyarrow.ipc as ipc
+
+        with ipc.new_stream(f, batch.schema) as w:
+            w.write_batch(batch)
+    t = flight.Ticket(json.dumps({"path": inside, "layout": "hash", "output_partition": 0}).encode())
+    got = list(client.do_get(t))
+    assert sum(c.data.num_rows for c in got) == 3
+
+
+def test_python_flight_path_containment(python_flight):
+    _assert_contained(*python_flight)
+
+
+@needs_native
+def test_native_flight_path_containment(native_flight):
+    _assert_contained(*native_flight)

@@ -74,3 +74,47 @@ def test_config_docs_generation():
     docs = generate_config_docs()
     assert "ballista.executor.engine" in docs
     assert "ballista.tpu.shape.buckets" in docs
+
+
+def test_hash_nullable_columns_match_clean_columns():
+    """Wire contract under nulls: a nullable column's VALID slots must hash
+    identically to the same values in a null-free column (and to the native
+    C++ hasher). Regression for the float64 to_numpy round-trip that
+    mis-hashed every row of nullable date32/bool columns and lost precision
+    on nullable int64 > 2^53."""
+    from ballista_tpu.ops import native
+
+    big = 2**60 + 12345  # would corrupt through float64
+    cases = [
+        (pa.array([1, None, big, -7], pa.int64()),
+         pa.array([1, 0, big, -7], pa.int64())),
+        (pa.array([3, None, 20000], pa.int32()).cast(pa.date32()),
+         pa.array([3, 0, 20000], pa.int32()).cast(pa.date32())),
+        (pa.array([True, None, False], pa.bool_()),
+         pa.array([True, False, False], pa.bool_())),
+        (pa.array([1.5, None, -2.25], pa.float64()),
+         pa.array([1.5, 0.0, -2.25], pa.float64())),
+    ]
+    for nullable, clean in cases:
+        hn = hash_arrays([nullable])
+        hc = hash_arrays([clean])
+        valid = np.asarray(nullable.is_valid())
+        assert (hn[valid] == hc[valid]).all(), nullable.type
+        # null slots get the stable null tag, distinct from the filled value
+        assert (hn[~valid] != hc[~valid]).all(), nullable.type
+        nat = native.hash_arrays_native([nullable])
+        if nat is not None:
+            assert (hn == nat).all(), nullable.type
+
+
+def test_hash_date64_columns():
+    """date64 repartition keys must hash (ms-int64 direct cast) and agree
+    with the equivalent date32 values where representable."""
+    from ballista_tpu.ops import native
+
+    ms = pa.array([86_400_000, None, 172_800_000], pa.int64()).cast(pa.date64())
+    h = hash_arrays([ms])
+    assert len(set(h.tolist())) == 3
+    nat = native.hash_arrays_native([ms])
+    if nat is not None:
+        assert (h == nat).all()

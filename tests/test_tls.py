@@ -79,3 +79,50 @@ def test_mtls_cluster_end_to_end(tmp_path, tpch_dir):
     finally:
         ex.shutdown()
         sched.shutdown()
+
+
+def test_mtls_cluster_proxied_results(tmp_path, tpch_dir):
+    """NAT/k8s mode under mTLS: the scheduler's Flight proxy serves TLS and
+    relays from the executor's TLS data plane with its own client certs."""
+    from ballista_tpu.client.context import SessionContext
+    from ballista_tpu.config import (
+        FLIGHT_PROXY,
+        GRPC_TLS_CA,
+        GRPC_TLS_CERT,
+        GRPC_TLS_KEY,
+        BallistaConfig,
+    )
+    from ballista_tpu.executor.executor_process import ExecutorProcess
+    from ballista_tpu.scheduler.process import SchedulerProcess
+    from ballista_tpu.testing.tpchgen import register_tpch
+
+    certs = _gen_certs(str(tmp_path))
+    sched = SchedulerProcess(
+        bind_host="127.0.0.1", port=0, rest_port=-1, flight_proxy_port=0,
+        tls_cert=certs["server_crt"], tls_key=certs["server_key"],
+        tls_client_ca=certs["ca"],
+    )
+    sched.start()
+    addr = f"127.0.0.1:{sched.port}"
+    ex = ExecutorProcess(
+        addr, bind_host="127.0.0.1", external_host="127.0.0.1", vcores=2,
+        tls_cert=certs["server_crt"], tls_key=certs["server_key"], tls_ca=certs["ca"],
+    )
+    ex.start()
+    time.sleep(0.3)
+    try:
+        cfg = BallistaConfig({
+            GRPC_TLS_CA: certs["ca"],
+            GRPC_TLS_CERT: certs["client_crt"],
+            GRPC_TLS_KEY: certs["client_key"],
+            FLIGHT_PROXY: f"127.0.0.1:{sched.flight_proxy_port}",
+        })
+        ctx = SessionContext.remote(addr, cfg)
+        register_tpch(ctx, tpch_dir)
+        out = ctx.sql(
+            "select n_regionkey, count(*) n from nation group by n_regionkey order by n_regionkey"
+        ).collect()
+        assert out.column("n").to_pylist() == [5, 5, 5, 5, 5]
+    finally:
+        ex.shutdown()
+        sched.shutdown()
