@@ -39,6 +39,7 @@ class DeviceCol:
     data: Any  # np/jnp array, padded
     dictionary: Optional[list] = None  # for kind == "code"
     scale: int = 0  # for kind == "money": value = data / 10**scale
+    valid: Optional[np.ndarray] = None  # bool validity plane; None = no nulls
 
 
 @dataclass
@@ -80,33 +81,65 @@ def _narrow_int(vals: np.ndarray) -> np.ndarray:
 
 
 def encode_column(arr: pa.Array) -> Optional[DeviceCol]:
-    """Encode one Arrow column; None = not encodable (fallback to CPU)."""
+    """Encode one Arrow column; None = not encodable (fallback to CPU).
+
+    Nullable columns encode with a boolean VALIDITY PLANE riding alongside
+    the value lane: null slots are filled with a type default (the plane,
+    not the fill value, is what kernels consult) so stages over NULL-bearing
+    data stay on device instead of falling back to the CPU engine."""
     if isinstance(arr, pa.ChunkedArray):
         arr = arr.combine_chunks()
-    if arr.null_count:
-        return None
     t = arr.type
+    valid = np.asarray(arr.is_valid()) if arr.null_count else None
+
+    def v(col: DeviceCol) -> DeviceCol:
+        col.valid = valid
+        return col
+
     if pa.types.is_dictionary(t):
-        codes = arr.indices.to_numpy(zero_copy_only=False)
-        return DeviceCol("code", _narrow_int(codes), dictionary=arr.dictionary.to_pylist())
+        idx = arr.indices
+        if idx.null_count:
+            idx = pc.fill_null(idx, 0)
+        codes = idx.to_numpy(zero_copy_only=False)
+        return v(DeviceCol("code", _narrow_int(codes), dictionary=arr.dictionary.to_pylist()))
+    if arr.null_count:
+        if pa.types.is_boolean(t):
+            arr = pc.fill_null(arr, False)
+        elif pa.types.is_date(t):
+            filled = pc.fill_null(arr.cast(pa.int32() if pa.types.is_date32(t) else pa.int64(),
+                                           safe=False), 0)
+            days = filled.to_numpy(zero_copy_only=False)
+            if pa.types.is_date64(t):
+                days = days // 86_400_000  # ms → days
+            return v(DeviceCol("date", days.astype(np.int32)))
+        elif pa.types.is_string(t) or pa.types.is_large_string(t):
+            pass  # dictionary_encode keeps nulls in the index; filled below
+        else:
+            arr = pc.fill_null(arr, 0)
     if pa.types.is_integer(t):
         vals = arr.cast(pa.int64(), safe=False).to_numpy(zero_copy_only=False)
-        return DeviceCol("i64", _narrow_int(vals))
+        return v(DeviceCol("i64", _narrow_int(vals.astype(np.int64, copy=False))))
     if pa.types.is_date(t):
-        return DeviceCol("date", arr.cast(pa.int32(), safe=False).to_numpy(zero_copy_only=False))
+        if pa.types.is_date64(t):
+            ms = arr.cast(pa.int64(), safe=False).to_numpy(zero_copy_only=False)
+            return v(DeviceCol("date", (ms // 86_400_000).astype(np.int32)))
+        return v(DeviceCol("date", arr.cast(pa.int32(), safe=False).to_numpy(zero_copy_only=False)))
     if pa.types.is_boolean(t):
-        return DeviceCol("bool", arr.to_numpy(zero_copy_only=False))
+        return v(DeviceCol("bool", arr.to_numpy(zero_copy_only=False)))
     if pa.types.is_floating(t):
         vals = arr.cast(pa.float64()).to_numpy(zero_copy_only=False)
         if _is_fixed_point(vals, 2):
-            return DeviceCol("money", _narrow_int(np.rint(vals * 100)), scale=2)
-        return DeviceCol("f64", vals)
+            return v(DeviceCol("money", _narrow_int(np.rint(vals * 100)), scale=2))
+        return v(DeviceCol("f64", vals))
     if pa.types.is_string(t) or pa.types.is_large_string(t):
         enc = pc.dictionary_encode(arr)
         if isinstance(enc, pa.ChunkedArray):
             enc = enc.combine_chunks()
-        codes = enc.indices.to_numpy(zero_copy_only=False)
-        return DeviceCol("code", _narrow_int(codes), dictionary=enc.dictionary.to_pylist())
+        idx = enc.indices
+        if idx.null_count:
+            idx = pc.fill_null(idx, 0)
+        codes = idx.to_numpy(zero_copy_only=False)
+        return v(DeviceCol("code", _narrow_int(codes), dictionary=enc.dictionary.to_pylist()))
     return None
 
 

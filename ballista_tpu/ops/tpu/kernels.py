@@ -33,6 +33,8 @@ from ballista_tpu.plan.expressions import (
     Column,
     Expr,
     InList,
+    IsNotNull,
+    IsNull,
     Like,
     Literal,
     Negative,
@@ -57,10 +59,30 @@ class DevVal:
     arr: Any  # jnp array
     scale: int = 0
     dictionary: list | None = None
+    valid: Any = None  # jnp bool array; None = known non-null everywhere
 
 
 class Unsupported(Exception):
     """Raised at lowering time → subtree falls back to the CPU engine."""
+
+
+def vand(*valids):
+    """Null-strict validity combine: result is null if ANY input is null
+    (the SQL rule for comparisons, arithmetic, casts, function args)."""
+    out = None
+    for v in valids:
+        if v is None:
+            continue
+        out = v if out is None else out & v
+    return out
+
+
+def true_mask(v: DevVal):
+    """Project three-valued logic onto filtering: rows pass a WHERE clause
+    only when the predicate is TRUE — unknown (NULL) behaves as false."""
+    if v.valid is None:
+        return v.arr
+    return v.arr & v.valid
 
 
 # -- bit-exact twin of ops/hashing.py ---------------------------------------
@@ -234,8 +256,9 @@ def lower_expr(e: Expr, ctx: Lowering) -> LoweredFn:
                         neg = e.op == "<>"
 
                         def run(cols, luts, src=src, li=li, neg=neg):
-                            out = luts[li][src(cols, luts).arr]
-                            return DevVal("bool", ~out if neg else out)
+                            v = src(cols, luts)
+                            out = luts[li][v.arr]
+                            return DevVal("bool", ~out if neg else out, valid=v.valid)
 
                         return run
         lf = lower_expr(e.left, ctx)
@@ -249,14 +272,35 @@ def lower_expr(e: Expr, ctx: Lowering) -> LoweredFn:
 
     if isinstance(e, Not):
         f = lower_expr(e.expr, ctx)
-        return lambda cols, luts: DevVal("bool", ~f(cols, luts).arr)
+
+        def run(cols, luts):
+            v = f(cols, luts)
+            return DevVal("bool", ~v.arr, valid=v.valid)  # NOT NULL is NULL
+
+        return run
+
+    if isinstance(e, IsNull) or isinstance(e, IsNotNull):
+        f = lower_expr(e.expr, ctx)
+        want_null = isinstance(e, IsNull)
+
+        def run(cols, luts):
+            jnp = _jnp()
+            v = f(cols, luts)
+            if v.valid is None:
+                out = jnp.zeros(jnp.shape(v.arr), bool) if want_null \
+                    else jnp.ones(jnp.shape(v.arr), bool)
+            else:
+                out = ~v.valid if want_null else v.valid
+            return DevVal("bool", out)  # IS [NOT] NULL is never null itself
+
+        return run
 
     if isinstance(e, Negative):
         f = lower_expr(e.expr, ctx)
 
         def run(cols, luts):
             v = f(cols, luts)
-            return DevVal(v.kind, -v.arr, v.scale)
+            return DevVal(v.kind, -v.arr, v.scale, valid=v.valid)
 
         return run
 
@@ -268,10 +312,10 @@ def lower_expr(e: Expr, ctx: Lowering) -> LoweredFn:
 
         def run(cols, luts):
             v = vf(cols, luts)
-            lo = _binop(v, ">=", lof(cols, luts)).arr
-            hi = _binop(v, "<=", hif(cols, luts)).arr
-            out = lo & hi
-            return DevVal("bool", ~out if neg else out)
+            lo = _binop(v, ">=", lof(cols, luts))
+            hi = _binop(v, "<=", hif(cols, luts))
+            both = _binop(lo, "and", hi)
+            return DevVal("bool", ~both.arr if neg else both.arr, valid=both.valid)
 
         return run
 
@@ -291,9 +335,9 @@ def lower_expr(e: Expr, ctx: Lowering) -> LoweredFn:
             neg = e.negated
 
             def run(cols, luts):
-                codes = src(cols, luts).arr
-                out = luts[li][codes]
-                return DevVal("bool", ~out if neg else out)
+                v = src(cols, luts)
+                out = luts[li][v.arr]
+                return DevVal("bool", ~out if neg else out, valid=v.valid)
 
             return run
         inner = lower_expr(e.expr, ctx)
@@ -308,13 +352,14 @@ def lower_expr(e: Expr, ctx: Lowering) -> LoweredFn:
 
                 def run(cols, luts):
                     jnp = _jnp()
-                    arr = src(cols, luts).arr
-                    out = jnp.zeros(arr.shape, dtype=bool)
-                    for v in vals:
-                        if isinstance(v, _dt.date):
-                            v = (v - _dt.date(1970, 1, 1)).days
-                        out = out | (arr == v)
-                    return DevVal("bool", ~out if neg else out)
+                    v = src(cols, luts)
+                    out = jnp.zeros(v.arr.shape, dtype=bool)
+                    for lit in vals:
+                        if isinstance(lit, _dt.date):
+                            lit = (lit - _dt.date(1970, 1, 1)).days
+                        out = out | (v.arr == lit)
+                    # NULL IN (...) / NULL NOT IN (...) are both unknown
+                    return DevVal("bool", ~out if neg else out, valid=v.valid)
 
                 return run
         raise Unsupported(f"IN over {e.expr}")
@@ -337,8 +382,9 @@ def lower_expr(e: Expr, ctx: Lowering) -> LoweredFn:
         neg = e.negated
 
         def run(cols, luts):
-            out = luts[li][src(cols, luts).arr]
-            return DevVal("bool", ~out if neg else out)
+            v = src(cols, luts)
+            out = luts[li][v.arr]
+            return DevVal("bool", ~out if neg else out, valid=v.valid)
 
         return run
 
@@ -346,26 +392,41 @@ def lower_expr(e: Expr, ctx: Lowering) -> LoweredFn:
         branch_fns = [(lower_expr(w, ctx), lower_expr(t, ctx)) for w, t in e.branches]
         else_fn = lower_expr(e.else_expr, ctx) if e.else_expr is not None else None
 
+        has_else = else_fn is not None
+
         def run(cols, luts):
             jnp = _jnp()
             thens = [tf(cols, luts) for _, tf in branch_fns]
             whens = [wf(cols, luts) for wf, _ in branch_fns]
             # align all branch values to a common kind/scale
             target = thens[0]
-            if else_fn is not None:
+            if has_else:
                 evd = else_fn(cols, luts)
             else:
-                evd = DevVal(target.kind, jnp.zeros((), dtype=target.arr.dtype), target.scale)
+                # no ELSE: the fall-through value is NULL
+                evd = DevVal(target.kind, jnp.zeros((), dtype=target.arr.dtype),
+                             target.scale, valid=jnp.zeros((), dtype=bool))
             allv = thens + [evd]
-            kind, scale = _common_kind([ (v.kind, v.scale) for v in allv ])
+            kind, scale = _common_kind([(v.kind, v.scale) for v in allv])
             allv = [_coerce(v, kind, scale) for v in allv]
+            nullable = any(v.valid is not None for v in whens) or any(
+                v.valid is not None for v in allv
+            )
             out = allv[-1].arr
+            out_valid = None
+            if nullable:
+                ev = allv[-1].valid
+                out_valid = ev if ev is not None else jnp.ones((), dtype=bool)
             decided = jnp.zeros((), dtype=bool)
             for w, t in zip(whens, allv[:-1]):
-                cond = w.arr & ~decided
+                taken = true_mask(w)  # a NULL condition skips its branch
+                cond = taken & ~decided
                 out = jnp.where(cond, t.arr, out)
-                decided = decided | w.arr
-            return DevVal(kind, out, scale)
+                if nullable:
+                    tv = t.valid if t.valid is not None else True
+                    out_valid = jnp.where(cond, tv, out_valid)
+                decided = decided | taken
+            return DevVal(kind, out, scale, valid=out_valid)
 
         return run
 
@@ -382,8 +443,8 @@ def lower_expr(e: Expr, ctx: Lowering) -> LoweredFn:
                 return _coerce(v, "f64", 0)
             if pa.types.is_integer(to):
                 if v.kind == "money":
-                    return DevVal("i64", v.arr // (10**v.scale))
-                return DevVal("i64", v.arr.astype(jnp.int64))
+                    return DevVal("i64", v.arr // (10**v.scale), valid=v.valid)
+                return DevVal("i64", v.arr.astype(jnp.int64), valid=v.valid)
             raise Unsupported(f"cast to {to}")
 
         return run
@@ -410,8 +471,8 @@ def lower_expr(e: Expr, ctx: Lowering) -> LoweredFn:
                 m = jnp.where(mp < 10, mp + 3, mp - 9)
                 y = jnp.where(m <= 2, y + 1, y)
                 if part == "extract_year":
-                    return DevVal("i64", y.astype(jnp.int64))
-                return DevVal("i64", m.astype(jnp.int64))
+                    return DevVal("i64", y.astype(jnp.int64), valid=v.valid)
+                return DevVal("i64", m.astype(jnp.int64), valid=v.valid)
 
             return run
         raise Unsupported(f"scalar fn {e.name}")
@@ -455,15 +516,15 @@ def _coerce(v: DevVal, kind: str, scale: int) -> DevVal:
         return v
     if kind == "f64":
         if v.kind == "money":
-            return DevVal("f64", v.arr.astype(jnp.float64) / (10**v.scale))
-        return DevVal("f64", v.arr.astype(jnp.float64))
+            return DevVal("f64", v.arr.astype(jnp.float64) / (10**v.scale), valid=v.valid)
+        return DevVal("f64", v.arr.astype(jnp.float64), valid=v.valid)
     if kind == "money":
         if v.kind == "money":
-            return DevVal("money", v.arr * (10 ** (scale - v.scale)), scale)
+            return DevVal("money", v.arr * (10 ** (scale - v.scale)), scale, valid=v.valid)
         if v.kind in ("i64", "bool"):
-            return DevVal("money", v.arr.astype(jnp.int64) * (10**scale), scale)
+            return DevVal("money", v.arr.astype(jnp.int64) * (10**scale), scale, valid=v.valid)
     if kind == "i64":
-        return DevVal("i64", v.arr.astype(jnp.int64))
+        return DevVal("i64", v.arr.astype(jnp.int64), valid=v.valid)
     raise Unsupported(f"coerce {v.kind}->{kind}")
 
 
@@ -473,10 +534,23 @@ _CMP_OPS = {"=", "<>", "<", "<=", ">", ">="}
 def _binop(l: DevVal, op: str, r: DevVal) -> DevVal:
     jnp = _jnp()
     if op in ("and", "or"):
+        # Kleene three-valued logic. Null value slots are FILLED with False
+        # at encode time, so the value lane of AND/OR is simply &/| — the
+        # validity lane records where the result is actually known:
+        #   x AND y known iff (both known) or (a known-FALSE side exists)
+        #   x OR  y known iff (both known) or (a known-TRUE  side exists)
+        if l.valid is None and r.valid is None:
+            out = l.arr & r.arr if op == "and" else l.arr | r.arr
+            return DevVal("bool", out)
+        lv = l.valid if l.valid is not None else True
+        rv = r.valid if r.valid is not None else True
         if op == "and":
-            return DevVal("bool", l.arr & r.arr)
-        return DevVal("bool", l.arr | r.arr)
+            valid = (lv & rv) | (lv & ~l.arr) | (rv & ~r.arr)
+            return DevVal("bool", l.arr & r.arr, valid=valid)
+        valid = (lv & rv) | (lv & l.arr) | (rv & r.arr)
+        return DevVal("bool", l.arr | r.arr, valid=valid)
 
+    valid = vand(l.valid, r.valid)
     if op in _CMP_OPS:
         if l.kind == "code" or r.kind == "code":
             code, lit = (l, r) if l.kind == "code" else (r, l)
@@ -487,30 +561,30 @@ def _binop(l: DevVal, op: str, r: DevVal) -> DevVal:
             "=": lambda: a == b, "<>": lambda: a != b, "<": lambda: a < b,
             "<=": lambda: a <= b, ">": lambda: a > b, ">=": lambda: a >= b,
         }[op]
-        return DevVal("bool", fn())
+        return DevVal("bool", fn(), valid=valid)
 
-    # arithmetic
+    # arithmetic (null-strict: validity is the AND of input validities)
     if op == "/":
         a = _coerce(l, "f64", 0).arr
         b = _coerce(r, "f64", 0).arr
-        return DevVal("f64", a / b)
+        return DevVal("f64", a / b, valid=valid)
     if op == "*":
         if l.kind == "money" and r.kind == "money":
-            return DevVal("money", l.arr * r.arr, l.scale + r.scale)
+            return DevVal("money", l.arr * r.arr, l.scale + r.scale, valid=valid)
         if l.kind == "money" and r.kind in ("i64", "bool"):
-            return DevVal("money", l.arr * r.arr.astype(jnp.int64), l.scale)
+            return DevVal("money", l.arr * r.arr.astype(jnp.int64), l.scale, valid=valid)
         if r.kind == "money" and l.kind in ("i64", "bool"):
-            return DevVal("money", r.arr * l.arr.astype(jnp.int64), r.scale)
+            return DevVal("money", r.arr * l.arr.astype(jnp.int64), r.scale, valid=valid)
         if "f64" in (l.kind, r.kind):
-            return DevVal("f64", _coerce(l, "f64", 0).arr * _coerce(r, "f64", 0).arr)
-        return DevVal("i64", l.arr.astype(jnp.int64) * r.arr.astype(jnp.int64))
+            return DevVal("f64", _coerce(l, "f64", 0).arr * _coerce(r, "f64", 0).arr, valid=valid)
+        return DevVal("i64", l.arr.astype(jnp.int64) * r.arr.astype(jnp.int64), valid=valid)
     if op in ("+", "-"):
         if l.kind == "date" and r.kind == "i64":
             arr = l.arr + (r.arr if op == "+" else -r.arr).astype(l.arr.dtype)
-            return DevVal("date", arr)
+            return DevVal("date", arr, valid=valid)
         kind, scale = _common_kind([(l.kind, l.scale), (r.kind, r.scale)])
         a, b = _coerce(l, kind, scale).arr, _coerce(r, kind, scale).arr
-        return DevVal(kind, a + b if op == "+" else a - b, scale)
+        return DevVal(kind, a + b if op == "+" else a - b, scale, valid=valid)
     raise Unsupported(f"binop {op}")
 
 

@@ -41,6 +41,7 @@ from ballista_tpu.ops.tpu.kernels import (
     Lowering,
     Unsupported,
     lower_expr,
+    true_mask,
 )
 from ballista_tpu.ops.tpu.runtime import ensure_jax
 from ballista_tpu.plan.expressions import Alias, Column, Expr
@@ -86,7 +87,7 @@ class BuildTable:
     so each probe row can emit up to dup joined rows into the agg."""
 
     def __init__(self, mode, keys, payloads, kinds, scales, dicts, n_rows, device=False,
-                 dup=1, cnt=None):
+                 dup=1, cnt=None, pay_valids=None):
         self.mode = mode  # direct | sorted
         self.keys = keys  # direct: int32 [T] row/lo table; sorted: int64 [B] keys
         self.payloads = payloads  # per column, padded (unique direct: original order)
@@ -98,20 +99,37 @@ class BuildTable:
         self.dup = dup  # max duplicates per key (1 = unique fast paths)
         self.cnt = cnt  # direct expansion mode: int32 [T] per-key match count
         self.shifts: list[int] = []  # multi-key combine shifts (per extra key)
+        # per payload column: bool [B] validity plane or None; padding slots
+        # are invalid, so an outer join's unmatched gathers decode as NULL
+        self.pay_valids = pay_valids if pay_valids is not None else [None] * len(payloads)
 
     def flat_arrays(self):
-        """Device-arg layout: keys [, cnt] , payloads... (offset contract
-        shared with the lowering closures)."""
+        """Device-arg layout: keys [, cnt] , payloads..., payload validity
+        planes... (offset contract shared with the lowering closures)."""
         out = [self.keys]
         if self.cnt is not None:
             out.append(self.cnt)
-        return out + list(self.payloads)
+        return out + list(self.payloads) + [v for v in self.pay_valids if v is not None]
+
+    def pay_valid_flat_idx(self) -> list:
+        """Per payload: index of its validity plane within flat_arrays()
+        (relative to this build's block), or None."""
+        out = []
+        nxt = (2 if self.cnt is not None else 1) + len(self.payloads)
+        for v in self.pay_valids:
+            if v is None:
+                out.append(None)
+            else:
+                out.append(nxt)
+                nxt += 1
+        return out
 
     def shape_key(self):
         return (
             self.mode, len(self.keys), tuple(self.shifts), self.dup,
             self.cnt is not None, self.padded_rows(),
             tuple(str(p.dtype) for p in self.payloads),
+            tuple(v is not None for v in self.pay_valids),
             tuple(_pow2(len(d)) if d else 0 for d in self.dicts),
         )
 
@@ -124,7 +142,8 @@ class BuildTable:
 class DeviceTable:
     """All partitions of one scan, device-resident as [P, N] stacks."""
 
-    def __init__(self, kinds, scales, dicts, cols, mask, part_rows, nbytes):
+    def __init__(self, kinds, scales, dicts, cols, mask, part_rows, nbytes,
+                 valids=None):
         self.kinds = kinds  # per column
         self.scales = scales
         self.dicts = dicts  # unified (global) dictionaries
@@ -132,10 +151,30 @@ class DeviceTable:
         self.mask = mask  # jnp bool [P, N]
         self.part_rows = part_rows
         self.nbytes = nbytes
+        # per column: jnp bool [P, N] validity plane, or None (no nulls);
+        # value slots under an invalid plane hold type-default fills
+        self.valids = valids if valids is not None else [None] * len(cols)
 
     @property
     def shape(self):
         return self.mask.shape
+
+    def flat_cols(self):
+        """Device-arg layout: data columns, then the validity planes of the
+        nullable columns (offset contract shared with _mk_col_reader)."""
+        return list(self.cols) + [v for v in self.valids if v is not None]
+
+    def valid_flat_idx(self) -> list:
+        """Per column: index of its validity plane in flat_cols(), or None."""
+        out = []
+        nxt = len(self.cols)
+        for v in self.valids:
+            if v is None:
+                out.append(None)
+            else:
+                out.append(nxt)
+                nxt += 1
+        return out
 
 
 class DeviceTableCache:
@@ -220,7 +259,7 @@ class DeviceTableCache:
                 part_rows.append(0)
         P = len(part_rows)
 
-        kinds, scales, dicts, cols_np = [], [], [], []
+        kinds, scales, dicts, cols_np, valids_np = [], [], [], [], []
         for name in full.column_names:
             dc = encode_column(full.column(name))
             if dc is None:
@@ -234,6 +273,15 @@ class DeviceTableCache:
                 stack[p, :r] = dc.data[off : off + r]
                 off += r
             cols_np.append(stack)
+            if dc.valid is None:
+                valids_np.append(None)
+            else:
+                vstack = np.zeros((P, N), dtype=bool)
+                off = 0
+                for p, r in enumerate(part_rows):
+                    vstack[p, :r] = dc.valid[off : off + r]
+                    off += r
+                valids_np.append(vstack)
         mask_np = np.zeros((P, N), dtype=bool)
         for p, r in enumerate(part_rows):
             mask_np[p, :r] = True
@@ -245,9 +293,11 @@ class DeviceTableCache:
         else:
             spec = None
         cols = [_put(mesh, c, spec) for c in cols_np]
+        valids = [None if v is None else _put(mesh, v, spec) for v in valids_np]
         mask = _put(mesh, mask_np, spec)
         nbytes = sum(c.nbytes for c in cols_np) + mask_np.nbytes
-        return DeviceTable(kinds, scales, dicts, cols, mask, part_rows, nbytes)
+        nbytes += sum(v.nbytes for v in valids_np if v is not None)
+        return DeviceTable(kinds, scales, dicts, cols, mask, part_rows, nbytes, valids)
 
 
 DEVICE_CACHE = DeviceTableCache()
@@ -358,6 +408,21 @@ class TpuStageExec(ExecutionPlan):
         for p in range(join.left.output_partition_count()):
             batches.extend(b for b in join.left.execute(p, ctx) if b.num_rows)
         tbl = _concat(batches, join.left.schema()).combine_chunks()
+        if tbl.num_rows:
+            # a build row whose key is NULL can never match any probe row
+            # (inner/semi/anti/outer alike): drop it before encoding
+            import pyarrow.compute as _pc
+
+            keep = None
+            for l_expr, _ in join.on:
+                arr = evaluate_to_array(
+                    bind_expr(l_expr, join.left.df_schema), tbl.to_batches()[0]
+                )
+                if arr.null_count:
+                    va = arr.is_valid()
+                    keep = va if keep is None else _pc.and_(keep, va)
+            if keep is not None:
+                tbl = tbl.filter(keep).combine_chunks()
         if tbl.num_rows == 0:
             raise Unsupported("empty build side (let CPU/AQE handle it)")
         batch = tbl.to_batches()[0]
@@ -370,7 +435,7 @@ class TpuStageExec(ExecutionPlan):
         for l_expr, _ in join.on:
             arr = evaluate_to_array(bind_expr(l_expr, join.left.df_schema), batch)
             if arr.null_count:
-                raise Unsupported("NULL build keys")
+                raise Unsupported("NULL build keys survived the pre-filter")
             import pyarrow as _pa
 
             t = arr.type
@@ -433,7 +498,7 @@ class TpuStageExec(ExecutionPlan):
             keys_dev[: len(sorted_keys)] = sorted_keys
             mode = "sorted"
 
-        kinds, scales, dicts, payloads = [], [], [], []
+        kinds, scales, dicts, payloads, pay_valids = [], [], [], [], []
         if join.join_type not in ("right_semi", "right_anti"):
             # membership-only joins never gather build columns: skip payload
             # encode/upload entirely (an unencodable non-key column must not
@@ -448,11 +513,18 @@ class TpuStageExec(ExecutionPlan):
                 padded = np.zeros(B, dtype=dc.data.dtype)
                 padded[: len(order)] = dc.data[order]
                 payloads.append(padded)
+                if dc.valid is None:
+                    pay_valids.append(None)
+                else:
+                    pv = np.zeros(B, dtype=bool)  # padding slots stay invalid
+                    pv[: len(order)] = dc.valid[order]
+                    pay_valids.append(pv)
 
         bt = BuildTable(
             mode, _put(mesh, keys_dev), [_put(mesh, p) for p in payloads],
             kinds, scales, dicts, len(order), device=True, dup=dup,
             cnt=None if cnt_dev is None else _put(mesh, cnt_dev),
+            pay_valids=[None if v is None else _put(mesh, v) for v in pay_valids],
         )
         bt.shifts = shifts
         _BUILD_CACHE[cache_key] = bt
@@ -484,6 +556,7 @@ class TpuStageExec(ExecutionPlan):
         emit_key = (tuple(self.emit_pid[0]), self.emit_pid[1]) if self.emit_pid else None
         key = (
             self.fingerprint, P, N, tuple(kinds), dtypes,
+            tuple(v is not None for v in dt.valids),
             tuple(_pow2(len(d)) if d else 0 for d in dicts),
             tuple(b.shape_key() for b in builds), emit_key,
         )
@@ -504,7 +577,7 @@ class TpuStageExec(ExecutionPlan):
             _LUT_CACHE[lut_key] = luts
 
         build_args = [b.flat_arrays() for b in builds]
-        outs = fn(dt.cols, luts, dt.mask, build_args)
+        outs = fn(dt.flat_cols(), luts, dt.mask, build_args)
         if meta["mode"] == "sorted":
             return self._decode_sorted(outs, meta, P, dicts, [b.dicts for b in builds])
         outs = jax.device_get(list(outs))  # ONE batched fetch
@@ -523,9 +596,11 @@ class TpuStageExec(ExecutionPlan):
         builds = builds or []
 
         ctx = Lowering(scan_schema, kinds, dicts)
+        valid_idx = dt.valid_flat_idx()
+        n_flat_cols = len(dt.cols) + sum(1 for v in dt.valids if v is not None)
         env_fns = []
         for i, (kind, scale) in enumerate(kinds):
-            env_fns.append(_mk_col_reader(i, kind, scale, dicts[i]))
+            env_fns.append(_mk_col_reader(i, kind, scale, dicts[i], valid_idx[i]))
         env_meta = [(k, s, d, i) for i, ((k, s), d) in enumerate(zip(kinds, dicts))]
         ctx.env_fns = env_fns
         ctx.env_meta = env_meta
@@ -547,7 +622,8 @@ class TpuStageExec(ExecutionPlan):
             elif isinstance(op, HashJoinExec):
                 bt = builds[jidx]
                 # build arrays ride at the tail of the flattened cols list
-                off = len(kinds) + sum(len(builds[i].flat_arrays()) for i in range(jidx))
+                # (after the scan columns AND their validity planes)
+                off = n_flat_cols + sum(len(builds[i].flat_arrays()) for i in range(jidx))
                 pay_off = off + (2 if bt.cnt is not None else 1)
                 probe_fns = [lower_expr(r, ctx) for (_, r) in op.on]
                 finder = _mk_join_finder(off, probe_fns, bt, lane_cells[jidx])
@@ -565,8 +641,11 @@ class TpuStageExec(ExecutionPlan):
                     continue
                 filter_fns.append(lambda cols, luts, _f=finder: _f(cols, luts)[1])
                 lane_dups.append(bt.dup)
+                pv_idx = bt.pay_valid_flat_idx()
                 build_fns = [
-                    _mk_build_gather(pay_off, ci, bt.kinds[ci], bt.scales[ci], bt.dicts[ci], finder)
+                    _mk_build_gather(pay_off, ci, bt.kinds[ci], bt.scales[ci], bt.dicts[ci],
+                                     finder,
+                                     None if pv_idx[ci] is None else off + pv_idx[ci])
                     for ci in range(len(bt.payloads))
                 ]
                 build_meta = [
@@ -601,6 +680,11 @@ class TpuStageExec(ExecutionPlan):
         # masked reductions (pure VPU, no scatter/sort). Everything else —
         # int64 keys like l_orderkey, composite keys, big dictionaries —
         # goes through the sort-based segmented reduction below.
+        def _slot_nullable(slot) -> bool:
+            if isinstance(slot, tuple) and slot[0] == "build":
+                return builds[slot[1]].pay_valids[slot[2]] is not None
+            return dt.valids[slot] is not None
+
         unrolled = True
         group_src_slots: list = []
         group_fns: list = []
@@ -613,6 +697,12 @@ class TpuStageExec(ExecutionPlan):
             i = cur_schema.index_of(gc.name, gc.qualifier)
             gmeta = ctx.env_meta[i]
             if gmeta is None or gmeta[0] != "code" or gmeta[2] is None:
+                unrolled = False
+                break
+            if _slot_nullable(gmeta[3]):
+                # a NULL group key needs its own group: the sorted path
+                # carries validity as an extra sort operand; the unrolled
+                # code-domain form cannot distinguish null from code 0
                 unrolled = False
                 break
             group_fns.append(ctx.env_fns[i])
@@ -683,12 +773,13 @@ class TpuStageExec(ExecutionPlan):
             cols = list(cols) + [a for b in build_args for a in b]
             outs = None
             presence = None
+            nullcnts: list = []
             for lane in lane_sets:
                 for cell, d_ in zip(lane_cells, lane):
                     cell["d"] = d_
                 m = mask
                 for ff in filter_fns:
-                    m = m & ff(cols, luts).arr
+                    m = m & true_mask(ff(cols, luts))
                 if group_fns:
                     gid = None
                     for gf, psz in zip(group_fns, pad_sizes):
@@ -702,6 +793,7 @@ class TpuStageExec(ExecutionPlan):
                 # stays on the XLA reductions below)
                 pallas_ok = (
                     use_pallas and gid is not None and aggs and G <= GROUP_LANES
+                    and all(v is None or v.valid is None for v in vs)
                     and all(
                         d.func in ("count", "count_all")
                         or (d.func == "sum" and v is not None and v.kind == "f64")
@@ -745,7 +837,9 @@ class TpuStageExec(ExecutionPlan):
                 gmasks = [m & (gid == g) for g in range(G)] if gid is not None else [m]
                 outs_lane = []
                 out_meta = []
-                for d, v in zip(aggs, vs):
+                nullcnt_lane = []
+                nullcnt_map: dict[int, int] = {}
+                for ai, (d, v) in enumerate(zip(aggs, vs)):
                     if v is None:
                         out_meta.append(("i64", 0))
                     else:
@@ -754,10 +848,19 @@ class TpuStageExec(ExecutionPlan):
                     for gm in gmasks:
                         cols_out.append(_masked_reduce(jnp, v, gm, d.func))
                     outs_lane.append(jnp.stack(cols_out, axis=1))  # [P, G]
+                    if (v is not None and v.valid is not None
+                            and d.func in ("sum", "min", "max")):
+                        # valid-count companion: a group whose inputs are all
+                        # NULL must decode to NULL, not 0 / ±inf
+                        nullcnt_map[ai] = len(nullcnt_lane)
+                        nullcnt_lane.append(jnp.stack(
+                            [(gm & v.valid).sum(axis=1) for gm in gmasks], axis=1
+                        ))
                 presence_lane = jnp.stack([gm.sum(axis=1) for gm in gmasks], axis=1)
                 meta_holder["out"] = out_meta
+                meta_holder["nullcnt_map"] = nullcnt_map
                 if outs is None:
-                    outs, presence = outs_lane, presence_lane
+                    outs, presence, nullcnts = outs_lane, presence_lane, nullcnt_lane
                 else:
                     merged = []
                     for d, prev, cur in zip(aggs, outs, outs_lane):
@@ -769,10 +872,11 @@ class TpuStageExec(ExecutionPlan):
                             merged.append(prev + cur)
                     outs = merged
                     presence = presence + presence_lane
-            return tuple(outs) + (presence,)
+                    nullcnts = [p_ + c_ for p_, c_ in zip(nullcnts, nullcnt_lane)]
+            return tuple(outs) + tuple(nullcnts) + (presence,)
 
         jitted = jax.jit(raw)
-        cols_spec = [jax.ShapeDtypeStruct(c.shape, c.dtype) for c in dt.cols]
+        cols_spec = [jax.ShapeDtypeStruct(c.shape, c.dtype) for c in dt.flat_cols()]
         luts0 = ctx.build_luts(dicts, [b.dicts for b in builds])
         luts_spec = [jax.ShapeDtypeStruct(l.shape, l.dtype) for l in luts0]
         mask_spec = jax.ShapeDtypeStruct(dt.mask.shape, np.bool_)
@@ -784,6 +888,7 @@ class TpuStageExec(ExecutionPlan):
         meta = {
             "mode": "unrolled",
             "out": meta_holder["out"],
+            "nullcnt_map": meta_holder.get("nullcnt_map", {}),
             "group_src_slots": group_src_slots,
             "pad_sizes": pad_sizes,
             "G": G,
@@ -846,18 +951,21 @@ class TpuStageExec(ExecutionPlan):
 
         def raw(cols, luts, mask, build_args):
             cols = list(cols) + [a for b in build_args for a in b]
-            # per expansion-join match lane: (valid, keys, agg values);
-            # lanes concatenate into one row set feeding a single sort
-            lane_valid, lane_keys, lane_vals = [], [], []
+            # per expansion-join match lane: (valid, key operands, payloads);
+            # lanes concatenate into one row set feeding a single sort.
+            # A NULLABLE group key contributes TWO sort operands — a null
+            # marker then the (filled) value — so NULL forms its own group
+            # (SQL GROUP BY treats NULLs as equal) without sentinel values.
+            lane_valid, lane_keyops, lane_pays = [], [], []
             for lane in lane_sets:
                 for cell, d_ in zip(lane_cells, lane):
                     cell["d"] = d_
                 m = mask
                 for ff in filter_fns:
-                    m = m & ff(cols, luts).arr
+                    m = m & true_mask(ff(cols, luts))
                 lane_valid.append(m.reshape(-1))
-                keys = []
-                key_meta = []
+                keyops = []  # flat key operand list
+                key_meta = []  # per key: (kind, scale, slot, has_null)
                 for gf, slot in zip(group_fns, key_slots):
                     v = gf(cols, luts)
                     if v.kind == "f64":
@@ -867,42 +975,69 @@ class TpuStageExec(ExecutionPlan):
                     arr = v.arr
                     if arr.dtype == jnp.bool_:
                         arr = arr.astype(jnp.int32)
-                    keys.append(jnp.broadcast_to(arr, mask.shape).reshape(-1))
-                    key_meta.append((v.kind, v.scale, slot))
+                    has_null = v.valid is not None
+                    if has_null:
+                        marker = jnp.broadcast_to(~v.valid, mask.shape).reshape(-1)
+                        keyops.append(marker.astype(jnp.int32))
+                    keyops.append(jnp.broadcast_to(arr, mask.shape).reshape(-1))
+                    key_meta.append((v.kind, v.scale, slot, has_null))
                 meta_holder["key_meta"] = key_meta
-                lane_keys.append(keys)
-                vals = []
+                lane_keyops.append(keyops)
+                # payload plan: per agg → (pay_idx|None, ncnt_idx|None)
+                pays = []
+                pay_plan = []
                 out_meta = []
                 for d, af in zip(aggs, agg_fns):
-                    if af is None or d.func in ("count", "count_all"):
-                        vals.append(None)  # counts come from segment lengths
+                    v = af(cols, luts) if af is not None else None
+                    if d.func in ("count", "count_all"):
                         out_meta.append(("i64", 0))
-                    else:
-                        v = af(cols, luts)
-                        vals.append(jnp.broadcast_to(v.arr, mask.shape).reshape(-1))
-                        out_meta.append((v.kind, v.scale))
+                        if v is None or v.valid is None:
+                            pay_plan.append((None, None))  # segment length
+                        else:
+                            # count(x): number of non-null x per group
+                            pays.append(jnp.broadcast_to(
+                                v.valid, mask.shape).reshape(-1).astype(jnp.int64))
+                            pay_plan.append((len(pays) - 1, None))
+                        continue
+                    out_meta.append((v.kind, v.scale))
+                    arr = v.arr
+                    ncnt_idx = None
+                    if v.valid is not None:
+                        # null-skip: neutralize invalid slots for the reduce,
+                        # and carry a valid-count so all-NULL groups decode
+                        # to NULL rather than 0 / ±inf
+                        if d.func == "sum":
+                            neutral = jnp.zeros((), dtype=arr.dtype)
+                        elif d.func == "min":
+                            neutral = (jnp.iinfo(arr.dtype).max
+                                       if jnp.issubdtype(arr.dtype, jnp.integer) else jnp.inf)
+                        else:
+                            neutral = (jnp.iinfo(arr.dtype).min
+                                       if jnp.issubdtype(arr.dtype, jnp.integer) else -jnp.inf)
+                        arr = jnp.where(v.valid, arr, neutral)
+                        pays.append(jnp.broadcast_to(
+                            v.valid, mask.shape).reshape(-1).astype(jnp.int64))
+                        ncnt_idx = len(pays) - 1
+                    pays.append(jnp.broadcast_to(arr, mask.shape).reshape(-1))
+                    pay_plan.append((len(pays) - 1, ncnt_idx))
                 meta_holder["out"] = out_meta
-                lane_vals.append(vals)
+                meta_holder["pay_plan"] = pay_plan
+                lane_pays.append(pays)
 
             valid = jnp.concatenate(lane_valid)
-            n_keys = len(lane_keys[0])
+            n_keyops = len(lane_keyops[0])
             cat_keys = [
-                jnp.concatenate([lk[i] for lk in lane_keys]) for i in range(n_keys)
+                jnp.concatenate([lk[i] for lk in lane_keyops]) for i in range(n_keyops)
             ]
-            cat_vals = [
-                None if lane_vals[0][i] is None
-                else jnp.concatenate([lv[i] for lv in lane_vals])
-                for i in range(len(aggs))
+            cat_pays = [
+                jnp.concatenate([lp[i] for lp in lane_pays])
+                for i in range(len(lane_pays[0]))
             ]
-            operands = (
-                [(~valid).astype(jnp.int32)]
-                + cat_keys
-                + [v for v in cat_vals if v is not None]
-            )
-            sorted_ = jax.lax.sort(tuple(operands), num_keys=1 + n_keys)
+            operands = [(~valid).astype(jnp.int32)] + cat_keys + cat_pays
+            sorted_ = jax.lax.sort(tuple(operands), num_keys=1 + n_keyops)
             svalid = sorted_[0] == 0
-            skeys = sorted_[1 : 1 + n_keys]
-            spays = list(sorted_[1 + n_keys :])
+            skeys = sorted_[1 : 1 + n_keyops]
+            spays = list(sorted_[1 + n_keyops :])
 
             diff = jnp.zeros((M,), bool).at[0].set(True)
             for k in skeys:
@@ -931,34 +1066,53 @@ class TpuStageExec(ExecutionPlan):
                     .set(src, mode="drop", unique_indices=True)
                 )
 
+            def int_segsum(sv):
+                # exact int64: global cumsum minus prefix-at-segment-start
+                w = sv.astype(jnp.int64)
+                csum = jnp.cumsum(w)
+                presum = csum - w  # exclusive
+                return compact(csum - presum[start])
+
             key_outs = [compact(k) for k in skeys]
             agg_outs = []
-            pi = 0
-            for d, v in zip(aggs, cat_vals):
-                if v is None:
+            ncnt_outs = []
+            ncnt_map: dict[int, int] = {}
+            for ai, (d, (pay_idx, ncnt_idx)) in enumerate(
+                zip(aggs, meta_holder["pay_plan"])
+            ):
+                if pay_idx is None:
                     agg_outs.append(compact((arange - start + 1).astype(jnp.int64)))
                     continue
-                sv = spays[pi]
-                pi += 1
-                if d.func == "sum" and jnp.issubdtype(sv.dtype, jnp.integer):
-                    # exact int64: global cumsum minus prefix-at-segment-start
-                    w = sv.astype(jnp.int64)
-                    csum = jnp.cumsum(w)
-                    presum = csum - w  # exclusive
-                    agg_outs.append(compact(csum - presum[start]))
+                sv = spays[pay_idx]
+                fname = "sum" if d.func in ("count", "count_all") else d.func
+                if fname == "sum" and jnp.issubdtype(sv.dtype, jnp.integer):
+                    agg_outs.append(int_segsum(sv))
                 else:
                     # float sums use the segmented scan too: cumsum-subtract
                     # would difference two near-equal whole-table totals
                     # (catastrophic cancellation for small late segments)
-                    agg_outs.append(compact(_segscan(jnp, sv, boundary, d.func)))
+                    agg_outs.append(compact(_segscan(jnp, sv, boundary, fname)))
+                if ncnt_idx is not None:
+                    ncnt_map[ai] = len(ncnt_outs)
+                    ncnt_outs.append(int_segsum(spays[ncnt_idx]))
+            meta_holder["nullcnt_map"] = ncnt_map
 
             if emit_keys is not None:
                 from ballista_tpu.ops.tpu.kernels import hash64, hash_combine_jax
 
+                # key_outs layout: optional marker precedes each nullable
+                # key's value — build a key→(marker, value) position map
+                pos = 0
+                key_pos = []
+                for (_k, _s, _slot, hn) in meta_holder["key_meta"]:
+                    key_pos.append((pos if hn else None, pos + (1 if hn else 0)))
+                    pos += 2 if hn else 1
+                _NULL_TAG = jnp.uint64(0x9E3779B97F4A7C15)
                 h = jnp.zeros((C,), jnp.uint64)
                 for ki in emit_keys:
-                    kind, scale, slot = meta_holder["key_meta"][ki]
-                    arr = key_outs[ki]
+                    kind, scale, slot, _hn = meta_holder["key_meta"][ki]
+                    mpos, vpos = key_pos[ki]
+                    arr = key_outs[vpos]
                     if kind == "code":
                         enc = luts[emit_luts[ki]][arr]
                     elif kind == "money":
@@ -967,13 +1121,16 @@ class TpuStageExec(ExecutionPlan):
                         enc = jax.lax.bitcast_convert_type(f, jnp.uint64)
                     else:  # i64 / date / bool — value-preserving int64 bits
                         enc = arr.astype(jnp.int64).astype(jnp.uint64)
-                    h = hash_combine_jax(h, hash64(enc))
+                    hv = hash64(enc)
+                    if mpos is not None:
+                        hv = jnp.where(key_outs[mpos] != 0, _NULL_TAG, hv)
+                    h = hash_combine_jax(h, hv)
                 pid = (h % jnp.uint64(emit_k)).astype(jnp.int32)
-                return tuple(key_outs) + tuple(agg_outs) + (pid, n_seg)
-            return tuple(key_outs) + tuple(agg_outs) + (n_seg,)
+                return tuple(key_outs) + tuple(agg_outs) + tuple(ncnt_outs) + (pid, n_seg)
+            return tuple(key_outs) + tuple(agg_outs) + tuple(ncnt_outs) + (n_seg,)
 
         jitted = jax.jit(raw)
-        cols_spec = [jax.ShapeDtypeStruct(c.shape, c.dtype) for c in dt.cols]
+        cols_spec = [jax.ShapeDtypeStruct(c.shape, c.dtype) for c in dt.flat_cols()]
         luts0 = ctx.build_luts(dt.dicts, [b.dicts for b in builds])
         luts_spec = [jax.ShapeDtypeStruct(l.shape, l.dtype) for l in luts0]
         mask_spec = jax.ShapeDtypeStruct(dt.mask.shape, np.bool_)
@@ -986,6 +1143,7 @@ class TpuStageExec(ExecutionPlan):
             "mode": "sorted",
             "out": meta_holder["out"],
             "key_meta": meta_holder["key_meta"],
+            "nullcnt_map": meta_holder.get("nullcnt_map", {}),
             "emit_pid": emit_keys is not None,
             "C": C,
         }
@@ -1003,6 +1161,7 @@ class TpuStageExec(ExecutionPlan):
         schema = self.schema()
         key_meta = meta["key_meta"]
         n_keys = len(key_meta)
+        n_keyops = sum(2 if km[3] else 1 for km in key_meta)
         C = meta["C"]
         n = int(jax.device_get(outs[-1]))
         if n > C:
@@ -1018,8 +1177,18 @@ class TpuStageExec(ExecutionPlan):
         cp = min(_pow2(n), C)  # sliced fetch: pay for actual groups only
         host = jax.device_get([o[:cp] for o in data_outs])
         pid_host = jax.device_get(pid_out[:cp]) if pid_out is not None else None
+        nullcnt_map = meta.get("nullcnt_map", {})
+        n_aggs = len(meta["out"])
+        ncnt_host = host[n_keyops + n_aggs:]
         arrays: list[pa.Array] = []
-        for kv, (kind, scale, slot), f in zip(host[:n_keys], key_meta, schema):
+        pos = 0
+        for (kind, scale, slot, has_null), f in zip(key_meta, schema):
+            null_mask = None
+            if has_null:
+                null_mask = host[pos][:n] != 0
+                pos += 1
+            kv = host[pos]
+            pos += 1
             vals = kv[:n]
             if kind == "code":
                 # resolve the LIVE dictionary (compilations are shared across
@@ -1028,24 +1197,34 @@ class TpuStageExec(ExecutionPlan):
                     dic = build_dicts[slot[1]][slot[2]]
                 else:
                     dic = dicts[slot]
-                arr = pa.array([dic[int(c)] for c in vals], f.type)
+                py = [None if (null_mask is not None and null_mask[j]) else dic[int(c)]
+                      for j, c in enumerate(vals)]
+                arr = pa.array(py, f.type)
             elif kind == "date":
-                arr = pa.array(vals.astype(np.int32), pa.int32()).cast(pa.date32())
+                arr = pa.array(vals.astype(np.int32), pa.int32(), mask=null_mask).cast(pa.date32())
             elif kind == "money":
-                arr = pa.array(vals.astype(np.float64) / (10**scale), pa.float64())
+                arr = pa.array(vals.astype(np.float64) / (10**scale), pa.float64(),
+                               mask=null_mask)
             else:
-                arr = pa.array(vals)
+                arr = pa.array(vals, mask=null_mask)
             if arr.type != f.type:
                 arr = arr.cast(f.type)
             arrays.append(arr)
-        for out, (kind, scale), f in zip(host[n_keys:], meta["out"], list(schema)[n_keys:]):
+        for ai, (out, (kind, scale), f) in enumerate(
+            zip(host[n_keyops:n_keyops + n_aggs], meta["out"], list(schema)[n_keys:])
+        ):
             vals = out[:n]
+            null_mask = None
+            if ai in nullcnt_map:
+                # all of the group's agg inputs were NULL → the agg is NULL
+                null_mask = ncnt_host[nullcnt_map[ai]][:n] == 0
             if kind == "money":
-                arr = pa.array(vals.astype(np.float64) / (10**scale), pa.float64())
+                arr = pa.array(vals.astype(np.float64) / (10**scale), pa.float64(),
+                               mask=null_mask)
             elif kind == "date":
-                arr = pa.array(vals.astype(np.int32), pa.int32()).cast(pa.date32())
+                arr = pa.array(vals.astype(np.int32), pa.int32(), mask=null_mask).cast(pa.date32())
             else:
-                arr = pa.array(vals)
+                arr = pa.array(vals, mask=null_mask)
             if arr.type != f.type:
                 arr = arr.cast(f.type)
             arrays.append(arr)
@@ -1071,6 +1250,9 @@ class TpuStageExec(ExecutionPlan):
             else:
                 group_dicts.append(dicts[s])
         presence = outs[-1]  # [P, G]
+        n_aggs = len(meta["out"])
+        nullcnt_map = meta.get("nullcnt_map", {})
+        nullcnt_outs = outs[n_aggs:-1]
         results: dict[int, list[pa.RecordBatch]] = {}
         n_group = len(agg.group_exprs)
         for p in range(P):
@@ -1087,14 +1269,21 @@ class TpuStageExec(ExecutionPlan):
             comps = list(reversed(comps))
             for comp, d, f in zip(comps, group_dicts, schema):
                 arrays.append(pa.array([d[int(c)] for c in comp], f.type))
-            for out, (kind, scale), f in zip(outs[:-1], meta["out"], list(schema)[n_group:]):
+            for ai, (out, (kind, scale), f) in enumerate(
+                zip(outs[:n_aggs], meta["out"], list(schema)[n_group:])
+            ):
                 vals = out[p][sel]
+                null_mask = None
+                if ai in nullcnt_map:
+                    # all agg inputs in the group were NULL → the agg is NULL
+                    null_mask = nullcnt_outs[nullcnt_map[ai]][p][sel] == 0
                 if kind == "money":
-                    arr = pa.array(vals.astype(np.float64) / (10**scale), pa.float64())
+                    arr = pa.array(vals.astype(np.float64) / (10**scale), pa.float64(),
+                                   mask=null_mask)
                 elif kind == "date":
-                    arr = pa.array(vals.astype(np.int32), pa.int32()).cast(pa.date32())
+                    arr = pa.array(vals.astype(np.int32), pa.int32(), mask=null_mask).cast(pa.date32())
                 else:
-                    arr = pa.array(vals)
+                    arr = pa.array(vals, mask=null_mask)
                 if arr.type != f.type:
                     arr = arr.cast(f.type)
                 arrays.append(arr)
@@ -1152,10 +1341,16 @@ def _segscan(jnp, values, boundary, func: str):
 
 
 def _masked_reduce(jnp, v, gm, func: str):
-    """One group's reduction over axis=1 of [P, N] lanes."""
-    if func in ("count", "count_all"):
+    """One group's reduction over axis=1 of [P, N] lanes. SQL null-skipping:
+    an agg input's validity plane joins the group mask — count(x) counts
+    only non-null x, sum/min/max ignore null slots."""
+    if func == "count_all" or (func == "count" and (v is None or v.valid is None)):
         return gm.sum(axis=1).astype(jnp.int64)
+    if func == "count":
+        return (gm & v.valid).sum(axis=1).astype(jnp.int64)
     arr = v.arr
+    if v.valid is not None:
+        gm = gm & v.valid
     if func == "sum":
         zero = jnp.zeros((), dtype=arr.dtype)
         return jnp.where(gm, arr, zero).sum(axis=1)
@@ -1175,9 +1370,10 @@ def _pow2(n: int) -> int:
     return p
 
 
-def _mk_col_reader(i: int, kind: str, scale: int, dictionary):
+def _mk_col_reader(i: int, kind: str, scale: int, dictionary, valid_idx=None):
     """Column reader with device-side upcast: columns ship narrow (int16/32)
-    to spare the link, then widen in HBM where bandwidth is cheap."""
+    to spare the link, then widen in HBM where bandwidth is cheap. Nullable
+    columns read their validity plane from the flattened arg tail."""
 
     def run(cols, luts):
         import jax.numpy as jnp
@@ -1189,7 +1385,8 @@ def _mk_col_reader(i: int, kind: str, scale: int, dictionary):
             arr = arr.astype(jnp.int32)
         elif kind == "date" and arr.dtype != jnp.int32:
             arr = arr.astype(jnp.int32)
-        return DevVal(kind, arr, scale, dictionary)
+        valid = cols[valid_idx] if valid_idx is not None else None
+        return DevVal(kind, arr, scale, dictionary, valid=valid)
 
     return run
 
@@ -1230,6 +1427,8 @@ def _mk_join_finder(off: int, probe_fns, bt: BuildTable, cell: dict):
                 shift = shifts[i - 1]
                 valid = valid & (ki >= 0) & (ki < (1 << shift))
                 k = (k << shift) | ki
+            if v.valid is not None:
+                valid = valid & v.valid  # a NULL probe key matches nothing
         d = cell["d"]
         if mode == "direct" and not has_cnt:
             T = keys_arr.shape[0]
@@ -1261,17 +1460,26 @@ def _mk_join_finder(off: int, probe_fns, bt: BuildTable, cell: dict):
     return run
 
 
-def _mk_build_gather(pay_off: int, ci: int, kind: str, scale: int, dictionary, finder):
+def _mk_build_gather(pay_off: int, ci: int, kind: str, scale: int, dictionary, finder,
+                     valid_abs_idx=None, outer=False):
+    """Gather one build-payload column through the join finder. Nullable
+    payloads gather their validity plane too; under an outer join the gather
+    of an UNMATCHED probe row is NULL (valid = matched & payload-valid)."""
+
     def run(cols, luts):
         import jax.numpy as jnp
 
-        idxc, _ = finder(cols, luts)
+        idxc, matched = finder(cols, luts)
         arr = cols[pay_off + ci][idxc]
         if kind in ("i64", "money") and arr.dtype != jnp.int64:
             arr = arr.astype(jnp.int64)
         elif kind in ("code", "date") and arr.dtype != jnp.int32:
             arr = arr.astype(jnp.int32)
-        return DevVal(kind, arr, scale, dictionary)
+        valid = cols[valid_abs_idx][idxc] if valid_abs_idx is not None else None
+        if outer:
+            m = true_mask(matched)
+            valid = m if valid is None else valid & m
+        return DevVal(kind, arr, scale, dictionary, valid=valid)
 
     return run
 

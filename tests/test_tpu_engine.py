@@ -490,3 +490,136 @@ def test_explain_analyze_shows_device_counters(tpu_ctx):
     body = out[out.plan_type.str.startswith("analyzed")].plan.iloc[0]
     assert "TpuStageExec" in body
     assert "device_runs=1" in body and "cpu_fallbacks=0" in body
+
+
+# -- NULL-bearing data on the device path (validity planes) -----------------
+
+
+def _device_oracle(sql: str, tables: dict, cfg_extra=None, expect_device=True):
+    """Run `sql` on the tpu engine over `tables`, assert the device path
+    actually executed (no silent fallback), and return the result alongside
+    the cpu engine's answer for the same query."""
+    import ballista_tpu.ops.tpu.stage_compiler as sc
+    from ballista_tpu.client.context import SessionContext
+    from ballista_tpu.engine.tpu_engine import maybe_compile_tpu
+    from ballista_tpu.plan.physical import TaskContext
+
+    results = {}
+    for engine in ("tpu", "cpu"):
+        cfg = BallistaConfig({EXECUTOR_ENGINE: engine, TPU_MIN_ROWS: 0,
+                              **(cfg_extra or {})})
+        ctx = SessionContext(cfg)
+        for name, tbl in tables.items():
+            ctx.register_arrow_table(name, tbl, partitions=2)
+        results[engine] = ctx.sql(sql).collect()
+        if engine == "tpu" and expect_device:
+            phys = maybe_compile_tpu(ctx.create_physical_plan(ctx.sql(sql).plan), cfg)
+            stages = [nd for nd in _walk(phys) if isinstance(nd, sc.TpuStageExec)]
+            assert stages, "no device stage compiled"
+            tc = TaskContext(cfg)
+            for p in range(phys.output_partition_count()):
+                list(phys.execute(p, tc))
+            assert sum(s.tpu_count for s in stages) >= 1
+            assert sum(s.fallback_count for s in stages) == 0, "silent cpu fallback"
+    return results["tpu"], results["cpu"]
+
+
+def _null_table(n=8000, seed=11):
+    rng = np.random.default_rng(seed)
+    k = rng.integers(0, 50, n).astype("int64")
+    price = np.round(rng.uniform(1, 100, n), 2)
+    qty = rng.integers(1, 50, n).astype("int64")
+    flag = rng.integers(0, 2, n).astype(bool)
+    null_price = rng.random(n) < 0.3
+    null_qty = rng.random(n) < 0.2
+    null_k = rng.random(n) < 0.1
+    return pa.table({
+        "k": pa.array(k, pa.int64()).to_pandas().where(~null_k).astype("Int64").to_numpy(
+            dtype=object, na_value=None),
+        "price": pa.array(np.where(null_price, np.nan, price)).to_pandas().where(
+            ~null_price).to_numpy(dtype=object, na_value=None),
+        "qty": pa.array(qty).to_pandas().where(~null_qty).astype("Int64").to_numpy(
+            dtype=object, na_value=None),
+        "flag": flag,
+    })
+
+
+def test_nullable_filter_and_aggs_on_device():
+    """Filters + sum/min/max/count over NULL-bearing columns stay on device
+    and agree with the CPU engine (null-strict comparisons, count(x) skips
+    nulls, WHERE treats unknown as false)."""
+    tbl = _null_table()
+    sql = ("SELECT count(*) AS c_all, count(qty) AS c_qty, sum(price) AS s, "
+           "min(qty) AS mn, max(qty) AS mx FROM t WHERE price > 10")
+    tpu, cpu = _device_oracle(sql, {"t": tbl})
+    tp, cp = tpu.to_pandas(), cpu.to_pandas()
+    assert tp.c_all[0] == cp.c_all[0]
+    assert tp.c_qty[0] == cp.c_qty[0]
+    assert abs(tp.s[0] - cp.s[0]) < 1e-6
+    assert tp.mn[0] == cp.mn[0] and tp.mx[0] == cp.mx[0]
+
+
+def test_nullable_group_key_on_device():
+    """A nullable GROUP BY key: NULL forms its own group (sorted path's
+    null-marker sort operand), matching the CPU engine."""
+    tbl = _null_table()
+    sql = ("SELECT k, count(*) AS c, sum(price) AS s FROM t "
+           "WHERE qty >= 1 GROUP BY k ORDER BY k NULLS LAST")
+    tpu, cpu = _device_oracle(sql, {"t": tbl})
+    tp, cp = tpu.to_pandas(), cpu.to_pandas()
+    assert len(tp) == len(cp)
+    # align on key (None sorts last in both by the ORDER BY)
+    assert tp.k.isna().tolist() == cp.k.isna().tolist()
+    assert tp.k.fillna(-1).tolist() == cp.k.fillna(-1).tolist()
+    assert (tp.c.values == cp.c.values).all()
+    assert np.allclose(tp.s.fillna(-1).values, cp.s.fillna(-1).values, atol=1e-6)
+
+
+def test_is_null_predicates_on_device():
+    tbl = _null_table()
+    sql = ("SELECT count(*) AS c FROM t WHERE qty IS NULL AND price IS NOT NULL")
+    tpu, cpu = _device_oracle(sql, {"t": tbl})
+    assert tpu.to_pandas().c[0] == cpu.to_pandas().c[0]
+
+
+def test_all_null_group_aggregates_to_null_on_device():
+    """A group whose agg inputs are all NULL yields NULL (not 0 / ±inf) —
+    the valid-count companion outputs."""
+    tbl = pa.table({
+        "g": pa.array([1, 1, 2, 2, 3], pa.int64()),
+        "v": pa.array([None, None, 5.25, 7.75, None], pa.float64()),
+        "q": pa.array([None, None, 4, 2, 9], pa.int64()),
+    })
+    sql = ("SELECT g, sum(v) AS s, min(q) AS mn, max(q) AS mx, count(q) AS c "
+           "FROM t GROUP BY g ORDER BY g")
+    tpu, cpu = _device_oracle(sql, {"t": tbl})
+    tp, cp = tpu.to_pandas(), cpu.to_pandas()
+    assert tp.s.isna().tolist() == cp.s.isna().tolist() == [True, False, True]
+    assert tp.mn.isna().tolist() == cp.mn.isna().tolist() == [True, False, False]
+    assert float(tp.s[1]) == 13.0
+    assert int(tp.mn[1]) == 2 and int(tp.mx[1]) == 4
+    assert int(tp.mn[2]) == 9
+    assert tp.c.tolist() == cp.c.tolist() == [0, 2, 1]
+
+
+def test_nullable_probe_key_join_on_device():
+    """Inner join whose probe key has NULLs: null keys match nothing."""
+    rng = np.random.default_rng(5)
+    n = 4000
+    key = rng.integers(0, 100, n).astype("int64")
+    null_key = rng.random(n) < 0.25
+    probe = pa.table({
+        "fk": pa.array([None if m else int(v) for v, m in zip(key, null_key)], pa.int64()),
+        "amt": np.round(rng.uniform(1, 10, n), 2),
+    })
+    build = pa.table({
+        "id": pa.array(np.arange(100), pa.int64()),
+        "cat": pa.array([f"c{i % 5}" for i in range(100)]),
+    })
+    sql = ("SELECT cat, count(*) AS c, sum(amt) AS s FROM probe "
+           "JOIN build ON fk = id GROUP BY cat ORDER BY cat")
+    tpu, cpu = _device_oracle(sql, {"probe": probe, "build": build})
+    tp, cp = tpu.to_pandas(), cpu.to_pandas()
+    assert tp.cat.tolist() == cp.cat.tolist()
+    assert tp.c.tolist() == cp.c.tolist()
+    assert np.allclose(tp.s.values, cp.s.values, atol=1e-6)
