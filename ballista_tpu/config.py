@@ -33,6 +33,7 @@ SORT_SHUFFLE_ENABLED = "ballista.shuffle.sort.enabled"
 SORT_SHUFFLE_MEMORY_LIMIT = "ballista.shuffle.sort.memory.limit"
 BROADCAST_JOIN_THRESHOLD = "ballista.optimizer.broadcast.join.threshold.bytes"
 BROADCAST_JOIN_ROWS_THRESHOLD = "ballista.optimizer.broadcast.join.threshold.rows"
+BROADCAST_SEMI_KEYS_THRESHOLD = "ballista.optimizer.broadcast.semi.keys.threshold.rows"
 MAX_PARTITIONS_PER_TASK = "ballista.scheduler.max_partitions_per_task"
 JOB_RESUBMIT_INTERVAL_MS = "ballista.scheduler.job.resubmit.interval.ms"
 PLANNER_ADAPTIVE_ENABLED = "ballista.planner.adaptive.enabled"
@@ -132,6 +133,7 @@ _ENTRIES: list[ConfigEntry] = [
     ConfigEntry(SORT_SHUFFLE_MEMORY_LIMIT, "Bytes of buffered batches before sort-shuffle spills (0 = unlimited).", int, 256 * 1024 * 1024, _nonneg),
     ConfigEntry(BROADCAST_JOIN_THRESHOLD, "Max build-side bytes to lower a join to a broadcast exchange.", int, 10 * 1024 * 1024, _nonneg),
     ConfigEntry(BROADCAST_JOIN_ROWS_THRESHOLD, "Max build-side rows to lower a join to a broadcast exchange.", int, 1_000_000, _nonneg),
+    ConfigEntry(BROADCAST_SEMI_KEYS_THRESHOLD, "Max build-side rows to collect a filterless semi/anti join's membership keys instead of co-partitioning (the build ships join keys only, so the collect threshold relaxes past the row-broadcast one).", int, 8_000_000, _nonneg),
     ConfigEntry(MAX_PARTITIONS_PER_TASK, "Group up to N partitions into one task (partition slices).", int, 1, _pos),
     ConfigEntry(JOB_RESUBMIT_INTERVAL_MS, "Periodically re-offer jobs holding runnable-but-unscheduled tasks (0 = off; offers otherwise fire on task/executor events only).", int, 0, _nonneg),
     ConfigEntry(PLANNER_ADAPTIVE_ENABLED, "Adaptive query execution: replan remaining stages with runtime stats.", bool, True),

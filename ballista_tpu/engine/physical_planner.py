@@ -19,7 +19,9 @@ import pyarrow as pa
 
 from ballista_tpu.config import (
     BROADCAST_JOIN_ROWS_THRESHOLD,
+    BROADCAST_SEMI_KEYS_THRESHOLD,
     DEFAULT_SHUFFLE_PARTITIONS,
+    EXECUTOR_ENGINE,
     TARGET_PARTITIONS,
     BallistaConfig,
 )
@@ -351,20 +353,60 @@ class PhysicalPlanner:
     # ------------------------------------------------------------------
 
     def _plan_join(self, node: Join) -> ExecutionPlan:
+        from ballista_tpu.plan.logical import Filter as LFilter
+
+        jt = node.join_type
+        join_filter = node.filter
+        # ON-clause predicates that touch only the NULL-SUPPLYING side of a
+        # one-sided outer join are equivalent to pre-filtering that input
+        # (a failing row can never match; it is not itself emitted). Pushing
+        # them down clears the join filter — which also unlocks the device
+        # outer-join lift. Invalid for FULL (both sides emit unmatched).
+        if join_filter is not None and jt in ("left", "right"):
+            null_side = node.right if jt == "left" else node.left
+            other = node.left if jt == "left" else node.right
+            if _refs_only(join_filter, null_side.schema, other.schema):
+                filtered = LFilter(null_side, join_filter)
+                if jt == "left":
+                    node = Join(node.left, filtered, node.on, jt, None)
+                else:
+                    node = Join(filtered, node.right, node.on, jt, None)
+                join_filter = None
+
         left = self._plan(node.left)
         right = self._plan(node.right)
         l_rows = estimate_rows(node.left)
         r_rows = estimate_rows(node.right)
 
-        jt = node.join_type
+        semi_keys_rows = int(self.config.get(BROADCAST_SEMI_KEYS_THRESHOLD))
         # choose build side (exec always builds its LEFT input)
         swap = False
-        if jt in ("inner", "full", "left", "right"):
+        if jt in ("inner", "full"):
             swap = r_rows < l_rows
+        elif jt in ("left", "right"):
+            swap = r_rows < l_rows
+            # engine=tpu prefers the null-supplying side as the BUILD so the
+            # emitted side stays a probe-driven device chain (right outer on
+            # device: unmatched probe rows gather NULL build columns) —
+            # worth it when the null-supplying side is collectable
+            if str(self.config.get(EXECUTOR_ENGINE)) == "tpu" and join_filter is None:
+                # outer builds ship FULL payload columns, so only the normal
+                # row-broadcast budget applies (not the keys-only relaxation)
+                null_rows = r_rows if jt == "left" else l_rows
+                if null_rows <= self.broadcast_rows:
+                    swap = jt == "left"  # build must end up the null side
         elif jt in ("left_semi", "left_anti"):
             swap = True  # build the (usually small) subquery side, probe outer
             if r_rows > l_rows * 4:
                 swap = False
+            # engine=tpu: filterless semi/anti builds ship membership keys
+            # only (the device build skips payload encode), so the collect
+            # threshold relaxes — keep the subquery side as build whenever
+            # its keys still fit (q4: orders SEMI lineitem). The CPU engine
+            # collects full rows, so it keeps the strict rules.
+            if (not swap and join_filter is None and r_rows <= semi_keys_rows
+                    and str(self.config.get(EXECUTOR_ENGINE)) == "tpu"):
+                swap = True
         elif jt in ("right_semi", "right_anti"):
             swap = False
 
@@ -382,6 +424,10 @@ class PhysicalPlanner:
             build_schema, probe_schema = node.left.schema, node.right.schema
 
         broadcast = build_rows <= self.broadcast_rows or probe.output_partition_count() == 1
+        if (exec_jt in ("right_semi", "right_anti") and node.filter is None
+                and build_rows <= semi_keys_rows
+                and str(self.config.get(EXECUTOR_ENGINE)) == "tpu"):
+            broadcast = True  # membership keys only: relaxed collect budget
 
         # build-side-emitting joins (left/full/left_semi/left_anti after the
         # swap) need every probe row to pass through ONE join instance before
@@ -408,6 +454,26 @@ class PhysicalPlanner:
             order = [Column(f.name, f.qualifier) for f in node.schema]
             return ProjectionExec(j, order, node.schema)
         return j
+
+
+def _refs_only(e: Expr, inside, outside) -> bool:
+    """True iff every Column in `e` resolves in `inside` and none resolve in
+    `outside` (conservative: an ambiguous name blocks the pushdown)."""
+    cols: list[Column] = []
+
+    def walk(x: Expr):
+        if isinstance(x, Column):
+            cols.append(x)
+        for c in x.children():
+            walk(c)
+
+    walk(e)
+    for c in cols:
+        if inside.maybe_index_of(c.name, c.qualifier) is None:
+            return False
+        if outside.maybe_index_of(c.name, c.qualifier) is not None:
+            return False
+    return True
 
 
 def _swap_join_type(jt: str) -> str:

@@ -107,11 +107,16 @@ def _match_chain(node: ExecutionPlan):
         if (
             isinstance(cur, HashJoinExec)
             and cur.mode == "collect_left"
-            and cur.join_type in ("inner", "right_semi", "right_anti")
-            and cur.filter is None
+            and (
+                (cur.join_type in ("inner", "right") and cur.filter is None)
+                or cur.join_type in ("right_semi", "right_anti")
+            )
         ):
-            # inner: build-column gathers join the chain; right_semi/right_anti
-            # emit probe rows only — the match mask IS the filter
+            # inner: build-column gathers join the chain; right (outer):
+            # every probe row emits, unmatched gathers are NULL (validity
+            # planes); right_semi/right_anti emit probe rows only — the
+            # match mask IS the filter, and a join filter (e.g. q21's
+            # l_suppkey <> l1.l_suppkey) ORs across build match lanes
             ops.append(cur)
             cur = cur.right  # probe side continues the device chain
             continue

@@ -102,6 +102,9 @@ class BuildTable:
         # per payload column: bool [B] validity plane or None; padding slots
         # are invalid, so an outer join's unmatched gathers decode as NULL
         self.pay_valids = pay_valids if pay_valids is not None else [None] * len(payloads)
+        # build-schema field index → position in payloads (None = column was
+        # not encodable and not uploaded; only legal for semi/anti filters)
+        self.pay_pos: list = list(range(len(payloads)))
 
     def flat_arrays(self):
         """Device-arg layout: keys [, cnt] , payloads..., payload validity
@@ -130,6 +133,7 @@ class BuildTable:
             self.cnt is not None, self.padded_rows(),
             tuple(str(p.dtype) for p in self.payloads),
             tuple(v is not None for v in self.pay_valids),
+            tuple(self.pay_pos),
             tuple(_pow2(len(d)) if d else 0 for d in self.dicts),
         )
 
@@ -458,9 +462,19 @@ class TpuStageExec(ExecutionPlan):
                 shifts.append(shift)
         uniq, counts = np.unique(key_np, return_counts=True)
         dup = int(counts.max())
-        if dup > MAX_JOIN_DUP and join.join_type not in ("right_semi", "right_anti"):
-            # semi/anti probes only test membership — multiplicity never
-            # unrolls lanes, so any dup count is fine there
+        membership_only = join.join_type in ("right_semi", "right_anti") and join.filter is None
+        cba = _mult_shape_check(self.partial_agg, self.ops, join)
+        # mirror _compile's activation exactly (counted build columns must be
+        # non-null): a looser exemption here would pay the full build
+        # collect/encode/upload only to fall back at compile time anyway
+        mult_shaped = cba is not None and all(
+            tbl.column(fi).null_count == 0 for fi in cba.values()
+        )
+        if dup > MAX_JOIN_DUP and not membership_only and not mult_shaped:
+            # filterless semi/anti probes only test membership, and
+            # aggregate-through-join stages consume match COUNTS — neither
+            # unrolls lanes, so any dup is fine there; inner/outer gathers
+            # and semi/anti FILTERS unroll dup lanes and are budgeted
             raise Unsupported(f"build key multiplicity {dup} > {MAX_JOIN_DUP}")
 
         max_key = int(key_np.max())
@@ -498,20 +512,33 @@ class TpuStageExec(ExecutionPlan):
             keys_dev[: len(sorted_keys)] = sorted_keys
             mode = "sorted"
 
-        kinds, scales, dicts, payloads, pay_valids = [], [], [], [], []
-        if join.join_type not in ("right_semi", "right_anti"):
+        kinds, scales, dicts, payloads, pay_valids, pay_pos = [], [], [], [], [], []
+        if membership_only:
             # membership-only joins never gather build columns: skip payload
             # encode/upload entirely (an unencodable non-key column must not
             # knock a semi join off the device)
+            pass
+        else:
+            # semi/anti WITH a join filter only gather the columns the
+            # filter touches: tolerate unencodable columns with a None
+            # payload slot (lowering raises only if the filter uses one)
+            tolerate = join.join_type in ("right_semi", "right_anti")
             for name in batch.schema.names:
                 dc = encode_column(batch.column(batch.schema.get_field_index(name)))
                 if dc is None:
-                    raise Unsupported(f"unencodable build column {name}")
+                    if not tolerate:
+                        raise Unsupported(f"unencodable build column {name}")
+                    kinds.append("?")
+                    scales.append(0)
+                    dicts.append(None)
+                    pay_pos.append(None)
+                    continue
                 kinds.append(dc.kind)
                 scales.append(dc.scale)
                 dicts.append(dc.dictionary)
                 padded = np.zeros(B, dtype=dc.data.dtype)
                 padded[: len(order)] = dc.data[order]
+                pay_pos.append(len(payloads))
                 payloads.append(padded)
                 if dc.valid is None:
                     pay_valids.append(None)
@@ -526,6 +553,7 @@ class TpuStageExec(ExecutionPlan):
             cnt=None if cnt_dev is None else _put(mesh, cnt_dev),
             pay_valids=[None if v is None else _put(mesh, v) for v in pay_valids],
         )
+        bt.pay_pos = pay_pos
         bt.shifts = shifts
         _BUILD_CACHE[cache_key] = bt
         return bt
@@ -614,6 +642,32 @@ class TpuStageExec(ExecutionPlan):
 
         lane_cells = [{"d": 0} for _ in builds]
         lane_dups: list[int] = []  # per build: lanes to unroll (1 for semi/anti)
+        outer_jidx: set[int] = set()  # joins whose build gathers are nullable-by-miss
+
+        # Aggregate-through-join pre-scan: when the LAST op is an inner/right
+        # join whose build columns appear ONLY as count(col) arguments (and
+        # group keys are probe-side), the stage aggregates THROUGH the join
+        # with per-row match counts — no dup-lane unrolling, no MAX_JOIN_DUP
+        # ceiling (the q13 shape: count(o_orderkey) group by c_custkey).
+        mult_jidx = None
+        mult_outer = False
+        count_build_aggs: dict[int, int] = {}  # agg idx → build field idx
+        join_ops = [o for o in self.ops if isinstance(o, HashJoinExec)]
+        if builds and join_ops:
+            jop = join_ops[-1]
+            bt_last = builds[-1]
+            cba = _mult_shape_check(agg, self.ops, jop)
+            if cba is not None and bt_last.dup > 1:
+                ok = True
+                for fi in cba.values():
+                    pp = bt_last.pay_pos[fi] if fi < len(bt_last.pay_pos) else None
+                    if pp is None or bt_last.pay_valids[pp] is not None:
+                        ok = False  # nullable build col: match count ≠ count(col)
+                if ok:
+                    mult_jidx = len(builds) - 1
+                    mult_outer = jop.join_type == "right"
+                    count_build_aggs = cba
+        mult_weight_fn = None
         jidx = 0
         for op in self.ops:
             _bind_env(ctx, cur_schema)
@@ -627,25 +681,104 @@ class TpuStageExec(ExecutionPlan):
                 pay_off = off + (2 if bt.cnt is not None else 1)
                 probe_fns = [lower_expr(r, ctx) for (_, r) in op.on]
                 finder = _mk_join_finder(off, probe_fns, bt, lane_cells[jidx])
+                pv_idx = bt.pay_valid_flat_idx()
                 if op.join_type in ("right_semi", "right_anti"):
-                    # membership only: the match mask filters probe rows
-                    # (EXISTS / NOT IN after decorrelation) — no build
-                    # columns, no expansion lanes, schema unchanged
                     neg = op.join_type == "right_anti"
-                    filter_fns.append(
-                        lambda cols, luts, _f=finder, _n=neg:
-                        DevVal("bool", ~_f(cols, luts)[1].arr if _n else _f(cols, luts)[1].arr)
-                    )
+                    if op.filter is None:
+                        # membership only: the match mask filters probe rows
+                        # (EXISTS / NOT IN after decorrelation) — no build
+                        # columns, no expansion lanes, schema unchanged
+                        filter_fns.append(
+                            lambda cols, luts, _f=finder, _n=neg:
+                            DevVal("bool", ~_f(cols, luts)[1].arr if _n else _f(cols, luts)[1].arr)
+                        )
+                    else:
+                        # EXISTS with a correlated residual predicate (q21's
+                        # l2.l_suppkey <> l1.l_suppkey): OR the filtered
+                        # match across all dup lanes of the build key
+                        if bt.dup > MAX_JOIN_DUP:
+                            raise Unsupported(
+                                f"semi/anti join filter over dup {bt.dup} > {MAX_JOIN_DUP}"
+                            )
+                        lane_preds = []
+                        saved_fns, saved_meta = list(ctx.env_fns), list(ctx.env_meta)
+                        combined_schema = op.left.df_schema.merge(cur_schema)
+                        for d in range(bt.dup):
+                            finder_d = _mk_join_finder(off, probe_fns, bt, {"d": d})
+                            gfns, gmeta = [], []
+                            for ci, pp in enumerate(bt.pay_pos):
+                                if pp is None:
+                                    gfns.append(_mk_raising(
+                                        f"unencodable build column {ci} in join filter"))
+                                    gmeta.append(None)
+                                else:
+                                    gfns.append(_mk_build_gather(
+                                        pay_off, pp, bt.kinds[ci], bt.scales[ci],
+                                        bt.dicts[ci], finder_d,
+                                        None if pv_idx[pp] is None else off + pv_idx[pp]))
+                                    gmeta.append((bt.kinds[ci], bt.scales[ci],
+                                                  bt.dicts[ci], ("build", jidx, ci)))
+                            ctx.env_fns = gfns + saved_fns
+                            ctx.env_meta = gmeta + saved_meta
+                            _bind_env(ctx, combined_schema)
+                            lane_preds.append((finder_d, lower_expr(op.filter, ctx)))
+                        ctx.env_fns, ctx.env_meta = saved_fns, saved_meta
+                        _bind_env(ctx, cur_schema)
+
+                        def run(cols, luts, _lp=lane_preds, _n=neg):
+                            any_m = None
+                            for fd, pf in _lp:
+                                _, matched = fd(cols, luts)
+                                md = true_mask(matched) & true_mask(pf(cols, luts))
+                                any_m = md if any_m is None else any_m | md
+                            return DevVal("bool", ~any_m if _n else any_m)
+
+                        filter_fns.append(run)
                     lane_dups.append(1)
                     jidx += 1
                     continue
-                filter_fns.append(lambda cols, luts, _f=finder: _f(cols, luts)[1])
+                if jidx == mult_jidx:
+                    # aggregate-through-join: ONE count gather replaces all
+                    # dup match lanes; build columns are never materialized
+                    counter = _mk_join_counter(off, probe_fns, bt)
+                    if op.join_type == "inner":
+                        filter_fns.append(
+                            lambda cols, luts, _c=counter:
+                            DevVal("bool", _c(cols, luts) > 0)
+                        )
+                    mult_weight_fn = counter
+                    n_bf = len(op.left.df_schema)
+                    ctx.env_fns = [
+                        _mk_raising("build column consumed as a value in an "
+                                    "aggregate-through-join stage")
+                    ] * n_bf + list(ctx.env_fns)
+                    ctx.env_meta = [None] * n_bf + list(ctx.env_meta)
+                    cur_schema = op.df_schema
+                    lane_dups.append(1)
+                    jidx += 1
+                    continue
+                outer = op.join_type == "right"
+                if outer:
+                    outer_jidx.add(jidx)
+                    # right outer: every probe row emits — on lane 0
+                    # unconditionally (unmatched rows ride lane 0 with NULL
+                    # build gathers), on later lanes only when matched
+                    def emit(cols, luts, _f=finder, _cell=lane_cells[jidx]):
+                        jnp = ensure_jax().numpy
+                        _, matched = _f(cols, luts)
+                        if _cell["d"] == 0:
+                            return DevVal("bool", jnp.ones_like(matched.arr))
+                        return matched
+
+                    filter_fns.append(emit)
+                else:
+                    filter_fns.append(lambda cols, luts, _f=finder: _f(cols, luts)[1])
                 lane_dups.append(bt.dup)
-                pv_idx = bt.pay_valid_flat_idx()
                 build_fns = [
                     _mk_build_gather(pay_off, ci, bt.kinds[ci], bt.scales[ci], bt.dicts[ci],
                                      finder,
-                                     None if pv_idx[ci] is None else off + pv_idx[ci])
+                                     None if pv_idx[ci] is None else off + pv_idx[ci],
+                                     outer=outer)
                     for ci in range(len(bt.payloads))
                 ]
                 build_meta = [
@@ -682,7 +815,10 @@ class TpuStageExec(ExecutionPlan):
         # goes through the sort-based segmented reduction below.
         def _slot_nullable(slot) -> bool:
             if isinstance(slot, tuple) and slot[0] == "build":
-                return builds[slot[1]].pay_valids[slot[2]] is not None
+                if slot[1] in outer_jidx:
+                    return True  # unmatched outer gathers are NULL
+                pp = builds[slot[1]].pay_pos[slot[2]]
+                return pp is None or builds[slot[1]].pay_valids[pp] is not None
             return dt.valids[slot] is not None
 
         unrolled = True
@@ -723,10 +859,17 @@ class TpuStageExec(ExecutionPlan):
             unrolled = False
 
         agg_fns = []
-        for d in agg.aggs:
+        agg_modes = []  # "row" | "build_cnt" (count of a mult-join build col)
+        for ai, d in enumerate(agg.aggs):
             if d.func not in ("sum", "min", "max", "count", "count_all"):
                 raise Unsupported(f"agg {d.func}")
-            agg_fns.append(lower_expr(d.expr, ctx) if d.expr is not None else None)
+            if ai in count_build_aggs:
+                agg_fns.append(None)
+                agg_modes.append("build_cnt")
+            else:
+                agg_fns.append(lower_expr(d.expr, ctx) if d.expr is not None else None)
+                agg_modes.append("row")
+        mult = (mult_weight_fn, mult_outer) if mult_weight_fn is not None else None
 
         if not unrolled:
             group_fns = [lower_expr(g, ctx) for g in agg.group_exprs]
@@ -747,7 +890,8 @@ class TpuStageExec(ExecutionPlan):
                 key_slots.append(slot)
                 key_premeta.append(gmeta)
             return self._compile_sorted(
-                dt, ctx, P, N, builds, group_fns, agg_fns, key_slots, key_premeta
+                dt, ctx, P, N, builds, group_fns, agg_fns, key_slots, key_premeta,
+                agg_modes=agg_modes, mult=mult,
             )
 
         meta_holder: dict = {}
@@ -788,11 +932,16 @@ class TpuStageExec(ExecutionPlan):
                 else:
                     gid = None
                 vs = [af(cols, luts) if af is not None else None for af in agg_fns]
+                w = m_eff = None
+                if mult_weight_fn is not None:
+                    w = jnp.broadcast_to(mult_weight_fn(cols, luts), mask.shape)
+                    m_eff = jnp.maximum(w, 1) if mult_outer else w
                 # fused Pallas path: one VMEM pass per float value lane
                 # computing ALL G masked sums + counts (exact int64 money
                 # stays on the XLA reductions below)
                 pallas_ok = (
                     use_pallas and gid is not None and aggs and G <= GROUP_LANES
+                    and mult_weight_fn is None
                     and all(v is None or v.valid is None for v in vs)
                     and all(
                         d.func in ("count", "count_all")
@@ -846,7 +995,13 @@ class TpuStageExec(ExecutionPlan):
                         out_meta.append(("i64", 0) if d.func == "count" else (v.kind, v.scale))
                     cols_out = []
                     for gm in gmasks:
-                        cols_out.append(_masked_reduce(jnp, v, gm, d.func))
+                        if agg_modes[ai] == "build_cnt":
+                            cols_out.append(
+                                jnp.where(gm, w, 0).astype(jnp.int64).sum(axis=1))
+                        elif m_eff is None:
+                            cols_out.append(_masked_reduce(jnp, v, gm, d.func))
+                        else:
+                            cols_out.append(_masked_reduce_w(jnp, v, gm, d.func, m_eff))
                     outs_lane.append(jnp.stack(cols_out, axis=1))  # [P, G]
                     if (v is not None and v.valid is not None
                             and d.func in ("sum", "min", "max")):
@@ -897,7 +1052,7 @@ class TpuStageExec(ExecutionPlan):
 
     def _compile_sorted(self, dt: DeviceTable, ctx: Lowering, P: int, N: int,
                         builds: list[BuildTable], group_fns, agg_fns, key_slots,
-                        key_premeta):
+                        key_premeta, agg_modes=None, mult=None):
         """Sort-based segmented reduction for large/int group domains.
 
         The TPU has no fast random scatter, so hash aggregation is out; the
@@ -983,24 +1138,44 @@ class TpuStageExec(ExecutionPlan):
                     key_meta.append((v.kind, v.scale, slot, has_null))
                 meta_holder["key_meta"] = key_meta
                 lane_keyops.append(keyops)
+                w_b = m_eff = None
+                if mult is not None:
+                    wfn, mouter = mult
+                    w_b = jnp.broadcast_to(wfn(cols, luts), mask.shape)
+                    m_eff = jnp.maximum(w_b, 1) if mouter else w_b
                 # payload plan: per agg → (pay_idx|None, ncnt_idx|None)
                 pays = []
                 pay_plan = []
                 out_meta = []
-                for d, af in zip(aggs, agg_fns):
+                for ai, (d, af) in enumerate(zip(aggs, agg_fns)):
+                    if agg_modes is not None and agg_modes[ai] == "build_cnt":
+                        # count of a mult-join build column == match count
+                        out_meta.append(("i64", 0))
+                        pays.append(w_b.reshape(-1).astype(jnp.int64))
+                        pay_plan.append((len(pays) - 1, None))
+                        continue
                     v = af(cols, luts) if af is not None else None
                     if d.func in ("count", "count_all"):
                         out_meta.append(("i64", 0))
                         if v is None or v.valid is None:
-                            pay_plan.append((None, None))  # segment length
+                            if m_eff is None:
+                                pay_plan.append((None, None))  # segment length
+                            else:
+                                pays.append(m_eff.reshape(-1).astype(jnp.int64))
+                                pay_plan.append((len(pays) - 1, None))
                         else:
-                            # count(x): number of non-null x per group
-                            pays.append(jnp.broadcast_to(
-                                v.valid, mask.shape).reshape(-1).astype(jnp.int64))
+                            # count(x): number of non-null x per group (each
+                            # probe row weighted by its join multiplicity)
+                            vb = jnp.broadcast_to(v.valid, mask.shape)
+                            cnt1 = m_eff if m_eff is not None else 1
+                            pays.append(jnp.where(vb, cnt1, 0)
+                                        .reshape(-1).astype(jnp.int64))
                             pay_plan.append((len(pays) - 1, None))
                         continue
                     out_meta.append((v.kind, v.scale))
                     arr = v.arr
+                    if m_eff is not None and d.func == "sum":
+                        arr = arr * m_eff.astype(arr.dtype)
                     ncnt_idx = None
                     if v.valid is not None:
                         # null-skip: neutralize invalid slots for the reduce,
@@ -1340,6 +1515,26 @@ def _segscan(jnp, values, boundary, func: str):
     return out
 
 
+def _masked_reduce_w(jnp, v, gm, func: str, m_eff):
+    """Weighted reduction for aggregate-through-join: each probe row stands
+    in for m_eff joined rows (match count; max(count, 1) under outer)."""
+    if func == "count_all":
+        return jnp.where(gm, m_eff, 0).astype(jnp.int64).sum(axis=1)
+    if func == "count":
+        m2 = gm if (v is None or v.valid is None) else gm & v.valid
+        return jnp.where(m2, m_eff, 0).astype(jnp.int64).sum(axis=1)
+    if func == "sum":
+        arr = v.arr
+        if v.valid is not None:
+            gm = gm & v.valid
+        scaled = arr * m_eff.astype(arr.dtype)
+        zero = jnp.zeros((), dtype=arr.dtype)
+        return jnp.where(gm, scaled, zero).sum(axis=1)
+    # min/max are multiplicity-invariant (w==0 rows are filtered for inner
+    # joins; under outer every probe row legitimately appears)
+    return _masked_reduce(jnp, v, gm, func)
+
+
 def _masked_reduce(jnp, v, gm, func: str):
     """One group's reduction over axis=1 of [P, N] lanes. SQL null-skipping:
     an agg input's validity plane joins the group mask — count(x) counts
@@ -1456,6 +1651,131 @@ def _mk_join_finder(off: int, probe_fns, bt: BuildTable, cell: dict):
         matched = valid & (lo + d < hi)
         idxc = jnp.clip(lo + d, 0, keys_arr.shape[0] - 1).astype(jnp.int32)
         return idxc, DevVal("bool", matched)
+
+    return run
+
+
+def _mult_shape_check(partial_agg, ops, join) -> dict | None:
+    """Structural eligibility for aggregate-through-join: `join` must be the
+    stage's LAST join (only pass-through projections may follow), inner or
+    right with no residual filter, group keys probe-side, and every
+    build-column use a bare count(col). Returns {agg index → build field
+    index} (may be empty) or None if ineligible. Shared by _prepare_build
+    (to exempt such joins from the dup-lane cap) and _compile (to activate
+    the weight path)."""
+    from ballista_tpu.plan.physical import HashJoinExec, ProjectionExec
+
+    real_ops = [o for o in ops if not isinstance(o, CoalesceBatchesExec)]
+    if join not in real_ops:
+        return None
+    if join.join_type not in ("inner", "right") or join.filter is not None:
+        return None
+    k = real_ops.index(join)
+    n_build = len(join.left.df_schema)
+    schema = join.df_schema
+    # per current-schema field: originating build field index, or None
+    build_of: list = [i if i < n_build else None for i in range(len(schema))]
+
+    def refs_build(e) -> list[int]:
+        refs: list[int] = []
+
+        def walk(x):
+            if isinstance(x, Column):
+                i = schema.maybe_index_of(x.name, x.qualifier)
+                if i is not None and build_of[i] is not None:
+                    refs.append(build_of[i])
+            for c in x.children():
+                walk(c)
+
+        walk(e)
+        return refs
+
+    for op in real_ops[k + 1:]:
+        if not isinstance(op, ProjectionExec):
+            return None  # a later join/filter may consume build values
+        new_build: list = []
+        for e in op.exprs:
+            inner = e.expr if isinstance(e, Alias) else e
+            if isinstance(inner, Column):
+                i = schema.maybe_index_of(inner.name, inner.qualifier)
+                if i is None:
+                    return None
+                new_build.append(build_of[i])
+            else:
+                if refs_build(inner):
+                    return None  # computed expr over a build column
+                new_build.append(None)
+        schema = op.df_schema
+        build_of = new_build
+
+    for g in partial_agg.group_exprs:
+        if refs_build(g.expr if isinstance(g, Alias) else g):
+            return None
+    out: dict[int, int] = {}
+    for ai, d in enumerate(partial_agg.aggs):
+        if d.expr is None:
+            continue
+        brefs = refs_build(d.expr)
+        if not brefs:
+            continue
+        inner_e = d.expr.expr if isinstance(d.expr, Alias) else d.expr
+        if d.func == "count" and isinstance(inner_e, Column) and len(brefs) == 1:
+            out[ai] = brefs[0]
+        else:
+            return None
+    return out
+
+
+def _mk_join_counter(off: int, probe_fns, bt: BuildTable):
+    """Closure computing each probe row's MATCH COUNT against the build —
+    the aggregate-through-join weight. Where every build-column use in the
+    stage is multiplicity-shaped (count(col), count(*), probe-side sums),
+    gathering the count replaces dup-lane unrolling entirely: one gather
+    instead of dup traced pipelines, and no MAX_JOIN_DUP ceiling."""
+    mode, shifts = bt.mode, bt.shifts
+    has_cnt = bt.cnt is not None
+
+    def run(cols, luts):
+        import jax.numpy as jnp
+
+        keys_arr = cols[off]
+        valid = None
+        k = None
+        for i, pf in enumerate(probe_fns):
+            v = pf(cols, luts)
+            if v.kind not in ("i64", "date"):
+                raise Unsupported(f"non-integer probe key kind {v.kind}")
+            ki = v.arr.astype(jnp.int64)
+            if i == 0:
+                k = ki
+                valid = ki >= 0
+            else:
+                shift = shifts[i - 1]
+                valid = valid & (ki >= 0) & (ki < (1 << shift))
+                k = (k << shift) | ki
+            if v.valid is not None:
+                valid = valid & v.valid
+        zero = jnp.zeros((), jnp.int32)
+        if mode == "direct" and has_cnt:
+            T = keys_arr.shape[0]
+            in_range = valid & (k >= 0) & (k < T)
+            kc = jnp.where(in_range, k, 0)
+            return jnp.where(in_range, cols[off + 1][kc], zero)
+        if mode == "direct":
+            T = keys_arr.shape[0]
+            in_range = valid & (k >= 0) & (k < T)
+            row = keys_arr[jnp.where(in_range, k, 0)]
+            return jnp.where(in_range & (row >= 0), 1, zero).astype(jnp.int32)
+        lo = jnp.searchsorted(keys_arr, k, side="left")
+        hi = jnp.searchsorted(keys_arr, k, side="right")
+        return jnp.where(valid, (hi - lo).astype(jnp.int32), zero)
+
+    return run
+
+
+def _mk_raising(msg: str):
+    def run(cols, luts):
+        raise Unsupported(msg)
 
     return run
 

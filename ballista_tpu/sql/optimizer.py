@@ -232,7 +232,7 @@ class Decorrelator:
             negated = isinstance(conj, Not) or (isinstance(conj, Exists) and conj.negated)
             ex = conj.expr if isinstance(conj, Not) else conj
             sub = self.run(ex.plan)
-            keys, residual, sub = self._extract_correlation(sub, outer.schema)
+            keys, residual, sub = self._extract_correlation(sub, outer.schema, exists=True)
             if not keys and residual is None:
                 raise PlanningError("uncorrelated EXISTS not supported")
             jt = "left_anti" if negated else "left_semi"
@@ -257,15 +257,24 @@ class Decorrelator:
 
     # ------------------------------------------------------------------
 
-    def _extract_correlation(self, sub: LogicalPlan, outer_schema):
+    def _extract_correlation(self, sub: LogicalPlan, outer_schema, exists: bool = False):
         """Pull conjuncts referencing outer columns out of the subplan's
-        top-reachable Filter. Returns (equi_keys, residual_filter, new_sub)."""
+        top-reachable Filter. Returns (equi_keys, residual_filter, new_sub).
+
+        For EXISTS the select list is semantically void (only row existence
+        matters), so Projection/Distinct nodes ABOVE the correlated Filter
+        are DROPPED — `EXISTS (SELECT 1 FROM t WHERE t.k = outer.k)` must
+        not narrow the build side to the literal and lose the correlation
+        columns. Projections BELOW the filter stay: a derived table's
+        renames/computed columns are what the extracted keys reference."""
         keys: list[tuple[Expr, Expr]] = []
         residual: list[Expr] = []
 
-        def walk(p: LogicalPlan) -> LogicalPlan:
+        def walk(p: LogicalPlan, above_filter: bool = True) -> LogicalPlan:
+            if exists and above_filter and isinstance(p, (Projection, Distinct)):
+                return walk(p.children()[0], above_filter)
             if isinstance(p, (Projection, SubqueryAlias, Distinct)):
-                inner = walk(p.children()[0])
+                inner = walk(p.children()[0], above_filter)
                 out = p.with_children([inner])
                 return out
             if isinstance(p, Filter):
@@ -280,7 +289,7 @@ class Decorrelator:
                             residual.append(c)
                     else:
                         keep.append(c)
-                new_input = walk(p.input)
+                new_input = walk(p.input, False)
                 if keep:
                     return Filter(new_input, and_(*keep))
                 return new_input

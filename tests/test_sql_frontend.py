@@ -243,3 +243,24 @@ def test_set_op_precedence_and_null_semantics():
     assert e.v.tolist() == [1]
     with pytest.raises(PlanningError):
         ctx.sql("select v, v from t intersect select v, v from u").collect()
+
+
+def test_exists_with_select_one_and_derived_table(tpch_ctx):
+    """EXISTS (SELECT 1 ...) must keep correlation columns visible (the
+    select list is void for existence, but projections BELOW the correlated
+    filter — derived-table renames — are load-bearing)."""
+    out = tpch_ctx.sql(
+        "SELECT count(*) AS c FROM nation WHERE EXISTS "
+        "(SELECT 1 FROM region WHERE r_regionkey = n_regionkey)"
+    ).collect()
+    assert out.column("c").to_pylist() == [25]
+    out = tpch_ctx.sql(
+        "SELECT count(*) AS c FROM nation WHERE EXISTS "
+        "(SELECT 1 FROM (SELECT r_regionkey AS rk FROM region) s WHERE s.rk = n_regionkey)"
+    ).collect()
+    assert out.column("c").to_pylist() == [25]
+    out = tpch_ctx.sql(
+        "SELECT count(*) AS c FROM nation WHERE NOT EXISTS "
+        "(SELECT 1 FROM region WHERE r_regionkey = n_regionkey AND r_regionkey < 2)"
+    ).collect()
+    assert out.column("c").to_pylist() == [15]

@@ -623,3 +623,125 @@ def test_nullable_probe_key_join_on_device():
     assert tp.cat.tolist() == cp.cat.tolist()
     assert tp.c.tolist() == cp.c.tolist()
     assert np.allclose(tp.s.values, cp.s.values, atol=1e-6)
+
+
+def test_right_outer_join_on_device():
+    """Right outer join (emit every probe row; NULL build columns on miss)
+    through the device chain: unmatched rows ride lane 0 with invalid
+    gathers, count(build_col) skips them."""
+    rng = np.random.default_rng(3)
+    n = 6000
+    probe = pa.table({
+        "ck": rng.integers(0, 200, n).astype("int64"),   # some keys miss
+        "amt": np.round(rng.uniform(1, 10, n), 2),
+    })
+    build = pa.table({
+        "id": pa.array(np.arange(0, 120), pa.int64()),   # ids 120..199 unmatched
+        "grp": pa.array([f"g{i % 4}" for i in range(120)]),
+        "w": pa.array(np.arange(0, 120).astype("float64") / 2),
+    })
+    sql = ("SELECT ck, count(w) AS cw, count(*) AS c, sum(amt) AS s "
+           "FROM build RIGHT JOIN probe ON id = ck GROUP BY ck ORDER BY ck")
+    tpu, cpu = _device_oracle(sql, {"probe": probe, "build": build})
+    tp, cp = tpu.to_pandas(), cpu.to_pandas()
+    assert tp.ck.tolist() == cp.ck.tolist()
+    assert tp.cw.tolist() == cp.cw.tolist()
+    assert tp.c.tolist() == cp.c.tolist()
+    assert np.allclose(tp.s.values, cp.s.values, atol=1e-6)
+    # sanity: the miss range exists, so count(w) < count(*) somewhere
+    assert (tp.cw.values < tp.c.values).any()
+
+
+def test_filtered_semi_anti_join_on_device():
+    """EXISTS / NOT EXISTS with a correlated residual predicate (the q21
+    shape: l2.l_suppkey <> l1.l_suppkey) lowers to an OR across build match
+    lanes on device."""
+    rng = np.random.default_rng(9)
+    n = 5000
+    t1 = pa.table({
+        "ok": rng.integers(0, 400, n).astype("int64"),
+        "sk": rng.integers(0, 10, n).astype("int64"),
+        "v": np.round(rng.uniform(1, 5, n), 2),
+    })
+    m = 2000
+    t2 = pa.table({
+        "ok2": rng.integers(0, 400, m).astype("int64"),
+        "sk2": rng.integers(0, 10, m).astype("int64"),
+    })
+    for kw in ("EXISTS", "NOT EXISTS"):
+        sql = (f"SELECT sk, count(*) AS c, sum(v) AS s FROM t1 WHERE {kw} "
+               f"(SELECT 1 FROM t2 WHERE ok2 = ok AND sk2 <> sk) "
+               f"GROUP BY sk ORDER BY sk")
+        tpu, cpu = _device_oracle(sql, {"t1": t1, "t2": t2})
+        tp, cp = tpu.to_pandas(), cpu.to_pandas()
+        assert tp.sk.tolist() == cp.sk.tolist(), kw
+        assert tp.c.tolist() == cp.c.tolist(), kw
+        assert np.allclose(tp.s.values, cp.s.values, atol=1e-6), kw
+
+
+def test_aggregate_through_join_multiplicity():
+    """count(build_col) through a dup≫16 expansion join uses match-count
+    gathers (no lane unrolling, no MAX_JOIN_DUP ceiling) — the q13 shape."""
+    rng = np.random.default_rng(21)
+    n = 3000
+    build = pa.table({
+        "fk": rng.integers(0, 60, n).astype("int64"),  # up to ~70 dups per key
+        "bid": pa.array(np.arange(n), pa.int64()),
+    })
+    probe = pa.table({
+        "id": pa.array(np.arange(80), pa.int64()),     # ids 60..79 unmatched
+        "grp": pa.array([i % 7 for i in range(80)], pa.int64()),
+    })
+    for jt, sqljoin in (("inner", "JOIN"), ("outer", "RIGHT JOIN")):
+        sql = (f"SELECT grp, count(bid) AS cb, count(*) AS c FROM build "
+               f"{sqljoin} probe ON fk = id GROUP BY grp ORDER BY grp")
+        tpu, cpu = _device_oracle(sql, {"probe": probe, "build": build})
+        tp, cp = tpu.to_pandas(), cpu.to_pandas()
+        assert tp.grp.tolist() == cp.grp.tolist(), jt
+        assert tp.cb.tolist() == cp.cb.tolist(), jt
+        assert tp.c.tolist() == cp.c.tolist(), jt
+
+
+@pytest.fixture(scope="module")
+def tpch_mid_dir(tmp_path_factory):
+    """SF0.05: large enough that no filtered build side is empty (at SF0.01
+    the q16/q18 subquery builds vanish and adaptively fall back — correct,
+    but it would mask real device-coverage regressions)."""
+    from ballista_tpu.testing.tpchgen import generate_tpch
+
+    d = tmp_path_factory.mktemp("tpch-mid") / "sf005"
+    # seed 1: every correlated-subquery build side (q16 complaint suppliers,
+    # q18 big-quantity orders) is non-empty at this scale
+    generate_tpch(str(d), scale=0.05, seed=1, files_per_table=2)
+    return str(d)
+
+
+def test_all_22_tpch_queries_run_device_stages(tpch_mid_dir):
+    """Coverage pin: every TPC-H query compiles ≥1 device stage and runs it
+    with ZERO cpu fallbacks (VERDICT round-1 item #2's done criterion)."""
+    import ballista_tpu.ops.tpu.stage_compiler as sc
+    from ballista_tpu.client.context import SessionContext
+    from ballista_tpu.engine.tpu_engine import maybe_compile_tpu
+    from ballista_tpu.plan.physical import TaskContext
+    from ballista_tpu.testing.tpchgen import register_tpch
+
+    cfg = BallistaConfig({EXECUTOR_ENGINE: "tpu", TPU_MIN_ROWS: 0})
+    tpu_ctx = SessionContext(cfg)
+    register_tpch(tpu_ctx, tpch_mid_dir)
+    bad = []
+    for q in range(1, 23):
+        sql = tpch_query(q)
+        phys = maybe_compile_tpu(
+            tpu_ctx.create_physical_plan(tpu_ctx.sql(sql).plan), cfg)
+        stages = [nd for nd in _walk(phys) if isinstance(nd, sc.TpuStageExec)]
+        if not stages:
+            bad.append((q, "no device stage"))
+            continue
+        tc = TaskContext(cfg)
+        for p in range(phys.output_partition_count()):
+            list(phys.execute(p, tc))
+        runs = sum(s.tpu_count for s in stages)
+        fb = sum(s.fallback_count for s in stages)
+        if not runs or fb:
+            bad.append((q, f"runs={runs} fallbacks={fb}"))
+    assert not bad, bad
