@@ -141,7 +141,8 @@ class SchedulerGrpcService:
         tasks = self.scheduler.poll_work(meta, request.can_accept_task, request.free_slots, results)
         out = pb.PollWorkResult()
         for t in tasks:
-            out.tasks.append(encode_task_definition(t))
+            out.tasks.append(
+                encode_task_definition(t, self.scheduler.sessions.get(t.session_id)))
         return out
 
     def ExecutorStopped(self, request: pb.ExecutorStoppedParams, context) -> pb.ExecutorStoppedResult:

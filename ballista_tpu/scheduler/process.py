@@ -56,8 +56,8 @@ class GrpcTaskLauncher(TaskLauncher):
         addr = f"{slot.metadata.host}:{slot.metadata.grpc_port}"
         req = pb.LaunchMultiTaskParams(scheduler_id=server.scheduler_id)
         for t in tasks:
-            tp = encode_task_definition(t)
             cfg = server.sessions.get(t.session_id)
+            tp = encode_task_definition(t, cfg)
             if cfg is not None:
                 for k, v in cfg.to_key_value_pairs():
                     tp.props.add(key=k, value=v)

@@ -34,28 +34,46 @@ def decode_executor_metadata(p: pb.ExecutorMetadataProto) -> ExecutorMetadata:
     )
 
 
-def _encoded_plan_bytes(t: TaskDescription) -> bytes:
-    """Stage-plan encode cache (reference: TaskManager's optional
-    stage-plan cache, state/task_manager.rs): tasks of one stage attempt
-    share one plan object — encode once, not once per task. Memoized ON
-    the plan object so the cache's lifetime is the plan's (replanned/
-    retried stages build new plan objects and re-encode; no id() aliasing).
-    Plans are never mutated after task hand-out begins (AQE rewrites
-    happen at resolution, before the first task is popped)."""
-    hit = getattr(t.plan, "_encoded_task_plan", None)
+def _encoded_plan_bytes(t: TaskDescription, config=None) -> bytes:
+    """Per-task plan restriction + stage-plan encode cache.
+
+    The plan shipped to a task is RESTRICTED to the task's partition slice
+    (scan file-groups and reader location lists outside the slice become
+    empty; see scheduler/task_builder.py — the reference's
+    state/task_builder.rs:18-64). Encodings are memoized ON the shared
+    stage-plan object, keyed by the partition slice, so retries and
+    multi-partition slices reuse bytes; the cache's lifetime is the plan's
+    (replanned/retried stages build new plan objects and re-encode; no
+    id() aliasing). Plans are never mutated after task hand-out begins
+    (AQE rewrites happen at resolution, before the first task is popped)."""
+    from ballista_tpu.scheduler.task_builder import restrict_plan_to_partitions
+
+    restricted = restrict_plan_to_partitions(t.plan, t.partitions, config)
+    if restricted is t.plan:
+        hit = getattr(t.plan, "_encoded_task_plan", None)
+        if hit is None:
+            hit = encode_plan(t.plan).SerializeToString()
+            t.plan._encoded_task_plan = hit
+        return hit
+    cache = getattr(t.plan, "_encoded_task_plan_slices", None)
+    if cache is None:
+        cache = {}
+        t.plan._encoded_task_plan_slices = cache
+    key = tuple(sorted(set(t.partitions)))
+    hit = cache.get(key)
     if hit is None:
-        hit = encode_plan(t.plan).SerializeToString()
-        t.plan._encoded_task_plan = hit
+        hit = encode_plan(restricted).SerializeToString()
+        cache[key] = hit
     return hit
 
 
-def encode_task_definition(t: TaskDescription) -> pb.TaskDefinitionProto:
+def encode_task_definition(t: TaskDescription, config=None) -> pb.TaskDefinitionProto:
     out = pb.TaskDefinitionProto(
         task_id=t.task_id, job_id=t.job_id, stage_id=t.stage_id,
         stage_attempt=t.stage_attempt, session_id=t.session_id,
     )
     out.partitions.extend(t.partitions)
-    out.plan.ParseFromString(_encoded_plan_bytes(t))
+    out.plan.ParseFromString(_encoded_plan_bytes(t, config))
     return out
 
 

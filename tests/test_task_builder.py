@@ -1,0 +1,103 @@
+"""Per-task plan restriction (scheduler/task_builder.py — the reference's
+state/task_builder.rs semantics): task protos must stay ~flat as partition
+counts grow, and leaves under a collapse must keep full input."""
+
+import pyarrow as pa
+
+from ballista_tpu.config import BallistaConfig, EXECUTOR_ENGINE
+from ballista_tpu.plan.physical import (
+    CoalescePartitionsExec,
+    FilterExec,
+    HashJoinExec,
+    ParquetScanExec,
+)
+from ballista_tpu.plan.schema import DFSchema
+from ballista_tpu.scheduler.state.execution_graph import TaskDescription
+from ballista_tpu.scheduler.task_builder import restrict_plan_to_partitions
+from ballista_tpu.serde_control import encode_task_definition
+from ballista_tpu.shuffle.reader import ShuffleReaderExec
+from ballista_tpu.shuffle.types import PartitionLocation, PartitionStats
+from ballista_tpu.shuffle.writer import ShuffleWriterExec
+from ballista_tpu.plan.expressions import Column
+
+
+def _schema():
+    return DFSchema.from_arrow(pa.schema([("k", pa.int64()), ("v", pa.float64())]), "t")
+
+
+def _locs(n_parts: int, n_locs: int):
+    return [
+        [
+            PartitionLocation(
+                map_partition=m, job_id="j", stage_id=1, output_partition=p,
+                executor_id=f"e{m}", host=f"host-{m}.example.com", flight_port=50051,
+                path=f"/work/j/1/{p}/data-{m}.arrow", layout="hash",
+                stats=PartitionStats(100, 1000),
+            )
+            for m in range(n_locs)
+        ]
+        for p in range(n_parts)
+    ]
+
+
+def _task(plan, partitions):
+    return TaskDescription(job_id="j", stage_id=2, stage_attempt=0, task_id=1,
+                           partitions=partitions, plan=plan, session_id="s")
+
+
+def test_task_plan_size_flat_vs_partition_count():
+    """A 1-partition task's proto must not scale with the stage's total
+    partition×location table (the SF1000 16 MiB plan ceiling failure)."""
+    sizes = {}
+    for n_parts in (16, 64, 256):
+        reader = ShuffleReaderExec(_schema(), _locs(n_parts, 32))
+        plan = ShuffleWriterExec(FilterExec(reader, Column("k", "t")), "j", 2,
+                                 n_parts, [Column("k", "t")])
+        full = encode_task_definition(_task(plan, list(range(n_parts)))).ByteSize()
+        one = encode_task_definition(_task(plan, [3])).ByteSize()
+        sizes[n_parts] = (one, full)
+    # full plans grow linearly; single-partition tasks stay flat
+    assert sizes[256][1] > 10 * sizes[16][0]
+    assert sizes[256][0] < sizes[16][0] * 1.5, sizes
+    assert sizes[256][0] < sizes[256][1] / 50, sizes
+
+
+def test_restriction_keeps_global_partition_indexing():
+    reader = ShuffleReaderExec(_schema(), _locs(8, 4))
+    out = restrict_plan_to_partitions(FilterExec(reader, Column("k", "t")), [5])
+    new_reader = out.children()[0]
+    assert len(new_reader.partition_locations) == 8
+    assert [len(l) for l in new_reader.partition_locations] == [0, 0, 0, 0, 0, 4, 0, 0]
+
+
+def test_collapse_scoping_keeps_full_build_side():
+    """Leaves under a collect_left build (and under CoalescePartitions)
+    keep FULL input — the task_builder.rs under-collapse trap."""
+    build_reader = ShuffleReaderExec(_schema(), _locs(4, 2))
+    probe_reader = ShuffleReaderExec(_schema(), _locs(4, 2))
+    join = HashJoinExec(
+        CoalescePartitionsExec(build_reader), probe_reader,
+        [(Column("k", "t"), Column("k", "t"))], "inner", None, "collect_left",
+        _schema().merge(_schema()),
+    )
+    out = restrict_plan_to_partitions(join, [1])
+    new_build = out.children()[0].children()[0]
+    new_probe = out.children()[1]
+    assert [len(l) for l in new_build.partition_locations] == [2, 2, 2, 2]
+    assert [len(l) for l in new_probe.partition_locations] == [0, 2, 0, 0]
+
+
+def test_tpu_engine_keeps_full_scans():
+    """engine=tpu: scans stay whole (device-table cache is keyed on the
+    scan's file set) while reader lists still shrink."""
+    scan = ParquetScanExec(_schema(), [{"files": [{"file": f"/d/{i}.parquet"}]}
+                                       for i in range(8)], ["k", "v"], [], "t")
+    reader = ShuffleReaderExec(_schema(), _locs(8, 2))
+    join = HashJoinExec(scan, reader, [(Column("k", "t"), Column("k", "t"))],
+                        "inner", None, "partitioned", _schema().merge(_schema()))
+    tpu_cfg = BallistaConfig({EXECUTOR_ENGINE: "tpu"})
+    out = restrict_plan_to_partitions(join, [2], tpu_cfg)
+    assert [len(p["files"]) for p in out.children()[0].partitions] == [1] * 8
+    assert [len(l) for l in out.children()[1].partition_locations] == [0, 0, 2, 0, 0, 0, 0, 0]
+    cpu_out = restrict_plan_to_partitions(join, [2], BallistaConfig())
+    assert [len(p["files"]) for p in cpu_out.children()[0].partitions] == [0, 0, 1, 0, 0, 0, 0, 0]
