@@ -76,11 +76,36 @@ class ShuffleReaderExec(ExecutionPlan):
         force_remote = bool(ctx.config.get(SHUFFLE_READER_FORCE_REMOTE))
         produced = False
         gov = _governor(ctx)
-        for loc in locs:
-            for b in fetch_partition(loc, ctx, force_remote=force_remote, governor=gov):
-                if b.num_rows:
-                    produced = True
-                    yield b
+        if len(locs) > 1:
+            # fetch ALL upstream map outputs concurrently under the governor
+            # (reference: send_fetch_partitions spawns every fetch,
+            # shuffle_reader.rs:762-875); results YIELD in location order so
+            # order-sensitive float merges stay deterministic — later
+            # fetches overlap the consumption of earlier ones
+            import concurrent.futures as fut
+
+            pool = fut.ThreadPoolExecutor(
+                max_workers=min(len(locs), int(ctx.config.get(SHUFFLE_READER_MAX_REQUESTS))),
+                thread_name_prefix="shuffle-fetch",
+            )
+            try:
+                futures = [
+                    pool.submit(_fetch_buffered, loc, ctx, force_remote, gov)
+                    for loc in locs
+                ]
+                for f in futures:
+                    for b in f.result():
+                        if b.num_rows:
+                            produced = True
+                            yield b
+            finally:
+                pool.shutdown(wait=False, cancel_futures=True)
+        else:
+            for loc in locs:
+                for b in fetch_partition(loc, ctx, force_remote=force_remote, governor=gov):
+                    if b.num_rows:
+                        produced = True
+                        yield b
         if not produced:
             yield _empty_batch(self.schema())
 
@@ -182,6 +207,11 @@ def _governor(ctx: TaskContext) -> FetchGovernor:
         return g
 
 
+def _fetch_buffered(loc: PartitionLocation, ctx: TaskContext, force_remote: bool,
+                    governor: FetchGovernor | None) -> list[pa.RecordBatch]:
+    return list(fetch_partition(loc, ctx, force_remote=force_remote, governor=governor))
+
+
 def fetch_partition(loc: PartitionLocation, ctx: TaskContext, force_remote: bool = False,
                     governor: FetchGovernor | None = None) -> Iterator[pa.RecordBatch]:
     local = not force_remote and loc.path and os.path.exists(loc.path)
@@ -197,14 +227,21 @@ def fetch_partition(loc: PartitionLocation, ctx: TaskContext, force_remote: bool
         try:
             from ballista_tpu.flight.client import fetch_partition_flight
 
-            yield from fetch_partition_flight(loc, ctx)
-            return
+            # buffer the WHOLE partition before yielding anything: in
+            # decoded (do_get) mode the flight client streams batches
+            # incrementally, so a retry around a half-yielded stream would
+            # duplicate the first attempt's rows downstream (the
+            # reference's fetch_partition_buffered, shuffle_reader.rs:975)
+            batches = list(fetch_partition_flight(loc, ctx))
         except Exception as e:  # noqa: BLE001 — retried, then surfaced as FetchFailed
             last = e
             time.sleep(wait_ms * (attempt + 1) / 1000.0)
+            continue
         finally:
             if governor:
                 governor.release(addr, token)
+        yield from batches
+        return
     raise FetchFailed(loc.executor_id, loc.job_id, loc.stage_id, loc.map_partition, str(last))
 
 

@@ -119,12 +119,74 @@ def run_read(work_dir: str, partitions: int, layout: str, mode: str, ctx, rows: 
     return dt
 
 
+def run_reader_exec(work_dir: str, partitions: int, layout: str, ctx, rows: int):
+    """The REAL reduce path: ShuffleReaderExec over a Flight server, all of
+    a partition's upstream locations fetched concurrently under the
+    governor. Reports seconds; throughput should scale with location count
+    (shuffle_reader.rs:762-875)."""
+    from ballista_tpu.flight.server import start_flight_server
+    from ballista_tpu.plan.schema import DFSchema
+    from ballista_tpu.shuffle.reader import ShuffleReaderExec
+    from ballista_tpu.shuffle.types import PartitionLocation, PartitionStats
+
+    stage_dir = os.path.join(work_dir, "bench-job", "1")
+    per_part: dict[int, list] = {p: [] for p in range(partitions)}
+    for root, _, files in os.walk(stage_dir):
+        for f in files:
+            if f.endswith(".idx"):
+                continue
+            path = os.path.join(root, f)
+            if layout == "sort":
+                for p in range(partitions):
+                    per_part[p].append((path, p))
+            else:
+                p = int(os.path.basename(root))
+                per_part[p].append((path, p))
+    server, port = start_flight_server(work_dir, "127.0.0.1", 0)
+    try:
+        schema = DFSchema.from_arrow(
+            pa.schema([("k", pa.int64()), ("v", pa.int64()),
+                       ("price", pa.float64()), ("s", pa.string())]), "t")
+        locs = [
+            [
+                PartitionLocation(
+                    map_partition=m, job_id="bench-job", stage_id=1,
+                    output_partition=p, executor_id="e", host="127.0.0.1",
+                    flight_port=port, path=path, layout=layout,
+                    stats=PartitionStats(0, 0, 0),
+                )
+                for m, (path, _p) in enumerate(per_part[p])
+            ]
+            for p in range(partitions)
+        ]
+        rd = ShuffleReaderExec(schema, locs)
+        t0 = time.time()
+        got = 0
+        for p in range(partitions):
+            for b in rd.execute(p, _force_remote(ctx)):
+                got += b.num_rows
+        dt = time.time() - t0
+    finally:
+        server.shutdown()
+    assert got == rows, f"reader exec read {got} rows, expected {rows}"
+    return dt
+
+
+def _force_remote(ctx):
+    from ballista_tpu.config import SHUFFLE_READER_FORCE_REMOTE, BallistaConfig
+    from ballista_tpu.plan.physical import TaskContext
+
+    cfg = BallistaConfig.from_key_value_pairs(ctx.config.to_key_value_pairs())
+    cfg.set(SHUFFLE_READER_FORCE_REMOTE, True)
+    return TaskContext(cfg)
+
+
 def main() -> None:
     ap = argparse.ArgumentParser(description="shuffle writer/reader micro-benchmark")
     ap.add_argument("--rows", type=int, default=2_000_000)
     ap.add_argument("--partitions", type=int, default=16)
     ap.add_argument("--layout", choices=("sort", "hash", "both"), default="both")
-    ap.add_argument("--read", choices=("local", "flight", "none"), default="local")
+    ap.add_argument("--read", choices=("local", "flight", "reader", "none"), default="local")
     ap.add_argument("--json", action="store_true")
     args = ap.parse_args()
 
@@ -146,7 +208,11 @@ def main() -> None:
             "bytes": nbytes,
             "files": sum(len(fs) for _, _, fs in os.walk(work)),
         }
-        if args.read != "none":
+        if args.read == "reader":
+            rt = run_reader_exec(work, args.partitions, layout, ctx, args.rows)
+            entry["read_reader_s"] = round(rt, 3)
+            entry["read_reader_rows_per_s"] = int(args.rows / rt)
+        elif args.read != "none":
             rt = run_read(work, args.partitions, layout, args.read, ctx, args.rows)
             entry[f"read_{args.read}_s"] = round(rt, 3)
             entry[f"read_{args.read}_rows_per_s"] = int(args.rows / rt)
