@@ -1,0 +1,36 @@
+"""Regenerate the golden staged-plan snapshots.
+
+    python dev/update_plan_stability.py
+
+Rewrites tests/tpch_plan_stability/approved/{cpu,tpu}/qN.txt from the
+current planner over dataless SF100-stats tables (reference:
+dev/update-tpch-plan-stability.sh). Review the diff before committing —
+every change is a stage-boundary / join-mode / broadcast decision change.
+"""
+
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+sys.path.insert(0, os.path.join(ROOT, "tests"))
+
+
+def main() -> None:
+    from tpch_plan_stability.fixtures import query_path, staged_plan_text, stats_context
+
+    for engine in ("cpu", "tpu"):
+        ctx = stats_context(engine)
+        out_dir = os.path.join(ROOT, "tests", "tpch_plan_stability", "approved", engine)
+        os.makedirs(out_dir, exist_ok=True)
+        for q in range(1, 23):
+            with open(query_path(q)) as f:
+                sql = f.read()
+            text = staged_plan_text(ctx, sql)
+            with open(os.path.join(out_dir, f"q{q}.txt"), "w") as f:
+                f.write(text)
+            print(f"{engine}/q{q}: {text.count('=== Stage')} stages")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,35 @@
+"""Golden staged-plan stability suite (reference:
+scheduler/tests/tpch_plan_stability/): all 22 TPC-H distributed plans are
+frozen with injected SF100 stats at target_partitions=16, for both engine
+planning modes. Any stage-boundary / join-mode / broadcast / partition-
+count change fails here; regenerate deliberately with
+`python dev/update_plan_stability.py` and review the diff."""
+
+import os
+
+import pytest
+
+from .tpch_plan_stability.fixtures import query_path, staged_plan_text, stats_context
+
+APPROVED = os.path.join(os.path.dirname(os.path.abspath(__file__)), "tpch_plan_stability", "approved")
+
+
+@pytest.fixture(scope="module", params=["cpu", "tpu"])
+def golden_ctx(request):
+    return request.param, stats_context(request.param)
+
+
+@pytest.mark.parametrize("q", range(1, 23))
+def test_staged_plan_stable(golden_ctx, q):
+    engine, ctx = golden_ctx
+    with open(query_path(q)) as f:
+        sql = f.read()
+    got = staged_plan_text(ctx, sql)
+    path = os.path.join(APPROVED, engine, f"q{q}.txt")
+    with open(path) as f:
+        want = f.read()
+    assert got == want, (
+        f"staged plan for q{q} ({engine} planning) changed; if intended, run "
+        f"`python dev/update_plan_stability.py` and review the diff\n--- approved\n"
+        f"{want}\n--- got\n{got}"
+    )

@@ -1,0 +1,125 @@
+"""Golden staged-plan fixtures: dataless TPC-H tables with injected SF100
+statistics (reference: scheduler/tests/tpch_plan_stability/stats_table.rs).
+
+The planner sees real row counts — join build-side choices, broadcast
+promotions, semi-key relaxations, and stage boundaries are all decided from
+these stats — but no file ever exists: scan partitions are synthetic
+descriptors, so the frozen plans are byte-stable across machines.
+"""
+
+from __future__ import annotations
+
+import os
+
+import pyarrow as pa
+
+from ballista_tpu.plan.provider import TableProvider, TableStats
+
+# exact TPC-H SF100 cardinalities
+SF100_ROWS = {
+    "lineitem": 600_037_902,
+    "orders": 150_000_000,
+    "partsupp": 80_000_000,
+    "part": 20_000_000,
+    "customer": 15_000_000,
+    "supplier": 1_000_000,
+    "nation": 25,
+    "region": 5,
+}
+
+_D = pa.date32()
+_S = pa.string()
+_I = pa.int64()
+_F = pa.float64()
+
+SCHEMAS = {
+    "lineitem": [("l_orderkey", _I), ("l_partkey", _I), ("l_suppkey", _I),
+                 ("l_linenumber", _I), ("l_quantity", _F), ("l_extendedprice", _F),
+                 ("l_discount", _F), ("l_tax", _F), ("l_returnflag", _S),
+                 ("l_linestatus", _S), ("l_shipdate", _D), ("l_commitdate", _D),
+                 ("l_receiptdate", _D), ("l_shipinstruct", _S), ("l_shipmode", _S),
+                 ("l_comment", _S)],
+    "orders": [("o_orderkey", _I), ("o_custkey", _I), ("o_orderstatus", _S),
+               ("o_totalprice", _F), ("o_orderdate", _D), ("o_orderpriority", _S),
+               ("o_clerk", _S), ("o_shippriority", _I), ("o_comment", _S)],
+    "customer": [("c_custkey", _I), ("c_name", _S), ("c_address", _S),
+                 ("c_nationkey", _I), ("c_phone", _S), ("c_acctbal", _F),
+                 ("c_mktsegment", _S), ("c_comment", _S)],
+    "part": [("p_partkey", _I), ("p_name", _S), ("p_mfgr", _S), ("p_brand", _S),
+             ("p_type", _S), ("p_size", _I), ("p_container", _S),
+             ("p_retailprice", _F), ("p_comment", _S)],
+    "partsupp": [("ps_partkey", _I), ("ps_suppkey", _I), ("ps_availqty", _I),
+                 ("ps_supplycost", _F), ("ps_comment", _S)],
+    "supplier": [("s_suppkey", _I), ("s_name", _S), ("s_address", _S),
+                 ("s_nationkey", _I), ("s_phone", _S), ("s_acctbal", _F),
+                 ("s_comment", _S)],
+    "nation": [("n_nationkey", _I), ("n_name", _S), ("n_regionkey", _I),
+               ("n_comment", _S)],
+    "region": [("r_regionkey", _I), ("r_name", _S), ("r_comment", _S)],
+}
+
+
+class TpchStatsTable(TableProvider):
+    """Schema + injected stats, zero data (plans only — never executed)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._schema = pa.schema(SCHEMAS[name])
+        self._rows = SF100_ROWS[name]
+
+    def arrow_schema(self) -> pa.Schema:
+        return self._schema
+
+    def statistics(self) -> TableStats:
+        return TableStats(num_rows=self._rows, total_bytes=self._rows * 100)
+
+    def scan_partitions(self, target_partitions: int) -> list[dict]:
+        n = min(target_partitions, max(1, self._rows // 1_000_000)) or 1
+        return [
+            {"files": [{"file": f"tpch-sf100/{self.name}/part-{i:03d}.parquet"}]}
+            for i in range(int(n))
+        ]
+
+
+def stats_context(engine: str = "cpu"):
+    """SessionContext over the dataless SF100 tables, target_partitions=16
+    (the reference suite's configuration)."""
+    from ballista_tpu.client.context import SessionContext
+    from ballista_tpu.config import (
+        EXECUTOR_ENGINE,
+        TARGET_PARTITIONS,
+        BallistaConfig,
+    )
+
+    cfg = BallistaConfig({TARGET_PARTITIONS: 16, EXECUTOR_ENGINE: engine})
+    ctx = SessionContext(cfg)
+    for name in SF100_ROWS:
+        ctx.register_table(name, TpchStatsTable(name))
+    return ctx
+
+
+def staged_plan_text(ctx, sql: str) -> str:
+    """SQL → optimized logical → physical → distributed stages → stable
+    text. Any change to stage boundaries, join modes/orders, broadcast
+    decisions, or partition counts changes this text and fails the pin."""
+    from ballista_tpu.scheduler.planner import DistributedPlanner
+
+    physical = ctx.create_physical_plan(ctx.sql(sql).plan)
+    stages = DistributedPlanner("golden").plan_query_stages(physical)
+    out = []
+    for s in stages:
+        flags = []
+        if s.broadcast:
+            flags.append("broadcast")
+        flag = f" [{','.join(flags)}]" if flags else ""
+        out.append(
+            f"=== Stage {s.stage_id} partitions={s.partitions} -> "
+            f"{s.output_partitions} inputs={s.input_stage_ids}{flag}\n"
+            + s.plan.display(0)
+        )
+    return "\n".join(out).rstrip() + "\n"
+
+
+def query_path(n: int) -> str:
+    root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    return os.path.join(root, "benchmarks", "tpch", "queries", f"q{n}.sql")
