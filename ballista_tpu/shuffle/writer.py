@@ -194,16 +194,42 @@ class ShuffleWriterExec(ExecutionPlan):
         return self._finish_hash(map_partition, task_id, schema, buckets, bucket_rows, bucket_batches, ctx)
 
     def _finish_hash(self, map_partition, task_id, schema, buckets, rows, batches, ctx):
-        out = []
-        for k, bs in enumerate(buckets):
-            if not rows[k]:
-                continue
+        """Drain the K bucket files CONCURRENTLY (the reference's K
+        concurrent per-output drain tasks, shuffle_writer.rs:214-303):
+        Arrow's IPC write releases the GIL for compression + IO, so the
+        drains genuinely overlap."""
+        import concurrent.futures as fut
+
+        live = [k for k in range(len(buckets)) if rows[k]]
+        if not live:
+            return self._meta([])
+
+        def drain(k: int):
             path = paths.hash_data_path(ctx.work_dir, self.job_id, self.stage_id, k, task_id)
             os.makedirs(os.path.dirname(path), exist_ok=True)
             with open(path, "wb") as f:
-                _, nbytes = write_ipc_stream(bs, schema, f, ctx)
-            out.append((k, path, rows[k], batches[k], nbytes, "hash"))
+                _, nbytes = write_ipc_stream(buckets[k], schema, f, ctx)
+            return (k, path, rows[k], batches[k], nbytes, "hash")
+
+        if len(live) == 1:
+            return self._meta([drain(live[0])])
+        with fut.ThreadPoolExecutor(max_workers=min(len(live), 8),
+                                    thread_name_prefix="shuffle-drain") as pool:
+            out = list(pool.map(drain, live))
         return self._meta(out)
+
+    @staticmethod
+    def _iter_bucket_batches(in_memory: list, spill_files: list[str]):
+        """Stream a bucket's batches: in-memory first, then each spill file
+        decoded ONE BATCH AT A TIME. Consolidation must never rebuffer what
+        it spilled — that would peak at exactly the memory the spill
+        existed to avoid (sort_shuffle/spill.rs:46 streams the same way)."""
+        for b in in_memory:
+            yield b
+        for sp in spill_files:
+            with open(sp, "rb") as sf:
+                yield from ipc.open_stream(sf)
+            os.remove(sp)
 
     def _finish_sort(self, map_partition, schema, buckets, spills, rows, batches, ctx):
         """Consolidate buckets (memory + spills) into one data file + index."""
@@ -216,13 +242,12 @@ class ShuffleWriterExec(ExecutionPlan):
                 if not rows[k]:
                     continue
                 start = f.tell()
-                all_batches = list(buckets[k])
-                for sp in spills[k]:
-                    with open(sp, "rb") as sf:
-                        reader = ipc.open_stream(sf)
-                        all_batches.extend(reader)
-                    os.remove(sp)
-                nrows, _ = write_ipc_stream(all_batches, schema, f, ctx)
+                nrows = 0
+                with ipc.new_stream(f, schema, options=_ipc_options(ctx)) as w:
+                    for b in self._iter_bucket_batches(buckets[k], spills[k]):
+                        if b.num_rows:
+                            w.write_batch(b)
+                            nrows += b.num_rows
                 length = f.tell() - start
                 index[str(k)] = [start, length, nrows, length]
                 out.append((k, data_path, nrows, batches[k], length, "sort"))

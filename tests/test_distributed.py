@@ -308,3 +308,57 @@ def test_concurrent_location_fetch_order_deterministic(monkeypatch):
     assert out == list(range(n_locs))          # deterministic location order
     assert inflight["peak"] >= 3               # genuinely concurrent
     assert elapsed < 0.05 * n_locs * 0.8       # faster than serial
+
+
+def test_spill_consolidation_streams_bounded_memory(tmp_path):
+    """Consolidating spilled buckets must stream spill files batch-by-batch,
+    never rebuffering a whole bucket (peak Arrow allocation during the
+    consolidation stays near one batch, far under the spilled volume)."""
+    import numpy as np
+    import pyarrow as pa
+
+    from ballista_tpu.config import BallistaConfig, SORT_SHUFFLE_MEMORY_LIMIT
+    from ballista_tpu.plan.expressions import Column
+    from ballista_tpu.plan.physical import MemoryScanExec, TaskContext
+    from ballista_tpu.plan.schema import DFSchema
+    from ballista_tpu.shuffle import writer as writer_mod
+
+    rng = np.random.default_rng(4)
+    batch_rows = 20_000
+    n_batches = 24
+    batches = [
+        pa.record_batch({
+            "k": pa.array(rng.integers(0, 1 << 20, batch_rows)),
+            "v": pa.array(rng.random(batch_rows)),
+        })
+        for _ in range(n_batches)
+    ]
+    batch_bytes = batches[0].nbytes
+    schema = DFSchema.from_arrow(batches[0].schema, "t")
+    scan = MemoryScanExec(schema, batches)
+    w = writer_mod.ShuffleWriterExec(scan, "spilljob", 1, 4, [Column("k", "t")],
+                                     sort_shuffle=True)
+
+    peaks = []
+    orig = writer_mod.ShuffleWriterExec._iter_bucket_batches
+
+    def spy(in_memory, spill_files):
+        base = pa.total_allocated_bytes()
+        for b in orig(in_memory, spill_files):
+            peaks.append(pa.total_allocated_bytes() - base)
+            yield b
+
+    writer_mod.ShuffleWriterExec._iter_bucket_batches = staticmethod(spy)
+    try:
+        ctx = TaskContext(BallistaConfig({SORT_SHUFFLE_MEMORY_LIMIT: 2 * batch_bytes}),
+                          work_dir=str(tmp_path))
+        meta = list(w.execute(0, ctx))[0]
+        total_rows = sum(meta.column(2).to_pylist())
+        assert total_rows == batch_rows * n_batches
+    finally:
+        writer_mod.ShuffleWriterExec._iter_bucket_batches = staticmethod(orig)
+    assert peaks, "consolidation never streamed"
+    spilled_volume = batch_bytes * n_batches
+    # old behavior rebuffered ~a whole bucket (¼ of the data); streaming
+    # holds at most a few decoded batches at once
+    assert max(peaks) < spilled_volume / 8, (max(peaks), spilled_volume)
