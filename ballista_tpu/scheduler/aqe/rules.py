@@ -208,3 +208,89 @@ def _select_joins(plan: ExecutionPlan, input_stats, config: BallistaConfig) -> E
         return n
 
     return walk(plan)
+
+
+# -- incremental replanning over UNRESOLVED stage specs ----------------------
+
+
+def propagate_empty_unresolved(plan: ExecutionPlan, empty_ids: set[int]) -> ExecutionPlan:
+    """The incremental form of PropagateEmptyExecRule: operates on a NOT yet
+    resolved stage spec whose leaves are UnresolvedShuffleExec placeholders.
+    A placeholder whose source stage finished with ZERO rows is a proven-
+    empty leaf — join shapes collapse immediately, before the stage ever
+    resolves or schedules (reference: aqe/optimizer_rule/propagate_empty
+    over the remaining plan, state/aqe/planner.rs:304)."""
+    from ballista_tpu.shuffle.reader import UnresolvedShuffleExec
+
+    def is_empty(n: ExecutionPlan) -> bool:
+        if isinstance(n, UnresolvedShuffleExec):
+            return n.stage_id in empty_ids
+        if isinstance(n, EmptyExec):
+            return not n.produce_one_row
+        return False
+
+    def walk(n: ExecutionPlan) -> ExecutionPlan:
+        kids = n.children()
+        if kids:
+            new_kids = [walk(c) for c in kids]
+            if any(a is not b for a, b in zip(new_kids, kids)):
+                n = n.with_children(new_kids)
+        if isinstance(n, HashJoinExec):
+            l_empty, r_empty = is_empty(n.left), is_empty(n.right)
+            jt = n.join_type
+            if jt == "inner" and (l_empty or r_empty):
+                return EmptyExec(n.df_schema, False)
+            if jt in ("left_semi", "right_semi") and (l_empty or r_empty):
+                return EmptyExec(n.df_schema, False)
+            if jt == "left_anti" and r_empty:
+                return n.left
+            if jt == "right_anti" and l_empty:
+                return n.right
+            if jt in ("left", "right", "full"):
+                # outer joins: an empty probe/emitting side empties the join
+                if (jt == "right" and r_empty) or (jt == "left" and l_empty):
+                    return EmptyExec(n.df_schema, False)
+        return n
+
+    return walk(plan)
+
+
+def provably_empty(plan: ExecutionPlan) -> bool:
+    """True iff the plan yields ZERO rows given its EmptyExec leaves — the
+    gate for SKIPPING a stage outright. Conservative: only operators that
+    provably preserve emptiness qualify (a group-less aggregate emits one
+    row from empty input, so it never qualifies)."""
+    from ballista_tpu.plan.physical import (
+        CoalesceBatchesExec,
+        CoalescePartitionsExec,
+        FilterExec,
+        GlobalLimitExec,
+        HashAggregateExec,
+        LocalLimitExec,
+        ProjectionExec,
+        SortExec,
+        SortPreservingMergeExec,
+        UnionExec,
+        WindowExec,
+    )
+
+    if isinstance(plan, EmptyExec):
+        return not plan.produce_one_row
+    if isinstance(plan, (FilterExec, ProjectionExec, CoalesceBatchesExec,
+                         LocalLimitExec, GlobalLimitExec, SortExec,
+                         SortPreservingMergeExec, CoalescePartitionsExec,
+                         WindowExec)):
+        return provably_empty(plan.children()[0])
+    if isinstance(plan, HashAggregateExec):
+        return bool(plan.group_exprs) and provably_empty(plan.children()[0])
+    if isinstance(plan, UnionExec):
+        return all(provably_empty(c) for c in plan.children())
+    if isinstance(plan, HashJoinExec):
+        jt = plan.join_type
+        if jt in ("inner", "left_semi", "right_semi"):
+            return provably_empty(plan.left) or provably_empty(plan.right)
+        if jt == "full":
+            return provably_empty(plan.left) and provably_empty(plan.right)
+        emit = plan.left if jt in ("left", "left_anti") else plan.right
+        return provably_empty(emit)
+    return False

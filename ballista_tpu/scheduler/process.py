@@ -65,6 +65,17 @@ class GrpcTaskLauncher(TaskLauncher):
         stub = self._stub_for(addr)
         stub.LaunchMultiTask(req, timeout=30)
 
+    def cancel_tasks(self, executor_id: str, job_id: str, items, server) -> None:
+        slot = server.executors.get(executor_id)
+        if slot is None:
+            return
+        addr = f"{slot.metadata.host}:{slot.metadata.grpc_port}"
+        req = pb.CancelTasksParams()
+        for task_id, stage_id in items:
+            req.tasks.add(task_id=task_id, job_id=job_id, stage_id=stage_id)
+        stub = self._stub_for(addr)
+        stub.CancelTasks(req, timeout=10)
+
 
 class SchedulerProcess:
     def __init__(self, bind_host: str = "0.0.0.0", port: int = 50050,

@@ -47,6 +47,13 @@ class TaskLauncher:
     def launch(self, executor_id: str, tasks: list[TaskDescription], server: "SchedulerServer") -> None:
         raise NotImplementedError
 
+    def cancel_tasks(self, executor_id: str, job_id: str,
+                     items: list[tuple[int, int]], server: "SchedulerServer") -> None:
+        """Best-effort CancelTasks push: items = [(task_id, stage_id)].
+        In-process/virtual launchers may ignore it (their tasks either
+        finish instantly or are synthetic)."""
+        return
+
 
 @dataclass
 class Event:
@@ -265,6 +272,22 @@ class SchedulerServer:
                 elif ev == "job_failed":
                     self.metrics.record_failed(g.job_id)
                     self._notify(g.job_id)
+            self._push_cancellations(g)
+
+    def _push_cancellations(self, g) -> None:
+        """Fan CancelTasks out to the executors running tasks that
+        incremental replanning (or a job cancel) obsoleted."""
+        doomed = g.drain_cancelled_tasks()
+        if not doomed:
+            return
+        by_exec: dict[str, list[tuple[int, int]]] = {}
+        for executor_id, task_id, stage_id in doomed:
+            by_exec.setdefault(executor_id, []).append((task_id, stage_id))
+        for executor_id, items in by_exec.items():
+            try:
+                self.launcher.cancel_tasks(executor_id, g.job_id, items, self)
+            except Exception as e:  # noqa: BLE001 — best-effort; expiry sweeps catch leaks
+                log.warning("CancelTasks to %s failed: %s", executor_id, e)
 
     # -- executor lifecycle -----------------------------------------------------------
 
@@ -311,6 +334,7 @@ class SchedulerServer:
             g = self.jobs.get(job_id)
         if g is not None:
             g.cancel()
+            self._push_cancellations(g)
             self.job_state.save_graph(g)  # terminal transition: checkpoint
             self.metrics.record_cancelled(job_id)
             self._notify(job_id)

@@ -88,6 +88,7 @@ class ExecutionStage:
         self.completed: dict[int, list[PartitionLocation]] = {}
         self.failure_reasons: set[str] = set()
         self.task_failures = 0
+        self.skipped = False  # completed by AQE pruning, never scheduled
 
     @property
     def is_runnable(self) -> bool:
@@ -133,6 +134,16 @@ class ExecutionGraph:
                 self.output_links[inp].append(s.stage_id)
         self._lock = threading.RLock()
         self.stage_metrics: dict[int, list] = {}
+        # (executor_id, task_id, stage_id) of tasks obsoleted by incremental
+        # replanning or job cancellation, awaiting a CancelTasks rpc
+        # (drained by the scheduler server)
+        self.cancelled_tasks: list[tuple[str, int, int]] = []
+
+    def drain_cancelled_tasks(self) -> list[tuple[str, int, int]]:
+        with self._lock:
+            out = self.cancelled_tasks
+            self.cancelled_tasks = []
+            return out
 
     # ------------------------------------------------------------------
 
@@ -229,10 +240,107 @@ class ExecutionGraph:
             self.ended_at = time.time()
             events.append("job_finished")
             return
+        self._cascade_empty_stages(stage, events)
+        if self.status is not JobState.RUNNING:
+            return
         for out_id in self.output_links.get(stage.stage_id, []):
-            consumer = self.stages[out_id]
+            consumer = self.stages.get(out_id)
+            if consumer is None:
+                continue
             self._try_broadcast_elision(consumer)
             self._try_resolve(consumer)
+
+    def _cascade_empty_stages(self, finished: ExecutionStage, events: list[str]) -> None:
+        """Incremental replanning after a stage finalizes EMPTY: collapse
+        joins in every still-unresolved stage spec, SKIP stages proven to
+        yield zero rows (they complete without scheduling a single task),
+        and CANCEL stages nothing references anymore (reference: stage
+        alteration + cancellation, state/aqe/planner.rs:349)."""
+        from ballista_tpu.config import AQE_EMPTY_PROPAGATION, PLANNER_ADAPTIVE_ENABLED
+        from ballista_tpu.scheduler.aqe.rules import (
+            propagate_empty_unresolved,
+            provably_empty,
+        )
+        from ballista_tpu.scheduler.planner import _find_input_stages
+
+        if not (bool(self.config.get(PLANNER_ADAPTIVE_ENABLED))
+                and bool(self.config.get(AQE_EMPTY_PROPAGATION))):
+            return
+        if any(l.stats.num_rows for l in finished.output_locations()):
+            return
+
+        def empty_ids() -> set[int]:
+            return {
+                sid for sid, s in self.stages.items()
+                if s.state is StageState.SUCCESSFUL
+                and not any(l.stats.num_rows for l in s.output_locations())
+            }
+
+        changed = True
+        while changed and self.status is JobState.RUNNING:
+            changed = False
+            ids = empty_ids()
+            for s in self.stages.values():
+                if s.state is not StageState.UNRESOLVED:
+                    continue
+                new_plan = propagate_empty_unresolved(s.spec.plan, ids)
+                if new_plan is s.spec.plan:
+                    continue
+                s.spec.plan = new_plan
+                s.spec.input_stage_ids = _find_input_stages(s.spec.plan)
+                changed = True
+                if s.stage_id != self.final_stage_id and provably_empty(s.spec.plan.input):
+                    log.info(
+                        "incremental AQE: stage %d proven empty after stage %d "
+                        "finished with 0 rows — skipped without scheduling",
+                        s.stage_id, finished.stage_id,
+                    )
+                    s.pending = []
+                    s.completed = {p: [] for p in range(s.effective_partitions)}
+                    s.state = StageState.SUCCESSFUL
+                    s.skipped = True
+                    events.append("stage_completed")
+                    self._on_stage_success(s, events)
+                else:
+                    # the collapse may have removed the LAST pending input
+                    # (e.g. a group-less aggregate over the emptied join
+                    # still has to run to emit its zero-count row): nothing
+                    # else will trigger resolution, so try it here
+                    self._try_resolve(s)
+        self._rebuild_output_links()
+        self._cancel_obsolete_stages(events)
+
+    def _rebuild_output_links(self) -> None:
+        self.output_links = {sid: [] for sid in self.stages}
+        for s in self.stages.values():
+            for inp in s.spec.input_stage_ids:
+                if inp in self.output_links:
+                    self.output_links[inp].append(s.stage_id)
+
+    def _cancel_obsolete_stages(self, events: list[str]) -> None:
+        """A stage no consumer references (after join collapses rewired the
+        graph) is dead weight: drop its pending work and queue its running
+        tasks for a CancelTasks rpc."""
+        referenced: set[int] = {self.final_stage_id}
+        for s in self.stages.values():
+            if s.state in (StageState.UNRESOLVED, StageState.RESOLVED, StageState.RUNNING):
+                referenced.update(s.spec.input_stage_ids)
+        for s in self.stages.values():
+            if s.stage_id in referenced or s.state is StageState.SUCCESSFUL:
+                continue
+            if not s.pending and not s.running:
+                continue
+            log.info("incremental AQE: stage %d is no longer consumed — cancelled", s.stage_id)
+            s.pending = []
+            if s.running:
+                self.cancelled_tasks.extend(
+                    (t.executor_id, t.task_id, s.stage_id) for t in s.running.values()
+                )
+                s.running.clear()
+            s.state = StageState.SUCCESSFUL
+            s.skipped = True
+            s.completed = {p: [] for p in range(s.effective_partitions)}
+            events.append("stage_cancelled")
 
     def _try_broadcast_elision(self, stage: ExecutionStage) -> None:
         """Incremental AQE replanning (AdaptivePlanner::replan_stages analog,
@@ -402,6 +510,9 @@ class ExecutionGraph:
             self.ended_at = time.time()
             out = []
             for s in self.stages.values():
+                self.cancelled_tasks.extend(
+                    (t.executor_id, t.task_id, s.stage_id) for t in s.running.values()
+                )
                 out.extend(s.running.values())
                 s.running.clear()
                 s.pending.clear()

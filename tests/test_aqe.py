@@ -231,3 +231,79 @@ def _walk_plan(node):
     yield node
     for c in node.children():
         yield from _walk_plan(c)
+
+
+def test_incremental_empty_cascade_skips_and_cancels():
+    """alter_stages analog: when the build input finishes EMPTY, the join
+    stage is proven empty and completes WITHOUT scheduling, the probe-side
+    shuffle stage is cancelled as unconsumed, and the job still finishes."""
+    import numpy as np
+
+    from ballista_tpu.client.context import SessionContext
+    from ballista_tpu.plan.physical import HashJoinExec
+    from ballista_tpu.scheduler.planner import DistributedPlanner
+    from ballista_tpu.scheduler.state.execution_graph import ExecutionGraph
+
+    from .test_distributed import _fake_success
+
+    from ballista_tpu.config import BROADCAST_JOIN_ROWS_THRESHOLD
+
+    rng = np.random.default_rng(5)
+    cfg = BallistaConfig({
+        DEFAULT_SHUFFLE_PARTITIONS: 4,
+        BROADCAST_JOIN_ROWS_THRESHOLD: 1000,  # force partitioned mode
+    })
+    ctx = SessionContext(cfg)
+    ctx.register_arrow_table("fact", pa.table({
+        "k": rng.integers(0, 10_000, 50_000), "v": rng.integers(0, 100, 50_000),
+    }), partitions=4)
+    ctx.register_arrow_table("dim", pa.table({
+        "k": np.arange(10_000), "x": rng.integers(0, 200, 10_000),
+    }), partitions=2)
+    sql = "select fact.k, sum(v) s from fact, dim where fact.k = dim.k and x = 1 group by fact.k"
+    physical = ctx.create_physical_plan(ctx.sql(sql).plan)
+    stages = DistributedPlanner("jobe").plan_query_stages(physical)
+    g = ExecutionGraph("jobe", "", "s1", stages, cfg)
+    join_stage = next(
+        s for s in stages
+        if any(isinstance(n, HashJoinExec) for n in _walk_plan(s.plan))
+    )
+    b_id, p_id = sorted(join_stage.input_stage_ids)[:2]
+
+    # pop tasks WITHOUT completing them until a probe task is in flight,
+    # so the cancellation path has a genuinely running task to revoke
+    popped = []
+    t_probe = None
+    guard = 0
+    while t_probe is None and guard < 50:
+        guard += 1
+        t = g.pop_next_task("e-probe")
+        assert t is not None
+        if t.stage_id == p_id:
+            t_probe = t
+        else:
+            popped.append(t)
+    # now finish the build stage with EMPTY output (zero locations)
+    for t in popped:
+        assert t.stage_id == b_id, t
+        g.update_task_status(t.task_id, t.stage_id, t.stage_attempt,
+                             "success", t.partitions, [])
+    assert g.stages[b_id].state.value == "successful"
+
+    # the join stage was proven empty and completed without running
+    js = g.stages[join_stage.stage_id]
+    assert js.state.value == "successful" and js.skipped
+    # the probe stage is no longer consumed: cancelled, its running task queued
+    ps = g.stages[p_id]
+    assert ps.state.value == "successful" and ps.skipped
+    doomed = g.drain_cancelled_tasks()
+    assert any(tid == t_probe.task_id for (_e, tid, _s) in doomed), doomed
+    # and the rest of the graph still completes
+    guard = 0
+    while g.status.value == "running" and guard < 1000:
+        guard += 1
+        t = g.pop_next_task("e1")
+        if t is None:
+            break
+        _fake_success(g, t)
+    assert g.status.value == "successful"
