@@ -15,7 +15,7 @@ import time
 import grpc
 import pyarrow as pa
 
-from ballista_tpu.config import BallistaConfig
+from ballista_tpu.config import CLIENT_JOB_TIMEOUT_S, BallistaConfig
 from ballista_tpu.errors import ExecutionError, GrpcError
 from ballista_tpu.proto import pb
 from ballista_tpu.scheduler.grpc_service import scheduler_stub
@@ -102,19 +102,20 @@ class RemoteSchedulerClient:
         from ballista_tpu.client.context import fetch_job_results
         from ballista_tpu.config import PUSH_STATUS
 
+        timeout = float(self.config.get(CLIENT_JOB_TIMEOUT_S))
         sql_ok = df.sql_text is not None and not df.ctx._has_memory_tables()
         if sql_ok and bool(self.config.get(PUSH_STATUS)):
-            status = self.execute_sql_push(df.sql_text)
+            status = self.execute_sql_push(df.sql_text, timeout=timeout)
         elif sql_ok:
             job_id = self.execute_sql(df.sql_text)
-            status = self.wait_for_job(job_id)
+            status = self.wait_for_job(job_id, timeout=timeout)
         else:
             # memory tables can't be re-resolved from SQL on the scheduler:
             # plan client-side, ship the physical plan (MemoryScanNode
             # carries the batches as IPC bytes)
             physical = df.ctx.create_physical_plan(df.plan)
             job_id = self.execute_physical(physical)
-            status = self.wait_for_job(job_id)
+            status = self.wait_for_job(job_id, timeout=timeout)
         if status["state"] != "successful":
             raise ExecutionError(
                 f"job {status.get('job_id', '?')} {status['state']}: {status.get('error', '')}"

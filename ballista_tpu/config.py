@@ -45,6 +45,7 @@ AQE_DYNAMIC_JOIN_SELECTION = "ballista.planner.adaptive.join.selection"
 GRPC_CLIENT_MAX_MESSAGE_SIZE = "ballista.grpc.client.max.message.size.bytes"
 GRPC_SERVER_MAX_MESSAGE_SIZE = "ballista.grpc.server.max.message.size.bytes"
 FLIGHT_PROXY = "ballista.client.flight.proxy"
+CLIENT_JOB_TIMEOUT_S = "ballista.client.job.timeout.seconds"
 PUSH_STATUS = "ballista.client.push.status"
 GRPC_TLS_CA = "ballista.grpc.tls.ca.path"
 GRPC_TLS_CERT = "ballista.grpc.tls.cert.path"
@@ -143,6 +144,7 @@ _ENTRIES: list[ConfigEntry] = [
     ConfigEntry(AQE_EMPTY_PROPAGATION, "AQE: prune stages proven empty by runtime stats.", bool, True),
     ConfigEntry(AQE_DYNAMIC_JOIN_SELECTION, "AQE: choose join strategy at runtime from actual input sizes.", bool, True),
     ConfigEntry(GRPC_CLIENT_MAX_MESSAGE_SIZE, "Client-side gRPC message ceiling.", int, 256 * 1024 * 1024, _pos),
+    ConfigEntry(CLIENT_JOB_TIMEOUT_S, "How long a client waits for a submitted job before giving up.", int, 600, _pos),
     ConfigEntry(GRPC_SERVER_MAX_MESSAGE_SIZE, "Server-side gRPC message ceiling.", int, 256 * 1024 * 1024, _pos),
     ConfigEntry(
         FLIGHT_PROXY,

@@ -372,8 +372,13 @@ def main(argv=None) -> None:
     ap.add_argument("--memory-fraction", type=float, default=0.6,
                     help="fraction of detected cgroup/host memory for the pool")
     ap.add_argument("--log-level", default="INFO")
+    ap.add_argument("--log-file", default=None, help="also log to this file (rotating)")
+    ap.add_argument("--log-rotation", choices=("never", "minutely", "hourly", "daily"),
+                    default="daily", help="rotation policy for --log-file")
     args = ap.parse_args(argv)
-    logging.basicConfig(level=args.log_level, format="%(asctime)s %(levelname)s %(name)s %(message)s")
+    from ballista_tpu.utils.log_util import init_logging
+
+    init_logging(args.log_level, args.log_file, args.log_rotation)
 
     proc = ExecutorProcess(
         args.scheduler, args.bind_host, args.external_host, args.grpc_port,

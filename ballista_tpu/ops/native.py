@@ -29,11 +29,15 @@ _SO_PATH = os.path.join(_NATIVE_DIR, "libballista_native.so")
 
 def get_lib() -> ctypes.CDLL | None:
     global _lib, _tried
+    # BALLISTA_NATIVE_LIB: explicit .so override (the sanitizer leg points
+    # this at an ASAN/TSAN build of the same source)
+    override = os.environ.get("BALLISTA_NATIVE_LIB")
+    so_path = override or _SO_PATH
     with _lock:
         if _lib is not None or _tried:
             return _lib
         _tried = True
-        if not os.path.exists(_SO_PATH):
+        if not override and not os.path.exists(so_path):
             build = os.path.join(_NATIVE_DIR, "build.sh")
             if os.path.exists(build):
                 try:
@@ -41,10 +45,12 @@ def get_lib() -> ctypes.CDLL | None:
                 except Exception as e:  # noqa: BLE001
                     log.info("native build unavailable (%s); using numpy paths", e)
                     return None
-        if not os.path.exists(_SO_PATH):
+        if not os.path.exists(so_path):
+            if override:
+                log.warning("BALLISTA_NATIVE_LIB=%s does not exist; numpy fallback", so_path)
             return None
         try:
-            lib = ctypes.CDLL(_SO_PATH)
+            lib = ctypes.CDLL(so_path)
             u64p = ctypes.POINTER(ctypes.c_uint64)
             i64p = ctypes.POINTER(ctypes.c_int64)
             u8p = ctypes.POINTER(ctypes.c_uint8)

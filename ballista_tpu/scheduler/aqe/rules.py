@@ -122,13 +122,36 @@ def apply_aqe(plan: ExecutionPlan, input_stats: dict[int, InputStageStats],
                     combined[i] += b
         groups = coalesce_groups(combined, target, min_b, factor)
         if 0 < len(groups) < k:
+            # build FRESH readers rather than mutating shared ones in place:
+            # a reader aliased by a replayed/retried resolution must never
+            # see half-regrouped location lists (the stale-alias class of
+            # bug this codebase hit once already)
+            replacements: dict[int, ShuffleReaderExec] = {}
             for r in readers:
-                r.partition_locations = [
-                    [loc for i in g for loc in r.partition_locations[i]] for g in groups
-                ]
+                nr = ShuffleReaderExec(
+                    r.df_schema,
+                    [[loc for i in g for loc in r.partition_locations[i]] for g in groups],
+                    r.broadcast,
+                )
+                nr.source_stage_id = getattr(r, "source_stage_id", None)
+                replacements[id(r)] = nr
+            plan = _replace_readers(plan, replacements)
             new_parts = len(groups)
             log.info("AQE coalesced %d reduce partitions into %d groups", k, len(groups))
     return plan, new_parts
+
+
+def _replace_readers(plan: ExecutionPlan, replacements: dict[int, ShuffleReaderExec]) -> ExecutionPlan:
+    hit = replacements.get(id(plan))
+    if hit is not None:
+        return hit
+    kids = plan.children()
+    if not kids:
+        return plan
+    new_kids = [_replace_readers(c, replacements) for c in kids]
+    if all(a is b for a, b in zip(new_kids, kids)):
+        return plan
+    return plan.with_children(new_kids)
 
 
 def _hash_readers(plan: ExecutionPlan) -> list[ShuffleReaderExec]:

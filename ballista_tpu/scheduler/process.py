@@ -197,15 +197,21 @@ def main(argv=None) -> None:
     ap.add_argument("--force-recover", action="store_true",
                     help="adopt persisted jobs even if owned by another scheduler id "
                          "(standby takeover after the owner died)")
-    ap.add_argument("--task-distribution", choices=("bias", "round-robin"), default="bias")
+    ap.add_argument("--task-distribution", choices=("bias", "round-robin", "consistent-hash"),
+                    default="bias")
     ap.add_argument("--executor-timeout-seconds", type=float, default=180.0)
     ap.add_argument("--log-level", default="INFO")
+    ap.add_argument("--log-file", default=None, help="also log to this file (rotating)")
+    ap.add_argument("--log-rotation", choices=("never", "minutely", "hourly", "daily"),
+                    default="daily", help="rotation policy for --log-file")
     args = ap.parse_args(argv)
-    logging.basicConfig(level=args.log_level, format="%(asctime)s %(levelname)s %(name)s %(message)s")
+    from ballista_tpu.utils.log_util import init_logging
+
+    init_logging(args.log_level, args.log_file, args.log_rotation)
 
     proc = SchedulerProcess(
         args.bind_host, args.port,
-        "round_robin" if args.task_distribution == "round-robin" else "bias",
+        args.task_distribution,
         args.executor_timeout_seconds, args.rest_port, args.flight_proxy_port,
         job_state_dir=args.job_state_dir, scheduler_id=args.scheduler_id,
         force_recover=args.force_recover,

@@ -79,6 +79,7 @@ class SchedulerServer:
         self.metrics = metrics or NoopMetricsCollector()
         self._events: "queue.Queue[Event]" = queue.Queue(maxsize=10_000)
         self._jobs_lock = threading.RLock()
+        self._job_rr = 0  # round-robin offer fairness across jobs
         self._running = False
         self._loop_thread: threading.Thread | None = None
         self._watchers: dict[str, list[threading.Event]] = {}
@@ -186,15 +187,32 @@ class SchedulerServer:
 
     # -- scheduling (push mode) -------------------------------------------------
 
-    def _offer_reservation(self) -> None:
-        """Bind runnable tasks to free executor slots and launch them
-        (state/mod.rs:181-221: offer → bind → launch → unbind leftovers)."""
-        if self.launcher is None:
-            return
+    def _running_jobs_rotated(self) -> list:
+        """Round-robin fairness across jobs: each offer starts at a rotating
+        position, so a long job can no longer starve later submissions
+        (the reference round-robins offers across jobs)."""
         with self._jobs_lock:
             running = [g for g in self.jobs.values() if g.status is JobState.RUNNING]
+            if len(running) > 1:
+                off = self._job_rr % len(running)
+                self._job_rr += 1
+                running = running[off:] + running[:off]
+        return running
+
+    def _offer_reservation(self) -> None:
+        """Bind runnable tasks to free executor slots and launch them
+        (state/mod.rs:181-221: offer → bind → launch → unbind leftovers).
+        Launches leave the event loop immediately: one slow executor's gRPC
+        round trip must never stall scheduling for the rest of the cluster
+        (the reference spawns launch_tasks)."""
+        if self.launcher is None:
+            return
+        running = self._running_jobs_rotated()
         demand = sum(g.available_task_count() for g in running)
         if demand == 0:
+            return
+        if self.executors.task_distribution == "consistent-hash":
+            self._offer_consistent(running)
             return
         reservations = self.executors.reserve_slots(demand)
         for executor_id, count in reservations:
@@ -211,11 +229,37 @@ class SchedulerServer:
             if unused:
                 self.executors.free_slot(executor_id, unused)
             if tasks:
-                try:
-                    self.launcher.launch(executor_id, tasks, self)
-                except Exception as e:  # noqa: BLE001
-                    log.warning("launch to %s failed: %s", executor_id, e)
-                    self.post(Event("executor_lost", executor_id))
+                self._spawn_launch(executor_id, tasks)
+
+    def _offer_consistent(self, running: list) -> None:
+        """Consistent-hash binding: each task's (job, stage, partition)
+        identity picks its executor on the ring — sticky placement."""
+        by_exec: dict[str, list[TaskDescription]] = {}
+        for g in running:
+            while True:
+                peek = g.pop_next_task("")  # bound to a concrete executor below
+                if peek is None:
+                    break
+                key = f"{peek.job_id}/{peek.stage_id}/{peek.partitions[0] if peek.partitions else 0}"
+                executor_id = self.executors.pick_consistent(key)
+                if executor_id is None:
+                    # no free slot anywhere: return the work and stop
+                    g.return_task(peek)
+                    break
+                g.reassign_running(peek.task_id, peek.stage_id, executor_id)
+                by_exec.setdefault(executor_id, []).append(peek)
+        for executor_id, tasks in by_exec.items():
+            self._spawn_launch(executor_id, tasks)
+
+    def _spawn_launch(self, executor_id: str, tasks: list[TaskDescription]) -> None:
+        def run():
+            try:
+                self.launcher.launch(executor_id, tasks, self)
+            except Exception as e:  # noqa: BLE001
+                log.warning("launch to %s failed: %s", executor_id, e)
+                self.post(Event("executor_lost", executor_id))
+
+        threading.Thread(target=run, daemon=True, name=f"launch-{executor_id}").start()
 
     # -- pull mode ---------------------------------------------------------------
 
@@ -226,19 +270,24 @@ class SchedulerServer:
         if not self.executors.heartbeat(metadata.id):
             self.executors.register(metadata)
         if results:
-            self._apply_task_updates(metadata.id, results, free_slots_managed=False)
+            # frees the ledger slots taken at handout below
+            self._apply_task_updates(metadata.id, results, free_slots_managed=True)
         out: list[TaskDescription] = []
         if can_accept:
-            with self._jobs_lock:
-                running = [g for g in self.jobs.values() if g.status is JobState.RUNNING]
+            # debit the SHARED slot ledger for pull handouts, or a mixed
+            # push+pull cluster double-books the same vcores
+            granted = self.executors.take_slots(metadata.id, free_slots)
+            running = self._running_jobs_rotated()
             for g in running:
-                while len(out) < free_slots:
+                while len(out) < granted:
                     t = g.pop_next_task(metadata.id)
                     if t is None:
                         break
                     out.append(t)
-                if len(out) >= free_slots:
+                if len(out) >= granted:
                     break
+            if granted > len(out):
+                self.executors.free_slot(metadata.id, granted - len(out))
         return out
 
     # -- status ingestion ----------------------------------------------------------
@@ -276,18 +325,23 @@ class SchedulerServer:
 
     def _push_cancellations(self, g) -> None:
         """Fan CancelTasks out to the executors running tasks that
-        incremental replanning (or a job cancel) obsoleted."""
+        incremental replanning (or a job cancel) obsoleted. Off the event
+        loop: a dead executor's rpc timeout must not stall scheduling."""
         doomed = g.drain_cancelled_tasks()
-        if not doomed:
+        if not doomed or self.launcher is None:
             return
         by_exec: dict[str, list[tuple[int, int]]] = {}
         for executor_id, task_id, stage_id in doomed:
             by_exec.setdefault(executor_id, []).append((task_id, stage_id))
-        for executor_id, items in by_exec.items():
-            try:
-                self.launcher.cancel_tasks(executor_id, g.job_id, items, self)
-            except Exception as e:  # noqa: BLE001 — best-effort; expiry sweeps catch leaks
-                log.warning("CancelTasks to %s failed: %s", executor_id, e)
+
+        def run():
+            for executor_id, items in by_exec.items():
+                try:
+                    self.launcher.cancel_tasks(executor_id, g.job_id, items, self)
+                except Exception as e:  # noqa: BLE001 — best-effort; expiry sweeps catch leaks
+                    log.warning("CancelTasks to %s failed: %s", executor_id, e)
+
+        threading.Thread(target=run, daemon=True, name="cancel-push").start()
 
     # -- executor lifecycle -----------------------------------------------------------
 

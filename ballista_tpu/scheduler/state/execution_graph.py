@@ -179,6 +179,26 @@ class ExecutionGraph:
                 return task
             return None
 
+    def return_task(self, task: TaskDescription) -> None:
+        """Un-pop a task (no executor could take it): partitions go back to
+        pending, the running entry is dropped."""
+        with self._lock:
+            stage = self.stages.get(task.stage_id)
+            if stage is None:
+                return
+            stage.running.pop(task.task_id, None)
+            stage.pending = list(task.partitions) + stage.pending
+            if not stage.running and stage.state is StageState.RUNNING:
+                stage.state = StageState.RESOLVED
+
+    def reassign_running(self, task_id: int, stage_id: int, executor_id: str) -> None:
+        """Late-bind a popped task to the executor the distribution policy
+        chose (consistent-hash binds after the pop)."""
+        with self._lock:
+            stage = self.stages.get(stage_id)
+            if stage is not None and task_id in stage.running:
+                stage.running[task_id].executor_id = executor_id
+
     # ------------------------------------------------------------------
 
     def update_task_status(self, task_id: int, stage_id: int, stage_attempt: int,
@@ -197,6 +217,11 @@ class ExecutionGraph:
                 return events
             if stage_attempt != stage.attempt:
                 return events  # stale attempt
+            if stage.state in (StageState.SUCCESSFUL, StageState.FAILED):
+                # finalized (normally, or skipped/cancelled by incremental
+                # replanning): a doomed task racing the CancelTasks rpc must
+                # not overwrite the finalized outputs or re-fire completion
+                return events
             running = stage.running.pop(task_id, None)
             if state == "success":
                 for p in partitions:

@@ -116,3 +116,63 @@ class ExecutorManager:
             e = self.executors.get(executor_id)
             if e is not None:
                 e.free_slots = min(e.total_slots, e.free_slots + n)
+
+    def take_slots(self, executor_id: str, n: int) -> int:
+        """Reserve up to n slots on ONE executor (pull-mode handout: the
+        poller's self-reported free capacity must still debit the shared
+        ledger, or a mixed push+pull cluster double-books)."""
+        with self._lock:
+            e = self.executors.get(executor_id)
+            if e is None or e.terminating:
+                return 0
+            take = max(0, min(e.free_slots, n))
+            e.free_slots -= take
+            return take
+
+    @staticmethod
+    def _ring_point(s: str) -> int:
+        import hashlib
+
+        return int.from_bytes(hashlib.blake2b(s.encode(), digest_size=8).digest(), "big")
+
+    def _ring(self) -> tuple[list[int], list[str]]:
+        """Sorted virtual-node ring, cached until executor membership
+        changes (rebuilding + rehashing per pick would be O(tasks ×
+        executors log executors) per offer)."""
+        ids = tuple(sorted(
+            e.metadata.id for e in self.executors.values() if not e.terminating
+        ))
+        cached = getattr(self, "_ring_cache", None)
+        if cached is not None and cached[0] == ids:
+            return cached[1], cached[2]
+        ring: list[tuple[int, str]] = []
+        for eid in ids:
+            for v in range(8):  # virtual nodes smooth the distribution
+                ring.append((self._ring_point(f"{eid}#{v}"), eid))
+        ring.sort()
+        points = [p for p, _ in ring]
+        owners = [e for _, e in ring]
+        self._ring_cache = (ids, points, owners)
+        return points, owners
+
+    def pick_consistent(self, key: str) -> str | None:
+        """Consistent-hash task placement (reference: TaskDistributionPolicy
+        consistent-hash, scheduler/src/config.rs:92 / cluster/mod.rs:626):
+        the key (job/stage/partition identity) maps onto a ring of virtual
+        executor nodes; the first ring node at-or-after the key's point
+        with a free slot wins, so placement is sticky across offers (cache
+        affinity) yet spills to neighbors under load."""
+        import bisect
+
+        with self._lock:
+            points, owners = self._ring()
+            if not points:
+                return None
+            i = bisect.bisect_left(points, self._ring_point(key)) % len(points)
+            for off in range(len(points)):
+                eid = owners[(i + off) % len(points)]
+                e = self.executors.get(eid)
+                if e is not None and not e.terminating and e.free_slots > 0:
+                    e.free_slots -= 1
+                    return eid
+            return None

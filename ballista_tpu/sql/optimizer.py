@@ -252,6 +252,13 @@ class Decorrelator:
             for sq in subs:
                 outer, repl = self._plan_scalar(outer, self.run(sq.plan))
                 new_conj = _replace_node(new_conj, sq, repl)
+            # IN (subquery) nested under OR/NOT (not a top-level conjunct,
+            # so the semi-join lowering can't apply): an UNCORRELATED
+            # subquery evaluates EAGERLY at planning time and inlines as a
+            # literal IN list (q45's `zip IN (...) OR item_id IN (subq)`)
+            for isq in _collect_in_subqueries(new_conj):
+                values = _eval_uncorrelated_column(self.run(isq.plan))
+                new_conj = _replace_node(new_conj, isq, InList(isq.expr, tuple(values), isq.negated))
             return outer, new_conj
         return outer, conj
 
@@ -373,6 +380,47 @@ def _collect_scalar_subqueries(e: Expr, out: list | None = None) -> list:
     for c in e.children():
         _collect_scalar_subqueries(c, out)
     return out
+
+
+def _collect_in_subqueries(e: Expr, out: list | None = None) -> list:
+    if out is None:
+        out = []
+    if isinstance(e, InSubquery):
+        out.append(e)
+    for c in e.children():
+        _collect_in_subqueries(c, out)
+    return out
+
+
+_EAGER_IN_MAX_VALUES = 10_000
+
+
+def _eval_uncorrelated_column(sub: LogicalPlan) -> list:
+    """Execute an uncorrelated subplan locally and return its first column's
+    values. A correlated subplan fails binding (its outer columns don't
+    resolve) and surfaces as a clean planning error."""
+    from ballista_tpu.engine.physical_planner import PhysicalPlanner
+    from ballista_tpu.plan.physical import TaskContext
+
+    try:
+        phys = PhysicalPlanner().plan(sub)
+        ctx = TaskContext()
+        vals: list = []
+        for p in range(phys.output_partition_count()):
+            for b in phys.execute(p, ctx):
+                vals.extend(b.column(0).to_pylist())
+                if len(vals) > _EAGER_IN_MAX_VALUES:
+                    raise PlanningError(
+                        f"IN subquery inside a disjunction yielded more than "
+                        f"{_EAGER_IN_MAX_VALUES} values; rewrite as a join"
+                    )
+        return sorted({v for v in vals if v is not None})
+    except PlanningError:
+        raise
+    except Exception as e:  # noqa: BLE001
+        raise PlanningError(
+            f"cannot evaluate IN subquery inside a disjunction (correlated?): {e}"
+        ) from None
 
 
 def _replace_node(e: Expr, target: Expr, repl: Expr) -> Expr:

@@ -181,6 +181,368 @@ def run_reference(q: int, t: dict[str, pd.DataFrame]) -> pd.DataFrame:
         allc = pd.concat(out_frames, ignore_index=True)
         g = allc.groupby("i_manufact_id", as_index=False).agg(total_sales=("total_sales", "sum"))
         return g.sort_values(["total_sales", "i_manufact_id"]).head(100).reset_index(drop=True)
+    if q == 6:
+        cu, ca = t["customer"], t["customer_address"]
+        cat_avg = it.groupby("i_category")["i_current_price"].transform("mean")
+        hot = it[it.i_current_price > 1.2 * cat_avg]
+        m = ss.merge(dd[(dd.d_year == 2001) & (dd.d_moy == 1)],
+                     left_on="ss_sold_date_sk", right_on="d_date_sk")
+        m = m.merge(hot, left_on="ss_item_sk", right_on="i_item_sk")
+        m = m.merge(cu, left_on="ss_customer_sk", right_on="c_customer_sk")
+        m = m.merge(ca, left_on="c_current_addr_sk", right_on="ca_address_sk")
+        g = m.groupby("ca_state", as_index=False).agg(cnt=("ca_state", "size"))
+        g = g[g.cnt >= 10].rename(columns={"ca_state": "state"})
+        return g.sort_values(["cnt", "state"]).head(100).reset_index(drop=True)
+    if q in (12, 20):
+        fact, pfx = (t["web_sales"], "ws") if q == 12 else (t["catalog_sales"], "cs")
+        m = fact.merge(it[it.i_category.isin(["Sports", "Books", "Home"])],
+                       left_on=f"{pfx}_item_sk", right_on="i_item_sk")
+        lo, hi = dt.date(1999, 2, 22), dt.date(1999, 3, 24)
+        m = m.merge(dd[(dd.d_date >= lo) & (dd.d_date <= hi)],
+                    left_on=f"{pfx}_sold_date_sk", right_on="d_date_sk")
+        g = m.groupby(["i_item_id", "i_item_desc", "i_category", "i_class", "i_current_price"],
+                      as_index=False).agg(itemrevenue=(f"{pfx}_ext_sales_price", "sum"))
+        g["revenueratio"] = g.itemrevenue * 100.0 / g.groupby("i_class")["itemrevenue"].transform("sum")
+        return g.sort_values(["i_category", "i_class", "i_item_id", "i_item_desc", "revenueratio"]
+                             ).head(100).reset_index(drop=True)
+    if q == 13:
+        cd, hd, ca, st = (t["customer_demographics"], t["household_demographics"],
+                          t["customer_address"], t["store"])
+        m = ss.merge(st, left_on="ss_store_sk", right_on="s_store_sk")
+        m = m.merge(dd[dd.d_year == 2001], left_on="ss_sold_date_sk", right_on="d_date_sk")
+        m = m.merge(hd, left_on="ss_hdemo_sk", right_on="hd_demo_sk")
+        m = m.merge(cd, left_on="ss_cdemo_sk", right_on="cd_demo_sk")
+        m = m.merge(ca[ca.ca_country == "United States"],
+                    left_on="ss_addr_sk", right_on="ca_address_sk")
+        c1 = ((m.cd_marital_status == "M") & (m.cd_education_status == "College")
+              & m.ss_sales_price.between(100, 150) & (m.hd_dep_count == 3))
+        c2 = ((m.cd_marital_status == "S") & (m.cd_education_status == "Primary")
+              & m.ss_sales_price.between(50, 100) & (m.hd_dep_count == 1))
+        c3 = ((m.cd_marital_status == "W") & (m.cd_education_status == "2 yr Degree")
+              & m.ss_sales_price.between(150, 200) & (m.hd_dep_count == 1))
+        g1 = (m.ca_state.isin(["TX", "OH"]) & m.ss_net_profit.between(100, 200))
+        g2 = (m.ca_state.isin(["OR", "NM", "KY"]) & m.ss_net_profit.between(150, 300))
+        g3 = (m.ca_state.isin(["VA", "TX", "MS"]) & m.ss_net_profit.between(50, 250))
+        m = m[(c1 | c2 | c3) & (g1 | g2 | g3)]
+        return pd.DataFrame({
+            "avg_q": [m.ss_quantity.mean()], "avg_esp": [m.ss_ext_sales_price.mean()],
+            "avg_ewc": [m.ss_ext_wholesale_cost.mean()],
+            "sum_ewc": [m.ss_ext_wholesale_cost.sum() if len(m) else None],
+        })
+    if q == 15:
+        cs, cu, ca = t["catalog_sales"], t["customer"], t["customer_address"]
+        m = cs.merge(cu, left_on="cs_bill_customer_sk", right_on="c_customer_sk")
+        m = m.merge(ca, left_on="c_current_addr_sk", right_on="ca_address_sk")
+        m = m.merge(dd[(dd.d_qoy == 2) & (dd.d_year == 2001)],
+                    left_on="cs_sold_date_sk", right_on="d_date_sk")
+        zips = {"85669", "86197", "88274", "83405", "86475", "85392", "85460",
+                "80348", "81792"}
+        m = m[m.ca_zip.str[:5].isin(zips) | m.ca_state.isin(["CA", "WA", "GA"])
+              | (m.cs_sales_price > 500)]
+        g = m.groupby("ca_zip", as_index=False).agg(s=("cs_sales_price", "sum"))
+        return g.sort_values("ca_zip").head(100).reset_index(drop=True)
+    if q in (25, 29):
+        sr, cs, st = t["store_returns"], t["catalog_sales"], t["store"]
+        if q == 25:
+            d1 = dd[(dd.d_moy == 4) & (dd.d_year == 2001)]
+            d2 = dd[(dd.d_moy.between(4, 10)) & (dd.d_year == 2001)]
+            d3 = d2
+        else:
+            d1 = dd[(dd.d_moy == 9) & (dd.d_year == 1999)]
+            d2 = dd[(dd.d_moy.between(9, 12)) & (dd.d_year == 1999)]
+            d3 = dd[dd.d_year.isin([1999, 2000, 2001])]
+        m = ss.merge(d1[["d_date_sk"]], left_on="ss_sold_date_sk", right_on="d_date_sk")
+        m = m.merge(it, left_on="ss_item_sk", right_on="i_item_sk")
+        m = m.merge(st, left_on="ss_store_sk", right_on="s_store_sk")
+        m = m.merge(sr, left_on=["ss_customer_sk", "ss_item_sk", "ss_ticket_number"],
+                    right_on=["sr_customer_sk", "sr_item_sk", "sr_ticket_number"])
+        m = m.merge(d2[["d_date_sk"]].rename(columns={"d_date_sk": "_d2sk"}),
+                    left_on="sr_returned_date_sk", right_on="_d2sk")
+        m = m.merge(cs, left_on=["sr_customer_sk", "sr_item_sk"],
+                    right_on=["cs_bill_customer_sk", "cs_item_sk"])
+        m = m.merge(d3[["d_date_sk"]].rename(columns={"d_date_sk": "_d3sk"}),
+                    left_on="cs_sold_date_sk", right_on="_d3sk")
+        if q == 25:
+            g = m.groupby(["i_item_id", "i_item_desc", "s_store_id", "s_store_name"],
+                          as_index=False).agg(a=("ss_net_profit", "sum"),
+                                              b=("sr_net_loss", "sum"),
+                                              c=("cs_net_profit", "sum"))
+        else:
+            g = m.groupby(["i_item_id", "i_item_desc", "s_store_id", "s_store_name"],
+                          as_index=False).agg(a=("ss_quantity", "sum"),
+                                              b=("sr_return_quantity", "sum"),
+                                              c=("cs_quantity", "sum"))
+        return g.sort_values(["i_item_id", "i_item_desc", "s_store_id", "s_store_name"]
+                             ).head(100).reset_index(drop=True)
+    if q == 26:
+        cs, cd, pr = t["catalog_sales"], t["customer_demographics"], t["promotion"]
+        m = cs.merge(dd[dd.d_year == 2000], left_on="cs_sold_date_sk", right_on="d_date_sk")
+        m = m.merge(it, left_on="cs_item_sk", right_on="i_item_sk")
+        cdf = cd[(cd.cd_gender == "M") & (cd.cd_marital_status == "S")
+                 & (cd.cd_education_status == "College")]
+        m = m.merge(cdf, left_on="cs_bill_cdemo_sk", right_on="cd_demo_sk")
+        prf = pr[(pr.p_channel_email == "N") | (pr.p_channel_event == "N")]
+        m = m.merge(prf, left_on="cs_promo_sk", right_on="p_promo_sk")
+        g = m.groupby("i_item_id", as_index=False).agg(
+            agg1=("cs_quantity", "mean"), agg2=("cs_list_price", "mean"),
+            agg3=("cs_coupon_amt", "mean"), agg4=("cs_sales_price", "mean"))
+        return g.sort_values("i_item_id").head(100).reset_index(drop=True)
+    if q in (32, 92):
+        fact, pfx, mid = ((t["catalog_sales"], "cs", 77) if q == 32
+                          else (t["web_sales"], "ws", 53))
+        lo, hi = dt.date(2000, 1, 27), dt.date(2000, 4, 26)
+        dsel = dd[(dd.d_date >= lo) & (dd.d_date <= hi)][["d_date_sk"]]
+        win = fact.merge(dsel, left_on=f"{pfx}_sold_date_sk", right_on="d_date_sk")
+        thresh = win.groupby(f"{pfx}_item_sk")[f"{pfx}_ext_discount_amt"].transform("mean") * 1.3
+        hot = win[win[f"{pfx}_ext_discount_amt"] > thresh]
+        hot = hot.merge(it[it.i_manufact_id == mid], left_on=f"{pfx}_item_sk",
+                        right_on="i_item_sk")
+        total = hot[f"{pfx}_ext_discount_amt"].sum() if len(hot) else None
+        return pd.DataFrame({"excess_discount_amount": [total]})
+    if q == 34:
+        cu, st, hd = t["customer"], t["store"], t["household_demographics"]
+        m = ss.merge(dd[(dd.d_dom.between(1, 3) | dd.d_dom.between(25, 28))
+                        & dd.d_year.isin([1999, 2000, 2001])],
+                     left_on="ss_sold_date_sk", right_on="d_date_sk")
+        m = m.merge(st[st.s_county.isin(["Williamson County", "Walker County"])],
+                    left_on="ss_store_sk", right_on="s_store_sk")
+        m = m.merge(hd[((hd.hd_buy_potential == ">10000") | (hd.hd_buy_potential == "Unknown"))
+                       & (hd.hd_vehicle_count > 0)],
+                    left_on="ss_hdemo_sk", right_on="hd_demo_sk")
+        dn = m.groupby(["ss_ticket_number", "ss_customer_sk"], as_index=False).agg(
+            cnt=("ss_ticket_number", "size"))
+        dn = dn[dn.cnt.between(5, 10)]
+        dn = dn.merge(cu, left_on="ss_customer_sk", right_on="c_customer_sk")
+        out = dn[["c_last_name", "c_first_name", "c_salutation", "c_preferred_cust_flag",
+                  "ss_ticket_number", "cnt"]]
+        return out.sort_values(
+            ["c_last_name", "c_first_name", "c_salutation", "c_preferred_cust_flag",
+             "ss_ticket_number"], ascending=[True, True, True, False, True],
+        ).reset_index(drop=True)
+    if q == 37 or q == 82:
+        inv, fact = t["inventory"], t["catalog_sales"] if q == 37 else t["store_sales"]
+        item_col = "cs_item_sk" if q == 37 else "ss_item_sk"
+        price_lo, price_hi = (10, 150) if q == 37 else (10, 150)
+        mids = [67, 96, 91, 84] if q == 37 else [43, 12, 72, 66]
+        lo, hi = ((dt.date(2000, 2, 1), dt.date(2000, 4, 1)) if q == 37
+                  else (dt.date(2002, 5, 30), dt.date(2002, 7, 30)))
+        itf = it[it.i_current_price.between(price_lo, price_hi)
+                 & it.i_manufact_id.isin(mids)]
+        m = itf.merge(inv, left_on="i_item_sk", right_on="inv_item_sk")
+        m = m.merge(dd[(dd.d_date >= lo) & (dd.d_date <= hi)][["d_date_sk"]],
+                    left_on="inv_date_sk", right_on="d_date_sk")
+        m = m[m.inv_quantity_on_hand.between(100, 500)]
+        m = m.merge(fact[[item_col]], left_on="i_item_sk", right_on=item_col)
+        g = m[["i_item_id", "i_item_desc", "i_current_price"]].drop_duplicates()
+        return g.sort_values("i_item_id").head(100).reset_index(drop=True)
+    if q == 40:
+        cs, wh = t["catalog_sales"], t["warehouse"]
+        lo, hi = dt.date(2000, 2, 10), dt.date(2000, 4, 10)
+        cut = dt.date(2000, 3, 11)
+        m = cs.merge(it[it.i_current_price.between(0.99, 110.99)],
+                     left_on="cs_item_sk", right_on="i_item_sk")
+        m = m.merge(wh, left_on="cs_warehouse_sk", right_on="w_warehouse_sk")
+        m = m.merge(dd[(dd.d_date >= lo) & (dd.d_date <= hi)],
+                    left_on="cs_sold_date_sk", right_on="d_date_sk")
+        m["sales_before"] = np.where(m.d_date < cut, m.cs_sales_price, 0.0)
+        m["sales_after"] = np.where(m.d_date >= cut, m.cs_sales_price, 0.0)
+        g = m.groupby(["w_state", "i_item_id"], as_index=False).agg(
+            sales_before=("sales_before", "sum"), sales_after=("sales_after", "sum"))
+        return g.sort_values(["w_state", "i_item_id"]).head(100).reset_index(drop=True)
+    if q == 43:
+        st = t["store"]
+        m = ss.merge(dd[dd.d_year == 2000], left_on="ss_sold_date_sk", right_on="d_date_sk")
+        m = m.merge(st[st.s_gmt_offset == -5.0], left_on="ss_store_sk", right_on="s_store_sk")
+        g = m.groupby(["s_store_name", "s_store_id"], as_index=False).apply(
+            lambda x: pd.Series({
+                d: x.loc[x.d_day_name == n, "ss_sales_price"].sum()
+                if (x.d_day_name == n).any() else np.nan
+                for d, n in zip(
+                    ["sun_sales", "mon_sales", "tue_sales", "wed_sales",
+                     "thu_sales", "fri_sales", "sat_sales"],
+                    ["Sunday", "Monday", "Tuesday", "Wednesday", "Thursday",
+                     "Friday", "Saturday"])
+            }), include_groups=False).reset_index()
+        g = g.drop(columns=[c for c in g.columns
+                            if str(c).startswith("level") or str(c) == "index"],
+                   errors="ignore")
+        return g.sort_values(["s_store_name", "s_store_id"]).head(100).reset_index(drop=True)
+    if q == 45:
+        ws, cu, ca = t["web_sales"], t["customer"], t["customer_address"]
+        m = ws.merge(cu, left_on="ws_bill_customer_sk", right_on="c_customer_sk")
+        m = m.merge(ca, left_on="c_current_addr_sk", right_on="ca_address_sk")
+        m = m.merge(it, left_on="ws_item_sk", right_on="i_item_sk")
+        m = m.merge(dd[(dd.d_qoy == 2) & (dd.d_year == 2001)][["d_date_sk"]],
+                    left_on="ws_sold_date_sk", right_on="d_date_sk")
+        zips = {"85669", "86197", "88274", "83405", "86475", "85392", "85460",
+                "80348", "81792"}
+        hot_ids = set(it[it.i_item_sk.isin([2, 3, 5, 7, 11, 13, 17, 19, 23, 29])].i_item_id)
+        m = m[m.ca_zip.str[:5].isin(zips) | m.i_item_id.isin(hot_ids)]
+        g = m.groupby(["ca_zip", "ca_city"], as_index=False).agg(s=("ws_sales_price", "sum"))
+        return g.sort_values(["ca_zip", "ca_city"]).head(100).reset_index(drop=True)
+    if q == 46:
+        cu, ca, st, hd = (t["customer"], t["customer_address"], t["store"],
+                          t["household_demographics"])
+        m = ss.merge(dd[dd.d_dow.isin([6, 0]) & dd.d_year.isin([1999, 2000, 2001])],
+                     left_on="ss_sold_date_sk", right_on="d_date_sk")
+        m = m.merge(st[st.s_city.isin(["Fairview", "Midway"])],
+                    left_on="ss_store_sk", right_on="s_store_sk")
+        m = m.merge(hd[(hd.hd_dep_count == 4) | (hd.hd_vehicle_count == 3)],
+                    left_on="ss_hdemo_sk", right_on="hd_demo_sk")
+        m = m.merge(ca, left_on="ss_addr_sk", right_on="ca_address_sk")
+        dn = m.groupby(["ss_ticket_number", "ss_customer_sk", "ss_addr_sk", "ca_city"],
+                       as_index=False).agg(amt=("ss_coupon_amt", "sum"),
+                                           profit=("ss_net_profit", "sum"))
+        dn = dn.rename(columns={"ca_city": "bought_city"})
+        dn = dn.merge(cu, left_on="ss_customer_sk", right_on="c_customer_sk")
+        dn = dn.merge(ca, left_on="c_current_addr_sk", right_on="ca_address_sk")
+        dn = dn[dn.ca_city != dn.bought_city]
+        out = dn[["c_last_name", "c_first_name", "ca_city", "bought_city",
+                  "ss_ticket_number", "amt", "profit"]]
+        return out.sort_values(["c_last_name", "c_first_name", "ca_city", "bought_city",
+                                "ss_ticket_number"]).head(100).reset_index(drop=True)
+    if q == 48:
+        cd, ca, st = t["customer_demographics"], t["customer_address"], t["store"]
+        m = ss.merge(st, left_on="ss_store_sk", right_on="s_store_sk")
+        m = m.merge(dd[dd.d_year == 2000], left_on="ss_sold_date_sk", right_on="d_date_sk")
+        m = m.merge(cd, left_on="ss_cdemo_sk", right_on="cd_demo_sk")
+        m = m.merge(ca[ca.ca_country == "United States"],
+                    left_on="ss_addr_sk", right_on="ca_address_sk")
+        c1 = ((m.cd_marital_status == "M") & (m.cd_education_status == "4 yr Degree")
+              & m.ss_sales_price.between(100, 150))
+        c2 = ((m.cd_marital_status == "D") & (m.cd_education_status == "2 yr Degree")
+              & m.ss_sales_price.between(50, 100))
+        c3 = ((m.cd_marital_status == "S") & (m.cd_education_status == "College")
+              & m.ss_sales_price.between(150, 200))
+        g1 = (m.ca_state.isin(["CO", "OH", "TX"]) & m.ss_net_profit.between(0, 2000))
+        g2 = (m.ca_state.isin(["OR", "MN", "KY"]) & m.ss_net_profit.between(150, 3000))
+        g3 = (m.ca_state.isin(["VA", "CA", "MS"]) & m.ss_net_profit.between(50, 25000))
+        m = m[(c1 | c2 | c3) & (g1 | g2 | g3)]
+        return pd.DataFrame({"sq": [m.ss_quantity.sum() if len(m) else None]})
+    if q == 50:
+        sr, st = t["store_returns"], t["store"]
+        m = ss.merge(sr, left_on=["ss_ticket_number", "ss_item_sk", "ss_customer_sk"],
+                     right_on=["sr_ticket_number", "sr_item_sk", "sr_customer_sk"])
+        m = m.merge(dd[(dd.d_year == 2001) & (dd.d_moy == 8)][["d_date_sk"]],
+                    left_on="sr_returned_date_sk", right_on="d_date_sk")
+        m = m.merge(st, left_on="ss_store_sk", right_on="s_store_sk")
+        lag = m.sr_returned_date_sk - m.ss_sold_date_sk
+        m["d30"] = (lag <= 30).astype(int)
+        m["d31_60"] = ((lag > 30) & (lag <= 60)).astype(int)
+        m["d_gt_60"] = (lag > 60).astype(int)
+        g = m.groupby(["s_store_name", "s_county"], as_index=False).agg(
+            d30=("d30", "sum"), d31_60=("d31_60", "sum"), d_gt_60=("d_gt_60", "sum"))
+        return g.sort_values(["s_store_name", "s_county"]).head(100).reset_index(drop=True)
+    if q == 61:
+        st, pr, cu, ca = t["store"], t["promotion"], t["customer"], t["customer_address"]
+        base = ss.merge(dd[(dd.d_year == 1998) & (dd.d_moy == 11)],
+                        left_on="ss_sold_date_sk", right_on="d_date_sk")
+        base = base.merge(st[st.s_gmt_offset == -5.0], left_on="ss_store_sk",
+                          right_on="s_store_sk")
+        base = base.merge(cu, left_on="ss_customer_sk", right_on="c_customer_sk")
+        base = base.merge(ca[ca.ca_gmt_offset == -5.0], left_on="c_current_addr_sk",
+                          right_on="ca_address_sk")
+        base = base.merge(it[it.i_category == "Jewelry"], left_on="ss_item_sk",
+                          right_on="i_item_sk")
+        prf = pr[(pr.p_channel_email == "Y") | (pr.p_channel_event == "Y")]
+        promo = base.merge(prf, left_on="ss_promo_sk", right_on="p_promo_sk")
+        p_sum = promo.ss_ext_sales_price.sum()
+        t_sum = base.ss_ext_sales_price.sum()
+        return pd.DataFrame({"promotions": [p_sum], "total": [t_sum],
+                             "ratio": [p_sum / t_sum * 100 if t_sum else None]})
+    if q == 65:
+        st = t["store"]
+        m = ss.merge(dd[dd.d_year == 2000][["d_date_sk"]],
+                     left_on="ss_sold_date_sk", right_on="d_date_sk")
+        sc = m.groupby(["ss_store_sk", "ss_item_sk"], as_index=False).agg(
+            revenue=("ss_sales_price", "sum"))
+        sb = sc.groupby("ss_store_sk", as_index=False).agg(ave=("revenue", "mean"))
+        j = sc.merge(sb, on="ss_store_sk")
+        j = j[j.revenue <= 0.1 * j.ave]
+        j = j.merge(st, left_on="ss_store_sk", right_on="s_store_sk")
+        j = j.merge(it, left_on="ss_item_sk", right_on="i_item_sk")
+        out = j[["s_store_name", "i_item_desc", "revenue", "i_current_price",
+                 "i_wholesale_cost", "i_brand"]]
+        return out.sort_values(["s_store_name", "i_item_desc", "revenue"]
+                               ).head(100).reset_index(drop=True)
+    if q == 79:
+        cu, st, hd = t["customer"], t["store"], t["household_demographics"]
+        m = ss.merge(dd[(dd.d_dow == 1) & dd.d_year.isin([1999, 2000, 2001])],
+                     left_on="ss_sold_date_sk", right_on="d_date_sk")
+        m = m.merge(st[st.s_number_employees.between(200, 295)],
+                    left_on="ss_store_sk", right_on="s_store_sk")
+        m = m.merge(hd[(hd.hd_dep_count == 6) | (hd.hd_vehicle_count > 2)],
+                    left_on="ss_hdemo_sk", right_on="hd_demo_sk")
+        ms = m.groupby(["ss_ticket_number", "ss_customer_sk", "ss_addr_sk", "s_city"],
+                       as_index=False).agg(amt=("ss_coupon_amt", "sum"),
+                                           profit=("ss_net_profit", "sum"))
+        ms = ms.merge(cu, left_on="ss_customer_sk", right_on="c_customer_sk")
+        ms["city30"] = ms.s_city.str[:30]
+        out = ms[["c_last_name", "c_first_name", "city30", "ss_ticket_number",
+                  "amt", "profit"]]
+        return out.sort_values(["c_last_name", "c_first_name", "city30", "profit",
+                                "ss_ticket_number"]).head(100).reset_index(drop=True)
+    if q == 88:
+        td, st, hd = t["time_dim"], t["store"], t["household_demographics"]
+        hdf = hd[((hd.hd_dep_count == 4) & (hd.hd_vehicle_count <= 6))
+                 | ((hd.hd_dep_count == 2) & (hd.hd_vehicle_count <= 4))
+                 | ((hd.hd_dep_count == 0) & (hd.hd_vehicle_count <= 2))]
+        stf = st[st.s_store_name == "store 1"]
+
+        def bucket(hour, half):
+            m = ss.merge(td[(td.t_hour == hour)
+                            & ((td.t_minute >= 30) if half else (td.t_minute < 30))],
+                         left_on="ss_sold_time_sk", right_on="t_time_sk")
+            m = m.merge(hdf, left_on="ss_hdemo_sk", right_on="hd_demo_sk")
+            m = m.merge(stf, left_on="ss_store_sk", right_on="s_store_sk")
+            return len(m)
+
+        return pd.DataFrame({
+            "h8_30_to_9": [bucket(8, True)], "h9_to_9_30": [bucket(9, False)],
+            "h9_30_to_10": [bucket(9, True)], "h10_to_10_30": [bucket(10, False)],
+        })
+    if q == 90:
+        ws, td, hd, wp = (t["web_sales"], t["time_dim"], t["household_demographics"],
+                          t["web_page"])
+        hdf = hd[hd.hd_dep_count == 6]
+        wpf = wp[wp.wp_char_count.between(5000, 5200)]
+
+        def cnt(h_lo, h_hi):
+            m = ws.merge(td[td.t_hour.between(h_lo, h_hi)],
+                         left_on="ws_sold_time_sk", right_on="t_time_sk")
+            m = m.merge(hdf, left_on="ws_ship_hdemo_sk", right_on="hd_demo_sk")
+            m = m.merge(wpf, left_on="ws_web_page_sk", right_on="wp_web_page_sk")
+            return len(m)
+
+        amc, pmc = cnt(8, 9), cnt(19, 20)
+        return pd.DataFrame({"am_pm_ratio": [amc / pmc if pmc else None]})
+    if q == 93:
+        sr, rs = t["store_returns"], t["reason"]
+        srf = sr.merge(rs[rs.r_reason_desc == "reason 28"],
+                       left_on="sr_reason_sk", right_on="r_reason_sk")
+        m = ss.merge(srf, left_on=["ss_item_sk", "ss_ticket_number"],
+                     right_on=["sr_item_sk", "sr_ticket_number"])
+        m["act_sales"] = np.where(m.sr_return_quantity.notna(),
+                                  (m.ss_quantity - m.sr_return_quantity) * m.ss_sales_price,
+                                  m.ss_quantity * m.ss_sales_price)
+        g = m.groupby("ss_customer_sk", as_index=False).agg(sumsales=("act_sales", "sum"))
+        return g.sort_values(["sumsales", "ss_customer_sk"]).head(100).reset_index(drop=True)
+    if q == 99:
+        cs, wh, sm, cc = (t["catalog_sales"], t["warehouse"], t["ship_mode"],
+                          t["call_center"])
+        m = cs.merge(dd[dd.d_year == 2001][["d_date_sk"]],
+                     left_on="cs_ship_date_sk", right_on="d_date_sk")
+        m = m.merge(wh, left_on="cs_warehouse_sk", right_on="w_warehouse_sk")
+        m = m.merge(sm, left_on="cs_ship_mode_sk", right_on="sm_ship_mode_sk")
+        m = m.merge(cc, left_on="cs_call_center_sk", right_on="cc_call_center_sk")
+        lag = m.cs_ship_date_sk - m.cs_sold_date_sk
+        m["d30"] = (lag <= 30).astype(int)
+        m["d31_60"] = ((lag > 30) & (lag <= 60)).astype(int)
+        m["d_gt_60"] = (lag > 60).astype(int)
+        m["wname"] = m.w_warehouse_name.str[:20]
+        g = m.groupby(["wname", "sm_type", "cc_name"], as_index=False).agg(
+            d30=("d30", "sum"), d31_60=("d31_60", "sum"), d_gt_60=("d_gt_60", "sum"))
+        return g.sort_values(["wname", "sm_type", "cc_name"]).head(100).reset_index(drop=True)
     raise ValueError(f"no oracle for q{q}")
 
 

@@ -58,6 +58,7 @@ def generate_tpcds(out_dir: str, scale: float = 1.0, seed: int = 17,
         "d_moy": pa.array([d.month for d in dates], pa.int64()),
         "d_dom": pa.array([d.day for d in dates], pa.int64()),
         "d_qoy": pa.array([(d.month - 1) // 3 + 1 for d in dates], pa.int64()),
+        "d_dow": pa.array([d.isoweekday() % 7 for d in dates], pa.int64()),  # 0=Sunday
         "d_day_name": pa.array([DAY_NAMES[d.isoweekday() % 7] for d in dates]),
     })
 
@@ -83,9 +84,10 @@ def generate_tpcds(out_dir: str, scale: float = 1.0, seed: int = 17,
         "i_category": pa.array([CATEGORIES[c] for c in cat_ids]),
         "i_class_id": pa.array(class_ids + 1, pa.int64()),
         "i_class": pa.array([CLASSES[c] for c in class_ids]),
-        "i_manufact_id": pa.array(rng.integers(1, 1000, n_items), pa.int64()),
+        "i_manufact_id": pa.array(rng.integers(1, 200, n_items), pa.int64()),
         "i_manager_id": pa.array(rng.integers(1, 100, n_items), pa.int64()),
         "i_current_price": pa.array(np.round(rng.uniform(0.5, 300, n_items), 2)),
+        "i_wholesale_cost": pa.array(np.round(rng.uniform(0.5, 100, n_items), 2)),
     })
 
     # ---- store -----------------------------------------------------------
@@ -94,11 +96,14 @@ def generate_tpcds(out_dir: str, scale: float = 1.0, seed: int = 17,
         "s_store_id": pa.array([f"AAAAAAAA{i:04d}BAAA" for i in range(1, n_stores + 1)]),
         "s_store_name": pa.array([f"store {i}" for i in range(1, n_stores + 1)]),
         "s_number_employees": pa.array(rng.integers(200, 300, n_stores), pa.int64()),
-        "s_city": pa.array(rng.choice(CITIES, n_stores)),
-        "s_county": pa.array(rng.choice(COUNTIES, n_stores)),
-        "s_state": pa.array(rng.choice(STATES, n_stores)),
+        # cyclic assignment: the city/county/offset values the query set
+        # filters on must exist at EVERY scale (a random draw of 6 stores
+        # can miss 'Williamson County' and silently zero out q34/q73)
+        "s_city": pa.array([CITIES[i % len(CITIES)] for i in range(n_stores)]),
+        "s_county": pa.array([COUNTIES[i % len(COUNTIES)] for i in range(n_stores)]),
+        "s_state": pa.array([STATES[i % len(STATES)] for i in range(n_stores)]),
         "s_zip": pa.array([f"{z:05d}" for z in rng.integers(10000, 99999, n_stores)]),
-        "s_gmt_offset": pa.array(rng.choice([-5.0, -6.0, -7.0, -8.0], n_stores)),
+        "s_gmt_offset": pa.array([[-5.0, -6.0, -7.0, -8.0][i % 4] for i in range(n_stores)]),
     })
 
     # ---- demographics ----------------------------------------------------
@@ -132,6 +137,9 @@ def generate_tpcds(out_dir: str, scale: float = 1.0, seed: int = 17,
         "c_customer_id": pa.array([f"AAAAAAAA{i:08d}" for i in range(1, n_customers + 1)]),
         "c_first_name": pa.array([f"First{i % 997}" for i in range(1, n_customers + 1)]),
         "c_last_name": pa.array([f"Last{i % 499}" for i in range(1, n_customers + 1)]),
+        "c_salutation": pa.array([["Mr.", "Ms.", "Dr.", "Miss", "Sir"][i % 5]
+                                  for i in range(1, n_customers + 1)]),
+        "c_preferred_cust_flag": pa.array([["Y", "N"][i % 2] for i in range(1, n_customers + 1)]),
         "c_current_addr_sk": pa.array(rng.integers(1, n_addresses + 1, n_customers), pa.int64()),
         "c_current_cdemo_sk": pa.array(rng.integers(1, n_cd + 1, n_customers), pa.int64()),
         "c_current_hdemo_sk": pa.array(rng.integers(1, n_hd + 1, n_customers), pa.int64()),
@@ -158,17 +166,28 @@ def generate_tpcds(out_dir: str, scale: float = 1.0, seed: int = 17,
     coupon = np.where(rng.random(n_sales) < 0.1, np.round(ext_sales * 0.1, 2), 0.0)
     net_paid = np.round(ext_sales - coupon, 2)
     net_profit = np.round(net_paid - ext_wholesale, 2)
+    # tickets are BASKETS: every row of a ticket shares the visit's date,
+    # time, store, customer, household and address (the ticket-grouping
+    # queries — q34/q46/q68/q73/q79 — are meaningless over per-row noise)
+    n_tickets = max(n_sales // 8, 100)
+    tid = rng.integers(1, n_tickets + 1, n_sales)
+    t_cust = rng.integers(1, n_customers + 1, n_tickets + 1)
+    t_date = rng.integers(2450815, 2450815 + days, n_tickets + 1)
+    t_time = rng.choice(secs, n_tickets + 1)
+    t_store = rng.integers(1, n_stores + 1, n_tickets + 1)
+    t_hdemo = rng.integers(1, n_hd + 1, n_tickets + 1)
+    t_addr = rng.integers(1, n_addresses + 1, n_tickets + 1)
     store_sales = pa.table({
-        "ss_sold_date_sk": pa.array(rng.integers(2450815, 2450815 + days, n_sales), pa.int64()),
-        "ss_sold_time_sk": pa.array(rng.choice(secs, n_sales), pa.int64()),
+        "ss_sold_date_sk": pa.array(t_date[tid], pa.int64()),
+        "ss_sold_time_sk": pa.array(t_time[tid], pa.int64()),
         "ss_item_sk": pa.array(rng.integers(1, n_items + 1, n_sales), pa.int64()),
-        "ss_customer_sk": pa.array(rng.integers(1, n_customers + 1, n_sales), pa.int64()),
+        "ss_customer_sk": pa.array(t_cust[tid], pa.int64()),
         "ss_cdemo_sk": pa.array(rng.integers(1, n_cd + 1, n_sales), pa.int64()),
-        "ss_hdemo_sk": pa.array(rng.integers(1, n_hd + 1, n_sales), pa.int64()),
-        "ss_addr_sk": pa.array(rng.integers(1, n_addresses + 1, n_sales), pa.int64()),
-        "ss_store_sk": pa.array(rng.integers(1, n_stores + 1, n_sales), pa.int64()),
+        "ss_hdemo_sk": pa.array(t_hdemo[tid], pa.int64()),
+        "ss_addr_sk": pa.array(t_addr[tid], pa.int64()),
+        "ss_store_sk": pa.array(t_store[tid], pa.int64()),
         "ss_promo_sk": pa.array(rng.integers(1, n_promos + 1, n_sales), pa.int64()),
-        "ss_ticket_number": pa.array(rng.integers(1, n_sales // 3 + 2, n_sales), pa.int64()),
+        "ss_ticket_number": pa.array(tid, pa.int64()),
         "ss_quantity": pa.array(qty, pa.int64()),
         "ss_wholesale_cost": pa.array(wholesale),
         "ss_list_price": pa.array(list_price),
@@ -183,25 +202,137 @@ def generate_tpcds(out_dir: str, scale: float = 1.0, seed: int = 17,
         "ss_net_profit": pa.array(net_profit),
     })
 
+    # ---- small dims (warehouse / ship_mode / call_center / web_page /
+    #      reason / income_band) — tiny static tables many queries join ----
+    n_wh = 5
+    warehouse = pa.table({
+        "w_warehouse_sk": pa.array(range(1, n_wh + 1), pa.int64()),
+        "w_warehouse_name": pa.array([f"Warehouse {i}" for i in range(1, n_wh + 1)]),
+        "w_warehouse_sq_ft": pa.array(rng.integers(50_000, 1_000_000, n_wh), pa.int64()),
+        "w_city": pa.array(rng.choice(CITIES, n_wh)),
+        "w_county": pa.array(rng.choice(COUNTIES, n_wh)),
+        "w_state": pa.array(rng.choice(STATES, n_wh)),
+    })
+    sm_types = ["EXPRESS", "OVERNIGHT", "REGULAR", "TWO DAY", "LIBRARY"]
+    ship_mode = pa.table({
+        "sm_ship_mode_sk": pa.array(range(1, 21), pa.int64()),
+        "sm_type": pa.array([sm_types[i % len(sm_types)] for i in range(20)]),
+        "sm_code": pa.array([f"code{i % 4}" for i in range(20)]),
+        "sm_carrier": pa.array([f"CARRIER{i % 6}" for i in range(20)]),
+    })
+    call_center = pa.table({
+        "cc_call_center_sk": pa.array(range(1, 5), pa.int64()),
+        "cc_name": pa.array([f"call center {i}" for i in range(1, 5)]),
+        "cc_county": pa.array(rng.choice(COUNTIES, 4)),
+        "cc_manager": pa.array([f"Manager{i}" for i in range(1, 5)]),
+    })
+    web_page = pa.table({
+        "wp_web_page_sk": pa.array(range(1, 41), pa.int64()),
+        "wp_char_count": pa.array(rng.integers(100, 8000, 40), pa.int64()),
+    })
+    reason = pa.table({
+        "r_reason_sk": pa.array(range(1, 36), pa.int64()),
+        "r_reason_desc": pa.array([f"reason {i}" for i in range(1, 36)]),
+    })
+    income_band = pa.table({
+        "ib_income_band_sk": pa.array(range(1, 21), pa.int64()),
+        "ib_lower_bound": pa.array([i * 10_000 for i in range(20)], pa.int64()),
+        "ib_upper_bound": pa.array([(i + 1) * 10_000 for i in range(20)], pa.int64()),
+    })
+
+    # ---- inventory -------------------------------------------------------
+    inv_rows = max(int(20_000 * scale), 2_000)
+    inventory = pa.table({
+        "inv_date_sk": pa.array(rng.integers(2450815, 2450815 + days, inv_rows), pa.int64()),
+        "inv_item_sk": pa.array(rng.integers(1, n_items + 1, inv_rows), pa.int64()),
+        "inv_warehouse_sk": pa.array(rng.integers(1, n_wh + 1, inv_rows), pa.int64()),
+        "inv_quantity_on_hand": pa.array(rng.integers(0, 1000, inv_rows), pa.int64()),
+    })
+
     # ---- catalog_sales / web_sales (cross-channel queries) ---------------
     def channel_fact(prefix: str, rows: int, seed_off: int) -> pa.Table:
         r = np.random.default_rng(seed + seed_off)
         cqty = r.integers(1, 101, rows)
-        cprice = np.round(r.uniform(1, 200, rows), 2)
+        cwhole = np.round(r.uniform(1, 100, rows), 2)
+        clist = np.round(cwhole * r.uniform(1.0, 2.0, rows), 2)
+        cprice = np.round(clist * r.uniform(0.3, 1.0, rows), 2)
         ext = np.round(cprice * cqty, 2)
-        return pa.table({
-            f"{prefix}_sold_date_sk": pa.array(r.integers(2450815, 2450815 + days, rows), pa.int64()),
+        ext_list = np.round(clist * cqty, 2)
+        coupon = np.where(r.random(rows) < 0.1, np.round(ext * 0.1, 2), 0.0)
+        sold = r.integers(2450815, 2450815 + days, rows)
+        cols = {
+            f"{prefix}_sold_date_sk": pa.array(sold, pa.int64()),
+            f"{prefix}_ship_date_sk": pa.array(sold + r.integers(1, 120, rows), pa.int64()),
+            f"{prefix}_sold_time_sk": pa.array(r.choice(secs, rows), pa.int64()),
             f"{prefix}_item_sk": pa.array(r.integers(1, n_items + 1, rows), pa.int64()),
             f"{prefix}_bill_customer_sk": pa.array(r.integers(1, n_customers + 1, rows), pa.int64()),
+            f"{prefix}_bill_cdemo_sk": pa.array(r.integers(1, n_cd + 1, rows), pa.int64()),
+            f"{prefix}_bill_hdemo_sk": pa.array(r.integers(1, n_hd + 1, rows), pa.int64()),
             f"{prefix}_bill_addr_sk": pa.array(r.integers(1, n_addresses + 1, rows), pa.int64()),
+            f"{prefix}_promo_sk": pa.array(r.integers(1, n_promos + 1, rows), pa.int64()),
+            f"{prefix}_order_number": pa.array(r.integers(1, rows // 2 + 2, rows), pa.int64()),
+            f"{prefix}_warehouse_sk": pa.array(r.integers(1, n_wh + 1, rows), pa.int64()),
+            f"{prefix}_ship_mode_sk": pa.array(r.integers(1, 21, rows), pa.int64()),
             f"{prefix}_quantity": pa.array(cqty, pa.int64()),
+            f"{prefix}_wholesale_cost": pa.array(cwhole),
+            f"{prefix}_list_price": pa.array(clist),
             f"{prefix}_sales_price": pa.array(cprice),
+            f"{prefix}_coupon_amt": pa.array(coupon),
             f"{prefix}_ext_sales_price": pa.array(ext),
+            f"{prefix}_ext_list_price": pa.array(ext_list),
+            f"{prefix}_ext_discount_amt": pa.array(np.round(ext_list - ext, 2)),
+            f"{prefix}_net_paid": pa.array(np.round(ext - coupon, 2)),
             f"{prefix}_net_profit": pa.array(np.round(ext * r.uniform(-0.2, 0.4, rows), 2)),
-        })
+        }
+        if prefix == "cs":
+            cols["cs_call_center_sk"] = pa.array(r.integers(1, 5, rows), pa.int64())
+        if prefix == "ws":
+            cols["ws_web_page_sk"] = pa.array(r.integers(1, 41, rows), pa.int64())
+            cols["ws_ship_hdemo_sk"] = pa.array(r.integers(1, n_hd + 1, rows), pa.int64())
+        return pa.table(cols)
 
     catalog_sales = channel_fact("cs", max(n_sales // 2, 500), 101)
     web_sales = channel_fact("ws", max(n_sales // 4, 500), 202)
+
+    # ---- returns: seeded subsets of the sales facts ----------------------
+    def returns_of(sales: pa.Table, prefix: str, src_prefix: str, frac: float,
+                   seed_off: int, extra: dict | None = None) -> pa.Table:
+        r = np.random.default_rng(seed + seed_off)
+        n = sales.num_rows
+        sel = np.sort(r.choice(n, max(int(n * frac), 50), replace=False))
+        sub = sales.take(pa.array(sel))
+        rq = np.maximum(1, (sub.column(f"{src_prefix}_quantity").to_numpy() *
+                            r.uniform(0.1, 1.0, len(sel))).astype(np.int64))
+        price = sub.column(f"{src_prefix}_sales_price").to_numpy()
+        amt = np.round(price * rq, 2)
+        sold = sub.column(f"{src_prefix}_sold_date_sk").to_numpy()
+        cols = {
+            f"{prefix}_returned_date_sk": pa.array(
+                np.minimum(sold + r.integers(1, 90, len(sel)), 2450815 + days - 1), pa.int64()),
+            f"{prefix}_item_sk": sub.column(f"{src_prefix}_item_sk"),
+            f"{prefix}_return_quantity": pa.array(rq, pa.int64()),
+            f"{prefix}_return_amt": pa.array(amt),
+            f"{prefix}_net_loss": pa.array(np.round(amt * r.uniform(0.0, 0.5, len(sel)), 2)),
+            f"{prefix}_reason_sk": pa.array(r.integers(1, 36, len(sel)), pa.int64()),
+        }
+        for name, src_col in (extra or {}).items():
+            cols[name] = sub.column(src_col)
+        return pa.table(cols)
+
+    store_returns = returns_of(store_sales, "sr", "ss", 0.10, 303, {
+        "sr_customer_sk": "ss_customer_sk", "sr_ticket_number": "ss_ticket_number",
+        "sr_store_sk": "ss_store_sk",
+    })
+    catalog_returns = returns_of(catalog_sales, "cr", "cs", 0.08, 404, {
+        "cr_order_number": "cs_order_number",
+        "cr_returning_customer_sk": "cs_bill_customer_sk",
+        "cr_call_center_sk": "cs_call_center_sk",
+    })
+    web_returns = returns_of(web_sales, "wr", "ws", 0.08, 505, {
+        "wr_order_number": "ws_order_number",
+        "wr_returning_customer_sk": "ws_bill_customer_sk",
+        "wr_web_page_sk": "ws_web_page_sk",
+    })
 
     tables = {
         "date_dim": date_dim, "time_dim": time_dim, "item": item, "store": store,
@@ -210,6 +341,10 @@ def generate_tpcds(out_dir: str, scale: float = 1.0, seed: int = 17,
         "household_demographics": household_demographics,
         "promotion": promotion, "store_sales": store_sales,
         "catalog_sales": catalog_sales, "web_sales": web_sales,
+        "store_returns": store_returns, "catalog_returns": catalog_returns,
+        "web_returns": web_returns, "inventory": inventory,
+        "warehouse": warehouse, "ship_mode": ship_mode, "call_center": call_center,
+        "web_page": web_page, "reason": reason, "income_band": income_band,
     }
     for name, tbl in tables.items():
         d = os.path.join(out_dir, name)
@@ -224,7 +359,9 @@ def generate_tpcds(out_dir: str, scale: float = 1.0, seed: int = 17,
 TPCDS_TABLES = [
     "date_dim", "time_dim", "item", "store", "customer", "customer_address",
     "customer_demographics", "household_demographics", "promotion", "store_sales",
-    "catalog_sales", "web_sales",
+    "catalog_sales", "web_sales", "store_returns", "catalog_returns",
+    "web_returns", "inventory", "warehouse", "ship_mode", "call_center",
+    "web_page", "reason", "income_band",
 ]
 
 

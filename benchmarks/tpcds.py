@@ -18,7 +18,9 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-QUERIES = [3, 7, 19, 33, 36, 42, 52, 55, 68, 73, 96, 98]
+QUERIES = [3, 6, 7, 12, 13, 15, 19, 20, 25, 26, 29, 32, 33, 34, 36, 37, 40, 42,
+           43, 45, 46, 48, 50, 52, 55, 61, 65, 68, 73, 79, 82, 88, 90, 92, 93,
+           96, 98, 99]
 
 
 def q_path(n: int) -> str:

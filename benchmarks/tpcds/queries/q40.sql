@@ -1,0 +1,14 @@
+select w_state, i_item_id,
+       sum(case when d_date < date '2000-03-11' then cs_sales_price - 0.0 else 0.0 end)
+         as sales_before,
+       sum(case when d_date >= date '2000-03-11' then cs_sales_price - 0.0 else 0.0 end)
+         as sales_after
+from catalog_sales, warehouse, item, date_dim
+where i_current_price between 0.99 and 110.99
+  and i_item_sk = cs_item_sk
+  and cs_warehouse_sk = w_warehouse_sk
+  and cs_sold_date_sk = d_date_sk
+  and d_date between date '2000-02-10' and date '2000-04-10'
+group by w_state, i_item_id
+order by w_state, i_item_id
+limit 100

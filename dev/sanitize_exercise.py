@@ -1,0 +1,121 @@
+"""Drive the SANITIZED native components through their wire contracts.
+
+Invoked by dev/sanitize_native.sh with LD_PRELOAD pointing at the
+sanitizer runtime: any ASAN/TSAN/UBSAN report aborts the process and
+fails the leg.
+
+- row router: hash parity vs the numpy hasher over random + adversarial
+  inputs (nulls, negatives, huge ints, empty strings, multi-key), routing
+  bounds over many K values.
+- Flight server: both layouts via do_get, raw-block transport, path
+  containment rejections, remove_job_data — against the sanitized binary.
+"""
+
+import ctypes
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.flight as flight
+import pyarrow.ipc as ipc
+
+MODE = os.environ.get("SAN_MODE", "asan")
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def exercise_router() -> None:
+    os.environ["BALLISTA_NATIVE_LIB"] = os.path.join(
+        ROOT, "native", "sanitize", f"libballista_native_{MODE}.so")
+    from ballista_tpu.ops import native
+    from ballista_tpu.ops.hashing import hash_arrays
+
+    rng = np.random.default_rng(11)
+    cases = [
+        [pa.array(rng.integers(-(2**62), 2**62, 10_000), pa.int64())],
+        [pa.array(rng.random(5_000))],
+        [pa.array(["x" * (i % 40) for i in range(3_000)])],
+        [pa.array([None, 1, None, 2**60, -5], pa.int64())],
+        [pa.array([True, None, False] * 100, pa.bool_())],
+        [pa.array(np.arange(1000), pa.int64()),
+         pa.array([f"k{i % 7}" for i in range(1000)])],
+        [pa.array([], pa.int64())],
+    ]
+    for arrays in cases:
+        got = native.hash_arrays_native(arrays)
+        assert got is not None, "sanitized lib not used"
+        want = hash_arrays(arrays)
+        assert (got == want).all(), "hash parity broke under sanitizer build"
+        if len(arrays[0]):
+            for k in (1, 2, 7, 64, 1024):
+                routed = native.route_native(got, k)
+                if routed is not None:
+                    pids, bounds, order = routed
+                    assert pids.max() < k and pids.min() >= 0
+                    assert bounds[-1] == len(arrays[0])
+    print("row router: ok")
+
+
+def exercise_flight() -> None:
+    import tempfile
+
+    work = tempfile.mkdtemp(prefix="san-flight-")
+    batch = pa.record_batch({"x": pa.array(np.arange(1000), pa.int64())})
+    d = os.path.join(work, "j1", "1", "0")
+    os.makedirs(d)
+    data = os.path.join(d, "data-t0.arrow")
+    with open(data, "wb") as f:
+        with ipc.new_stream(f, batch.schema) as w:
+            w.write_batch(batch)
+
+    bin_path = os.path.join(ROOT, "native", "sanitize", f"ballista-flight-server-{MODE}")
+    env = dict(os.environ)
+    env.pop("LD_PRELOAD", None)  # the server's sanitizer runtime is linked in
+    proc = subprocess.Popen(
+        [bin_path, "--host", "127.0.0.1", "--port", "0", "--work-dir", work],
+        stdout=subprocess.PIPE, text=True, env=env,
+    )
+    try:
+        line = proc.stdout.readline().strip()
+        assert line.startswith("PORT "), line
+        port = int(line.split()[1])
+        c = flight.FlightClient(f"grpc://127.0.0.1:{port}")
+        t = flight.Ticket(json.dumps({"path": data, "layout": "hash", "output_partition": 0}).encode())
+        got = sum(ch.data.num_rows for ch in c.do_get(t))
+        assert got == 1000
+        a = flight.Action("io_block_transport", json.dumps(
+            {"path": data, "layout": "hash", "output_partition": 0}).encode())
+        blob = b"".join(r.body.to_pybytes() for r in c.do_action(a))
+        assert sum(b.num_rows for b in ipc.open_stream(pa.BufferReader(blob))) == 1000
+        # containment rejection
+        bad = flight.Ticket(json.dumps({"path": "/etc/hostname", "layout": "hash",
+                                        "output_partition": 0}).encode())
+        try:
+            list(c.do_get(bad))
+            raise AssertionError("containment did not reject")
+        except flight.FlightError:
+            pass
+        except pa.ArrowInvalid:
+            pass
+        list(c.do_action(flight.Action("remove_job_data", json.dumps({"job_id": "j1"}).encode())))
+        assert not os.path.exists(os.path.join(work, "j1"))
+        c.close()
+    finally:
+        proc.terminate()
+        rc = proc.wait(timeout=15)
+    # a sanitizer report makes the server exit non-zero (or abort)
+    assert rc in (0, -15), f"sanitized flight server exited {rc} (sanitizer report?)"
+    # TSAN exits with TSAN_OPTIONS exitcode=66 on an unsuppressed report
+    print("flight server: ok")
+
+
+if __name__ == "__main__":
+    leg = os.environ.get("SAN_LEG", "all")
+    if leg in ("router", "all"):
+        exercise_router()
+    if leg in ("flight", "all"):
+        exercise_flight()
+    print(f"sanitize exercise ({MODE}/{leg}): PASSED")

@@ -1,0 +1,58 @@
+#!/bin/sh
+# Sanitizer leg for native/ (SURVEY §5: C++ in the data plane makes
+# TSAN/ASAN necessary, not optional).
+#
+#   dev/sanitize_native.sh asan     # address+UB sanitizer (default)
+#   dev/sanitize_native.sh tsan     # thread sanitizer
+#
+# Builds sanitized variants of the row router and the Flight shuffle
+# server into native/sanitize/, then drives them through the SAME python
+# wire-contract exercises the unit tests use: hash/route parity over
+# random and adversarial inputs, and a client session against the
+# sanitized Flight server (do_get both layouts, raw-block transport,
+# containment rejections, job GC). A sanitizer report fails the script.
+set -e
+MODE="${1:-asan}"
+case "$MODE" in
+  asan) FLAGS="-fsanitize=address,undefined -fno-omit-frame-pointer -g -O1" ;;
+  tsan) FLAGS="-fsanitize=thread -fno-omit-frame-pointer -g -O1" ;;
+  *) echo "usage: $0 [asan|tsan]" >&2; exit 2 ;;
+esac
+
+cd "$(dirname "$0")/../native"
+OUT="sanitize"
+mkdir -p "$OUT"
+
+g++ $FLAGS -shared -fPIC -o "$OUT/libballista_native_$MODE.so" row_router.cpp
+echo "built $OUT/libballista_native_$MODE.so"
+
+PYA="$(python -c 'import os, pyarrow; print(os.path.dirname(pyarrow.__file__))')"
+AR_SO="$(ls "$PYA"/libarrow.so.* 2>/dev/null | head -1)"
+FL_SO="$(ls "$PYA"/libarrow_flight.so.* 2>/dev/null | head -1)"
+g++ -std=c++20 $FLAGS -I"$PYA/include" flight_shuffle.cpp \
+    -o "$OUT/ballista-flight-server-$MODE" \
+    -L"$PYA" -l:"$(basename "$AR_SO")" -l:"$(basename "$FL_SO")" \
+    -Wl,-rpath,"$PYA"
+echo "built $OUT/ballista-flight-server-$MODE"
+
+cd ..
+if [ "$MODE" = "asan" ]; then
+  # ASAN inside a sanitized .so loaded by an unsanitized python needs the
+  # runtime preloaded into the python process for the ROUTER leg.
+  RT="$(g++ -print-file-name=libasan.so)"
+  env SAN_MODE="$MODE" SAN_LEG=router PYTHONPATH="$(pwd)" \
+      LD_PRELOAD="$RT" ASAN_OPTIONS="detect_leaks=0" \
+      JAX_PLATFORMS=cpu python dev/sanitize_exercise.py
+else
+  echo "(tsan: router leg skipped — TSAN needs a whole-program build, and" \
+       "preloading libtsan into CPython deadlocks; the multithreaded risk" \
+       "surface is the Flight server, checked below in its own process)"
+fi
+# Flight server leg: the SERVER process is the sanitized one (its runtime
+# links in at compile time); the python client stays unsanitized. TSAN
+# needs suppressions for the unsanitized arrow/grpc libs (their internal
+# synchronization is invisible to the tool).
+TSAN_OPTIONS="suppressions=$(pwd)/dev/tsan_suppressions.txt exitcode=66 halt_on_error=0" \
+    env SAN_MODE="$MODE" SAN_LEG=flight PYTHONPATH="$(pwd)" \
+    JAX_PLATFORMS=cpu python dev/sanitize_exercise.py
+echo "sanitizer leg ($MODE) PASSED"

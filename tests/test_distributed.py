@@ -362,3 +362,33 @@ def test_spill_consolidation_streams_bounded_memory(tmp_path):
     # old behavior rebuffered ~a whole bucket (¼ of the data); streaming
     # holds at most a few decoded batches at once
     assert max(peaks) < spilled_volume / 8, (max(peaks), spilled_volume)
+
+
+def test_consistent_hash_distribution_sticky():
+    """task-distribution=consistent-hash: the same (job, stage, partition)
+    identity lands on the same executor across offers, spilling to ring
+    neighbors only when the preferred node is full."""
+    from ballista_tpu.scheduler.state.executor_manager import ExecutorManager
+    from ballista_tpu.executor.executor import ExecutorMetadata
+    from ballista_tpu.version import WIRE_PROTOCOL_VERSION
+
+    m = ExecutorManager("consistent-hash")
+    for i in range(4):
+        m.register(ExecutorMetadata(id=f"e{i}", host=f"h{i}", vcores=4,
+                                    wire_version=WIRE_PROTOCOL_VERSION))
+    keys = [f"job-a/2/{p}" for p in range(16)]
+    first = {k: m.pick_consistent(k) for k in keys}
+    assert len(set(first.values())) > 1, "ring degenerated to one executor"
+    # free everything and re-pick: placement must be identical (sticky)
+    for k, e in first.items():
+        m.free_slot(e, 1)
+    second = {k: m.pick_consistent(k) for k in keys}
+    assert first == second
+    # saturate one executor's slots: its keys spill to a neighbor
+    for k, e in second.items():
+        m.free_slot(e, 1)
+    target = first[keys[0]]
+    taken = m.take_slots(target, 4)
+    assert taken == 4
+    spilled = m.pick_consistent(keys[0])
+    assert spilled is not None and spilled != target

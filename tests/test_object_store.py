@@ -1,0 +1,66 @@
+"""S3 object-store integration (reference: examples/tests/object_store.rs
+with testcontainers + MinIO — replaced by an in-process S3 protocol shim,
+since this environment has no containers or network egress). Exercises the
+REAL pyarrow S3FileSystem client end-to-end: registration discovery
+(ListObjectsV2), schema reads, and ranged GETs during scans."""
+
+import os
+
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+
+@pytest.fixture()
+def s3_env(tmp_path, monkeypatch):
+    from ballista_tpu.testing.mini_s3 import start_mini_s3
+
+    bucket = tmp_path / "test-bucket" / "sales"
+    bucket.mkdir(parents=True)
+    tbl = pa.table({
+        "id": pa.array(range(1000), pa.int64()),
+        "region": pa.array([f"r{i % 4}" for i in range(1000)]),
+        "amount": pa.array([round(0.25 * (i % 97), 2) for i in range(1000)]),
+    })
+    pq.write_table(tbl.slice(0, 500), bucket / "part-0.parquet")
+    pq.write_table(tbl.slice(500), bucket / "part-1.parquet")
+    srv, endpoint = start_mini_s3(str(tmp_path))
+    monkeypatch.setenv("AWS_ENDPOINT_URL", endpoint)
+    monkeypatch.setenv("AWS_ACCESS_KEY_ID", "test")
+    monkeypatch.setenv("AWS_SECRET_ACCESS_KEY", "test")
+    monkeypatch.setenv("AWS_ALLOW_HTTP", "true")
+    monkeypatch.setenv("AWS_REGION", "us-east-1")
+    yield "s3://test-bucket/sales", tbl
+    srv.shutdown()
+
+
+def test_s3_scan_end_to_end(s3_env):
+    from ballista_tpu.client.context import SessionContext
+
+    uri, tbl = s3_env
+    ctx = SessionContext()
+    ctx.register_parquet("sales", uri)
+    out = ctx.sql(
+        "SELECT region, count(*) AS c, sum(amount) AS s FROM sales "
+        "GROUP BY region ORDER BY region"
+    ).collect().to_pandas()
+    assert out.region.tolist() == ["r0", "r1", "r2", "r3"]
+    assert int(out.c.sum()) == 1000
+    df = tbl.to_pandas().groupby("region")["amount"].sum()
+    import numpy as np
+
+    assert np.allclose(out.s.values, df.sort_index().values, atol=1e-9)
+
+
+def test_s3_scan_distributed_standalone(s3_env):
+    from ballista_tpu.client.context import SessionContext
+    from ballista_tpu.config import BallistaConfig
+
+    uri, _ = s3_env
+    ctx = SessionContext.standalone(BallistaConfig(), num_executors=1, vcores=2)
+    try:
+        ctx.register_parquet("sales", uri)
+        out = ctx.sql("SELECT count(*) AS c FROM sales WHERE id < 250").collect()
+        assert out.column("c").to_pylist() == [250]
+    finally:
+        ctx.shutdown()

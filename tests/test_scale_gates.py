@@ -1,0 +1,102 @@
+"""Reproducible scale gates (reference: .github/workflows/tpch.yml — the
+SF10 distributed correctness matrix, scaled to what one host runs on
+demand). Excluded from the default run by pytest.ini; invoke explicitly:
+
+    python -m pytest -m sf1    # all 22 queries, 2 daemons, remote reads
+    python -m pytest -m sf10   # SF10-shaped single-query leg
+
+Data generates once into /tmp and is reused across invocations."""
+
+import os
+import time
+
+import pytest
+
+from .conftest import tpch_query
+
+
+def _dataset(scale: float, tag: str) -> str:
+    from ballista_tpu.testing.tpchgen import generate_tpch
+
+    d = os.environ.get("TPCH_DATA", f"/tmp/ballista_tpch_gate_{tag}")
+    if not os.path.isdir(os.path.join(d, "lineitem")):
+        generate_tpch(d, scale=scale, seed=1, files_per_table=8)
+    return d
+
+
+@pytest.fixture(scope="module")
+def sf1_cluster():
+    from ballista_tpu.executor.executor_process import ExecutorProcess
+    from ballista_tpu.scheduler.process import SchedulerProcess
+
+    sched = SchedulerProcess(bind_host="127.0.0.1", port=0, rest_port=-1)
+    sched.start()
+    addr = f"127.0.0.1:{sched.port}"
+    ex1 = ExecutorProcess(addr, bind_host="127.0.0.1", external_host="127.0.0.1", vcores=4)
+    ex2 = ExecutorProcess(addr, bind_host="127.0.0.1", external_host="127.0.0.1",
+                          vcores=4, policy="pull")
+    ex1.start()
+    ex2.start()
+    time.sleep(0.3)
+    yield addr
+    ex1.shutdown()
+    ex2.shutdown()
+    sched.shutdown()
+
+
+@pytest.mark.sf1
+@pytest.mark.parametrize("q", range(1, 23))
+def test_sf1_all22_distributed(q, sf1_cluster):
+    """22/22 over a REAL 2-daemon cluster with forced remote Flight reads,
+    each query oracle-checked against pandas at SF1."""
+    from ballista_tpu.client.context import SessionContext
+    from ballista_tpu.config import SHUFFLE_READER_FORCE_REMOTE, BallistaConfig
+    from ballista_tpu.testing.reference import compare_results, load_tables, run_reference
+    from ballista_tpu.testing.tpchgen import register_tpch
+
+    data = _dataset(1.0, "sf1")
+    global _SF1_REF
+    if "_SF1_REF" not in globals() or _SF1_REF is None:
+        _SF1_REF = load_tables(data)
+    from ballista_tpu.config import CLIENT_JOB_TIMEOUT_S
+
+    cfg = BallistaConfig({SHUFFLE_READER_FORCE_REMOTE: True,
+                          CLIENT_JOB_TIMEOUT_S: 2400})
+    ctx = SessionContext.remote(sf1_cluster, cfg)
+    register_tpch(ctx, data)
+    eng = ctx.sql(tpch_query(q)).collect()
+    problems = compare_results(eng, run_reference(q, _SF1_REF), q)
+    assert not problems, "\n".join(problems)
+
+
+_SF1_REF = None
+
+
+@pytest.mark.sf10
+@pytest.mark.parametrize("q", [1, 6])
+def test_sf10_single_query(q):
+    """SF10-shaped leg: a standalone cluster must agree with the local CPU
+    engine at a scale where shuffles and memory pressure are real."""
+    from ballista_tpu.client.context import SessionContext
+    from ballista_tpu.config import BallistaConfig
+    from ballista_tpu.testing.tpchgen import register_tpch
+
+    data = _dataset(10.0, "sf10")
+    local = SessionContext(BallistaConfig())
+    register_tpch(local, data)
+    want = local.sql(tpch_query(q)).collect().to_pandas()
+
+    ctx = SessionContext.standalone(BallistaConfig(), num_executors=2, vcores=4)
+    register_tpch(ctx, data)
+    try:
+        got = ctx.sql(tpch_query(q)).collect().to_pandas()
+    finally:
+        ctx.shutdown()
+    assert len(got) == len(want)
+    import numpy as np
+
+    for c in want.columns:
+        if want[c].dtype.kind == "f":
+            assert np.allclose(got[c].values, want[c].values, rtol=1e-9), c
+        else:
+            assert (got[c].values == want[c].values).all(), c

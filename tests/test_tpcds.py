@@ -27,7 +27,7 @@ def _query(n: int) -> str:
     return open(os.path.join(root, "benchmarks", "tpcds", "queries", f"q{n}.sql")).read()
 
 
-@pytest.mark.parametrize("q", [3, 7, 19, 33, 36, 42, 52, 55, 68, 73, 96, 98])
+@pytest.mark.parametrize("q", [3, 6, 7, 12, 13, 15, 19, 20, 25, 26, 29, 32, 33, 34, 36, 37, 40, 42, 43, 45, 46, 48, 50, 52, 55, 61, 65, 68, 73, 79, 82, 88, 90, 92, 93, 96, 98, 99])
 def test_tpcds_local(q, tpcds_dir, tpcds_ref):
     from ballista_tpu.client.context import SessionContext
     from ballista_tpu.testing.tpcds_reference import compare_results, run_reference
@@ -40,7 +40,7 @@ def test_tpcds_local(q, tpcds_dir, tpcds_ref):
     assert not problems, "\n".join(problems)
 
 
-@pytest.mark.parametrize("q", [3, 68, 98])
+@pytest.mark.parametrize("q", [3, 25, 68, 93, 98, 99])
 def test_tpcds_distributed_standalone(q, tpcds_dir, tpcds_ref):
     """Representative queries through the full distributed path (q98
     exercises a window over aggregate output across a shuffle)."""
