@@ -60,11 +60,103 @@ def _common_type(a: pa.DataType, b: pa.DataType) -> pa.DataType:
     return a
 
 
+def _key_np(arr: pa.Array, target: pa.DataType):
+    """(numpy values, null mask|None) for a join key column; values are
+    comparable within one column's space (null slots hold fills)."""
+    if isinstance(arr, pa.ChunkedArray):
+        arr = arr.combine_chunks()
+    if arr.type != target:
+        arr = arr.cast(target, safe=False)
+    null = np.asarray(pc.is_null(arr)) if arr.null_count else None
+    t = arr.type
+    if pa.types.is_integer(t) or pa.types.is_date(t) or pa.types.is_boolean(t):
+        filled = pc.fill_null(arr, False if pa.types.is_boolean(t) else 0) if arr.null_count else arr
+        return filled.cast(pa.int64(), safe=False).to_numpy(zero_copy_only=False), null
+    if pa.types.is_floating(t):
+        filled = pc.fill_null(arr, 0.0) if arr.null_count else arr
+        return filled.cast(pa.float64()).to_numpy(zero_copy_only=False), null
+    # strings / binary: object arrays (python compare); null slots fill ""
+    filled = pc.fill_null(arr, "") if arr.null_count else arr
+    return filled.to_numpy(zero_copy_only=False), null
+
+
+class PreparedBuild:
+    """Build side encoded + sorted ONCE, probed many times.
+
+    The join executes per probe batch; re-encoding a multi-million-row
+    build side (or rebuilding a hash set of it, as pyarrow's index_in does
+    per call) for every batch dominated q21's runtime. Preparation sorts
+    each key column's distinct values once; probe batches map in with a
+    pure-numpy binary search — absent values get no code and never match."""
+
+    def __init__(self, build_cols: list[pa.Array]):
+        self.n = len(build_cols[0]) if build_cols else 0
+        self.sorted_vals: list[np.ndarray] = []
+        self.types: list[pa.DataType] = []
+        self.cards: list[int] = []
+        b_ids = np.zeros(self.n, dtype=np.int64)
+        b_null = np.zeros(self.n, dtype=bool)
+        for bcol in build_cols:
+            t = bcol.type if not isinstance(bcol, pa.ChunkedArray) else bcol.combine_chunks().type
+            vals, null = _key_np(bcol, t)
+            self.types.append(t)
+            uniq = np.unique(vals)
+            codes = np.searchsorted(uniq, vals)
+            card = len(uniq) + 1
+            self.sorted_vals.append(uniq)
+            self.cards.append(card)
+            if null is not None:
+                b_null |= null
+            b_ids = b_ids * card + (codes + 1)
+        b_ids[b_null] = -1
+        order = np.argsort(b_ids, kind="stable")
+        sorted_ids = b_ids[order]
+        start_valid = np.searchsorted(sorted_ids, 0, side="left")  # ids >= 0
+        self.sorted_valid = sorted_ids[start_valid:]
+        self.order_valid = order[start_valid:]
+
+    def probe_ids(self, probe_cols: list[pa.Array]) -> np.ndarray:
+        p_ids = np.zeros(len(probe_cols[0]), dtype=np.int64)
+        p_null = np.zeros(len(probe_cols[0]), dtype=bool)
+        for pcol, uniq, card, t in zip(probe_cols, self.sorted_vals, self.cards, self.types):
+            vals, null = _key_np(pcol, t)
+            pos = np.searchsorted(uniq, vals)
+            posc = np.clip(pos, 0, max(len(uniq) - 1, 0))
+            if len(uniq):
+                present = uniq[posc] == vals
+            else:
+                present = np.zeros(len(vals), dtype=bool)
+            codes = np.where(present, posc, -1)
+            if null is not None:
+                codes = np.where(null, -1, codes)
+            p_null |= codes < 0  # input NULL or value absent from the build
+            p_ids = p_ids * card + (codes + 1)
+        p_ids[p_null] = -2
+        return p_ids
+
+    def match(self, probe_cols: list[pa.Array]):
+        """All matching (build_idx, probe_idx) pairs for one probe batch."""
+        p_ids = self.probe_ids(probe_cols)
+        lo = np.searchsorted(self.sorted_valid, p_ids, side="left")
+        hi = np.searchsorted(self.sorted_valid, p_ids, side="right")
+        counts = hi - lo
+        total = int(counts.sum())
+        if total == 0:
+            return np.zeros(0, dtype=np.int64), np.zeros(0, dtype=np.int64)
+        probe_idx = np.repeat(np.arange(len(p_ids), dtype=np.int64), counts)
+        # expand [lo, hi) ranges: standard cumsum trick
+        offs = np.concatenate(([0], np.cumsum(counts)[:-1]))
+        flat = np.arange(total, dtype=np.int64) - np.repeat(offs, counts) + np.repeat(lo, counts)
+        build_idx = self.order_valid[flat]
+        return build_idx, probe_idx
+
+
 def match_pairs(build_cols: list[pa.Array], probe_cols: list[pa.Array]):
     """All matching (build_idx, probe_idx) pairs.
 
     Returns (build_idx int64[M], probe_idx int64[M]); NULL keys never match.
-    """
+    One-shot form; executors that probe many batches against one build use
+    PreparedBuild directly."""
     b_ids, p_ids = _combined_ids(build_cols, probe_cols)
     order = np.argsort(b_ids, kind="stable")
     sorted_ids = b_ids[order]

@@ -621,6 +621,32 @@ class HashJoinExec(ExecutionPlan):
             build_batch = build.combine_chunks().to_batches()[0]
         build_key_arrays = [evaluate_to_array(k, build_batch) for k in lkeys]
 
+        # prepare the build ONCE per execution: dictionary-encode + sort the
+        # build keys a single time, then map every probe batch into that id
+        # space (re-encoding a large build per batch dominated join time).
+        # Both sides cast to a common key type first so the shared id space
+        # is lossless.
+        from ballista_tpu.ops.cpu.join_kernel import PreparedBuild, _common_type
+
+        key_types: list = []
+        if build.num_rows:
+            probe_schema = self.right.schema()
+            prep_cols = []
+            for k_expr, arr in zip(rkeys, build_key_arrays):
+                a = arr.combine_chunks() if isinstance(arr, pa.ChunkedArray) else arr
+                try:
+                    p_type = evaluate_to_array(
+                        k_expr, _empty_batch(self.right.df_schema)
+                    ).type
+                except Exception:  # noqa: BLE001 — fall back to the build type
+                    p_type = a.type
+                common = _common_type(a.type, p_type)
+                key_types.append(common)
+                prep_cols.append(a.cast(common) if a.type != common else a)
+            prepared = PreparedBuild(prep_cols)
+        else:
+            prepared = None
+
         jt = self.join_type
         build_emitting = jt in ("left", "full", "left_semi", "left_anti")
         shared = self.mode == "collect_left" and build_emitting and self.right.output_partition_count() > 1
@@ -637,8 +663,16 @@ class HashJoinExec(ExecutionPlan):
             if probe.num_rows == 0:
                 continue
             probe_keys = [evaluate_to_array(k, probe) for k in rkeys]
-            if build.num_rows:
-                bi, pi = match_pairs(build_key_arrays, probe_keys)
+            if prepared is not None:
+                cast_keys = [
+                    (a.combine_chunks() if isinstance(a, pa.ChunkedArray) else a)
+                    for a in probe_keys
+                ]
+                cast_keys = [
+                    a.cast(ty) if a.type != ty else a
+                    for a, ty in zip(cast_keys, key_types)
+                ]
+                bi, pi = prepared.match(cast_keys)
             else:
                 bi = pi = np.zeros(0, dtype=np.int64)
             if filt is not None and len(bi):
