@@ -92,6 +92,9 @@ class Executor:
         self.tasks_run = 0
         self.tasks_failed = 0
         self.memory_limit_per_task = 0  # bytes; set by the executor process
+        # session-shared pools (runtime_cache.rs:59): set by the executor
+        # process once the executor-wide capacity is known
+        self.session_pools = None  # SessionPoolRegistry | None
 
     # ------------------------------------------------------------------
 
@@ -135,6 +138,10 @@ class Executor:
                 if self._is_cancelled(task.job_id, task.stage_id):
                     raise Cancelled(f"task {task.task_id} cancelled")
                 ctx = TaskContext(cfg, task_id=f"{task.task_id}", work_dir=self.work_dir)
+                if self.session_pools is not None:
+                    # concurrent tasks of one session share the pool: idle
+                    # tasks lend spill budget to a heavy sort (try_grow)
+                    ctx.memory_pool = self.session_pools.get(task.session_id)
                 for meta_batch in prepared.execute(p, ctx):
                     locations.extend(
                         metadata_to_locations(

@@ -194,10 +194,16 @@ class ExecutorProcess:
             id=str(new_executor_id()), host=host, flight_port=bound_flight, vcores=vcores
         )
         self.executor = Executor(self.work_dir, self.metadata, config=config)
-        # concurrent tasks share the pool: per-task spill budget
+        # per-task static floor (backstop when no session pool is present)
         self.executor.memory_limit_per_task = max(
             64 * 1024 * 1024, self.memory_pool_bytes // max(1, vcores)
         )
+        # session-shared pool with try_grow semantics: concurrent tasks of a
+        # session draw from ONE executor-sized budget, so idle tasks lend
+        # headroom to a heavy sort (runtime_cache.rs:59)
+        from ballista_tpu.executor.memory_pool import SessionPoolRegistry
+
+        self.executor.session_pools = SessionPoolRegistry(self.memory_pool_bytes)
 
         from ballista_tpu.utils.grpc_util import create_channel
 

@@ -1,0 +1,58 @@
+"""Session-shared memory pool with try_grow semantics.
+
+Rebuild of the reference's per-session RuntimeEnv memory pool
+(executor/src/runtime_cache.rs:59): ONE pool per session id, shared by
+every concurrent task of that session on this executor — so N small tasks
+lend unused budget to one big sort instead of each task being statically
+boxed to capacity/vcores. Consumers call try_grow before buffering and
+shrink when they spill or finish; a refusal means "spill first".
+"""
+
+from __future__ import annotations
+
+import threading
+
+
+class MemoryPool:
+    def __init__(self, capacity: int):
+        self.capacity = capacity
+        self.reserved = 0
+        self._lock = threading.Lock()
+
+    def try_grow(self, nbytes: int) -> bool:
+        with self._lock:
+            if self.reserved + nbytes > self.capacity:
+                return False
+            self.reserved += nbytes
+            return True
+
+    def grow(self, nbytes: int) -> None:
+        """Unchecked growth — the liveness escape hatch after a consumer has
+        spilled everything it can and still needs one batch of headroom."""
+        with self._lock:
+            self.reserved += nbytes
+
+    def shrink(self, nbytes: int) -> None:
+        with self._lock:
+            self.reserved = max(0, self.reserved - nbytes)
+
+
+class SessionPoolRegistry:
+    """session id → shared MemoryPool (created on first use)."""
+
+    def __init__(self, capacity_per_session: int):
+        self.capacity = capacity_per_session
+        self._pools: dict[str, MemoryPool] = {}
+        self._lock = threading.Lock()
+
+    def get(self, session_id: str) -> MemoryPool:
+        with self._lock:
+            p = self._pools.get(session_id)
+            if p is None:
+                p = MemoryPool(self.capacity)
+                self._pools[session_id] = p
+            return p
+
+    def remove(self, session_id: str) -> None:
+        with self._lock:
+            self._pools.pop(session_id, None)

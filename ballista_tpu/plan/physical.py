@@ -53,6 +53,9 @@ class TaskContext:
         self.task_id = task_id
         self.work_dir = work_dir
         self.batch_size = int(self.config.get(BATCH_SIZE))
+        # session-shared MemoryPool (try_grow semantics) when running under
+        # an executor; None = static per-task limits only
+        self.memory_pool = None
 
 
 class ExecutionPlan:

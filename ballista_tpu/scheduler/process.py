@@ -76,6 +76,14 @@ class GrpcTaskLauncher(TaskLauncher):
         stub = self._stub_for(addr)
         stub.CancelTasks(req, timeout=10)
 
+    def remove_job_data(self, executor_id: str, job_id: str, server) -> None:
+        slot = server.executors.get(executor_id)
+        if slot is None:
+            return
+        addr = f"{slot.metadata.host}:{slot.metadata.grpc_port}"
+        stub = self._stub_for(addr)
+        stub.RemoveJobData(pb.RemoveJobDataParams(job_id=job_id), timeout=10)
+
 
 class SchedulerProcess:
     def __init__(self, bind_host: str = "0.0.0.0", port: int = 50050,

@@ -54,6 +54,11 @@ class TaskLauncher:
         finish instantly or are synthetic)."""
         return
 
+    def remove_job_data(self, executor_id: str, job_id: str,
+                        server: "SchedulerServer") -> None:
+        """Best-effort shuffle-GC push for a finished/cleaned job."""
+        return
+
 
 @dataclass
 class Event:
@@ -426,9 +431,25 @@ class SchedulerServer:
                 ev.set()
 
     def clean_job_data(self, job_id: str) -> None:
+        """Drop scheduler-side job state AND fan a shuffle-GC rpc out to
+        every live executor (reference: ExecutorManager::clean_up_job_data,
+        state/executor_manager.rs — otherwise shuffle files linger until
+        the work-dir TTL sweep)."""
         with self._jobs_lock:
             self.jobs.pop(job_id, None)
         self.job_state.remove_job(job_id)
+        if self.launcher is None:
+            return
+        executors = [e.metadata.id for e in self.executors.alive_executors()]
+
+        def run():
+            for executor_id in executors:
+                try:
+                    self.launcher.remove_job_data(executor_id, job_id, self)
+                except Exception as e:  # noqa: BLE001 — TTL sweep catches leftovers
+                    log.debug("RemoveJobData to %s failed: %s", executor_id, e)
+
+        threading.Thread(target=run, daemon=True, name="job-gc").start()
 
     # -- fail-over recovery ------------------------------------------------
 

@@ -150,48 +150,79 @@ class ShuffleWriterExec(ExecutionPlan):
         buffered = 0
         spills: list[list[str]] = [[] for _ in range(K)]
         limit = int(ctx.config.get(SORT_SHUFFLE_MEMORY_LIMIT)) if self.sort_shuffle else 0
+        # session-shared pool (try_grow semantics): when present, buffering
+        # reserves against the SESSION's budget — concurrent tasks share it,
+        # so idle tasks lend headroom to a heavy sort and a refusal means
+        # "spill first" (the reference's per-session RuntimeEnv MemoryPool,
+        # runtime_cache.rs:59)
+        pool = ctx.memory_pool if self.sort_shuffle else None
+        pool_held = 0
 
-        def spill_largest():
-            nonlocal buffered
+        def spill_largest() -> bool:
+            nonlocal buffered, pool_held
             k = max(range(K), key=lambda i: sum(b.nbytes for b in buckets[i]))
             if not buckets[k]:
-                return
+                return False
             sp = paths.sort_data_path(ctx.work_dir, self.job_id, self.stage_id, map_partition) + f".spill{len(spills[k])}.{k}"
             os.makedirs(os.path.dirname(sp), exist_ok=True)
             with open(sp, "wb") as f:
                 write_ipc_stream(buckets[k], schema, f, ctx)
             spills[k].append(sp)
-            buffered -= sum(b.nbytes for b in buckets[k])
+            freed = sum(b.nbytes for b in buckets[k])
+            buffered -= freed
+            if pool is not None:
+                pool.shrink(min(freed, pool_held))
+                pool_held -= min(freed, pool_held)
             buckets[k] = []
+            return True
+
+        def reserve(nbytes: int) -> None:
+            nonlocal pool_held
+            if pool is None:
+                return
+            while not pool.try_grow(nbytes):
+                if not spill_largest():
+                    # nothing of ours left to spill: take the headroom
+                    # anyway (liveness over strictness; other tasks will
+                    # spill on their next refusal)
+                    pool.grow(nbytes)
+                    break
+            pool_held += nbytes
 
         from ballista_tpu.ops.hashing import split_batch_by_partition
 
-        for b in self.input.execute(map_partition, ctx):
-            if b.num_rows == 0:
-                continue
-            pids = None
-            if getattr(self, "device_routed", False) and "__pid" in b.schema.names:
-                # device-side routing: the TPU stage already hashed rows to
-                # partitions (bit-exact twin); consume and drop the column.
-                # Gated on the engine-set flag so a user column named __pid
-                # is never misinterpreted.
-                i = b.schema.get_field_index("__pid")
-                pids = b.column(i).to_numpy(zero_copy_only=False).astype(np.uint64)
-                b = b.select([n for n in b.schema.names if n != "__pid"])
-                key_arrays = []
-            else:
-                key_arrays = [evaluate_to_array(kb, b) for kb in bound]
-            for k, part in split_batch_by_partition(b, key_arrays, K, precomputed_pids=pids):
-                buckets[k].append(part)
-                bucket_rows[k] += part.num_rows
-                bucket_batches[k] += 1
-                buffered += part.nbytes
-            while limit and buffered > limit:
-                spill_largest()
+        try:
+            for b in self.input.execute(map_partition, ctx):
+                if b.num_rows == 0:
+                    continue
+                pids = None
+                if getattr(self, "device_routed", False) and "__pid" in b.schema.names:
+                    # device-side routing: the TPU stage already hashed rows to
+                    # partitions (bit-exact twin); consume and drop the column.
+                    # Gated on the engine-set flag so a user column named __pid
+                    # is never misinterpreted.
+                    i = b.schema.get_field_index("__pid")
+                    pids = b.column(i).to_numpy(zero_copy_only=False).astype(np.uint64)
+                    b = b.select([n for n in b.schema.names if n != "__pid"])
+                    key_arrays = []
+                else:
+                    key_arrays = [evaluate_to_array(kb, b) for kb in bound]
+                for k, part in split_batch_by_partition(b, key_arrays, K, precomputed_pids=pids):
+                    reserve(part.nbytes)
+                    buckets[k].append(part)
+                    bucket_rows[k] += part.num_rows
+                    bucket_batches[k] += 1
+                    buffered += part.nbytes
+                while limit and buffered > limit:
+                    if not spill_largest():
+                        break
 
-        if self.sort_shuffle:
-            return self._finish_sort(map_partition, schema, buckets, spills, bucket_rows, bucket_batches, ctx)
-        return self._finish_hash(map_partition, task_id, schema, buckets, bucket_rows, bucket_batches, ctx)
+            if self.sort_shuffle:
+                return self._finish_sort(map_partition, schema, buckets, spills, bucket_rows, bucket_batches, ctx)
+            return self._finish_hash(map_partition, task_id, schema, buckets, bucket_rows, bucket_batches, ctx)
+        finally:
+            if pool is not None and pool_held:
+                pool.shrink(pool_held)
 
     def _finish_hash(self, map_partition, task_id, schema, buckets, rows, batches, ctx):
         """Drain the K bucket files CONCURRENTLY (the reference's K

@@ -392,3 +392,39 @@ def test_consistent_hash_distribution_sticky():
     assert taken == 4
     spilled = m.pick_consistent(keys[0])
     assert spilled is not None and spilled != target
+
+
+def test_session_memory_pool_try_grow_drives_spill(tmp_path):
+    """The session-shared pool's try_grow refusal makes a writer spill even
+    with NO static per-task limit — and budget not taken by one task is
+    available to another (cross-task lending, runtime_cache.rs:59)."""
+    import numpy as np
+    import pyarrow as pa
+
+    from ballista_tpu.config import BallistaConfig, SORT_SHUFFLE_MEMORY_LIMIT
+    from ballista_tpu.executor.memory_pool import MemoryPool
+    from ballista_tpu.plan.expressions import Column
+    from ballista_tpu.plan.physical import MemoryScanExec, TaskContext
+    from ballista_tpu.plan.schema import DFSchema
+    from ballista_tpu.shuffle.writer import ShuffleWriterExec
+
+    rng = np.random.default_rng(8)
+    batches = [
+        pa.record_batch({"k": pa.array(rng.integers(0, 1 << 20, 10_000)),
+                         "v": pa.array(rng.random(10_000))})
+        for _ in range(12)
+    ]
+    schema = DFSchema.from_arrow(batches[0].schema, "t")
+    pool = MemoryPool(capacity=3 * batches[0].nbytes)
+
+    scan = MemoryScanExec(schema, batches)
+    w = ShuffleWriterExec(scan, "pooljob", 1, 4, [Column("k", "t")], sort_shuffle=True)
+    ctx = TaskContext(BallistaConfig({SORT_SHUFFLE_MEMORY_LIMIT: 0}),  # no static limit
+                      work_dir=str(tmp_path))
+    ctx.memory_pool = pool
+    meta = list(w.execute(0, ctx))[0]
+    assert sum(meta.column(2).to_pylist()) == 120_000  # all rows written
+    assert pool.reserved == 0, "pool reservation leaked"
+    # another consumer can now take the WHOLE capacity (cross-task lending)
+    assert pool.try_grow(pool.capacity)
+    pool.shrink(pool.capacity)

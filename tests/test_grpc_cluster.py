@@ -238,3 +238,28 @@ def test_concurrent_sessions_and_jobs(grpc_cluster, tpch_dir, tpch_ref_tables):
         results = list(pool.map(run_one, queries))
     bad = [(q, p) for q, p in results if p]
     assert not bad, bad
+
+
+def test_clean_job_data_gc_fans_out(grpc_cluster, remote_ctx):
+    """CleanJobData removes the job's shuffle files on EVERY executor
+    (reference: ExecutorManager::clean_up_job_data rpc fan-out), not just
+    the scheduler's own state."""
+    import glob
+    import os
+    import time as _t
+
+    sched, addr = grpc_cluster
+    out = remote_ctx.sql("select count(*) c from lineitem").collect()
+    assert out.num_rows == 1
+    with sched.scheduler._jobs_lock:
+        job_id = list(sched.scheduler.jobs)[-1]
+    # shuffle files exist somewhere under an executor work dir
+    dirs = [s.metadata.id for s in sched.scheduler.executors.alive_executors()]
+    assert dirs
+    sched.scheduler.clean_job_data(job_id)
+    deadline = _t.time() + 10
+    remaining = ["?"]
+    while _t.time() < deadline and remaining:
+        remaining = glob.glob(f"/tmp/ballista-tpu-executor-*/{job_id}")
+        _t.sleep(0.2)
+    assert not remaining, remaining
