@@ -30,9 +30,13 @@ from ballista_tpu.plan.expressions import (
     AggregateFunction,
     Alias,
     BinaryExpr,
+    Case,
     Cast,
     Column,
     Expr,
+    Literal,
+    ScalarFunction,
+    VARIANCE_FUNCS,
     to_field,
 )
 from ballista_tpu.plan.logical import (
@@ -309,6 +313,42 @@ class PhysicalPlanner:
                     partial_aggs.append(AggDesc("count", a.arg, nm))
                 acc_fields.append(DFField(nm, pa.int64(), False))
                 result_exprs.append(Alias(Column(nm), out_name))
+            elif a.func in VARIANCE_FUNCS:
+                # Welford-style decomposition: per-partition (count, mean, M2)
+                # partials — the same accumulator DataFusion's variance kernels
+                # use — merged at the final phase with the mean-centered
+                # formula M2 = ΣM2_i + Σn_i·(mean_i − mean)². A naive
+                # sum-of-squares decomposition (q − s²/n) catastrophically
+                # cancels for large-magnitude data (e.g. epoch-microsecond
+                # columns); the centered form never builds huge intermediates.
+                # The triple MUST stay adjacent in (cnt, mean, m2) order:
+                # HashAggregateExec's final mode merges them as a unit.
+                if a.distinct:
+                    raise PlanningError(f"{a.func}(DISTINCT) is unsupported")
+                cname, mname, qname = f"__acc{i}_cnt", f"__acc{i}_mean", f"__acc{i}_m2"
+                x = Cast(a.arg, pa.float64())
+                partial_aggs.append(AggDesc("count", a.arg, cname))
+                partial_aggs.append(AggDesc("welford_mean", x, mname))
+                partial_aggs.append(AggDesc("welford_m2", x, qname))
+                acc_fields.append(DFField(cname, pa.int64(), False))
+                acc_fields.append(DFField(mname, pa.float64(), True))
+                acc_fields.append(DFField(qname, pa.float64(), True))
+                n_f = Cast(Column(cname), pa.float64())
+                denom = (
+                    n_f if a.func in ("var_pop", "stddev_pop")
+                    else BinaryExpr(n_f, "-", Literal(1.0))
+                )
+                var = BinaryExpr(Column(qname), "/", denom)
+                if a.func in ("stddev_samp", "stddev_pop"):
+                    var = ScalarFunction("sqrt", (var,))
+                # SQL: sample forms need n>=2, population forms n>=1 (count=0
+                # gives NULL sums already, but 0/0 must not leak a NaN)
+                min_n = 1 if a.func in ("var_pop", "stddev_pop") else 2
+                guarded = Case(
+                    ((BinaryExpr(Column(cname), ">=", Literal(min_n)), var),),
+                    None,
+                )
+                result_exprs.append(Alias(guarded, out_name))
             else:
                 raise PlanningError(f"unsupported aggregate {a.func}")
             i += 1
@@ -499,7 +539,9 @@ def _sum_type(t: pa.DataType) -> pa.DataType:
 
 
 def _merge_func(f: str) -> str:
-    return {"sum": "sum", "min": "min", "max": "max", "count": "count", "count_all": "count_all"}[f]
+    return {"sum": "sum", "min": "min", "max": "max", "count": "count",
+            "count_all": "count_all", "welford_mean": "welford_mean",
+            "welford_m2": "welford_m2"}[f]
 
 
 def _rebind_schema(s: DFSchema) -> DFSchema:

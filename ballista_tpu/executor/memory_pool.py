@@ -11,6 +11,7 @@ shrink when they spill or finish; a refusal means "spill first".
 from __future__ import annotations
 
 import threading
+import time
 
 
 class MemoryPool:
@@ -38,21 +39,44 @@ class MemoryPool:
 
 
 class SessionPoolRegistry:
-    """session id → shared MemoryPool (created on first use)."""
+    """session id → shared MemoryPool (created on first use).
 
-    def __init__(self, capacity_per_session: int):
+    TTL-evicting, like the reference's SessionRuntimeCache
+    (executor/src/runtime_cache.rs:86): executors never hear about session
+    removal from the scheduler, so pools idle past the TTL are dropped on
+    the next lookup. Eviction also heals leaked reservations from tasks
+    that died mid-reserve — the session's next task gets a fresh pool.
+    Tasks holding a reference to an evicted pool keep using it safely; only
+    new lookups see the fresh one.
+    """
+
+    def __init__(self, capacity_per_session: int, ttl_s: float = 3600.0):
         self.capacity = capacity_per_session
-        self._pools: dict[str, MemoryPool] = {}
+        self.ttl_s = ttl_s
+        self._pools: dict[str, tuple[MemoryPool, float]] = {}
         self._lock = threading.Lock()
 
     def get(self, session_id: str) -> MemoryPool:
+        now = time.monotonic()
         with self._lock:
-            p = self._pools.get(session_id)
-            if p is None:
-                p = MemoryPool(self.capacity)
-                self._pools[session_id] = p
-            return p
+            self._sweep_locked(now)
+            entry = self._pools.get(session_id)
+            if entry is None:
+                pool = MemoryPool(self.capacity)
+            else:
+                pool = entry[0]
+            self._pools[session_id] = (pool, now)
+            return pool
 
     def remove(self, session_id: str) -> None:
         with self._lock:
             self._pools.pop(session_id, None)
+
+    def _sweep_locked(self, now: float) -> None:
+        dead = [sid for sid, (_, used) in self._pools.items() if now - used > self.ttl_s]
+        for sid in dead:
+            del self._pools[sid]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._pools)

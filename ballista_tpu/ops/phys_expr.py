@@ -287,6 +287,8 @@ class ScalarFnOp(PhysicalExpr):
             return pc.binary_join_element_wise(*a, "")
         if n == "abs":
             return pc.abs(a[0])
+        if n == "sqrt":
+            return pc.sqrt(pc.cast(a[0], pa.float64()))
         if n == "round":
             ndigits = _as_py(a[1]) if len(a) > 1 else 0
             return pc.round(a[0], ndigits=ndigits)

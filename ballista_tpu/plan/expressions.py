@@ -462,6 +462,8 @@ class ScalarFunction(Expr):
             return pa.string()
         if n in ("abs", "round", "ceil", "floor"):
             return self.args[0].data_type(schema)
+        if n == "sqrt":
+            return pa.float64()
         if n == "coalesce":
             for a in self.args:
                 t = a.data_type(schema)
@@ -546,7 +548,12 @@ class WindowFunction(Expr):
         return f"{self.func}({a}) OVER ({' '.join(parts)})"
 
 
-AGG_FUNCS = ("sum", "avg", "min", "max", "count", "count_distinct")
+AGG_FUNCS = ("sum", "avg", "min", "max", "count", "count_distinct",
+             "stddev_samp", "stddev_pop", "var_samp", "var_pop")
+
+# aggregates whose result is always float64 (decomposed into Welford
+# (count, mean, M2) partials by the physical planner — see _plan_aggregate)
+VARIANCE_FUNCS = ("stddev_samp", "stddev_pop", "var_samp", "var_pop")
 
 
 @dataclass(frozen=True)
@@ -564,7 +571,7 @@ class AggregateFunction(Expr):
     def data_type(self, schema: DFSchema) -> pa.DataType:
         if self.func in ("count", "count_distinct"):
             return pa.int64()
-        if self.func == "avg":
+        if self.func == "avg" or self.func in VARIANCE_FUNCS:
             return pa.float64()
         assert self.arg is not None
         t = self.arg.data_type(schema)

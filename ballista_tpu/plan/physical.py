@@ -415,6 +415,55 @@ class CoalesceBatchesExec(ExecutionPlan):
 # ---------------------------------------------------------------------------
 
 
+def _as_py_scalar(v):
+    return v.as_py() if isinstance(v, pa.Scalar) else v
+
+
+def _welford_merge_lists(n_lists, mean_lists, m2_lists):
+    """Merge per-group lists of Welford partials (one element per upstream
+    partial row) with the mean-centered formula:
+
+        N = Σn_i;  mean = Σ n_i·mean_i / N
+        M2 = Σ M2_i + Σ n_i·(mean_i − mean)²
+
+    Centering before squaring keeps intermediates at data scale — this is
+    why the decomposition survives large-magnitude columns where the naive
+    q − s²/n form catastrophically cancels. Vectorized over groups via
+    flattened values + reduceat (list lengths are identical across the three
+    columns: each upstream partial row contributes one slot to each list).
+    """
+    def _la(col):
+        col = col.combine_chunks() if isinstance(col, pa.ChunkedArray) else col
+        return col
+
+    n_la, mean_la, m2_la = _la(n_lists), _la(mean_lists), _la(m2_lists)
+    off = n_la.offsets.to_numpy()
+    starts = off[:-1]
+    lens = np.diff(off)
+    n_flat = n_la.flatten().to_numpy(zero_copy_only=False).astype(np.float64)
+    mean_flat = mean_la.flatten().to_numpy(zero_copy_only=False)
+    m2_flat = m2_la.flatten().to_numpy(zero_copy_only=False)
+    # partials are null only when n==0 (zero contribution); with n>0 a NaN is
+    # genuine data NaN and must propagate, matching single-partition results
+    mean_flat = np.where(n_flat > 0, mean_flat, 0.0)
+    m2_flat = np.where(n_flat > 0, m2_flat, 0.0)
+    n_groups = len(lens)
+    if len(n_flat) == 0:
+        empty = pa.nulls(n_groups, pa.float64())
+        return empty, empty
+    N = np.add.reduceat(n_flat, starts)
+    wsum = np.add.reduceat(n_flat * mean_flat, starts)
+    with np.errstate(invalid="ignore", divide="ignore"):
+        mean_g = wsum / N
+    mean_rep = np.repeat(np.nan_to_num(mean_g), lens)
+    centered = n_flat * (mean_flat - mean_rep) ** 2
+    M2 = np.add.reduceat(m2_flat + centered, starts)
+    valid = N > 0
+    mean_arr = pa.array(np.where(valid, mean_g, 0.0), pa.float64(), mask=~valid)
+    m2_arr = pa.array(np.where(valid, M2, 0.0), pa.float64(), mask=~valid)
+    return mean_arr, m2_arr
+
+
 @dataclass
 class AggDesc:
     func: str  # sum | min | max | count | count_all
@@ -479,7 +528,9 @@ class HashAggregateExec(ExecutionPlan):
                 tbl = pa.table(cols)
             pairs = []
             for i, d in enumerate(self.aggs):
-                fn = {"sum": "sum", "min": "min", "max": "max", "count": "count", "count_all": "sum"}[d.func]
+                fn = {"sum": "sum", "min": "min", "max": "max", "count": "count",
+                      "count_all": "sum", "welford_mean": "mean",
+                      "welford_m2": "variance"}[d.func]
                 pairs.append((f"__a{i}", fn))
         else:  # final: input columns are [groups..., accumulators...]
             tbl = _concat(batches, self.input.schema()) if batches else None
@@ -488,7 +539,12 @@ class HashAggregateExec(ExecutionPlan):
                 tbl = tbl.rename_columns(names)
             pairs = []
             for i, d in enumerate(self.aggs):
-                fn = {"sum": "sum", "min": "min", "max": "max", "count": "sum", "count_all": "sum"}[d.func]
+                # welford partials merge as a (cnt, mean, m2) unit: list-collect
+                # the per-partition values, merged below with the mean-centered
+                # formula (numerically stable — no sum-of-squares cancellation)
+                fn = {"sum": "sum", "min": "min", "max": "max", "count": "sum",
+                      "count_all": "sum", "welford_mean": "list",
+                      "welford_m2": "list"}[d.func]
                 pairs.append((f"__a{i}", fn))
 
         if tbl is None or tbl.num_rows == 0:
@@ -500,9 +556,21 @@ class HashAggregateExec(ExecutionPlan):
 
         if n_group == 0:
             arrays = []
-            for (cname, fn), f in zip(pairs, schema):
+            welford_global: dict[int, tuple] = {}  # mean-desc idx → (mean, m2)
+            for i, ((cname, fn), d, f) in enumerate(zip(pairs, self.aggs, schema)):
                 col = tbl.column(cname)
-                if fn == "sum":
+                if d.func == "welford_mean" and self.mode == "final":
+                    welford_global[i] = self._welford_merge_global(tbl, i - 1)
+                    v = welford_global[i][0]
+                elif d.func == "welford_m2" and self.mode == "final":
+                    v = welford_global[i - 1][1]
+                elif d.func == "welford_mean":
+                    v = pc.mean(col)
+                elif d.func == "welford_m2":
+                    n = len(col) - col.null_count
+                    var = pc.variance(col, ddof=0).as_py() if n else None
+                    v = pa.scalar(None if var is None else var * n, pa.float64())
+                elif fn == "sum":
                     v = pc.sum(col)
                 elif fn == "min":
                     v = pc.min(col)
@@ -510,20 +578,46 @@ class HashAggregateExec(ExecutionPlan):
                     v = pc.max(col)
                 elif fn == "count":
                     v = pa.scalar(len(col) - col.null_count, pa.int64())
-                arr = pa.array([v.as_py()], f.type)
+                arr = pa.array([_as_py_scalar(v)], f.type)
                 arrays.append(arr)
             yield pa.RecordBatch.from_arrays(arrays, schema=schema)
             return
 
         keys = [f"__g{i}" for i in range(n_group)]
-        grouped = tbl.group_by(keys, use_threads=False).aggregate(pairs)
+        agg_calls: list = []
+        for (cname, fn), d in zip(pairs, self.aggs):
+            if fn == "variance":
+                agg_calls.append((cname, "variance", pc.VarianceOptions(ddof=0)))
+                agg_calls.append((cname, "count"))  # for m2 = var_pop * n
+            else:
+                agg_calls.append((cname, fn))
+        for i, d in enumerate(self.aggs):
+            if self.mode == "final" and d.func == "welford_mean":
+                agg_calls.append((f"__a{i - 1}", "list"))  # the triple's counts
+        grouped = tbl.group_by(keys, use_threads=False).aggregate(agg_calls)
         # grouped columns: [agg outputs named __aI_fn ..., keys...] (pyarrow puts
         # aggregates first or keys first depending on version) — map by name.
         out_arrays = []
         for i in range(n_group):
             out_arrays.append(grouped.column(f"__g{i}"))
-        for (cname, fn), d in zip(pairs, self.aggs):
-            out_arrays.append(grouped.column(f"{cname}_{fn}"))
+        welford_cache: dict[int, tuple] = {}  # mean-desc idx → (mean_arr, m2_arr)
+        for i, ((cname, fn), d) in enumerate(zip(pairs, self.aggs)):
+            if fn == "variance":  # partial welford_m2: m2 = var_pop * n
+                var = pc.cast(grouped.column(f"{cname}_variance"), pa.float64())
+                n = pc.cast(grouped.column(f"{cname}_count"), pa.float64())
+                out_arrays.append(pc.multiply(var, n))
+            elif fn == "list" and d.func == "welford_mean":
+                merged = _welford_merge_lists(
+                    grouped.column(f"__a{i - 1}_list"),
+                    grouped.column(f"__a{i}_list"),
+                    grouped.column(f"__a{i + 1}_list"),
+                )
+                welford_cache[i] = merged
+                out_arrays.append(merged[0])
+            elif fn == "list" and d.func == "welford_m2":
+                out_arrays.append(welford_cache[i - 1][1])
+            else:
+                out_arrays.append(grouped.column(f"{cname}_{fn}"))
         casted = []
         for arr, f in zip(out_arrays, schema):
             a = arr.combine_chunks() if isinstance(arr, pa.ChunkedArray) else arr
@@ -531,6 +625,22 @@ class HashAggregateExec(ExecutionPlan):
                 a = a.cast(f.type)
             casted.append(a)
         yield pa.RecordBatch.from_arrays(casted, schema=schema)
+
+    def _welford_merge_global(self, tbl: pa.Table, cnt_idx: int):
+        """Merge all partial (count, mean, m2) rows into one global pair.
+        Columns __a{cnt_idx}, __a{cnt_idx+1}, __a{cnt_idx+2} hold the triple."""
+        n = tbl.column(f"__a{cnt_idx}").to_numpy(zero_copy_only=False).astype(np.float64)
+        mean = tbl.column(f"__a{cnt_idx + 1}").to_numpy(zero_copy_only=False)
+        m2 = tbl.column(f"__a{cnt_idx + 2}").to_numpy(zero_copy_only=False)
+        # null partials ⟺ n==0; NaN with n>0 is data NaN and must propagate
+        mean = np.where(n > 0, mean, 0.0)
+        m2 = np.where(n > 0, m2, 0.0)
+        total = n.sum()
+        if total <= 0:
+            return None, None
+        g_mean = float((n * mean).sum() / total)
+        g_m2 = float(m2.sum() + (n * (mean - g_mean) ** 2).sum())
+        return g_mean, g_m2
 
     def _empty_global_row(self, schema: pa.Schema) -> pa.RecordBatch:
         arrays = []

@@ -55,7 +55,12 @@ from ballista_tpu.sql.ast import (
 )
 from ballista_tpu.sql.tokenizer import Token, tokenize
 
-AGGREGATES = {"SUM", "AVG", "MIN", "MAX", "COUNT"}
+AGGREGATES = {"SUM", "AVG", "MIN", "MAX", "COUNT",
+              "STDDEV", "STDDEV_SAMP", "STDDEV_POP",
+              "VARIANCE", "VAR_SAMP", "VAR_POP"}
+
+# SQL surface names → canonical aggregate names (SQL-standard sample forms)
+_AGG_CANONICAL = {"stddev": "stddev_samp", "variance": "var_samp"}
 
 SCALAR_FUNCS = {
     # canonical-name mapping; evaluation lives in the engines
@@ -65,6 +70,7 @@ SCALAR_FUNCS = {
     "CONCAT": "concat", "ABS": "abs", "ROUND": "round", "CEIL": "ceil",
     "CEILING": "ceil", "FLOOR": "floor", "COALESCE": "coalesce",
     "DATE_TRUNC": "date_trunc", "DATE_PART": "date_part", "YEAR": "extract_year",
+    "SQRT": "sqrt",
 }
 
 _TYPE_NAMES = {
@@ -768,7 +774,8 @@ class Parser:
             distinct = self.accept_kw("DISTINCT")
             arg = self.parse_expr()
             self.expect_punct(")")
-            return self._maybe_window(AggregateFunction(up.lower(), arg, distinct))
+            canonical = _AGG_CANONICAL.get(up.lower(), up.lower())
+            return self._maybe_window(AggregateFunction(canonical, arg, distinct))
         args: list[Expr] = []
         if not (self.peek().kind == "punct" and self.peek().value == ")"):
             args.append(self.parse_expr())
@@ -805,6 +812,8 @@ class Parser:
         if isinstance(fn, AggregateFunction):
             if fn.distinct or fn.func == "count_distinct":
                 raise SqlParseError("DISTINCT window aggregates are unsupported")
+            if fn.func not in WINDOW_FUNCS:
+                raise SqlParseError(f"{fn.func}() is not supported as a window function")
             func = fn.func
             args: tuple = (fn.arg,) if fn.arg is not None else ()
         elif isinstance(fn, ScalarFunction) and fn.name in WINDOW_FUNCS:

@@ -428,3 +428,23 @@ def test_session_memory_pool_try_grow_drives_spill(tmp_path):
     # another consumer can now take the WHOLE capacity (cross-task lending)
     assert pool.try_grow(pool.capacity)
     pool.shrink(pool.capacity)
+
+
+def test_session_pool_registry_ttl_eviction():
+    """Idle session pools are evicted on lookup after the TTL (the executor
+    never hears about session removal — runtime_cache.rs:86 semantics), and
+    eviction resets leaked reservations for the session's next task."""
+    from ballista_tpu.executor.memory_pool import SessionPoolRegistry
+
+    reg = SessionPoolRegistry(capacity_per_session=100, ttl_s=0.05)
+    p1 = reg.get("s1")
+    p1.grow(90)  # a task dies holding a reservation
+    reg.get("s2")
+    assert len(reg) == 2
+    import time as _t
+
+    _t.sleep(0.08)
+    p1b = reg.get("s1")  # sweep evicts both idle entries, s1 re-created fresh
+    assert p1b is not p1 and p1b.reserved == 0
+    assert len(reg) == 1  # s2 swept
+    assert reg.get("s2").reserved == 0

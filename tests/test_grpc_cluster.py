@@ -28,6 +28,7 @@ def grpc_cluster(tmp_path_factory):
     ex1.start()
     ex2.start()
     time.sleep(0.3)
+    sched.test_executors = [ex1, ex2]  # so tests can reach real work dirs
     yield sched, addr
     ex1.shutdown()
     ex2.shutdown()
@@ -253,13 +254,15 @@ def test_clean_job_data_gc_fans_out(grpc_cluster, remote_ctx):
     assert out.num_rows == 1
     with sched.scheduler._jobs_lock:
         job_id = list(sched.scheduler.jobs)[-1]
-    # shuffle files exist somewhere under an executor work dir
-    dirs = [s.metadata.id for s in sched.scheduler.executors.alive_executors()]
-    assert dirs
+    # the job's shuffle dirs must exist under the real executor work dirs
+    # BEFORE cleanup — otherwise this test can pass without testing anything
+    work_dirs = [ex.work_dir for ex in sched.test_executors]
+    before = [d for wd in work_dirs for d in glob.glob(os.path.join(wd, job_id))]
+    assert before, f"no shuffle dirs for {job_id} under {work_dirs}"
     sched.scheduler.clean_job_data(job_id)
     deadline = _t.time() + 10
-    remaining = ["?"]
+    remaining = list(before)
     while _t.time() < deadline and remaining:
-        remaining = glob.glob(f"/tmp/ballista-tpu-executor-*/{job_id}")
+        remaining = [d for wd in work_dirs for d in glob.glob(os.path.join(wd, job_id))]
         _t.sleep(0.2)
     assert not remaining, remaining
