@@ -543,6 +543,379 @@ def run_reference(q: int, t: dict[str, pd.DataFrame]) -> pd.DataFrame:
         g = m.groupby(["wname", "sm_type", "cc_name"], as_index=False).agg(
             d30=("d30", "sum"), d31_60=("d31_60", "sum"), d_gt_60=("d_gt_60", "sum"))
         return g.sort_values(["wname", "sm_type", "cc_name"]).head(100).reset_index(drop=True)
+    if q in (1, 30, 81):
+        # customer_total_return shape: per-customer returns vs 1.2x the
+        # state/store average (correlated scalar subquery over a CTE)
+        cu, ca = t["customer"], t["customer_address"]
+        if q == 1:
+            sr, st = t["store_returns"], t["store"]
+            m = sr.merge(dd[dd.d_year == 2000][["d_date_sk"]],
+                         left_on="sr_returned_date_sk", right_on="d_date_sk")
+            ctr = m.groupby(["sr_customer_sk", "sr_store_sk"], as_index=False).agg(
+                ctr_total_return=("sr_return_amt", "sum"))
+            avg_grp = ctr.groupby("sr_store_sk")["ctr_total_return"].transform("mean")
+            hot = ctr[ctr.ctr_total_return > avg_grp * 1.2]
+            hot = hot.merge(st[st.s_state == "TN"][["s_store_sk"]],
+                            left_on="sr_store_sk", right_on="s_store_sk")
+            hot = hot.merge(cu, left_on="sr_customer_sk", right_on="c_customer_sk")
+            return hot[["c_customer_id"]].sort_values("c_customer_id").head(100).reset_index(drop=True)
+        if q == 30:
+            wr = t["web_returns"]
+            m = wr.merge(dd[dd.d_year == 2002][["d_date_sk"]],
+                         left_on="wr_returned_date_sk", right_on="d_date_sk")
+            m = m.merge(ca[["ca_address_sk", "ca_state"]],
+                        left_on="wr_refunded_addr_sk", right_on="ca_address_sk")
+            ctr = m.groupby(["wr_returning_customer_sk", "ca_state"], as_index=False).agg(
+                ctr_total_return=("wr_return_amt", "sum"))
+            cust_col = "wr_returning_customer_sk"
+        else:
+            cr = t["catalog_returns"]
+            m = cr.merge(dd[dd.d_year == 2000][["d_date_sk"]],
+                         left_on="cr_returned_date_sk", right_on="d_date_sk")
+            m = m.merge(ca[["ca_address_sk", "ca_state"]],
+                        left_on="cr_returning_addr_sk", right_on="ca_address_sk")
+            ctr = m.groupby(["cr_returning_customer_sk", "ca_state"], as_index=False).agg(
+                ctr_total_return=("cr_return_amt", "sum"))
+            cust_col = "cr_returning_customer_sk"
+        avg_grp = ctr.groupby("ca_state")["ctr_total_return"].transform("mean")
+        hot = ctr[ctr.ctr_total_return > avg_grp * 1.2]
+        hot = hot.merge(cu, left_on=cust_col, right_on="c_customer_sk")
+        hot = hot.merge(ca.add_suffix("_cur"), left_on="c_current_addr_sk",
+                        right_on="ca_address_sk_cur")
+        hot = hot[hot.ca_state_cur == "GA"]
+        if q == 30:
+            cols = ["c_customer_id", "c_salutation", "c_first_name", "c_last_name",
+                    "c_preferred_cust_flag", "c_birth_day", "c_birth_month",
+                    "c_birth_year", "c_birth_country", "c_login", "c_email_address",
+                    "ctr_total_return"]
+            out = hot[cols]
+        else:
+            out = pd.DataFrame({
+                "c_customer_id": hot.c_customer_id, "c_salutation": hot.c_salutation,
+                "c_first_name": hot.c_first_name, "c_last_name": hot.c_last_name,
+                "ca_street_number": hot.ca_street_number_cur,
+                "ca_street_name": hot.ca_street_name_cur,
+                "ca_street_type": hot.ca_street_type_cur,
+                "ca_suite_number": hot.ca_suite_number_cur,
+                "ca_city": hot.ca_city_cur, "ca_county": hot.ca_county_cur,
+                "ca_state": hot.ca_state_cur, "ca_zip": hot.ca_zip_cur,
+                "ca_country": hot.ca_country_cur,
+                "ca_gmt_offset": hot.ca_gmt_offset_cur,
+                "ca_location_type": hot.ca_location_type_cur,
+                "ctr_total_return": hot.ctr_total_return})
+        return out.sort_values(list(out.columns)).head(100).reset_index(drop=True)
+    if q == 17:
+        sr, cs, st = t["store_returns"], t["catalog_sales"], t["store"]
+        d1 = dd[dd.d_quarter_name == "2001Q1"][["d_date_sk"]]
+        d23 = dd[dd.d_quarter_name.isin(["2001Q1", "2001Q2", "2001Q3"])][["d_date_sk"]]
+        m = ss.merge(d1, left_on="ss_sold_date_sk", right_on="d_date_sk")
+        srx = sr.merge(d23, left_on="sr_returned_date_sk", right_on="d_date_sk")
+        m = m.merge(srx, left_on=["ss_customer_sk", "ss_item_sk", "ss_ticket_number"],
+                    right_on=["sr_customer_sk", "sr_item_sk", "sr_ticket_number"])
+        csx = cs.merge(d23, left_on="cs_sold_date_sk", right_on="d_date_sk")
+        m = m.merge(csx, left_on=["sr_customer_sk", "sr_item_sk"],
+                    right_on=["cs_bill_customer_sk", "cs_item_sk"])
+        m = m.merge(st[["s_store_sk", "s_state"]], left_on="ss_store_sk", right_on="s_store_sk")
+        m = m.merge(it[["i_item_sk", "i_item_id", "i_item_desc"]],
+                    left_on="ss_item_sk", right_on="i_item_sk")
+        g = m.groupby(["i_item_id", "i_item_desc", "s_state"], as_index=False).agg(
+            c1=("ss_quantity", "count"), a1=("ss_quantity", "mean"), s1=("ss_quantity", "std"),
+            c2=("sr_return_quantity", "count"), a2=("sr_return_quantity", "mean"),
+            s2=("sr_return_quantity", "std"),
+            c3=("cs_quantity", "count"), a3=("cs_quantity", "mean"), s3=("cs_quantity", "std"))
+        for i in (1, 2, 3):
+            g[f"cov{i}"] = g[f"s{i}"] / g[f"a{i}"]
+        out = g[["i_item_id", "i_item_desc", "s_state", "c1", "a1", "s1", "cov1",
+                 "c2", "a2", "s2", "cov2", "c3", "a3", "s3", "cov3"]]
+        return out.sort_values(["i_item_id", "i_item_desc", "s_state"]).head(100).reset_index(drop=True)
+    if q == 21:
+        inv, wh = t["inventory"], t["warehouse"]
+        dsel = dd[(dd.d_date >= dt.date(2000, 2, 10)) & (dd.d_date <= dt.date(2000, 4, 10))]
+        m = inv.merge(dsel[["d_date_sk", "d_date"]], left_on="inv_date_sk", right_on="d_date_sk")
+        m = m.merge(it[(it.i_current_price >= 0.99) & (it.i_current_price <= 29.49)][
+            ["i_item_sk", "i_item_id"]], left_on="inv_item_sk", right_on="i_item_sk")
+        m = m.merge(wh[["w_warehouse_sk", "w_warehouse_name"]],
+                    left_on="inv_warehouse_sk", right_on="w_warehouse_sk")
+        pivot = dt.date(2000, 3, 11)
+        m["before"] = np.where([d < pivot for d in m.d_date], m.inv_quantity_on_hand, 0)
+        m["after"] = np.where([d >= pivot for d in m.d_date], m.inv_quantity_on_hand, 0)
+        g = m.groupby(["w_warehouse_name", "i_item_id"], as_index=False).agg(
+            inv_before=("before", "sum"), inv_after=("after", "sum"))
+        ratio = np.where(g.inv_before > 0,
+                         g.inv_after / np.where(g.inv_before > 0, g.inv_before, 1), np.nan)
+        g = g[(ratio >= 2.0 / 3.0) & (ratio <= 1.5)]
+        return g.sort_values(["w_warehouse_name", "i_item_id"]).head(100).reset_index(drop=True)
+    if q == 22:
+        inv = t["inventory"]
+        dsel = dd[(dd.d_month_seq >= 1200) & (dd.d_month_seq <= 1211)][["d_date_sk"]]
+        m = inv.merge(dsel, left_on="inv_date_sk", right_on="d_date_sk")
+        m = m.merge(it, left_on="inv_item_sk", right_on="i_item_sk")
+        cols = ["i_product_name", "i_brand", "i_class", "i_category"]
+        frames = []
+        for k in range(4, -1, -1):
+            keys = cols[:k]
+            if keys:
+                g = m.groupby(keys, as_index=False)["inv_quantity_on_hand"].mean()
+            else:
+                g = pd.DataFrame({"inv_quantity_on_hand": [m.inv_quantity_on_hand.mean()]})
+            for c in cols[k:]:
+                g[c] = None
+            frames.append(g[cols + ["inv_quantity_on_hand"]])
+        out = pd.concat(frames, ignore_index=True).rename(
+            columns={"inv_quantity_on_hand": "qoh"})
+        return out.sort_values(["qoh"] + cols, na_position="last").head(100).reset_index(drop=True)
+    if q == 39:
+        inv, wh = t["inventory"], t["warehouse"]
+        m = inv.merge(dd[dd.d_year == 2001][["d_date_sk", "d_moy"]],
+                      left_on="inv_date_sk", right_on="d_date_sk")
+        m = m.merge(it[["i_item_sk"]], left_on="inv_item_sk", right_on="i_item_sk")
+        m = m.merge(wh[["w_warehouse_sk", "w_warehouse_name"]],
+                    left_on="inv_warehouse_sk", right_on="w_warehouse_sk")
+        g = m.groupby(["w_warehouse_name", "w_warehouse_sk", "i_item_sk", "d_moy"],
+                      as_index=False).agg(stdev=("inv_quantity_on_hand", "std"),
+                                          mean=("inv_quantity_on_hand", "mean"))
+        sel = np.where(g["mean"] == 0, 0.0, g.stdev / g["mean"]) > 1
+        g = g[sel].copy()
+        g["cov"] = np.where(g["mean"] == 0, np.nan, g.stdev / g["mean"])
+        j = g[g.d_moy == 1].merge(g[g.d_moy == 2], on=["i_item_sk", "w_warehouse_sk"],
+                                  suffixes=("_1", "_2"))
+        out = pd.DataFrame({
+            "wsk1": j.w_warehouse_sk, "isk1": j.i_item_sk, "moy1": j.d_moy_1,
+            "mean1": j.mean_1, "cov1": j.cov_1,
+            "wsk2": j.w_warehouse_sk, "isk2": j.i_item_sk, "moy2": j.d_moy_2,
+            "mean2": j.mean_2, "cov2": j.cov_2})
+        return out.sort_values(list(out.columns)).reset_index(drop=True)
+    if q == 62:
+        ws, wh, sm, web = t["web_sales"], t["warehouse"], t["ship_mode"], t["web_site"]
+        m = ws.merge(dd[dd.d_year == 2001][["d_date_sk"]],
+                     left_on="ws_ship_date_sk", right_on="d_date_sk")
+        m = m.merge(wh, left_on="ws_warehouse_sk", right_on="w_warehouse_sk")
+        m = m.merge(sm, left_on="ws_ship_mode_sk", right_on="sm_ship_mode_sk")
+        m = m.merge(web, left_on="ws_web_site_sk", right_on="web_site_sk")
+        lag = m.ws_ship_date_sk - m.ws_sold_date_sk
+        m["d30"] = (lag <= 30).astype(int)
+        m["d31_60"] = ((lag > 30) & (lag <= 60)).astype(int)
+        m["d_gt_60"] = (lag > 60).astype(int)
+        m["wname"] = m.w_warehouse_name.str[:20]
+        g = m.groupby(["wname", "sm_type", "web_name"], as_index=False).agg(
+            d30=("d30", "sum"), d31_60=("d31_60", "sum"), d_gt_60=("d_gt_60", "sum"))
+        return g.sort_values(["wname", "sm_type", "web_name"]).head(100).reset_index(drop=True)
+    if q == 86:
+        ws = t["web_sales"]
+        dsel = dd[(dd.d_month_seq >= 1200) & (dd.d_month_seq <= 1211)][["d_date_sk"]]
+        m = ws.merge(dsel, left_on="ws_sold_date_sk", right_on="d_date_sk")
+        m = m.merge(it, left_on="ws_item_sk", right_on="i_item_sk")
+        rows = []
+        for (cat, cls), g in m.groupby(["i_category", "i_class"]):
+            rows.append((g.ws_net_paid.sum(), cat, cls, 0))
+        for cat, g in m.groupby("i_category"):
+            rows.append((g.ws_net_paid.sum(), cat, None, 1))
+        rows.append((m.ws_net_paid.sum(), None, None, 2))
+        out = pd.DataFrame(rows, columns=["total_sum", "i_category", "i_class", "lochierarchy"])
+        out["rank_within_parent"] = out.groupby("lochierarchy")["total_sum"].rank(
+            method="min", ascending=False).astype(int)
+        out = out.sort_values(["lochierarchy", "i_category", "i_class"],
+                              ascending=[False, True, True], na_position="last")
+        return out[["total_sum", "i_category", "i_class", "lochierarchy",
+                    "rank_within_parent"]].head(100).reset_index(drop=True)
+    if q == 91:
+        cc, cr, cu = t["call_center"], t["catalog_returns"], t["customer"]
+        ca, cd, hd = t["customer_address"], t["customer_demographics"], t["household_demographics"]
+        m = cr.merge(cc, left_on="cr_call_center_sk", right_on="cc_call_center_sk")
+        m = m.merge(dd[dd.d_year == 1998][["d_date_sk"]],
+                    left_on="cr_returned_date_sk", right_on="d_date_sk")
+        m = m.merge(cu, left_on="cr_returning_customer_sk", right_on="c_customer_sk")
+        cdf = cd[((cd.cd_marital_status == "M") & (cd.cd_education_status == "Unknown"))
+                 | ((cd.cd_marital_status == "W") & (cd.cd_education_status == "Advanced Degree"))]
+        m = m.merge(cdf, left_on="c_current_cdemo_sk", right_on="cd_demo_sk")
+        m = m.merge(hd[hd.hd_buy_potential.str.startswith("Unknown")],
+                    left_on="c_current_hdemo_sk", right_on="hd_demo_sk")
+        m = m.merge(ca[ca.ca_gmt_offset == -7], left_on="c_current_addr_sk",
+                    right_on="ca_address_sk")
+        g = m.groupby(["cc_call_center_id", "cc_name", "cc_manager",
+                       "cd_marital_status", "cd_education_status"], as_index=False).agg(
+            returns_loss=("cr_net_loss", "sum"))
+        out = g[["cc_call_center_id", "cc_name", "cc_manager", "returns_loss"]]
+        return out.sort_values("returns_loss", ascending=False).reset_index(drop=True)
+    if q in (47, 57):
+        # month-over-month outliers: windowed year-avg + lag/lead via rank
+        # self-joins on a CTE
+        dsel = dd[(dd.d_year == 1999) | ((dd.d_year == 1998) & (dd.d_moy == 12))
+                  | ((dd.d_year == 2000) & (dd.d_moy == 1))][["d_date_sk", "d_year", "d_moy"]]
+        if q == 47:
+            st = t["store"]
+            m = ss.merge(dsel, left_on="ss_sold_date_sk", right_on="d_date_sk")
+            m = m.merge(it[["i_item_sk", "i_category", "i_brand"]],
+                        left_on="ss_item_sk", right_on="i_item_sk")
+            m = m.merge(st[["s_store_sk", "s_store_name", "s_company_name"]],
+                        left_on="ss_store_sk", right_on="s_store_sk")
+            keys, val = ["i_category", "i_brand", "s_store_name", "s_company_name"], "ss_sales_price"
+            tie = ["s_store_name", "i_category", "i_brand", "s_company_name", "d_year", "d_moy"]
+        else:
+            cc = t["call_center"]
+            m = t["catalog_sales"].merge(dsel, left_on="cs_sold_date_sk", right_on="d_date_sk")
+            m = m.merge(it[["i_item_sk", "i_category", "i_brand"]],
+                        left_on="cs_item_sk", right_on="i_item_sk")
+            m = m.merge(cc[["cc_call_center_sk", "cc_name"]],
+                        left_on="cs_call_center_sk", right_on="cc_call_center_sk")
+            keys, val = ["i_category", "i_brand", "cc_name"], "cs_sales_price"
+            tie = ["cc_name", "i_category", "i_brand", "d_year", "d_moy"]
+        g = m.groupby(keys + ["d_year", "d_moy"], as_index=False).agg(sum_sales=(val, "sum"))
+        g["avg_monthly_sales"] = g.groupby(keys + ["d_year"])["sum_sales"].transform("mean")
+        g = g.sort_values(keys + ["d_year", "d_moy"]).reset_index(drop=True)
+        g["rn"] = g.groupby(keys).cumcount() + 1
+        lagd = g[keys + ["rn", "sum_sales"]].rename(columns={"sum_sales": "psum"})
+        lagd = lagd.assign(rn=lagd.rn + 1)
+        leadd = g[keys + ["rn", "sum_sales"]].rename(columns={"sum_sales": "nsum"})
+        leadd = leadd.assign(rn=leadd.rn - 1)
+        j = g.merge(lagd, on=keys + ["rn"]).merge(leadd, on=keys + ["rn"])
+        j = j[(j.d_year == 1999) & (j.avg_monthly_sales > 0)]
+        rel = np.abs(j.sum_sales - j.avg_monthly_sales) / j.avg_monthly_sales
+        j = j[rel > 0.1].copy()
+        j["_diff"] = j.sum_sales - j.avg_monthly_sales
+        cols = keys + ["d_year", "d_moy", "avg_monthly_sales", "sum_sales", "psum", "nsum"]
+        return j.sort_values(["_diff"] + tie).head(100)[cols].reset_index(drop=True)
+    if q in (53, 63):
+        st = t["store"]
+        key, per = ("i_manufact_id", "d_qoy") if q == 53 else ("i_manager_id", "d_moy")
+        m = ss.merge(dd[(dd.d_month_seq >= 1200) & (dd.d_month_seq <= 1211)][
+            ["d_date_sk", per]], left_on="ss_sold_date_sk", right_on="d_date_sk")
+        m = m.merge(st[["s_store_sk"]], left_on="ss_store_sk", right_on="s_store_sk")
+        m = m.merge(it, left_on="ss_item_sk", right_on="i_item_sk")
+        sel = ((m.i_category.isin(["Books", "Children", "Electronics"])
+                & m.i_class.isin(["class#1", "class#2", "class#3"]))
+               | (m.i_category.isin(["Women", "Music", "Men"])
+                  & m.i_class.isin(["class#4", "class#5", "class#6"])))
+        m = m[sel]
+        g = m.groupby([key, per], as_index=False).agg(sum_sales=("ss_sales_price", "sum"))
+        g["avg_s"] = g.groupby(key)["sum_sales"].transform("mean")
+        g = g[np.where(g.avg_s > 0, np.abs(g.sum_sales - g.avg_s) / g.avg_s, np.nan) > 0.1]
+        out = g[[key, "sum_sales", "avg_s"]]
+        order = (["avg_s", "sum_sales", key] if q == 53 else [key, "avg_s", "sum_sales"])
+        return out.sort_values(order).head(100).reset_index(drop=True)
+    if q == 89:
+        st = t["store"]
+        m = ss.merge(dd[dd.d_year == 1999][["d_date_sk", "d_moy"]],
+                     left_on="ss_sold_date_sk", right_on="d_date_sk")
+        m = m.merge(st[["s_store_sk", "s_store_name", "s_company_name"]],
+                    left_on="ss_store_sk", right_on="s_store_sk")
+        m = m.merge(it, left_on="ss_item_sk", right_on="i_item_sk")
+        sel = ((m.i_category.isin(["Books", "Electronics", "Sports"])
+                & m.i_class.isin(["class#1", "class#2", "class#3"]))
+               | (m.i_category.isin(["Men", "Jewelry", "Women"])
+                  & m.i_class.isin(["class#4", "class#5", "class#6"])))
+        m = m[sel]
+        keys = ["i_category", "i_class", "i_brand", "s_store_name", "s_company_name"]
+        g = m.groupby(keys + ["d_moy"], as_index=False).agg(sum_sales=("ss_sales_price", "sum"))
+        # the window partition deliberately OMITS i_class (official shape):
+        # a brand's average spans its classes
+        g["avg_monthly_sales"] = g.groupby(
+            ["i_category", "i_brand", "s_store_name", "s_company_name"]
+        )["sum_sales"].transform("mean")
+        g = g[np.where(g.avg_monthly_sales != 0,
+                       np.abs(g.sum_sales - g.avg_monthly_sales) / g.avg_monthly_sales,
+                       np.nan) > 0.1].copy()
+        g["_diff"] = g.sum_sales - g.avg_monthly_sales
+        out = g.sort_values(["_diff", "s_store_name", "i_category", "i_class",
+                             "i_brand", "s_company_name", "d_moy"]).head(100)
+        return out[["i_category", "i_class", "i_brand", "s_store_name",
+                    "s_company_name", "d_moy", "sum_sales",
+                    "avg_monthly_sales"]].reset_index(drop=True)
+    if q == 59:
+        st = t["store"]
+        m = ss.merge(dd[["d_date_sk", "d_week_seq", "d_day_name"]],
+                     left_on="ss_sold_date_sk", right_on="d_date_sk")
+        days = ["Sunday", "Monday", "Tuesday", "Wednesday", "Thursday", "Friday", "Saturday"]
+        dcols = ["sun", "mon", "tue", "wed", "thu", "fri", "sat"]
+        for day, c in zip(days, dcols):
+            m[c] = np.where(m.d_day_name == day, m.ss_sales_price, np.nan)
+        wss = m.groupby(["d_week_seq", "ss_store_sk"], as_index=False)[dcols].sum(min_count=1)
+
+        def leg(lo, hi):
+            weeks = dd[(dd.d_month_seq >= lo) & (dd.d_month_seq <= hi)][["d_week_seq"]]
+            x = wss.merge(weeks, on="d_week_seq")  # replicated per matching day, like the SQL
+            return x.merge(st[["s_store_sk", "s_store_name", "s_store_id"]],
+                           left_on="ss_store_sk", right_on="s_store_sk")
+
+        y = leg(1188, 1199).copy()
+        x2 = leg(1200, 1211).copy()
+        x2["wk_minus_52"] = x2.d_week_seq - 52
+        j = y.merge(x2, left_on=["s_store_id", "d_week_seq"],
+                    right_on=["s_store_id", "wk_minus_52"], suffixes=("_1", "_2"))
+        out = pd.DataFrame({
+            "s_store_name1": j.s_store_name_1, "s_store_id1": j.s_store_id,
+            "d_week_seq1": j.d_week_seq_1,
+            **{f"r_{c}": j[f"{c}_1"] / j[f"{c}_2"] for c in dcols}})
+        return out.sort_values(["s_store_name1", "s_store_id1", "d_week_seq1"]
+                               ).head(100).reset_index(drop=True)
+    if q == 67:
+        st = t["store"]
+        m = ss.merge(dd[(dd.d_month_seq >= 1200) & (dd.d_month_seq <= 1211)][
+            ["d_date_sk", "d_year", "d_qoy", "d_moy"]],
+            left_on="ss_sold_date_sk", right_on="d_date_sk")
+        m = m.merge(st[["s_store_sk", "s_store_id"]], left_on="ss_store_sk",
+                    right_on="s_store_sk")
+        m = m.merge(it[["i_item_sk", "i_category", "i_class", "i_brand", "i_product_name"]],
+                    left_on="ss_item_sk", right_on="i_item_sk")
+        m["val"] = (m.ss_sales_price * m.ss_quantity).fillna(0)
+        cols = ["i_category", "i_class", "i_brand", "i_product_name", "d_year",
+                "d_qoy", "d_moy", "s_store_id"]
+        frames = []
+        for k in range(8, -1, -1):
+            keys = cols[:k]
+            if keys:
+                g = m.groupby(keys, as_index=False)["val"].sum()
+            else:
+                g = pd.DataFrame({"val": [m.val.sum()]})
+            for c in cols[k:]:
+                g[c] = None
+            frames.append(g[cols + ["val"]])
+        outp = pd.concat(frames, ignore_index=True).rename(columns={"val": "sumsales"})
+        outp["rk"] = outp.groupby(outp.i_category.fillna("\x00null"))["sumsales"].rank(
+            method="min", ascending=False).astype(int)
+        outp = outp[outp.rk <= 100]
+        return outp.sort_values(cols + ["sumsales", "rk"], na_position="last"
+                                ).head(100).reset_index(drop=True)
+    if q == 70:
+        st = t["store"]
+        m = ss.merge(dd[(dd.d_month_seq >= 1200) & (dd.d_month_seq <= 1211)][["d_date_sk"]],
+                     left_on="ss_sold_date_sk", right_on="d_date_sk")
+        m = m.merge(st[["s_store_sk", "s_state", "s_county"]],
+                    left_on="ss_store_sk", right_on="s_store_sk")
+        # inner ranking partitions by its own group key, so every state ranks 1
+        rows = []
+        for (stt, cty), g in m.groupby(["s_state", "s_county"]):
+            rows.append((g.ss_net_profit.sum(), stt, cty, 0))
+        for stt, g in m.groupby("s_state"):
+            rows.append((g.ss_net_profit.sum(), stt, None, 1))
+        rows.append((m.ss_net_profit.sum(), None, None, 2))
+        out = pd.DataFrame(rows, columns=["total_sum", "s_state", "s_county", "lochierarchy"])
+        out["rank_within_parent"] = out.groupby("lochierarchy")["total_sum"].rank(
+            method="min", ascending=False).astype(int)
+        out = out.sort_values(["lochierarchy", "s_state", "s_county"],
+                              ascending=[False, True, True], na_position="last")
+        return out[["total_sum", "s_state", "s_county", "lochierarchy",
+                    "rank_within_parent"]].head(100).reset_index(drop=True)
+    if q == 71:
+        td = t["time_dim"]
+        frames = []
+        for fact, pfx in ((t["web_sales"], "ws"), (t["catalog_sales"], "cs"), (ss, "ss")):
+            mm = fact.merge(dd[(dd.d_moy == 11) & (dd.d_year == 1999)][["d_date_sk"]],
+                            left_on=f"{pfx}_sold_date_sk", right_on="d_date_sk")
+            frames.append(pd.DataFrame({
+                "ext_price": mm[f"{pfx}_ext_sales_price"],
+                "sold_item_sk": mm[f"{pfx}_item_sk"],
+                "time_sk": mm[f"{pfx}_sold_time_sk"]}))
+        u = pd.concat(frames, ignore_index=True)
+        u = u.merge(it[it.i_manager_id == 1][["i_item_sk", "i_brand_id", "i_brand"]],
+                    left_on="sold_item_sk", right_on="i_item_sk")
+        u = u.merge(td[td.t_meal_time.isin(["breakfast", "dinner"])][
+            ["t_time_sk", "t_hour", "t_minute"]], left_on="time_sk", right_on="t_time_sk")
+        g = u.groupby(["i_brand", "i_brand_id", "t_hour", "t_minute"], as_index=False).agg(
+            ext_price=("ext_price", "sum"))
+        out = g[["i_brand_id", "i_brand", "t_hour", "t_minute", "ext_price"]]
+        return out.sort_values(["ext_price", "i_brand_id", "t_hour", "t_minute"],
+                               ascending=[False, True, True, True]).reset_index(drop=True)
     raise ValueError(f"no oracle for q{q}")
 
 

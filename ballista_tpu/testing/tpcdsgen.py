@@ -33,6 +33,14 @@ BUY_POTENTIAL = [">10000", "5001-10000", "1001-5000", "501-1000", "0-500", "Unkn
 EDUCATION = ["Primary", "Secondary", "College", "2 yr Degree", "4 yr Degree",
              "Advanced Degree", "Unknown"]
 DAY_NAMES = ["Sunday", "Monday", "Tuesday", "Wednesday", "Thursday", "Friday", "Saturday"]
+ITEM_COLORS = ["maroon", "burnished", "dim", "frosted", "papaya", "peach",
+               "orchid", "pale", "metallic", "lace", "chiffon", "smoke"]
+ITEM_SIZES = ["small", "medium", "large", "extra large", "petite", "N/A"]
+ITEM_UNITS = ["Ounce", "Oz", "Bunch", "Ton", "Each", "Pound", "Pallet",
+              "Gross", "Cup", "Dram", "Bundle"]
+CREDIT_RATINGS = ["Low Risk", "Good", "High Risk", "Unknown"]
+STREET_TYPES = ["Street", "Ave", "Blvd", "Court", "Drive", "Lane", "Parkway", "Way"]
+LOCATION_TYPES = ["apartment", "condo", "single family"]
 
 
 def generate_tpcds(out_dir: str, scale: float = 1.0, seed: int = 17,
@@ -51,6 +59,8 @@ def generate_tpcds(out_dir: str, scale: float = 1.0, seed: int = 17,
     start = dt.date(1998, 1, 1)
     days = (dt.date(2002, 12, 31) - start).days + 1
     dates = [start + dt.timedelta(days=i) for i in range(days)]
+    # week_seq/month_seq: sequential like the spec (absolute origin arbitrary
+    # but stable — queries only use differences and +/- offsets)
     date_dim = pa.table({
         "d_date_sk": pa.array(range(2450815, 2450815 + days), pa.int64()),
         "d_date": pa.array(dates, pa.date32()),
@@ -60,14 +70,25 @@ def generate_tpcds(out_dir: str, scale: float = 1.0, seed: int = 17,
         "d_qoy": pa.array([(d.month - 1) // 3 + 1 for d in dates], pa.int64()),
         "d_dow": pa.array([d.isoweekday() % 7 for d in dates], pa.int64()),  # 0=Sunday
         "d_day_name": pa.array([DAY_NAMES[d.isoweekday() % 7] for d in dates]),
+        "d_quarter_name": pa.array([f"{d.year}Q{(d.month - 1) // 3 + 1}" for d in dates]),
+        "d_week_seq": pa.array(
+            [5270 + ((d - start).days + start.isoweekday() % 7) // 7 for d in dates],
+            pa.int64()),
+        "d_month_seq": pa.array(
+            [1176 + (d.year - 1998) * 12 + d.month - 1 for d in dates], pa.int64()),
     })
 
     # ---- time_dim --------------------------------------------------------
     secs = np.arange(0, 86400, 60)  # minute granularity keeps it small
+    hours = secs // 3600
+    meal = np.where(
+        (hours >= 6) & (hours <= 8), "breakfast",
+        np.where((hours >= 17) & (hours <= 20), "dinner", ""))
     time_dim = pa.table({
         "t_time_sk": pa.array(secs, pa.int64()),
-        "t_hour": pa.array(secs // 3600, pa.int64()),
+        "t_hour": pa.array(hours, pa.int64()),
         "t_minute": pa.array((secs % 3600) // 60, pa.int64()),
+        "t_meal_time": pa.array(meal),
     })
 
     # ---- item ------------------------------------------------------------
@@ -88,6 +109,15 @@ def generate_tpcds(out_dir: str, scale: float = 1.0, seed: int = 17,
         "i_manager_id": pa.array(rng.integers(1, 100, n_items), pa.int64()),
         "i_current_price": pa.array(np.round(rng.uniform(0.5, 300, n_items), 2)),
         "i_wholesale_cost": pa.array(np.round(rng.uniform(0.5, 100, n_items), 2)),
+        # attribute columns (separate stream keeps prior draws stable)
+        **(lambda r: {
+            "i_product_name": pa.array([f"product#{i}" for i in range(1, n_items + 1)]),
+            "i_manufact": pa.array([f"manufact#{m}" for m in r.integers(1, 100, n_items)]),
+            "i_color": pa.array(r.choice(ITEM_COLORS, n_items)),
+            "i_size": pa.array(r.choice(ITEM_SIZES, n_items)),
+            "i_units": pa.array(r.choice(ITEM_UNITS, n_items)),
+            "i_container": pa.array(["Unknown"] * n_items),
+        })(np.random.default_rng(seed + 11)),
     })
 
     # ---- store -----------------------------------------------------------
@@ -104,6 +134,12 @@ def generate_tpcds(out_dir: str, scale: float = 1.0, seed: int = 17,
         "s_state": pa.array([STATES[i % len(STATES)] for i in range(n_stores)]),
         "s_zip": pa.array([f"{z:05d}" for z in rng.integers(10000, 99999, n_stores)]),
         "s_gmt_offset": pa.array([[-5.0, -6.0, -7.0, -8.0][i % 4] for i in range(n_stores)]),
+        "s_company_id": pa.array([1] * n_stores, pa.int64()),
+        "s_company_name": pa.array(["Unknown"] * n_stores),
+        "s_street_number": pa.array([str(100 + i) for i in range(n_stores)]),
+        "s_street_name": pa.array([f"Commerce {i}" for i in range(n_stores)]),
+        "s_street_type": pa.array([STREET_TYPES[i % len(STREET_TYPES)] for i in range(n_stores)]),
+        "s_suite_number": pa.array([f"Suite {i}" for i in range(n_stores)]),
     })
 
     # ---- demographics ----------------------------------------------------
@@ -113,16 +149,23 @@ def generate_tpcds(out_dir: str, scale: float = 1.0, seed: int = 17,
         "cd_gender": pa.array(np.where(cd_idx % 2 == 0, "M", "F")),
         "cd_marital_status": pa.array([["M", "S", "D", "W", "U"][i % 5] for i in cd_idx]),
         "cd_education_status": pa.array([EDUCATION[i % len(EDUCATION)] for i in cd_idx]),
+        "cd_purchase_estimate": pa.array((cd_idx % 20 + 1) * 500, pa.int64()),
+        "cd_credit_rating": pa.array([CREDIT_RATINGS[i % len(CREDIT_RATINGS)] for i in cd_idx]),
+        "cd_dep_count": pa.array(cd_idx % 7, pa.int64()),
+        "cd_dep_employed_count": pa.array(cd_idx % 5, pa.int64()),
+        "cd_dep_college_count": pa.array(cd_idx % 4, pa.int64()),
     })
     hd_idx = np.arange(n_hd)
     household_demographics = pa.table({
         "hd_demo_sk": pa.array(hd_idx + 1, pa.int64()),
+        "hd_income_band_sk": pa.array(hd_idx % 20 + 1, pa.int64()),
         "hd_buy_potential": pa.array([BUY_POTENTIAL[i % len(BUY_POTENTIAL)] for i in hd_idx]),
         "hd_dep_count": pa.array(hd_idx % 10, pa.int64()),
         "hd_vehicle_count": pa.array(hd_idx % 5, pa.int64()),
     })
 
     # ---- customer_address / customer ------------------------------------
+    _ra = np.random.default_rng(seed + 12)
     customer_address = pa.table({
         "ca_address_sk": pa.array(range(1, n_addresses + 1), pa.int64()),
         "ca_city": pa.array(rng.choice(CITIES, n_addresses)),
@@ -131,6 +174,13 @@ def generate_tpcds(out_dir: str, scale: float = 1.0, seed: int = 17,
         "ca_zip": pa.array([f"{z:05d}" for z in rng.integers(10000, 99999, n_addresses)]),
         "ca_country": pa.array(["United States"] * n_addresses),
         "ca_gmt_offset": pa.array(rng.choice([-5.0, -6.0, -7.0, -8.0], n_addresses)),
+        "ca_street_number": pa.array([str(x) for x in _ra.integers(1, 1000, n_addresses)]),
+        "ca_street_name": pa.array([f"{a} {b}" for a, b in zip(
+            _ra.choice(["Oak", "Main", "Elm", "Pine", "Maple"], n_addresses),
+            _ra.choice(["Hill", "Ridge", "Park", "View", "Creek"], n_addresses))]),
+        "ca_street_type": pa.array(_ra.choice(STREET_TYPES, n_addresses)),
+        "ca_suite_number": pa.array([f"Suite {x}" for x in _ra.integers(0, 100, n_addresses)]),
+        "ca_location_type": pa.array(_ra.choice(LOCATION_TYPES, n_addresses)),
     })
     customer = pa.table({
         "c_customer_sk": pa.array(range(1, n_customers + 1), pa.int64()),
@@ -144,13 +194,25 @@ def generate_tpcds(out_dir: str, scale: float = 1.0, seed: int = 17,
         "c_current_cdemo_sk": pa.array(rng.integers(1, n_cd + 1, n_customers), pa.int64()),
         "c_current_hdemo_sk": pa.array(rng.integers(1, n_hd + 1, n_customers), pa.int64()),
         "c_birth_country": pa.array(["UNITED STATES"] * n_customers),
+        **(lambda r: {
+            "c_birth_day": pa.array(r.integers(1, 29, n_customers), pa.int64()),
+            "c_birth_month": pa.array(r.integers(1, 13, n_customers), pa.int64()),
+            "c_birth_year": pa.array(r.integers(1930, 1993, n_customers), pa.int64()),
+            "c_email_address": pa.array(
+                [f"c{i}@example.com" for i in range(1, n_customers + 1)]),
+            "c_login": pa.array([f"login{i}" for i in range(1, n_customers + 1)]),
+        })(np.random.default_rng(seed + 13)),
     })
 
     # ---- promotion -------------------------------------------------------
     promotion = pa.table({
         "p_promo_sk": pa.array(range(1, n_promos + 1), pa.int64()),
+        "p_promo_id": pa.array([f"AAAAAAAA{i:08d}" for i in range(1, n_promos + 1)]),
+        "p_promo_name": pa.array([f"promo {i}" for i in range(1, n_promos + 1)]),
         "p_channel_email": pa.array(["N" if i % 3 else "Y" for i in range(n_promos)]),
         "p_channel_event": pa.array(["N" if i % 2 else "Y" for i in range(n_promos)]),
+        "p_channel_tv": pa.array(["N" if i % 4 else "Y" for i in range(n_promos)]),
+        "p_channel_dmail": pa.array(["N" if (i + 1) % 3 else "Y" for i in range(n_promos)]),
     })
 
     # ---- store_sales (the fact table) -----------------------------------
@@ -212,6 +274,7 @@ def generate_tpcds(out_dir: str, scale: float = 1.0, seed: int = 17,
         "w_city": pa.array(rng.choice(CITIES, n_wh)),
         "w_county": pa.array(rng.choice(COUNTIES, n_wh)),
         "w_state": pa.array(rng.choice(STATES, n_wh)),
+        "w_country": pa.array(["United States"] * n_wh),
     })
     sm_types = ["EXPRESS", "OVERNIGHT", "REGULAR", "TWO DAY", "LIBRARY"]
     ship_mode = pa.table({
@@ -222,9 +285,16 @@ def generate_tpcds(out_dir: str, scale: float = 1.0, seed: int = 17,
     })
     call_center = pa.table({
         "cc_call_center_sk": pa.array(range(1, 5), pa.int64()),
+        "cc_call_center_id": pa.array([f"AAAAAAAA{i:04d}BAAA" for i in range(1, 5)]),
         "cc_name": pa.array([f"call center {i}" for i in range(1, 5)]),
         "cc_county": pa.array(rng.choice(COUNTIES, 4)),
         "cc_manager": pa.array([f"Manager{i}" for i in range(1, 5)]),
+    })
+    web_site = pa.table({
+        "web_site_sk": pa.array(range(1, 7), pa.int64()),
+        "web_site_id": pa.array([f"AAAAAAAA{i:04d}CAAA" for i in range(1, 7)]),
+        "web_name": pa.array([f"site_{i}" for i in range(6)]),
+        "web_company_name": pa.array([["pri", "sec", "third"][i % 3] for i in range(6)]),
     })
     web_page = pa.table({
         "wp_web_page_sk": pa.array(range(1, 41), pa.int64()),
@@ -241,12 +311,21 @@ def generate_tpcds(out_dir: str, scale: float = 1.0, seed: int = 17,
     })
 
     # ---- inventory -------------------------------------------------------
-    inv_rows = max(int(20_000 * scale), 2_000)
+    # weekly snapshots per (warehouse, item) like the spec — the stddev/mean
+    # queries (q21/q22/q39) need several observations per (item, wh, month),
+    # which random-sparse rows never give
+    n_inv_items = min(max(int(200 * scale), 40), n_items)
+    week_starts = np.arange(0, days, 7)
+    inv_items = np.arange(1, n_inv_items + 1)
+    grid_wh, grid_item, grid_week = np.meshgrid(
+        np.arange(1, n_wh + 1), inv_items, week_starts, indexing="ij")
+    _ri = np.random.default_rng(seed + 14)
     inventory = pa.table({
-        "inv_date_sk": pa.array(rng.integers(2450815, 2450815 + days, inv_rows), pa.int64()),
-        "inv_item_sk": pa.array(rng.integers(1, n_items + 1, inv_rows), pa.int64()),
-        "inv_warehouse_sk": pa.array(rng.integers(1, n_wh + 1, inv_rows), pa.int64()),
-        "inv_quantity_on_hand": pa.array(rng.integers(0, 1000, inv_rows), pa.int64()),
+        "inv_date_sk": pa.array(2450815 + grid_week.ravel(), pa.int64()),
+        "inv_item_sk": pa.array(grid_item.ravel(), pa.int64()),
+        "inv_warehouse_sk": pa.array(grid_wh.ravel(), pa.int64()),
+        "inv_quantity_on_hand": pa.array(
+            _ri.integers(0, 1000, grid_week.size), pa.int64()),
     })
 
     # ---- catalog_sales / web_sales (cross-channel queries) ---------------
@@ -284,15 +363,49 @@ def generate_tpcds(out_dir: str, scale: float = 1.0, seed: int = 17,
             f"{prefix}_net_paid": pa.array(np.round(ext - coupon, 2)),
             f"{prefix}_net_profit": pa.array(np.round(ext * r.uniform(-0.2, 0.4, rows), 2)),
         }
+        def _with_nulls(vals: np.ndarray, frac: float) -> pa.Array:
+            # sparse NULL foreign keys (the spec has them; q76-style queries
+            # count them, join queries must drop them consistently)
+            mask = r.random(rows) < frac
+            return pa.array(vals, pa.int64(), mask=mask)
+
         if prefix == "cs":
             cols["cs_call_center_sk"] = pa.array(r.integers(1, 5, rows), pa.int64())
+            cols["cs_ship_customer_sk"] = pa.array(
+                r.integers(1, n_customers + 1, rows), pa.int64())
+            cols["cs_ship_addr_sk"] = _with_nulls(
+                r.integers(1, n_addresses + 1, rows), 0.02)
         if prefix == "ws":
             cols["ws_web_page_sk"] = pa.array(r.integers(1, 41, rows), pa.int64())
             cols["ws_ship_hdemo_sk"] = pa.array(r.integers(1, n_hd + 1, rows), pa.int64())
+            cols["ws_web_site_sk"] = pa.array(r.integers(1, 7, rows), pa.int64())
+            cols["ws_ship_addr_sk"] = pa.array(r.integers(1, n_addresses + 1, rows), pa.int64())
+            cols["ws_ship_customer_sk"] = _with_nulls(
+                r.integers(1, n_customers + 1, rows), 0.02)
         return pa.table(cols)
 
     catalog_sales = channel_fact("cs", max(n_sales // 2, 500), 101)
     web_sales = channel_fact("ws", max(n_sales // 4, 500), 202)
+
+    # cross-channel correlation: a third of catalog/web purchases come from
+    # (customer, item) pairs seen in store_sales — without this, queries
+    # that chain store → returns → catalog (q17/q25/q29) or compare a
+    # customer's channels (q4/q11) join near-empty sets at test scales
+    def _correlate(fact: pa.Table, prefix: str, seed_off: int) -> pa.Table:
+        r = np.random.default_rng(seed + seed_off)
+        n = fact.num_rows
+        src = r.integers(0, n_sales, n)
+        take = r.random(n) < 0.33
+        cust = np.where(take, t_cust[tid][src], fact.column(f"{prefix}_bill_customer_sk").to_numpy())
+        item = np.where(take, store_sales.column("ss_item_sk").to_numpy()[src],
+                        fact.column(f"{prefix}_item_sk").to_numpy())
+        cols = {c: fact.column(c) for c in fact.column_names}
+        cols[f"{prefix}_bill_customer_sk"] = pa.array(cust, pa.int64())
+        cols[f"{prefix}_item_sk"] = pa.array(item, pa.int64())
+        return pa.table(cols)
+
+    catalog_sales = _correlate(catalog_sales, "cs", 15)
+    web_sales = _correlate(web_sales, "ws", 16)
 
     # ---- returns: seeded subsets of the sales facts ----------------------
     def returns_of(sales: pa.Table, prefix: str, src_prefix: str, frac: float,
@@ -321,16 +434,20 @@ def generate_tpcds(out_dir: str, scale: float = 1.0, seed: int = 17,
 
     store_returns = returns_of(store_sales, "sr", "ss", 0.10, 303, {
         "sr_customer_sk": "ss_customer_sk", "sr_ticket_number": "ss_ticket_number",
-        "sr_store_sk": "ss_store_sk",
+        "sr_store_sk": "ss_store_sk", "sr_cdemo_sk": "ss_cdemo_sk",
     })
-    catalog_returns = returns_of(catalog_sales, "cr", "cs", 0.08, 404, {
+    catalog_returns = returns_of(catalog_sales, "cr", "cs", 0.20, 404, {
         "cr_order_number": "cs_order_number",
         "cr_returning_customer_sk": "cs_bill_customer_sk",
+        "cr_returning_addr_sk": "cs_bill_addr_sk",
         "cr_call_center_sk": "cs_call_center_sk",
     })
-    web_returns = returns_of(web_sales, "wr", "ws", 0.08, 505, {
+    web_returns = returns_of(web_sales, "wr", "ws", 0.20, 505, {
         "wr_order_number": "ws_order_number",
         "wr_returning_customer_sk": "ws_bill_customer_sk",
+        "wr_returning_cdemo_sk": "ws_bill_cdemo_sk",
+        "wr_refunded_cdemo_sk": "ws_bill_cdemo_sk",
+        "wr_refunded_addr_sk": "ws_bill_addr_sk",
         "wr_web_page_sk": "ws_web_page_sk",
     })
 
@@ -345,6 +462,7 @@ def generate_tpcds(out_dir: str, scale: float = 1.0, seed: int = 17,
         "web_returns": web_returns, "inventory": inventory,
         "warehouse": warehouse, "ship_mode": ship_mode, "call_center": call_center,
         "web_page": web_page, "reason": reason, "income_band": income_band,
+        "web_site": web_site,
     }
     for name, tbl in tables.items():
         d = os.path.join(out_dir, name)
@@ -361,7 +479,7 @@ TPCDS_TABLES = [
     "customer_demographics", "household_demographics", "promotion", "store_sales",
     "catalog_sales", "web_sales", "store_returns", "catalog_returns",
     "web_returns", "inventory", "warehouse", "ship_mode", "call_center",
-    "web_page", "reason", "income_band",
+    "web_page", "reason", "income_band", "web_site",
 ]
 
 

@@ -1,9 +1,9 @@
 """Variance/stddev aggregate family (reference analog: DataFusion's
 VarianceAccumulator feeding Ballista's two-phase distributed aggregation).
 
-The planner decomposes var/stddev into sum / sum-of-squares / count
-partials, so the distributed two-phase path and the TPU device path both
-handle them with the machinery they already have.
+The planner decomposes var/stddev into Welford (count, mean, M2) partials
+merged with the mean-centered formula — NOT naive sum-of-squares, which
+catastrophically cancels (see test_variance_large_magnitude_stability).
 """
 
 import numpy as np
