@@ -69,7 +69,11 @@ def _key_np(arr: pa.Array, target: pa.DataType):
         arr = arr.cast(target, safe=False)
     null = np.asarray(pc.is_null(arr)) if arr.null_count else None
     t = arr.type
-    if pa.types.is_integer(t) or pa.types.is_date(t) or pa.types.is_boolean(t):
+    if pa.types.is_date(t) or pa.types.is_timestamp(t):
+        # pyarrow has no direct date32→int64 cast; hop through the storage int
+        arr = arr.cast(pa.int32() if pa.types.is_date32(t) else pa.int64(), safe=False)
+        t = arr.type
+    if pa.types.is_integer(t) or pa.types.is_boolean(t):
         filled = pc.fill_null(arr, False if pa.types.is_boolean(t) else 0) if arr.null_count else arr
         return filled.cast(pa.int64(), safe=False).to_numpy(zero_copy_only=False), null
     if pa.types.is_floating(t):

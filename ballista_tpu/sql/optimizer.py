@@ -34,6 +34,8 @@ from ballista_tpu.plan.expressions import (
     Expr,
     InList,
     InSubquery,
+    IsNotNull,
+    IsNull,
     Literal,
     Negative,
     Not,
@@ -252,15 +254,62 @@ class Decorrelator:
             for sq in subs:
                 outer, repl = self._plan_scalar(outer, self.run(sq.plan))
                 new_conj = _replace_node(new_conj, sq, repl)
-            # IN (subquery) nested under OR/NOT (not a top-level conjunct,
-            # so the semi-join lowering can't apply): an UNCORRELATED
-            # subquery evaluates EAGERLY at planning time and inlines as a
-            # literal IN list (q45's `zip IN (...) OR item_id IN (subq)`)
+            # EXISTS nested under OR/NOT (not a top-level conjunct, so the
+            # semi/anti-join lowering can't consume it) → MARK join: LEFT
+            # JOIN a deduped projection of the correlation keys and replace
+            # the EXISTS with a match-marker null test (the reference gets
+            # this from DataFusion's mark-join decorrelation)
+            for ex in _collect_exists(new_conj):
+                outer, repl = self._plan_mark_exists(outer, ex)
+                new_conj = _replace_node(new_conj, ex, repl)
+            # IN (subquery) nested under OR/NOT: an UNCORRELATED subquery
+            # evaluates EAGERLY at planning time and inlines as a literal
+            # IN list (q45's `zip IN (...) OR item_id IN (subq)`); a
+            # correlated one takes the mark-join path like EXISTS
             for isq in _collect_in_subqueries(new_conj):
-                values = _eval_uncorrelated_column(self.run(isq.plan))
-                new_conj = _replace_node(new_conj, isq, InList(isq.expr, tuple(values), isq.negated))
+                sub = self.run(isq.plan)
+                # exists=True drops projections above the correlated filter so
+                # correlation keys keep their qualified below-projection form;
+                # the IN value is the projection's first expr, inlined
+                keys, residual, sub2 = self._extract_correlation(
+                    sub, outer.schema, exists=True)
+                if not keys and residual is None:
+                    values = _eval_uncorrelated_column(sub)
+                    new_conj = _replace_node(
+                        new_conj, isq, InList(isq.expr, tuple(values), isq.negated))
+                else:
+                    keys = [(isq.expr, _first_output_expr(sub))] + keys
+                    outer, repl = self._plan_mark(outer, sub2, keys, residual,
+                                                  negated=isq.negated)
+                    new_conj = _replace_node(new_conj, isq, repl)
             return outer, new_conj
         return outer, conj
+
+    def _plan_mark_exists(self, outer: LogicalPlan, ex: Exists):
+        sub = self.run(ex.plan)
+        keys, residual, sub2 = self._extract_correlation(sub, outer.schema, exists=True)
+        if not keys and residual is None:
+            raise PlanningError("uncorrelated EXISTS not supported")
+        return self._plan_mark(outer, sub2, keys, residual, negated=ex.negated)
+
+    def _plan_mark(self, outer: LogicalPlan, sub2: LogicalPlan, keys, residual,
+                   negated: bool):
+        """LEFT JOIN `outer` against the deduped correlation keys of `sub2`;
+        the join's key columns double as the match marker. NULL-semantics
+        caveat (same as the NOT IN inline path): a NULL probe key yields
+        false where SQL says NULL — indistinguishable under WHERE unless
+        wrapped in NOT."""
+        if residual is not None:
+            raise PlanningError(
+                "correlated subquery under OR with non-equi correlation is unsupported")
+        self.counter += 1
+        alias = f"__mark{self.counter}"
+        proj = Projection(sub2, [Alias(ik, f"__mk{i}") for i, (_, ik) in enumerate(keys)])
+        build = SubqueryAlias(Distinct(proj), alias)
+        join_on = [(ok, Column(f"__mk{i}", alias)) for i, (ok, _) in enumerate(keys)]
+        new_outer = Join(outer, build, join_on, "left", None)
+        mark = Column("__mk0", alias)
+        return new_outer, (IsNull(mark) if negated else IsNotNull(mark))
 
     # ------------------------------------------------------------------
 
@@ -313,6 +362,16 @@ class Decorrelator:
         # locate [Projection] -> Aggregate -> [Filter] -> input
         proj, agg, below = _find_agg_pattern(sub)
         if agg is None:
+            if not _plan_references_outer(sub, outer.schema):
+                # uncorrelated non-aggregate subquery (e.g. SELECT col FROM
+                # cte_that_aggregates): evaluate eagerly like the inline IN
+                # path — this is also where SQL's one-row contract is
+                # enforced (on ROWS, not distinct values) instead of
+                # silently multiplying outer rows
+                vals = _eval_uncorrelated_column(
+                    sub, dedup=False, max_values=1, what="scalar subquery",
+                    overflow_hint=" (SQL allows at most one row)")
+                return outer, Literal(vals[0] if vals else None)
             raise PlanningError(f"scalar subquery must aggregate:\n{sub.display()}")
         corr_keys: list[tuple[Expr, Expr]] = []
         new_below = below
@@ -372,55 +431,82 @@ def _has_subquery(e: Expr) -> bool:
     return expr_any(e, lambda x: isinstance(x, (ScalarSubquery, InSubquery, Exists)))
 
 
-def _collect_scalar_subqueries(e: Expr, out: list | None = None) -> list:
+def _collect_nodes(e: Expr, cls, out: list | None = None) -> list:
     if out is None:
         out = []
-    if isinstance(e, ScalarSubquery):
+    if isinstance(e, cls):
         out.append(e)
     for c in e.children():
-        _collect_scalar_subqueries(c, out)
+        _collect_nodes(c, cls, out)
     return out
 
 
-def _collect_in_subqueries(e: Expr, out: list | None = None) -> list:
-    if out is None:
-        out = []
-    if isinstance(e, InSubquery):
-        out.append(e)
-    for c in e.children():
-        _collect_in_subqueries(c, out)
-    return out
+def _collect_scalar_subqueries(e: Expr) -> list:
+    return _collect_nodes(e, ScalarSubquery)
+
+
+def _collect_in_subqueries(e: Expr) -> list:
+    return _collect_nodes(e, InSubquery)
+
+
+def _first_output_expr(sub: LogicalPlan) -> Expr:
+    """First output column of `sub` as an expression over the schema that
+    remains after _extract_correlation(exists=True) drops the top
+    Projection/Distinct wrappers."""
+    p = sub
+    while isinstance(p, (SubqueryAlias, Distinct)):
+        p = p.children()[0]
+    if isinstance(p, Projection):
+        e = p.exprs[0]
+        return e.expr if isinstance(e, Alias) else e
+    f0 = p.schema.field(0)
+    return Column(f0.name, f0.qualifier)
+
+
+def _collect_exists(e: Expr) -> list:
+    return _collect_nodes(e, Exists)
 
 
 _EAGER_IN_MAX_VALUES = 10_000
 
 
-def _eval_uncorrelated_column(sub: LogicalPlan) -> list:
+def _eval_uncorrelated_column(
+    sub: LogicalPlan,
+    dedup: bool = True,
+    max_values: int = _EAGER_IN_MAX_VALUES,
+    what: str = "IN subquery inside a disjunction",
+    overflow_hint: str = "; rewrite as a join",
+) -> list:
     """Execute an uncorrelated subplan locally and return its first column's
-    values. A correlated subplan fails binding (its outer columns don't
-    resolve) and surfaces as a clean planning error."""
+    values (deduped + null-stripped for IN lists; raw rows for scalar
+    callers, whose one-row contract counts rows, not distinct values). A
+    correlated subplan fails binding (its outer columns don't resolve) and
+    surfaces as a clean planning error."""
     from ballista_tpu.engine.physical_planner import PhysicalPlanner
     from ballista_tpu.plan.physical import TaskContext
 
     try:
-        phys = PhysicalPlanner().plan(sub)
+        # run the full rewrite pipeline on the subplan: it was extracted from
+        # an expression, so the plan-tree passes (join extraction, pushdown)
+        # never saw it — planning it raw would execute comma-joins as
+        # cartesian products
+        phys = PhysicalPlanner().plan(optimize(sub))
         ctx = TaskContext()
         vals: list = []
         for p in range(phys.output_partition_count()):
             for b in phys.execute(p, ctx):
                 vals.extend(b.column(0).to_pylist())
-                if len(vals) > _EAGER_IN_MAX_VALUES:
+                if len(vals) > max_values:
                     raise PlanningError(
-                        f"IN subquery inside a disjunction yielded more than "
-                        f"{_EAGER_IN_MAX_VALUES} values; rewrite as a join"
-                    )
+                        f"{what} yielded more than {max_values} "
+                        f"value(s){overflow_hint}")
+        if not dedup:
+            return vals
         return sorted({v for v in vals if v is not None})
     except PlanningError:
         raise
     except Exception as e:  # noqa: BLE001
-        raise PlanningError(
-            f"cannot evaluate IN subquery inside a disjunction (correlated?): {e}"
-        ) from None
+        raise PlanningError(f"cannot evaluate {what} (correlated?): {e}") from None
 
 
 def _replace_node(e: Expr, target: Expr, repl: Expr) -> Expr:
@@ -435,6 +521,30 @@ def _replace_node(e: Expr, target: Expr, repl: Expr) -> Expr:
 def _references_outer(e: Expr, inner_schema) -> bool:
     cols = collect_columns(e)
     return any(inner_schema.maybe_index_of(c.name, c.qualifier) is None for c in cols)
+
+
+def _plan_references_outer(plan: LogicalPlan, outer_schema) -> bool:
+    """True if any Filter in `plan` references a column that does not
+    resolve against its own input but DOES resolve against the outer query
+    (a column resolving against neither is a plain unknown-column error and
+    must not be classified as correlation)."""
+    found = False
+
+    def walk(p: LogicalPlan):
+        nonlocal found
+        if found:
+            return
+        if isinstance(p, Filter):
+            for c in collect_columns(p.predicate):
+                if (p.input.schema.maybe_index_of(c.name, c.qualifier) is None
+                        and outer_schema.maybe_index_of(c.name, c.qualifier) is not None):
+                    found = True
+                    return
+        for ch in p.children():
+            walk(ch)
+
+    walk(plan)
+    return found
 
 
 def _corr_equi_pair(c: Expr, inner_schema, outer_schema):

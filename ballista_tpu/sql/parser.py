@@ -215,8 +215,10 @@ class Parser:
                 ctes.append((name.lower(), sub))
                 if not self.accept_punct(","):
                     break
-        stmt = self._parse_select_body()
-        stmt.ctes = ctes
+        stmt = self._parse_query_term()
+        # a parenthesized term may carry its own WITH clause — outer CTEs
+        # prepend (inner names shadow outer per SQL scoping)
+        stmt.ctes = ctes + list(stmt.ctes or [])
         # set operations: chain via nested set_op fields on the RHS so
         # a UNION ALL b UNION ALL c keeps all three branches (homogeneous
         # chains are associative; planner flattens them)
@@ -228,7 +230,7 @@ class Parser:
                 raise SqlParseError(f"{kw} ALL is unsupported (set semantics only)")
             # standard SQL: set-op branches take no bare ORDER BY/LIMIT —
             # trailing clauses bind to the whole chain
-            rhs = self._parse_select_body(allow_order=False)
+            rhs = self._parse_query_term(allow_order=False)
             op = {"UNION": "union_all" if all_ else "union",
                   "EXCEPT": "except", "INTERSECT": "intersect"}[kw]
             cur.set_op = (op, rhs)
@@ -239,6 +241,28 @@ class Parser:
         if self.peek().is_kw("LIMIT") and stmt.limit is None:
             stmt.limit, stmt.offset = self._parse_limit()
         return stmt
+
+    def _parse_query_term(self, allow_order: bool = True) -> SelectStmt:
+        """One operand of a set-operation chain: a SELECT body or a
+        parenthesized query expression `( query )` (q38/q87 shape).
+
+        A parenthesized operand that carries its own set-op chain, ORDER
+        BY/LIMIT, or WITH clause wraps into `SELECT * FROM (query)` — the
+        outer chain's left-associative splicing would otherwise regroup
+        non-associative EXCEPT/INTERSECT or misattach the inner clauses."""
+        if self.peek().kind == "punct" and self.peek().value == "(":
+            self.next()
+            sub = self.parse_query()
+            self.expect_punct(")")
+            if sub.set_op or sub.order_by or sub.limit is not None or sub.ctes:
+                self._wrap_counter = getattr(self, "_wrap_counter", 0) + 1
+                wrapped = SelectStmt()
+                wrapped.projections = [Column("*")]
+                wrapped.from_tables = [
+                    DerivedTable(sub, f"__setwrap{self._wrap_counter}")]
+                return wrapped
+            return sub
+        return self._parse_select_body(allow_order=allow_order)
 
     def _parse_select_body(self, allow_order: bool = True) -> SelectStmt:
         self.expect_kw("SELECT")

@@ -18,6 +18,25 @@ import pyarrow.parquet as pq
 from ballista_tpu.testing.tpcdsgen import TPCDS_TABLES
 
 
+def _rollup(m: pd.DataFrame, cols: list, valcol: str, how: str) -> pd.DataFrame:
+    """GROUP BY ROLLUP(cols): one frame per prefix level (full detail down
+    to grand total), grouped-out keys padded with None. Adds a
+    `lochierarchy` column (= number of grouped-out keys, the
+    grouping()-sum the rollup queries select)."""
+    frames = []
+    for k in range(len(cols), -1, -1):
+        keys = cols[:k]
+        if keys:
+            g = getattr(m.groupby(keys, as_index=False)[valcol], how)()
+        else:
+            g = pd.DataFrame({valcol: [getattr(m[valcol], how)()]})
+        for c in cols[k:]:
+            g[c] = None
+        g["lochierarchy"] = len(cols) - k
+        frames.append(g[cols + [valcol, "lochierarchy"]])
+    return pd.concat(frames, ignore_index=True)
+
+
 def load_tables(data_dir: str) -> dict[str, pd.DataFrame]:
     out = {}
     for t in TPCDS_TABLES:
@@ -474,8 +493,8 @@ def run_reference(q: int, t: dict[str, pd.DataFrame]) -> pd.DataFrame:
         m = m.merge(hd[(hd.hd_dep_count == 6) | (hd.hd_vehicle_count > 2)],
                     left_on="ss_hdemo_sk", right_on="hd_demo_sk")
         ms = m.groupby(["ss_ticket_number", "ss_customer_sk", "ss_addr_sk", "s_city"],
-                       as_index=False).agg(amt=("ss_coupon_amt", "sum"),
-                                           profit=("ss_net_profit", "sum"))
+                       as_index=False, dropna=False).agg(amt=("ss_coupon_amt", "sum"),
+                                                         profit=("ss_net_profit", "sum"))
         ms = ms.merge(cu, left_on="ss_customer_sk", right_on="c_customer_sk")
         ms["city30"] = ms.s_city.str[:30]
         out = ms[["c_last_name", "c_first_name", "city30", "ss_ticket_number",
@@ -651,18 +670,9 @@ def run_reference(q: int, t: dict[str, pd.DataFrame]) -> pd.DataFrame:
         m = inv.merge(dsel, left_on="inv_date_sk", right_on="d_date_sk")
         m = m.merge(it, left_on="inv_item_sk", right_on="i_item_sk")
         cols = ["i_product_name", "i_brand", "i_class", "i_category"]
-        frames = []
-        for k in range(4, -1, -1):
-            keys = cols[:k]
-            if keys:
-                g = m.groupby(keys, as_index=False)["inv_quantity_on_hand"].mean()
-            else:
-                g = pd.DataFrame({"inv_quantity_on_hand": [m.inv_quantity_on_hand.mean()]})
-            for c in cols[k:]:
-                g[c] = None
-            frames.append(g[cols + ["inv_quantity_on_hand"]])
-        out = pd.concat(frames, ignore_index=True).rename(
-            columns={"inv_quantity_on_hand": "qoh"})
+        out = _rollup(m, cols, "inv_quantity_on_hand", "mean").drop(
+            columns=["lochierarchy"]).rename(columns={"inv_quantity_on_hand": "qoh"})
+        out = out[cols + ["qoh"]]
         return out.sort_values(["qoh"] + cols, na_position="last").head(100).reset_index(drop=True)
     if q == 39:
         inv, wh = t["inventory"], t["warehouse"]
@@ -705,13 +715,8 @@ def run_reference(q: int, t: dict[str, pd.DataFrame]) -> pd.DataFrame:
         dsel = dd[(dd.d_month_seq >= 1200) & (dd.d_month_seq <= 1211)][["d_date_sk"]]
         m = ws.merge(dsel, left_on="ws_sold_date_sk", right_on="d_date_sk")
         m = m.merge(it, left_on="ws_item_sk", right_on="i_item_sk")
-        rows = []
-        for (cat, cls), g in m.groupby(["i_category", "i_class"]):
-            rows.append((g.ws_net_paid.sum(), cat, cls, 0))
-        for cat, g in m.groupby("i_category"):
-            rows.append((g.ws_net_paid.sum(), cat, None, 1))
-        rows.append((m.ws_net_paid.sum(), None, None, 2))
-        out = pd.DataFrame(rows, columns=["total_sum", "i_category", "i_class", "lochierarchy"])
+        out = _rollup(m, ["i_category", "i_class"], "ws_net_paid", "sum").rename(
+            columns={"ws_net_paid": "total_sum"})
         out["rank_within_parent"] = out.groupby("lochierarchy")["total_sum"].rank(
             method="min", ascending=False).astype(int)
         out = out.sort_values(["lochierarchy", "i_category", "i_class"],
@@ -860,17 +865,8 @@ def run_reference(q: int, t: dict[str, pd.DataFrame]) -> pd.DataFrame:
         m["val"] = (m.ss_sales_price * m.ss_quantity).fillna(0)
         cols = ["i_category", "i_class", "i_brand", "i_product_name", "d_year",
                 "d_qoy", "d_moy", "s_store_id"]
-        frames = []
-        for k in range(8, -1, -1):
-            keys = cols[:k]
-            if keys:
-                g = m.groupby(keys, as_index=False)["val"].sum()
-            else:
-                g = pd.DataFrame({"val": [m.val.sum()]})
-            for c in cols[k:]:
-                g[c] = None
-            frames.append(g[cols + ["val"]])
-        outp = pd.concat(frames, ignore_index=True).rename(columns={"val": "sumsales"})
+        outp = _rollup(m, cols, "val", "sum").drop(columns=["lochierarchy"]).rename(
+            columns={"val": "sumsales"})
         outp["rk"] = outp.groupby(outp.i_category.fillna("\x00null"))["sumsales"].rank(
             method="min", ascending=False).astype(int)
         outp = outp[outp.rk <= 100]
@@ -883,13 +879,8 @@ def run_reference(q: int, t: dict[str, pd.DataFrame]) -> pd.DataFrame:
         m = m.merge(st[["s_store_sk", "s_state", "s_county"]],
                     left_on="ss_store_sk", right_on="s_store_sk")
         # inner ranking partitions by its own group key, so every state ranks 1
-        rows = []
-        for (stt, cty), g in m.groupby(["s_state", "s_county"]):
-            rows.append((g.ss_net_profit.sum(), stt, cty, 0))
-        for stt, g in m.groupby("s_state"):
-            rows.append((g.ss_net_profit.sum(), stt, None, 1))
-        rows.append((m.ss_net_profit.sum(), None, None, 2))
-        out = pd.DataFrame(rows, columns=["total_sum", "s_state", "s_county", "lochierarchy"])
+        out = _rollup(m, ["s_state", "s_county"], "ss_net_profit", "sum").rename(
+            columns={"ss_net_profit": "total_sum"})
         out["rank_within_parent"] = out.groupby("lochierarchy")["total_sum"].rank(
             method="min", ascending=False).astype(int)
         out = out.sort_values(["lochierarchy", "s_state", "s_county"],
@@ -916,6 +907,182 @@ def run_reference(q: int, t: dict[str, pd.DataFrame]) -> pd.DataFrame:
         out = g[["i_brand_id", "i_brand", "t_hour", "t_minute", "ext_price"]]
         return out.sort_values(["ext_price", "i_brand_id", "t_hour", "t_minute"],
                                ascending=[False, True, True, True]).reset_index(drop=True)
+    if q == 8:
+        ca, cu, st = t["customer_address"], t["customer"], t["store"]
+        zips = {"24000", "24050", "24100", "24150", "24200", "24250", "24300",
+                "24350", "24400", "24450", "24500", "24550", "24010", "24060",
+                "24110", "24160", "24210", "24260", "24310", "24360", "24410",
+                "24460", "24510", "24560"}
+        s1 = set(ca.ca_zip.str[:5][ca.ca_zip.str[:5].isin(zips)])
+        pref = ca.merge(cu[cu.c_preferred_cust_flag == "Y"],
+                        left_on="ca_address_sk", right_on="c_current_addr_sk")
+        cnt = pref.groupby(pref.ca_zip.str[:5]).size()
+        sel = sorted(s1 & set(cnt[cnt > 10].index))
+        vz = pd.DataFrame({"ca_zip": sel})
+        vz["p2"] = vz.ca_zip.str[:2]
+        m = ss.merge(dd[(dd.d_qoy == 2) & (dd.d_year == 1998)][["d_date_sk"]],
+                     left_on="ss_sold_date_sk", right_on="d_date_sk")
+        m = m.merge(st[["s_store_sk", "s_store_name", "s_zip"]],
+                    left_on="ss_store_sk", right_on="s_store_sk")
+        m["p2"] = m.s_zip.str[:2]
+        m = m.merge(vz, on="p2")  # row multiplication per matching zip, like the SQL
+        g = m.groupby("s_store_name", as_index=False)["ss_net_profit"].sum()
+        return g.sort_values("s_store_name").head(100).reset_index(drop=True)
+    if q in (10, 35, 69):
+        cu, ca, cd = t["customer"], t["customer_address"], t["customer_demographics"]
+        if q == 10:
+            dfilt = (dd.d_year == 2002) & dd.d_moy.between(1, 4)
+        elif q == 35:
+            dfilt = (dd.d_year == 2002) & (dd.d_qoy < 4)
+        else:
+            dfilt = (dd.d_year == 2001) & dd.d_moy.between(4, 6)
+        dsel = dd[dfilt][["d_date_sk"]]
+
+        def bought(fact, dkey, ckey):
+            mm = fact.merge(dsel, left_on=dkey, right_on="d_date_sk")
+            return set(mm[ckey].dropna())
+
+        sset = bought(ss, "ss_sold_date_sk", "ss_customer_sk")
+        wset = bought(t["web_sales"], "ws_sold_date_sk", "ws_bill_customer_sk")
+        cset = bought(t["catalog_sales"], "cs_sold_date_sk", "cs_bill_customer_sk")
+        m = cu.merge(ca, left_on="c_current_addr_sk", right_on="ca_address_sk")
+        m = m.merge(cd, left_on="c_current_cdemo_sk", right_on="cd_demo_sk")
+        if q == 10:
+            m = m[m.ca_county.isin(["Williamson County", "Walker County",
+                                    "Ziebach County", "Daviess County", "Barrow County"])]
+        if q == 69:
+            m = m[m.ca_state.isin(["TN", "TX", "SD"])]
+            keep = (m.c_customer_sk.isin(sset) & ~m.c_customer_sk.isin(wset)
+                    & ~m.c_customer_sk.isin(cset))
+        else:
+            keep = m.c_customer_sk.isin(sset) & (m.c_customer_sk.isin(wset)
+                                                 | m.c_customer_sk.isin(cset))
+        m = m[keep]
+        if q == 35:
+            keys = ["ca_state", "cd_gender", "cd_marital_status", "cd_dep_count",
+                    "cd_dep_employed_count", "cd_dep_college_count"]
+            g = m.groupby(keys, as_index=False).size().rename(columns={"size": "cnt"})
+            out = pd.DataFrame({
+                "ca_state": g.ca_state, "cd_gender": g.cd_gender,
+                "cd_marital_status": g.cd_marital_status,
+                "cd_dep_count": g.cd_dep_count, "cnt1": g.cnt,
+                "avg1": g.cd_dep_count.astype(float), "max1": g.cd_dep_count,
+                "sum1": g.cd_dep_count * g.cnt,
+                "cd_dep_employed_count": g.cd_dep_employed_count, "cnt2": g.cnt,
+                "avg2": g.cd_dep_employed_count.astype(float),
+                "max2": g.cd_dep_employed_count,
+                "sum2": g.cd_dep_employed_count * g.cnt,
+                "cd_dep_college_count": g.cd_dep_college_count, "cnt3": g.cnt,
+                "avg3": g.cd_dep_college_count.astype(float),
+                "max3": g.cd_dep_college_count,
+                "sum3": g.cd_dep_college_count * g.cnt})
+            return out.sort_values(keys).head(100).reset_index(drop=True)
+        if q == 10:
+            keys = ["cd_gender", "cd_marital_status", "cd_education_status",
+                    "cd_purchase_estimate", "cd_credit_rating", "cd_dep_count",
+                    "cd_dep_employed_count", "cd_dep_college_count"]
+            g = m.groupby(keys, as_index=False).size().rename(columns={"size": "cnt"})
+            out = pd.DataFrame({
+                "cd_gender": g.cd_gender, "cd_marital_status": g.cd_marital_status,
+                "cd_education_status": g.cd_education_status, "cnt1": g.cnt,
+                "cd_purchase_estimate": g.cd_purchase_estimate, "cnt2": g.cnt,
+                "cd_credit_rating": g.cd_credit_rating, "cnt3": g.cnt,
+                "cd_dep_count": g.cd_dep_count, "cnt4": g.cnt,
+                "cd_dep_employed_count": g.cd_dep_employed_count, "cnt5": g.cnt,
+                "cd_dep_college_count": g.cd_dep_college_count, "cnt6": g.cnt})
+            return out.sort_values(keys).head(100).reset_index(drop=True)
+        keys = ["cd_gender", "cd_marital_status", "cd_education_status",
+                "cd_purchase_estimate", "cd_credit_rating"]
+        g = m.groupby(keys, as_index=False).size().rename(columns={"size": "cnt"})
+        out = pd.DataFrame({
+            "cd_gender": g.cd_gender, "cd_marital_status": g.cd_marital_status,
+            "cd_education_status": g.cd_education_status, "cnt1": g.cnt,
+            "cd_purchase_estimate": g.cd_purchase_estimate, "cnt2": g.cnt,
+            "cd_credit_rating": g.cd_credit_rating, "cnt3": g.cnt})
+        return out.sort_values(keys).head(100).reset_index(drop=True)
+    if q == 23:
+        cu = t["customer"]
+        years = [1999, 2000, 2001, 2002]
+        m = ss.merge(dd[dd.d_year.isin(years)][["d_date_sk", "d_date"]],
+                     left_on="ss_sold_date_sk", right_on="d_date_sk")
+        m = m.merge(it[["i_item_sk", "i_item_desc"]], left_on="ss_item_sk",
+                    right_on="i_item_sk")
+        m["itemdesc"] = m.i_item_desc.str[:30]
+        fcnt = m.groupby(["itemdesc", "i_item_sk", "d_date"]).size()
+        freq_items = set(fcnt[fcnt > 4].reset_index().i_item_sk)
+        m2 = ss.merge(dd[dd.d_year.isin(years)][["d_date_sk"]],
+                      left_on="ss_sold_date_sk", right_on="d_date_sk")
+        m2 = m2.merge(cu[["c_customer_sk"]], left_on="ss_customer_sk",
+                      right_on="c_customer_sk")
+        m2["v"] = m2.ss_quantity * m2.ss_sales_price
+        cmax = m2.groupby("c_customer_sk")["v"].sum().max()
+        allm = ss.merge(cu[["c_customer_sk"]], left_on="ss_customer_sk",
+                        right_on="c_customer_sk")
+        allm["v"] = allm.ss_quantity * allm.ss_sales_price
+        ssales = allm.groupby("c_customer_sk")["v"].sum()
+        best = set(ssales[ssales > 0.5 * cmax].index)
+        dsel = dd[(dd.d_year == 2000) & (dd.d_moy == 2)][["d_date_sk"]]
+        total, n = 0.0, 0
+        for fact, pfx in ((t["catalog_sales"], "cs"), (t["web_sales"], "ws")):
+            mm = fact.merge(dsel, left_on=f"{pfx}_sold_date_sk", right_on="d_date_sk")
+            mm = mm[mm[f"{pfx}_item_sk"].isin(freq_items)
+                    & mm[f"{pfx}_bill_customer_sk"].isin(best)]
+            total += (mm[f"{pfx}_quantity"] * mm[f"{pfx}_list_price"]).sum()
+            n += len(mm)
+        return pd.DataFrame({"sum_sales": [total if n else None]})
+    if q in (38, 87):
+        cu = t["customer"]
+        dsel = dd[(dd.d_month_seq >= 1200) & (dd.d_month_seq <= 1211)][
+            ["d_date_sk", "d_date"]]
+
+        def chan(fact, dkey, ckey):
+            mm = fact.merge(dsel, left_on=dkey, right_on="d_date_sk")
+            mm = mm.merge(cu[["c_customer_sk", "c_last_name", "c_first_name"]],
+                          left_on=ckey, right_on="c_customer_sk")
+            return set(map(tuple, mm[["c_last_name", "c_first_name", "d_date"]]
+                           .drop_duplicates().values))
+
+        a = chan(ss, "ss_sold_date_sk", "ss_customer_sk")
+        b = chan(t["catalog_sales"], "cs_sold_date_sk", "cs_bill_customer_sk")
+        c = chan(t["web_sales"], "ws_sold_date_sk", "ws_bill_customer_sk")
+        n = len(a & b & c) if q == 38 else len(a - b - c)
+        return pd.DataFrame({"cnt": [n]})
+    if q == 76:
+        frames = []
+        for fact, pfx, nullcol, label in (
+            (ss, "ss", "ss_addr_sk", "store"),
+            (t["web_sales"], "ws", "ws_ship_customer_sk", "web"),
+            (t["catalog_sales"], "cs", "cs_ship_addr_sk", "catalog"),
+        ):
+            selr = fact[fact[nullcol].isna()]
+            mm = selr.merge(dd[["d_date_sk", "d_year", "d_qoy"]],
+                            left_on=f"{pfx}_sold_date_sk", right_on="d_date_sk")
+            mm = mm.merge(it[["i_item_sk", "i_category"]],
+                          left_on=f"{pfx}_item_sk", right_on="i_item_sk")
+            frames.append(pd.DataFrame({
+                "channel": label, "col_name": nullcol, "d_year": mm.d_year,
+                "d_qoy": mm.d_qoy, "i_category": mm.i_category,
+                "ext": mm[f"{pfx}_ext_sales_price"]}))
+        u = pd.concat(frames, ignore_index=True)
+        g = u.groupby(["channel", "col_name", "d_year", "d_qoy", "i_category"],
+                      as_index=False).agg(sales_cnt=("ext", "size"),
+                                          sales_amt=("ext", "sum"))
+        return g.sort_values(["channel", "col_name", "d_year", "d_qoy",
+                              "i_category"]).head(100).reset_index(drop=True)
+    if q == 97:
+        cs = t["catalog_sales"]
+        dsel = dd[(dd.d_month_seq >= 1200) & (dd.d_month_seq <= 1211)][["d_date_sk"]]
+        a = ss.merge(dsel, left_on="ss_sold_date_sk", right_on="d_date_sk")[
+            ["ss_customer_sk", "ss_item_sk"]].drop_duplicates()
+        b = cs.merge(dsel, left_on="cs_sold_date_sk", right_on="d_date_sk")[
+            ["cs_bill_customer_sk", "cs_item_sk"]].drop_duplicates()
+        j = a.merge(b, left_on=["ss_customer_sk", "ss_item_sk"],
+                    right_on=["cs_bill_customer_sk", "cs_item_sk"],
+                    how="outer", indicator=True)
+        return pd.DataFrame({
+            "store_only": [int((j._merge == "left_only").sum())],
+            "catalog_only": [int((j._merge == "right_only").sum())],
+            "store_and_catalog": [int((j._merge == "both").sum())]})
     raise ValueError(f"no oracle for q{q}")
 
 

@@ -41,6 +41,9 @@ ITEM_UNITS = ["Ounce", "Oz", "Bunch", "Ton", "Each", "Pound", "Pallet",
 CREDIT_RATINGS = ["Low Risk", "Good", "High Risk", "Unknown"]
 STREET_TYPES = ["Street", "Ave", "Blvd", "Court", "Drive", "Lane", "Parkway", "Way"]
 LOCATION_TYPES = ["apartment", "condo", "single family"]
+# low-cardinality zip pool (spec zips repeat; q8's preferred-customer-count
+# per zip is meaningless over unique random zips)
+ZIP_POOL = [f"{z:05d}" for z in range(24000, 24600, 10)]
 
 
 def generate_tpcds(out_dir: str, scale: float = 1.0, seed: int = 17,
@@ -132,7 +135,7 @@ def generate_tpcds(out_dir: str, scale: float = 1.0, seed: int = 17,
         "s_city": pa.array([CITIES[i % len(CITIES)] for i in range(n_stores)]),
         "s_county": pa.array([COUNTIES[i % len(COUNTIES)] for i in range(n_stores)]),
         "s_state": pa.array([STATES[i % len(STATES)] for i in range(n_stores)]),
-        "s_zip": pa.array([f"{z:05d}" for z in rng.integers(10000, 99999, n_stores)]),
+        "s_zip": pa.array([ZIP_POOL[i * 7 % len(ZIP_POOL)] for i in range(n_stores)]),
         "s_gmt_offset": pa.array([[-5.0, -6.0, -7.0, -8.0][i % 4] for i in range(n_stores)]),
         "s_company_id": pa.array([1] * n_stores, pa.int64()),
         "s_company_name": pa.array(["Unknown"] * n_stores),
@@ -171,7 +174,7 @@ def generate_tpcds(out_dir: str, scale: float = 1.0, seed: int = 17,
         "ca_city": pa.array(rng.choice(CITIES, n_addresses)),
         "ca_county": pa.array(rng.choice(COUNTIES, n_addresses)),
         "ca_state": pa.array(rng.choice(STATES, n_addresses)),
-        "ca_zip": pa.array([f"{z:05d}" for z in rng.integers(10000, 99999, n_addresses)]),
+        "ca_zip": pa.array(rng.choice(ZIP_POOL, n_addresses)),
         "ca_country": pa.array(["United States"] * n_addresses),
         "ca_gmt_offset": pa.array(rng.choice([-5.0, -6.0, -7.0, -8.0], n_addresses)),
         "ca_street_number": pa.array([str(x) for x in _ra.integers(1, 1000, n_addresses)]),
@@ -239,14 +242,23 @@ def generate_tpcds(out_dir: str, scale: float = 1.0, seed: int = 17,
     t_store = rng.integers(1, n_stores + 1, n_tickets + 1)
     t_hdemo = rng.integers(1, n_hd + 1, n_tickets + 1)
     t_addr = rng.integers(1, n_addresses + 1, n_tickets + 1)
+    # item popularity skew + a rotating per-day "deal item" taking ~15% of
+    # that day's rows: frequent-item queries (q23/q14) group by (item, day)
+    # with HAVING count>k — uniform draws never repeat within a day
+    _rskew = np.random.default_rng(seed + 17)
+    base_draw = np.minimum(
+        (n_items * _rskew.power(3.0, n_sales)).astype(np.int64) + 1, n_items)
+    deal_item = t_date[tid] % n_items + 1
+    item_draw = np.where(_rskew.random(n_sales) < 0.15, deal_item, base_draw)
     store_sales = pa.table({
         "ss_sold_date_sk": pa.array(t_date[tid], pa.int64()),
         "ss_sold_time_sk": pa.array(t_time[tid], pa.int64()),
-        "ss_item_sk": pa.array(rng.integers(1, n_items + 1, n_sales), pa.int64()),
+        "ss_item_sk": pa.array(item_draw, pa.int64()),
         "ss_customer_sk": pa.array(t_cust[tid], pa.int64()),
         "ss_cdemo_sk": pa.array(rng.integers(1, n_cd + 1, n_sales), pa.int64()),
         "ss_hdemo_sk": pa.array(t_hdemo[tid], pa.int64()),
-        "ss_addr_sk": pa.array(t_addr[tid], pa.int64()),
+        "ss_addr_sk": pa.array(t_addr[tid], pa.int64(),
+                               mask=_rskew.random(n_sales) < 0.015),
         "ss_store_sk": pa.array(t_store[tid], pa.int64()),
         "ss_promo_sk": pa.array(rng.integers(1, n_promos + 1, n_sales), pa.int64()),
         "ss_ticket_number": pa.array(tid, pa.int64()),

@@ -1,0 +1,26 @@
+select s_store_name, sum(ss_net_profit)
+from store_sales, date_dim, store,
+     (select ca_zip
+      from (select substr(ca_zip, 1, 5) ca_zip
+            from customer_address
+            where substr(ca_zip, 1, 5) in
+                  ('24000', '24050', '24100', '24150', '24200', '24250',
+                   '24300', '24350', '24400', '24450', '24500', '24550',
+                   '24010', '24060', '24110', '24160', '24210', '24260',
+                   '24310', '24360', '24410', '24460', '24510', '24560')
+            intersect
+            select ca_zip
+            from (select substr(ca_zip, 1, 5) ca_zip, count(*) cnt
+                  from customer_address, customer
+                  where ca_address_sk = c_current_addr_sk
+                    and c_preferred_cust_flag = 'Y'
+                  group by ca_zip
+                  having count(*) > 10) a1) a2) v1
+where ss_store_sk = s_store_sk
+  and ss_sold_date_sk = d_date_sk
+  and d_qoy = 2
+  and d_year = 1998
+  and substr(s_zip, 1, 2) = substr(v1.ca_zip, 1, 2)
+group by s_store_name
+order by s_store_name
+limit 100
