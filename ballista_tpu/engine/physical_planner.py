@@ -253,7 +253,7 @@ class PhysicalPlanner:
                 isinstance(a, AggregateFunction) and a.func == "count_distinct"
                 for a in node.agg_exprs
             ):
-                raise PlanningError("mixing count(distinct) with other aggregates is unsupported")
+                return self._plan_mixed_distinct(node)
             args = [a.arg for a in node.agg_exprs]
             inner = Aggregate(node.input, list(group_exprs) + args, [])
             inner_planned = self._plan_aggregate(inner)
@@ -283,8 +283,10 @@ class PhysicalPlanner:
         acc_fields: list[DFField] = []
         i = 0
         for a in node.agg_exprs:
-            assert isinstance(a, AggregateFunction), a
             out_name = a.output_name()
+            if isinstance(a, Alias):  # composed rewrites name their aggs
+                a = a.expr
+            assert isinstance(a, AggregateFunction), a
             if a.func == "avg":
                 sname, cname = f"__acc{i}_sum", f"__acc{i}_cnt"
                 partial_aggs.append(AggDesc("sum", a.arg, sname))
@@ -371,6 +373,65 @@ class PhysicalPlanner:
         ]
         final = HashAggregateExec(merged, final_group, final_aggs, "final", acc_schema)
         return ProjectionExec(final, result_exprs, _rebind_schema(node.schema))
+
+    def _plan_mixed_distinct(self, node: Aggregate) -> ExecutionPlan:
+        """count(DISTINCT x) mixed with mergeable aggregates — the standard
+        single-distinct expansion (Spark/DataFusion do the same rewrite):
+
+            inner:  GROUP BY keys, x  →  partials of the other aggregates
+            outer:  GROUP BY keys     →  count(x) + merge of the partials
+
+        Lowered as composed LOGICAL aggregates so the normal planner
+        machinery (avg decomposition, two-phase exchange) applies at each
+        level. Reference shape: q16/q94's `count(distinct order_number),
+        sum(ship_cost), sum(net_profit)`."""
+        distinct_aggs = [a for a in node.agg_exprs if a.func == "count_distinct"]
+        dargs = {str(a.arg) for a in distinct_aggs}
+        if len(dargs) > 1:
+            raise PlanningError(
+                "multiple DISTINCT columns mixed with other aggregates are unsupported")
+        mergeable = {"sum", "count", "min", "max", "avg"}
+        bad = [a.func for a in node.agg_exprs
+               if a.func != "count_distinct" and a.func not in mergeable]
+        if bad:
+            raise PlanningError(f"count(DISTINCT) mixed with {bad[0]} is unsupported")
+        darg = distinct_aggs[0].arg
+        if any(str(darg) == str(g) for g in node.group_exprs):
+            raise PlanningError("count(DISTINCT <group key>) is unsupported")
+
+        inner_aggs: list[Expr] = []
+        outer_aggs: list[Expr] = []
+        # final projection refs are UNQUALIFIED (the outer aggregate's output
+        # fields carry no qualifier); the original qualified schema is
+        # re-imposed on the ProjectionExec below
+        final_exprs: list[Expr] = [Column(g.output_name()) for g in node.group_exprs]
+        for i, a in enumerate(node.agg_exprs):
+            out_name = a.output_name()
+            if a.func == "count_distinct":
+                outer_aggs.append(Alias(
+                    AggregateFunction("count", Column(darg.output_name())), out_name))
+                final_exprs.append(Column(out_name))
+            elif a.func in ("min", "max", "sum", "count"):
+                nm = f"__d{i}"
+                inner_aggs.append(Alias(AggregateFunction(a.func, a.arg), nm))
+                outer_fn = "sum" if a.func == "count" else a.func
+                outer_aggs.append(Alias(AggregateFunction(outer_fn, Column(nm)), out_name))
+                final_exprs.append(Column(out_name))
+            else:  # avg: sum-of-sums / sum-of-counts at the final projection
+                sn, cn = f"__d{i}_s", f"__d{i}_c"
+                inner_aggs.append(Alias(AggregateFunction("sum", a.arg), sn))
+                inner_aggs.append(Alias(AggregateFunction("count", a.arg), cn))
+                outer_aggs.append(Alias(AggregateFunction("sum", Column(sn)), sn))
+                outer_aggs.append(Alias(AggregateFunction("sum", Column(cn)), cn))
+                final_exprs.append(Alias(
+                    BinaryExpr(Cast(Column(sn), pa.float64()), "/",
+                               Cast(Column(cn), pa.float64())), out_name))
+
+        inner = Aggregate(node.input, list(node.group_exprs) + [darg], inner_aggs)
+        outer_group = [Column(g.output_name()) for g in node.group_exprs]
+        outer = Aggregate(inner, outer_group, outer_aggs)
+        outer_planned = self._plan_aggregate(outer)
+        return ProjectionExec(outer_planned, final_exprs, _rebind_schema(node.schema))
 
     def _two_phase(self, inner_planned, inner_schema, outer_group, outer_aggs, node, result_exprs_override):
         """Lower the count-distinct outer aggregate over a pre-deduped input."""

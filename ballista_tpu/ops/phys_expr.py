@@ -242,9 +242,11 @@ class DateAddOp(PhysicalExpr):
 
     def evaluate(self, batch):
         arr = self.child.evaluate(batch)
+        if pa.types.is_timestamp(arr.type):  # joins may surface dates as ts
+            arr = arr.cast(pa.date32())
         n = self.n * self.sign
         if self.unit == "day":
-            return pc.add(arr, pa.scalar(n, pa.int32())).cast(pa.date32())
+            return pc.add(arr.cast(pa.int32()), pa.scalar(n, pa.int32())).cast(pa.date32())
         np_days = arr.cast(pa.int32()).to_numpy(zero_copy_only=False)
         dates = np_days.astype("datetime64[D]")
         months = n * 12 if self.unit == "year" else n

@@ -205,9 +205,32 @@ class Decorrelator:
         def fn(p: LogicalPlan) -> LogicalPlan:
             if isinstance(p, Filter) and _has_subquery(p.predicate):
                 return self.rewrite_filter(p)
+            if isinstance(p, Projection) and any(_has_subquery(e) for e in p.exprs):
+                return self.rewrite_projection(p)
             return p
 
         return transform_plan_up(plan, fn)
+
+    def rewrite_projection(self, proj: Projection) -> LogicalPlan:
+        """Scalar subqueries in the SELECT list (q9's CASE-of-aggregates
+        shape): each lowers exactly like a WHERE-clause scalar — join/
+        cross-join against the projection's input."""
+        input_plan: LogicalPlan = proj.input
+        new_exprs: list[Expr] = []
+        for e in proj.exprs:
+            if _has_subquery(e):
+                orig_name = e.output_name()
+                for sq in _collect_scalar_subqueries(e):
+                    input_plan, repl = self._plan_scalar(
+                        input_plan, self.run(sq.plan), join_type="left")
+                    e = _replace_node(e, sq, repl)
+                if _has_subquery(e):
+                    raise PlanningError(
+                        "only scalar subqueries are supported in the SELECT list")
+                if e.output_name() != orig_name:  # don't leak __value
+                    e = Alias(e, orig_name)
+            new_exprs.append(e)
+        return Projection(input_plan, new_exprs)
 
     def rewrite_filter(self, f: Filter) -> LogicalPlan:
         # Build the join tree from subquery-free conjuncts FIRST so the
@@ -355,8 +378,13 @@ class Decorrelator:
         res = and_(*residual) if residual else None
         return keys, res, new_sub
 
-    def _plan_scalar(self, outer: LogicalPlan, sub: LogicalPlan):
-        """Turn a scalar subquery into a join; returns (new_outer, replacement)."""
+    def _plan_scalar(self, outer: LogicalPlan, sub: LogicalPlan,
+                     join_type: str = "inner"):
+        """Turn a scalar subquery into a join; returns (new_outer, replacement).
+
+        join_type: WHERE-context callers keep "inner" (a no-match row's NULL
+        comparison filters it anyway); SELECT-list callers must pass "left"
+        — the outer row survives with a NULL value."""
         self.counter += 1
         alias_name = f"__sq{self.counter}"
         # locate [Projection] -> Aggregate -> [Filter] -> input
@@ -411,7 +439,7 @@ class Decorrelator:
         join_on = [
             (ok, Column(ik.output_name(), alias_name)) for (ok, ik) in corr_keys
         ]
-        return Join(outer, aliased, join_on, "inner", None), Column("__value", alias_name)
+        return Join(outer, aliased, join_on, join_type, None), Column("__value", alias_name)
 
 
 def _find_agg_pattern(sub: LogicalPlan):

@@ -18,22 +18,23 @@ import pyarrow.parquet as pq
 from ballista_tpu.testing.tpcdsgen import TPCDS_TABLES
 
 
-def _rollup(m: pd.DataFrame, cols: list, valcol: str, how: str) -> pd.DataFrame:
+def _rollup(m: pd.DataFrame, cols: list, valcol, how: str) -> pd.DataFrame:
     """GROUP BY ROLLUP(cols): one frame per prefix level (full detail down
-    to grand total), grouped-out keys padded with None. Adds a
-    `lochierarchy` column (= number of grouped-out keys, the
-    grouping()-sum the rollup queries select)."""
+    to grand total), grouped-out keys padded with None. `valcol` may be a
+    single column name or a list. Adds a `lochierarchy` column (= number
+    of grouped-out keys, the grouping()-sum the rollup queries select)."""
+    vals = [valcol] if isinstance(valcol, str) else list(valcol)
     frames = []
     for k in range(len(cols), -1, -1):
         keys = cols[:k]
         if keys:
-            g = getattr(m.groupby(keys, as_index=False)[valcol], how)()
+            g = getattr(m.groupby(keys, as_index=False)[vals], how)()
         else:
-            g = pd.DataFrame({valcol: [getattr(m[valcol], how)()]})
+            g = pd.DataFrame({v: [getattr(m[v], how)()] for v in vals})
         for c in cols[k:]:
             g[c] = None
         g["lochierarchy"] = len(cols) - k
-        frames.append(g[cols + [valcol, "lochierarchy"]])
+        frames.append(g[cols + vals + ["lochierarchy"]])
     return pd.concat(frames, ignore_index=True)
 
 
@@ -1083,6 +1084,997 @@ def run_reference(q: int, t: dict[str, pd.DataFrame]) -> pd.DataFrame:
             "store_only": [int((j._merge == "left_only").sum())],
             "catalog_only": [int((j._merge == "right_only").sum())],
             "store_and_catalog": [int((j._merge == "both").sum())]})
+    if q in (16, 94, 95):
+        ca = t["customer_address"]
+        if q == 16:
+            fact, pfx = t["catalog_sales"], "cs"
+            rets, rkey = t["catalog_returns"], "cr_order_number"
+            lo, hi = dt.date(2000, 2, 1), dt.date(2000, 4, 2)
+            m = fact.merge(dd[(dd.d_date >= lo) & (dd.d_date <= hi)][["d_date_sk"]],
+                           left_on="cs_ship_date_sk", right_on="d_date_sk")
+            m = m.merge(ca[ca.ca_state == "GA"][["ca_address_sk"]],
+                        left_on="cs_ship_addr_sk", right_on="ca_address_sk")
+            cc = t["call_center"]
+            m = m.merge(cc[cc.cc_county.isin(["Williamson County", "Walker County",
+                                              "Ziebach County"])][["cc_call_center_sk"]],
+                        left_on="cs_call_center_sk", right_on="cc_call_center_sk")
+        else:
+            fact, pfx = t["web_sales"], "ws"
+            rets, rkey = t["web_returns"], "wr_order_number"
+            lo, hi = dt.date(1999, 2, 1), dt.date(1999, 4, 2)
+            m = fact.merge(dd[(dd.d_date >= lo) & (dd.d_date <= hi)][["d_date_sk"]],
+                           left_on="ws_ship_date_sk", right_on="d_date_sk")
+            m = m.merge(ca[ca.ca_state == "TX"][["ca_address_sk"]],
+                        left_on="ws_ship_addr_sk", right_on="ca_address_sk")
+            web = t["web_site"]
+            m = m.merge(web[web.web_company_name == "pri"][["web_site_sk"]],
+                        left_on="ws_web_site_sk", right_on="web_site_sk")
+        onum, wh = f"{pfx}_order_number", f"{pfx}_warehouse_sk"
+        wh_counts = fact.groupby(onum)[wh].nunique()
+        multi = set(wh_counts[wh_counts > 1].index)
+        returned = set(rets[rkey])
+        if q == 95:
+            m = m[m[onum].isin(multi) & m[onum].isin(returned & multi)]
+        else:
+            m = m[m[onum].isin(multi) & ~m[onum].isin(returned)]
+        return pd.DataFrame({
+            "order_count": [int(m[onum].nunique())],
+            "total_shipping_cost": [m[f"{pfx}_ext_ship_cost"].sum() if len(m) else None],
+            "total_net_profit": [m[f"{pfx}_net_profit"].sum() if len(m) else None]})
+    if q == 28:
+        buckets = [
+            ((0, 5), (8, 18), (459, 1459), (57, 77)),
+            ((6, 10), (90, 100), (2323, 3323), (31, 51)),
+            ((11, 15), (142, 152), (12214, 13214), (79, 99)),
+            ((16, 20), (135, 145), (6071, 7071), (38, 58)),
+            ((21, 25), (122, 132), (836, 1836), (17, 37)),
+            ((26, 30), (154, 164), (7326, 8326), (7, 27)),
+        ]
+        vals = {}
+        for i, (qt, lp, cp, wc) in enumerate(buckets, 1):
+            b = ss[ss.ss_quantity.between(*qt)
+                   & (ss.ss_list_price.between(*lp)
+                      | ss.ss_coupon_amt.between(*cp)
+                      | ss.ss_wholesale_cost.between(*wc))]
+            vals[f"b{i}_lp"] = [b.ss_list_price.mean() if len(b) else None]
+            vals[f"b{i}_cnt"] = [int(b.ss_list_price.count())]
+            vals[f"b{i}_cntd"] = [int(b.ss_list_price.nunique())]
+        return pd.DataFrame(vals)
+    if q == 2:
+        frames = []
+        for fact, pfx in ((t["web_sales"], "ws"), (t["catalog_sales"], "cs")):
+            frames.append(pd.DataFrame({
+                "sold_date_sk": fact[f"{pfx}_sold_date_sk"],
+                "sales_price": fact[f"{pfx}_ext_sales_price"]}))
+        u = pd.concat(frames, ignore_index=True)
+        m = u.merge(dd[["d_date_sk", "d_week_seq", "d_day_name"]],
+                    left_on="sold_date_sk", right_on="d_date_sk")
+        days = ["Sunday", "Monday", "Tuesday", "Wednesday", "Thursday", "Friday", "Saturday"]
+        dcols = ["sun", "mon", "tue", "wed", "thu", "fri", "sat"]
+        for day, c in zip(days, dcols):
+            m[c] = np.where(m.d_day_name == day, m.sales_price, np.nan)
+        wss = m.groupby("d_week_seq", as_index=False)[dcols].sum(min_count=1)
+
+        def leg(year):
+            weeks = dd[dd.d_year == year][["d_week_seq"]]
+            return wss.merge(weeks, on="d_week_seq")  # per-day dup, like the SQL
+
+        y = leg(1999)
+        z = leg(2000).copy()
+        z["wk_minus"] = z.d_week_seq - 53
+        j = y.merge(z, left_on="d_week_seq", right_on="wk_minus", suffixes=("_1", "_2"))
+        out = pd.DataFrame({"d_week_seq1": j.d_week_seq_1,
+                            **{f"r_{c}": np.round(j[f"{c}_1"] / j[f"{c}_2"], 2)
+                               for c in dcols}})
+        return out.sort_values("d_week_seq1").reset_index(drop=True)
+    if q == 18:
+        cs, cd, cu, ca = (t["catalog_sales"], t["customer_demographics"],
+                          t["customer"], t["customer_address"])
+        m = cs.merge(dd[dd.d_year == 1998][["d_date_sk"]],
+                     left_on="cs_sold_date_sk", right_on="d_date_sk")
+        m = m.merge(it[["i_item_sk", "i_item_id"]], left_on="cs_item_sk",
+                    right_on="i_item_sk")
+        cd1 = cd[(cd.cd_gender == "F") & (cd.cd_education_status == "Unknown")]
+        m = m.merge(cd1[["cd_demo_sk", "cd_dep_count"]],
+                    left_on="cs_bill_cdemo_sk", right_on="cd_demo_sk")
+        m = m.merge(cu[cu.c_birth_month.isin([1, 6, 8, 9, 12, 2])][
+            ["c_customer_sk", "c_current_cdemo_sk", "c_current_addr_sk", "c_birth_year"]],
+            left_on="cs_bill_customer_sk", right_on="c_customer_sk")
+        m = m.merge(cd[["cd_demo_sk"]].rename(columns={"cd_demo_sk": "cd2_sk"}),
+                    left_on="c_current_cdemo_sk", right_on="cd2_sk")
+        m = m.merge(ca[ca.ca_state.isin(["MT", "CA", "NY"])][
+            ["ca_address_sk", "ca_country", "ca_state", "ca_county"]],
+            left_on="c_current_addr_sk", right_on="ca_address_sk")
+        for src, nm in (("cs_quantity", "agg1"), ("cs_list_price", "agg2"),
+                        ("cs_coupon_amt", "agg3"), ("cs_sales_price", "agg4"),
+                        ("cs_net_profit", "agg5"), ("c_birth_year", "agg6"),
+                        ("cd_dep_count", "agg7")):
+            m[nm] = m[src].astype(float)
+        cols = ["i_item_id", "ca_country", "ca_state", "ca_county"]
+        vals = [f"agg{i}" for i in range(1, 8)]
+        out = _rollup(m, cols, vals, "mean").drop(columns=["lochierarchy"])
+        out = out[cols + vals]
+        return out.sort_values(["ca_country", "ca_state", "ca_county", "i_item_id"],
+                               na_position="last").head(100).reset_index(drop=True)
+    if q == 27:
+        cd, st = t["customer_demographics"], t["store"]
+        m = ss.merge(dd[dd.d_year == 2002][["d_date_sk"]],
+                     left_on="ss_sold_date_sk", right_on="d_date_sk")
+        m = m.merge(it[["i_item_sk", "i_item_id"]], left_on="ss_item_sk",
+                    right_on="i_item_sk")
+        m = m.merge(st[st.s_state.isin(["TN", "TX", "SD", "IN", "GA", "OH"])][
+            ["s_store_sk", "s_state"]], left_on="ss_store_sk", right_on="s_store_sk")
+        cdf = cd[(cd.cd_gender == "M") & (cd.cd_marital_status == "S")
+                 & (cd.cd_education_status == "College")]
+        m = m.merge(cdf[["cd_demo_sk"]], left_on="ss_cdemo_sk", right_on="cd_demo_sk")
+        for src, nm in (("ss_quantity", "agg1"), ("ss_list_price", "agg2"),
+                        ("ss_coupon_amt", "agg3"), ("ss_sales_price", "agg4")):
+            m[nm] = m[src].astype(float)
+        vals = [f"agg{i}" for i in range(1, 5)]
+        out = _rollup(m, ["i_item_id", "s_state"], vals, "mean")
+        out["g_state"] = (out.lochierarchy >= 1).astype(int)
+        out = out[["i_item_id", "s_state", "g_state"] + vals]
+        return out.sort_values(["i_item_id", "s_state"], na_position="last"
+                               ).head(100).reset_index(drop=True)
+    if q == 31:
+        ca = t["customer_address"]
+
+        def cte(fact, dkey, akey, val, name):
+            mm = fact.merge(dd[["d_date_sk", "d_qoy", "d_year"]],
+                            left_on=dkey, right_on="d_date_sk")
+            mm = mm.merge(ca[["ca_address_sk", "ca_county"]],
+                          left_on=akey, right_on="ca_address_sk")
+            return mm.groupby(["ca_county", "d_qoy", "d_year"], as_index=False).agg(
+                **{name: (val, "sum")})
+
+        sscte = cte(ss, "ss_sold_date_sk", "ss_addr_sk", "ss_ext_sales_price", "store_sales")
+        wscte = cte(t["web_sales"], "ws_sold_date_sk", "ws_bill_addr_sk",
+                    "ws_ext_sales_price", "web_sales")
+
+        def pick(c, qoy, name):
+            sel = c[(c.d_qoy == qoy) & (c.d_year == 2000)][["ca_county", name]]
+            return sel.rename(columns={name: f"{name}{qoy}"})
+
+        j = pick(sscte, 1, "store_sales").merge(pick(sscte, 2, "store_sales"), on="ca_county")
+        j = j.merge(pick(sscte, 3, "store_sales"), on="ca_county")
+        j = j.merge(pick(wscte, 1, "web_sales"), on="ca_county")
+        j = j.merge(pick(wscte, 2, "web_sales"), on="ca_county")
+        j = j.merge(pick(wscte, 3, "web_sales"), on="ca_county")
+        w12 = np.where(j.web_sales1 > 0, j.web_sales2 / j.web_sales1, np.nan)
+        s12 = np.where(j.store_sales1 > 0, j.store_sales2 / j.store_sales1, np.nan)
+        w23 = np.where(j.web_sales2 > 0, j.web_sales3 / j.web_sales2, np.nan)
+        s23 = np.where(j.store_sales2 > 0, j.store_sales3 / j.store_sales2, np.nan)
+        j = j[(w12 > s12) & (w23 > s23)]
+        out = pd.DataFrame({
+            "ca_county": j.ca_county, "d_year": 2000,
+            "web_q1_q2_increase": j.web_sales2 / j.web_sales1,
+            "store_q1_q2_increase": j.store_sales2 / j.store_sales1,
+            "web_q2_q3_increase": j.web_sales3 / j.web_sales2,
+            "store_q2_q3_increase": j.store_sales3 / j.store_sales2})
+        return out.sort_values("ca_county").reset_index(drop=True)
+    if q == 54:
+        cu, ca, st = t["customer"], t["customer_address"], t["store"]
+        frames = []
+        for fact, pfx in ((t["catalog_sales"], "cs"), (t["web_sales"], "ws")):
+            frames.append(pd.DataFrame({
+                "sold_date_sk": fact[f"{pfx}_sold_date_sk"],
+                "customer_sk": fact[f"{pfx}_bill_customer_sk"],
+                "item_sk": fact[f"{pfx}_item_sk"]}))
+        u = pd.concat(frames, ignore_index=True)
+        u = u.merge(dd[(dd.d_moy == 12) & (dd.d_year == 1998)][["d_date_sk"]],
+                    left_on="sold_date_sk", right_on="d_date_sk")
+        u = u.merge(it[(it.i_category == "Women") & (it.i_class == "class#1")][
+            ["i_item_sk"]], left_on="item_sk", right_on="i_item_sk")
+        u = u.merge(cu[["c_customer_sk", "c_current_addr_sk"]],
+                    left_on="customer_sk", right_on="c_customer_sk")
+        my_customers = u[["c_customer_sk", "c_current_addr_sk"]].drop_duplicates()
+        base_seq = int(dd[(dd.d_year == 1998) & (dd.d_moy == 12)].d_month_seq.iloc[0])
+        dsel = dd[(dd.d_month_seq >= base_seq + 1) & (dd.d_month_seq <= base_seq + 3)][["d_date_sk"]]
+        mm = my_customers.merge(ca, left_on="c_current_addr_sk", right_on="ca_address_sk")
+        mm = mm.merge(st, left_on=["ca_county", "ca_state"], right_on=["s_county", "s_state"])
+        mm = mm.merge(ss, left_on="c_customer_sk", right_on="ss_customer_sk")
+        mm = mm.merge(dsel, left_on="ss_sold_date_sk", right_on="d_date_sk")
+        rev = mm.groupby("c_customer_sk")["ss_ext_sales_price"].sum()
+        seg = (rev / 50).astype(int)
+        g = seg.value_counts().sort_index()
+        out = pd.DataFrame({"segment": g.index, "num_customers": g.values})
+        out["segment_base"] = out.segment * 50
+        return out.sort_values(["segment", "num_customers"]).head(100).reset_index(drop=True)
+    if q in (56, 60):
+        ca = t["customer_address"]
+        if q == 56:
+            items = set(it[it.i_color.isin(["papaya", "burnished", "smoke"])].i_item_id)
+            yr, moy, gmt = 2000, 2, -5
+        else:
+            items = set(it[it.i_category == "Music"].i_item_id)
+            yr, moy, gmt = 1998, 9, -6
+        dsel = dd[(dd.d_year == yr) & (dd.d_moy == moy)][["d_date_sk"]]
+        casel = ca[ca.ca_gmt_offset == gmt][["ca_address_sk"]]
+        frames = []
+        for fact, dkey, akey, ikey, val in (
+            (ss, "ss_sold_date_sk", "ss_addr_sk", "ss_item_sk", "ss_ext_sales_price"),
+            (t["catalog_sales"], "cs_sold_date_sk", "cs_bill_addr_sk", "cs_item_sk", "cs_ext_sales_price"),
+            (t["web_sales"], "ws_sold_date_sk", "ws_bill_addr_sk", "ws_item_sk", "ws_ext_sales_price"),
+        ):
+            mm = fact.merge(dsel, left_on=dkey, right_on="d_date_sk")
+            mm = mm.merge(casel, left_on=akey, right_on="ca_address_sk")
+            mm = mm.merge(it[["i_item_sk", "i_item_id"]], left_on=ikey, right_on="i_item_sk")
+            mm = mm[mm.i_item_id.isin(items)]
+            frames.append(mm.groupby("i_item_id", as_index=False).agg(total_sales=(val, "sum")))
+        u = pd.concat(frames, ignore_index=True)
+        g = u.groupby("i_item_id", as_index=False)["total_sales"].sum()
+        order = ["total_sales", "i_item_id"] if q == 56 else ["i_item_id", "total_sales"]
+        return g.sort_values(order).head(100).reset_index(drop=True)
+    if q == 58:
+        wk = int(dd[dd.d_date == dt.date(2000, 1, 3)].d_week_seq.iloc[0])
+        dsel = dd[dd.d_week_seq == wk][["d_date_sk"]]
+
+        def chan(fact, ikey, dkey, val, name):
+            mm = fact.merge(dsel, left_on=dkey, right_on="d_date_sk")
+            mm = mm.merge(it[["i_item_sk", "i_item_id"]], left_on=ikey, right_on="i_item_sk")
+            return mm.groupby("i_item_id", as_index=False).agg(**{name: (val, "sum")})
+
+        a = chan(ss, "ss_item_sk", "ss_sold_date_sk", "ss_ext_sales_price", "ss_item_rev")
+        b = chan(t["catalog_sales"], "cs_item_sk", "cs_sold_date_sk",
+                 "cs_ext_sales_price", "cs_item_rev")
+        c = chan(t["web_sales"], "ws_item_sk", "ws_sold_date_sk",
+                 "ws_ext_sales_price", "ws_item_rev")
+        j = a.merge(b, on="i_item_id").merge(c, on="i_item_id")
+        sel = (j.ss_item_rev.between(0.9 * j.cs_item_rev, 1.1 * j.cs_item_rev)
+               & j.ss_item_rev.between(0.9 * j.ws_item_rev, 1.1 * j.ws_item_rev)
+               & j.cs_item_rev.between(0.9 * j.ss_item_rev, 1.1 * j.ss_item_rev)
+               & j.cs_item_rev.between(0.9 * j.ws_item_rev, 1.1 * j.ws_item_rev)
+               & j.ws_item_rev.between(0.9 * j.ss_item_rev, 1.1 * j.ss_item_rev)
+               & j.ws_item_rev.between(0.9 * j.cs_item_rev, 1.1 * j.cs_item_rev))
+        j = j[sel]
+        avg3 = (j.ss_item_rev + j.cs_item_rev + j.ws_item_rev) / 3
+        out = pd.DataFrame({
+            "item_id": j.i_item_id, "ss_item_rev": j.ss_item_rev,
+            "ss_dev": j.ss_item_rev / avg3 * 100, "cs_item_rev": j.cs_item_rev,
+            "cs_dev": j.cs_item_rev / avg3 * 100, "ws_item_rev": j.ws_item_rev,
+            "ws_dev": j.ws_item_rev / avg3 * 100, "average": avg3})
+        return out.sort_values(["item_id", "ss_item_rev"]).head(100).reset_index(drop=True)
+    if q == 66:
+        wh, td, sm = t["warehouse"], t["time_dim"], t["ship_mode"]
+        frames = []
+        for fact, pfx in ((t["web_sales"], "ws"), (t["catalog_sales"], "cs")):
+            mm = fact.merge(dd[dd.d_year == 2001][["d_date_sk", "d_moy"]],
+                            left_on=f"{pfx}_sold_date_sk", right_on="d_date_sk")
+            mm = mm.merge(td[(td.t_time >= 30838) & (td.t_time <= 30838 + 28800)][
+                ["t_time_sk"]], left_on=f"{pfx}_sold_time_sk", right_on="t_time_sk")
+            mm = mm.merge(sm[sm.sm_carrier.isin(["CARRIER1", "CARRIER3"])][
+                ["sm_ship_mode_sk"]], left_on=f"{pfx}_ship_mode_sk",
+                right_on="sm_ship_mode_sk")
+            mm = mm.merge(wh, left_on=f"{pfx}_warehouse_sk", right_on="w_warehouse_sk")
+            price, net = f"{pfx}_ext_sales_price", f"{pfx}_net_paid"
+            qty = f"{pfx}_quantity"
+            months = ["jan", "feb", "mar", "apr", "may", "jun", "jul", "aug",
+                      "sep", "oct", "nov", "dec"]
+            for i, mo in enumerate(months, 1):
+                mm[f"{mo}_sales"] = np.where(mm.d_moy == i, mm[price] * mm[qty], 0.0)
+                mm[f"{mo}_net"] = np.where(mm.d_moy == i, mm[net] * mm[qty], 0.0)
+            keys = ["w_warehouse_name", "w_warehouse_sq_ft", "w_city", "w_county",
+                    "w_state", "w_country"]
+            cols = [f"{mo}_sales" for mo in months] + [f"{mo}_net" for mo in months]
+            g = mm.groupby(keys, as_index=False)[cols].sum()
+            g["ship_carriers"] = "CARRIER1,CARRIER3"
+            g["year_"] = 2001
+            frames.append(g)
+        u = pd.concat(frames, ignore_index=True)
+        keys = ["w_warehouse_name", "w_warehouse_sq_ft", "w_city", "w_county",
+                "w_state", "w_country", "ship_carriers", "year_"]
+        months = ["jan", "feb", "mar", "apr", "may", "jun", "jul", "aug",
+                  "sep", "oct", "nov", "dec"]
+        cols = [f"{mo}_sales" for mo in months] + [f"{mo}_net" for mo in months]
+        g = u.groupby(keys, as_index=False)[cols].sum()
+        return g[keys + cols].sort_values("w_warehouse_name").head(100).reset_index(drop=True)
+    if q == 74:
+        cu = t["customer"]
+
+        def yt(fact, ckey, dkey, val, stype):
+            mm = fact.merge(dd[dd.d_year.isin([1999, 2000])][["d_date_sk", "d_year"]],
+                            left_on=dkey, right_on="d_date_sk")
+            mm = mm.merge(cu[["c_customer_sk", "c_customer_id", "c_first_name",
+                              "c_last_name"]], left_on=ckey, right_on="c_customer_sk")
+            g = mm.groupby(["c_customer_id", "c_first_name", "c_last_name", "d_year"],
+                           as_index=False).agg(year_total=(val, "sum"))
+            g["sale_type"] = stype
+            return g
+
+        u = pd.concat([
+            yt(ss, "ss_customer_sk", "ss_sold_date_sk", "ss_net_paid", "s"),
+            yt(t["web_sales"], "ws_bill_customer_sk", "ws_sold_date_sk",
+               "ws_net_paid", "w")], ignore_index=True)
+
+        def leg(stype, year, name):
+            sel = u[(u.sale_type == stype) & (u.d_year == year)]
+            return sel[["c_customer_id", "c_first_name", "c_last_name", "year_total"]
+                       ].rename(columns={"year_total": name})
+
+        j = leg("s", 1999, "s1").merge(leg("s", 2000, "s2"),
+                                       on=["c_customer_id", "c_first_name", "c_last_name"])
+        j = j.merge(leg("w", 1999, "w1"), on=["c_customer_id", "c_first_name", "c_last_name"])
+        j = j.merge(leg("w", 2000, "w2"), on=["c_customer_id", "c_first_name", "c_last_name"])
+        j = j[(j.s1 > 0) & (j.w1 > 0)]
+        j = j[np.where(j.w1 > 0, j.w2 / j.w1, np.nan)
+              > np.where(j.s1 > 0, j.s2 / j.s1, np.nan)]
+        out = j[["c_customer_id", "c_first_name", "c_last_name"]]
+        return out.sort_values(list(out.columns)).head(100).reset_index(drop=True)
+    if q == 83:
+        dates = [dt.date(2000, 6, 30), dt.date(2000, 9, 27), dt.date(2000, 11, 17)]
+        wks = set(dd[dd.d_date.isin(dates)].d_week_seq)
+        dsel = dd[dd.d_week_seq.isin(wks)][["d_date_sk"]]
+
+        def chan(fact, ikey, dkey, val, name):
+            mm = fact.merge(dsel, left_on=dkey, right_on="d_date_sk")
+            mm = mm.merge(it[["i_item_sk", "i_item_id"]], left_on=ikey, right_on="i_item_sk")
+            return mm.groupby("i_item_id", as_index=False).agg(**{name: (val, "sum")})
+
+        a = chan(t["store_returns"], "sr_item_sk", "sr_returned_date_sk",
+                 "sr_return_quantity", "sr_item_qty")
+        b = chan(t["catalog_returns"], "cr_item_sk", "cr_returned_date_sk",
+                 "cr_return_quantity", "cr_item_qty")
+        c = chan(t["web_returns"], "wr_item_sk", "wr_returned_date_sk",
+                 "wr_return_quantity", "wr_item_qty")
+        j = a.merge(b, on="i_item_id").merge(c, on="i_item_id")
+        tot = j.sr_item_qty + j.cr_item_qty + j.wr_item_qty
+        out = pd.DataFrame({
+            "item_id": j.i_item_id, "sr_item_qty": j.sr_item_qty,
+            "sr_dev": j.sr_item_qty / tot / 3.0 * 100, "cr_item_qty": j.cr_item_qty,
+            "cr_dev": j.cr_item_qty / tot / 3.0 * 100, "wr_item_qty": j.wr_item_qty,
+            "wr_dev": j.wr_item_qty / tot / 3.0 * 100, "average": tot / 3.0})
+        return out.sort_values(["item_id", "sr_item_qty"]).head(100).reset_index(drop=True)
+    if q == 84:
+        cu, ca, cd = t["customer"], t["customer_address"], t["customer_demographics"]
+        hd, ib, sr = t["household_demographics"], t["income_band"], t["store_returns"]
+        m = cu.merge(ca[ca.ca_city == "Fairview"][["ca_address_sk"]],
+                     left_on="c_current_addr_sk", right_on="ca_address_sk")
+        m = m.merge(hd[["hd_demo_sk", "hd_income_band_sk"]],
+                    left_on="c_current_hdemo_sk", right_on="hd_demo_sk")
+        ibf = ib[(ib.ib_lower_bound >= 38128) & (ib.ib_upper_bound <= 38128 + 50000)]
+        m = m.merge(ibf[["ib_income_band_sk"]], left_on="hd_income_band_sk",
+                    right_on="ib_income_band_sk")
+        m = m.merge(cd[["cd_demo_sk"]], left_on="c_current_cdemo_sk", right_on="cd_demo_sk")
+        m = m.merge(sr[["sr_cdemo_sk"]], left_on="cd_demo_sk", right_on="sr_cdemo_sk")
+        out = pd.DataFrame({
+            "customer_id": m.c_customer_id,
+            "customername": m.c_last_name + ", " + m.c_first_name})
+        return out.sort_values("customer_id").head(100).reset_index(drop=True)
+    if q == 85:
+        wsx, wr, wp = t["web_sales"], t["web_returns"], t["web_page"]
+        cd, ca, rs = t["customer_demographics"], t["customer_address"], t["reason"]
+        m = wsx.merge(wr, left_on=["ws_item_sk", "ws_order_number"],
+                      right_on=["wr_item_sk", "wr_order_number"])
+        m = m.merge(wp[["wp_web_page_sk"]], left_on="ws_web_page_sk",
+                    right_on="wp_web_page_sk")
+        m = m.merge(dd[dd.d_year == 2000][["d_date_sk"]],
+                    left_on="ws_sold_date_sk", right_on="d_date_sk")
+        m = m.merge(cd.add_prefix("c1_"), left_on="wr_refunded_cdemo_sk",
+                    right_on="c1_cd_demo_sk")
+        m = m.merge(cd.add_prefix("c2_"), left_on="wr_returning_cdemo_sk",
+                    right_on="c2_cd_demo_sk")
+        m = m.merge(ca, left_on="wr_refunded_addr_sk", right_on="ca_address_sk")
+        m = m.merge(rs, left_on="wr_reason_sk", right_on="r_reason_sk")
+        ms_eq = ((m.c1_cd_marital_status == m.c2_cd_marital_status)
+                 & (m.c1_cd_education_status == m.c2_cd_education_status))
+        c1 = (ms_eq & (m.c1_cd_marital_status == "M")
+              & (m.c1_cd_education_status == "Advanced Degree")
+              & m.ws_sales_price.between(100.0, 150.0))
+        c2 = (ms_eq & (m.c1_cd_marital_status == "S")
+              & (m.c1_cd_education_status == "College")
+              & m.ws_sales_price.between(50.0, 100.0))
+        c3 = (ms_eq & (m.c1_cd_marital_status == "W")
+              & (m.c1_cd_education_status == "2 yr Degree")
+              & m.ws_sales_price.between(150.0, 200.0))
+        a1 = ((m.ca_country == "United States") & m.ca_state.isin(["IN", "OH", "NJ"])
+              & m.ws_net_profit.between(10, 2000))
+        a2 = ((m.ca_country == "United States") & m.ca_state.isin(["CA", "TX", "MT"])
+              & m.ws_net_profit.between(15, 3000))
+        a3 = ((m.ca_country == "United States") & m.ca_state.isin(["GA", "TN", "NY"])
+              & m.ws_net_profit.between(5, 2500))
+        m = m[(c1 | c2 | c3) & (a1 | a2 | a3)]
+        g = m.groupby("r_reason_desc", as_index=False).agg(
+            avg_qty=("ws_quantity", "mean"), avg_refund=("wr_refund_cash", "mean"),
+            avg_fee=("wr_fee", "mean"))
+        g["reason20"] = g.r_reason_desc.str[:20]
+        out = g[["reason20", "avg_qty", "avg_refund", "avg_fee"]]
+        return out.sort_values(list(out.columns)).head(100).reset_index(drop=True)
+    if q in (4, 11):
+        cu = t["customer"]
+        keys = ["c_customer_id", "c_first_name", "c_last_name",
+                "c_preferred_cust_flag", "c_birth_country", "c_login",
+                "c_email_address"]
+
+        def yt(fact, ckey, dkey, val_fn, stype):
+            mm = fact.merge(dd[["d_date_sk", "d_year"]], left_on=dkey,
+                            right_on="d_date_sk")
+            mm = mm.merge(cu[["c_customer_sk"] + keys], left_on=ckey,
+                          right_on="c_customer_sk")
+            mm["v"] = val_fn(mm)
+            g = mm.groupby(keys + ["d_year"], as_index=False).agg(year_total=("v", "sum"))
+            g["sale_type"] = stype
+            return g
+
+        if q == 11:
+            legs = [
+                yt(ss, "ss_customer_sk", "ss_sold_date_sk",
+                   lambda m: m.ss_ext_list_price - m.ss_ext_discount_amt, "s"),
+                yt(t["web_sales"], "ws_bill_customer_sk", "ws_sold_date_sk",
+                   lambda m: m.ws_ext_list_price - m.ws_ext_discount_amt, "w")]
+            types = ["s", "w"]
+            sel_col = "c_email_address"
+        else:
+            legs = [
+                yt(ss, "ss_customer_sk", "ss_sold_date_sk",
+                   lambda m: ((m.ss_ext_list_price - m.ss_ext_wholesale_cost
+                               - m.ss_ext_discount_amt) + m.ss_ext_sales_price) / 2, "s"),
+                yt(t["catalog_sales"], "cs_bill_customer_sk", "cs_sold_date_sk",
+                   lambda m: ((m.cs_ext_list_price - m.cs_wholesale_cost * m.cs_quantity
+                               - m.cs_ext_discount_amt) + m.cs_ext_sales_price) / 2, "c"),
+                yt(t["web_sales"], "ws_bill_customer_sk", "ws_sold_date_sk",
+                   lambda m: ((m.ws_ext_list_price - m.ws_wholesale_cost * m.ws_quantity
+                               - m.ws_ext_discount_amt) + m.ws_ext_sales_price) / 2, "w")]
+            types = ["s", "c", "w"]
+            sel_col = "c_preferred_cust_flag"
+        u = pd.concat(legs, ignore_index=True)
+
+        def leg(stype, year, name):
+            sel = u[(u.sale_type == stype) & (u.d_year == year)]
+            return sel[keys + ["year_total"]].rename(columns={"year_total": name})
+
+        j = leg("s", 2001, "s1").merge(leg("s", 2002, "s2"), on=keys)
+        if q == 4:
+            j = j.merge(leg("c", 2001, "c1"), on=keys).merge(leg("c", 2002, "c2"), on=keys)
+        j = j.merge(leg("w", 2001, "w1"), on=keys).merge(leg("w", 2002, "w2"), on=keys)
+        if q == 11:
+            j = j[(j.s1 > 0) & (j.w1 > 0)]
+            wr_ = np.where(j.w1 > 0, j.w2 / j.w1, 0.0)
+            sr_ = np.where(j.s1 > 0, j.s2 / j.s1, 0.0)
+            j = j[wr_ > sr_]
+        else:
+            j = j[(j.s1 > 0) & (j.c1 > 0) & (j.w1 > 0)]
+            cr_ = np.where(j.c1 > 0, j.c2 / j.c1, np.nan)
+            sr_ = np.where(j.s1 > 0, j.s2 / j.s1, np.nan)
+            wr_ = np.where(j.w1 > 0, j.w2 / j.w1, np.nan)
+            j = j[(cr_ > sr_) & (cr_ > wr_)]
+        out = j[["c_customer_id", "c_first_name", "c_last_name", sel_col]]
+        return out.sort_values(list(out.columns)).head(100).reset_index(drop=True)
+    if q == 44:
+        base = ss[ss.ss_store_sk == 4]
+        nulladdr = base[base.ss_addr_sk.isna()]
+        thresh = 0.9 * nulladdr.ss_net_profit.mean()
+        g = base.groupby("ss_item_sk", as_index=False).agg(
+            rank_col=("ss_net_profit", "mean"))
+        g = g[g.rank_col > thresh]
+        g["rnk_asc"] = g.rank_col.rank(method="min").astype(int)
+        g["rnk_desc"] = g.rank_col.rank(method="min", ascending=False).astype(int)
+        asc = g[g.rnk_asc < 11][["ss_item_sk", "rnk_asc"]].rename(
+            columns={"rnk_asc": "rnk"})
+        desc = g[g.rnk_desc < 11][["ss_item_sk", "rnk_desc"]].rename(
+            columns={"rnk_desc": "rnk"})
+        j = asc.merge(desc, on="rnk", suffixes=("_a", "_d"))
+        j = j.merge(it[["i_item_sk", "i_product_name"]].rename(
+            columns={"i_product_name": "best_performing"}),
+            left_on="ss_item_sk_a", right_on="i_item_sk")
+        j = j.merge(it[["i_item_sk", "i_product_name"]].rename(
+            columns={"i_product_name": "worst_performing"}),
+            left_on="ss_item_sk_d", right_on="i_item_sk")
+        out = j[["rnk", "best_performing", "worst_performing"]]
+        return out.sort_values("rnk").head(100).reset_index(drop=True)
+    if q == 49:
+        frames = []
+        for label, fact, rets, skey, rkey, qty, rqty, paid, ramt, prof in (
+            ("web", t["web_sales"], t["web_returns"],
+             ["ws_order_number", "ws_item_sk"], ["wr_order_number", "wr_item_sk"],
+             "ws_quantity", "wr_return_quantity", "ws_net_paid", "wr_return_amt",
+             "ws_net_profit"),
+            ("catalog", t["catalog_sales"], t["catalog_returns"],
+             ["cs_order_number", "cs_item_sk"], ["cr_order_number", "cr_item_sk"],
+             "cs_quantity", "cr_return_quantity", "cs_net_paid", "cr_return_amt",
+             "cs_net_profit"),
+            ("store", ss, t["store_returns"],
+             ["ss_ticket_number", "ss_item_sk"], ["sr_ticket_number", "sr_item_sk"],
+             "ss_quantity", "sr_return_quantity", "ss_net_paid", "sr_return_amt",
+             "ss_net_profit"),
+        ):
+            dsel = dd[(dd.d_year == 2001) & (dd.d_moy == 12)][["d_date_sk"]]
+            mm = fact.merge(rets, left_on=skey, right_on=rkey, how="left")
+            mm = mm.merge(dsel, left_on=skey[0].replace("order_number", "sold_date_sk")
+                          .replace("ticket_number", "sold_date_sk"), right_on="d_date_sk")
+            mm = mm[(mm[ramt] > 100) & (mm[prof] > 1) & (mm[paid] > 0) & (mm[qty] > 0)]
+            g = mm.groupby(skey[1], as_index=False).agg(
+                rq=(rqty, lambda s: s.fillna(0).sum()),
+                sq=(qty, "sum"), ra=(ramt, lambda s: s.fillna(0).sum()),
+                np_=(paid, "sum"))
+            g["return_ratio"] = g.rq / g.sq
+            g["currency_ratio"] = g.ra / g.np_
+            g["return_rank"] = g.return_ratio.rank(method="min").astype(int)
+            g["currency_rank"] = g.currency_ratio.rank(method="min").astype(int)
+            g = g[(g.return_rank <= 10) | (g.currency_rank <= 10)]
+            frames.append(pd.DataFrame({
+                "channel": label, "item": g[skey[1]],
+                "return_ratio": g.return_ratio, "return_rank": g.return_rank,
+                "currency_rank": g.currency_rank}))
+        u = pd.concat(frames, ignore_index=True).drop_duplicates()
+        return u.sort_values(["channel", "return_rank", "currency_rank", "item"]
+                             ).head(100).reset_index(drop=True)
+    if q == 51:
+        dsel = dd[(dd.d_month_seq >= 1200) & (dd.d_month_seq <= 1211)][
+            ["d_date_sk", "d_date"]]
+
+        def v1(fact, ikey, dkey, price):
+            mm = fact.merge(dsel, left_on=dkey, right_on="d_date_sk")
+            g = mm.groupby([ikey, "d_date"], as_index=False).agg(s=(price, "sum"))
+            g = g.sort_values([ikey, "d_date"])
+            g["cume_sales"] = g.groupby(ikey)["s"].cumsum()
+            return g.rename(columns={ikey: "item_sk"})[["item_sk", "d_date", "cume_sales"]]
+
+        web = v1(t["web_sales"], "ws_item_sk", "ws_sold_date_sk", "ws_sales_price")
+        store = v1(ss, "ss_item_sk", "ss_sold_date_sk", "ss_sales_price")
+        j = web.merge(store, on=["item_sk", "d_date"], how="outer",
+                      suffixes=("_w", "_s"))
+        j = j.sort_values(["item_sk", "d_date"]).reset_index(drop=True)
+        # SQL MAX ignores NULLs over the frame: a side's running max carries
+        # through rows where that side is absent (pandas cummax leaves NaN
+        # at those positions — forward-fill within the partition)
+        j["web_cumulative"] = j.groupby("item_sk")["cume_sales_w"].cummax()
+        j["web_cumulative"] = j.groupby("item_sk")["web_cumulative"].ffill()
+        j["store_cumulative"] = j.groupby("item_sk")["cume_sales_s"].cummax()
+        j["store_cumulative"] = j.groupby("item_sk")["store_cumulative"].ffill()
+        j = j[j.web_cumulative > j.store_cumulative]
+        out = pd.DataFrame({
+            "item_sk": j.item_sk, "d_date": j.d_date,
+            "web_sales": j.cume_sales_w, "store_sales": j.cume_sales_s,
+            "web_cumulative": j.web_cumulative, "store_cumulative": j.store_cumulative})
+        return out.sort_values(["item_sk", "d_date"]).head(100).reset_index(drop=True)
+    if q == 5:
+        lo, hi = dt.date(2000, 8, 23), dt.date(2000, 9, 6)
+        dsel = dd[(dd.d_date >= lo) & (dd.d_date <= hi)][["d_date_sk"]]
+        st, cc, web, wr = t["store"], t["call_center"], t["web_site"], t["web_returns"]
+
+        def chan(sales_rows, dim, dim_key, dim_id):
+            mm = sales_rows.merge(dsel, left_on="date_sk", right_on="d_date_sk")
+            mm = mm.merge(dim[[dim_key, dim_id]], left_on="loc_sk", right_on=dim_key)
+            return mm.groupby(dim_id, as_index=False).agg(
+                sales=("sales_price", "sum"), profit=("profit", "sum"),
+                returns_=("return_amt", "sum"), profit_loss=("net_loss", "sum"))
+
+        def rows(df, loc, date, price=None, prof=None, ramt=None, loss=None):
+            return pd.DataFrame({
+                "loc_sk": df[loc], "date_sk": df[date],
+                "sales_price": df[price] if price else 0.0,
+                "profit": df[prof] if prof else 0.0,
+                "return_amt": df[ramt] if ramt else 0.0,
+                "net_loss": df[loss] if loss else 0.0})
+
+        ssr = chan(pd.concat([
+            rows(ss, "ss_store_sk", "ss_sold_date_sk", "ss_ext_sales_price",
+                 "ss_net_profit"),
+            rows(t["store_returns"], "sr_store_sk", "sr_returned_date_sk",
+                 ramt="sr_return_amt", loss="sr_net_loss")], ignore_index=True),
+            st, "s_store_sk", "s_store_id")
+        csr = chan(pd.concat([
+            rows(t["catalog_sales"], "cs_call_center_sk", "cs_sold_date_sk",
+                 "cs_ext_sales_price", "cs_net_profit"),
+            rows(t["catalog_returns"], "cr_call_center_sk", "cr_returned_date_sk",
+                 ramt="cr_return_amt", loss="cr_net_loss")], ignore_index=True),
+            cc, "cc_call_center_sk", "cc_call_center_id")
+        wrj = wr.merge(t["web_sales"][["ws_item_sk", "ws_order_number", "ws_web_site_sk"]],
+                       left_on=["wr_item_sk", "wr_order_number"],
+                       right_on=["ws_item_sk", "ws_order_number"], how="left")
+        wsr = chan(pd.concat([
+            rows(t["web_sales"], "ws_web_site_sk", "ws_sold_date_sk",
+                 "ws_ext_sales_price", "ws_net_profit"),
+            rows(wrj, "ws_web_site_sk", "wr_returned_date_sk",
+                 ramt="wr_return_amt", loss="wr_net_loss")], ignore_index=True),
+            web, "web_site_sk", "web_site_id")
+        frames = []
+        for label, d_, idc in (("store channel", ssr, "s_store_id"),
+                               ("catalog channel", csr, "cc_call_center_id"),
+                               ("web channel", wsr, "web_site_id")):
+            frames.append(pd.DataFrame({
+                "channel": label, "id": d_[idc], "sales": d_.sales,
+                "returns_": d_.returns_, "profit": d_.profit - d_.profit_loss}))
+        u = pd.concat(frames, ignore_index=True)
+        out = _rollup(u, ["channel", "id"], ["sales", "returns_", "profit"], "sum")
+        out = out.drop(columns=["lochierarchy"])
+        return out[["channel", "id", "sales", "returns_", "profit"]].sort_values(
+            ["channel", "id"], na_position="last").head(100).reset_index(drop=True)
+    if q == 9:
+        vals = {}
+        for i, ((qlo, qhi), thresh) in enumerate(zip(
+                [(1, 20), (21, 40), (41, 60), (61, 80), (81, 100)],
+                [3500, 3000, 10000, 2500, 15000]), 1):
+            b = ss[ss.ss_quantity.between(qlo, qhi)]
+            vals[f"bucket{i}"] = [b.ss_ext_discount_amt.mean() if len(b) > thresh
+                                  else b.ss_net_paid.mean()]
+        return pd.DataFrame(vals)
+    if q == 41:
+        i1 = it[it.i_manufact_id.between(70, 110)]
+        combos = (
+            ("Women", ["papaya", "frosted"], ["Ounce", "Ton"], ["medium", "extra large"]),
+            ("Women", ["chiffon", "lace"], ["Pound", "Dram"], ["economy", "small"]),
+            ("Men", ["orchid", "peach"], ["Bundle", "Gross"], ["N/A", "large"]),
+            ("Men", ["smoke", "dim"], ["Each", "Oz"], ["medium", "petite"]),
+        )
+        sel = np.zeros(len(it), dtype=bool)
+        for cat, colors, units, sizes in combos:
+            sel |= ((it.i_category == cat) & it.i_color.isin(colors)
+                    & it.i_units.isin(units) & it.i_size.isin(sizes)).values
+        good_manufacts = set(it[sel].i_manufact)
+        out = i1[i1.i_manufact.isin(good_manufacts)][["i_product_name"]].drop_duplicates()
+        return out.sort_values("i_product_name").head(100).reset_index(drop=True)
+    if q == 75:
+        frames = []
+        for fact, rets, ikey, dkey, skey, rkey, qty, rqty, price, ramt in (
+            (t["catalog_sales"], t["catalog_returns"], "cs_item_sk", "cs_sold_date_sk",
+             ["cs_order_number", "cs_item_sk"], ["cr_order_number", "cr_item_sk"],
+             "cs_quantity", "cr_return_quantity", "cs_ext_sales_price", "cr_return_amt"),
+            (ss, t["store_returns"], "ss_item_sk", "ss_sold_date_sk",
+             ["ss_ticket_number", "ss_item_sk"], ["sr_ticket_number", "sr_item_sk"],
+             "ss_quantity", "sr_return_quantity", "ss_ext_sales_price", "sr_return_amt"),
+            (t["web_sales"], t["web_returns"], "ws_item_sk", "ws_sold_date_sk",
+             ["ws_order_number", "ws_item_sk"], ["wr_order_number", "wr_item_sk"],
+             "ws_quantity", "wr_return_quantity", "ws_ext_sales_price", "wr_return_amt"),
+        ):
+            mm = fact.merge(it[it.i_category == "Books"][
+                ["i_item_sk", "i_brand_id", "i_class_id", "i_category_id", "i_manufact_id"]],
+                left_on=ikey, right_on="i_item_sk")
+            mm = mm.merge(dd[["d_date_sk", "d_year"]], left_on=dkey, right_on="d_date_sk")
+            mm = mm.merge(rets, left_on=skey, right_on=rkey, how="left")
+            frames.append(pd.DataFrame({
+                "d_year": mm.d_year, "i_brand_id": mm.i_brand_id,
+                "i_class_id": mm.i_class_id, "i_category_id": mm.i_category_id,
+                "i_manufact_id": mm.i_manufact_id,
+                "sales_cnt": mm[qty] - mm[rqty].fillna(0),
+                "sales_amt": mm[price] - mm[ramt].fillna(0.0)}))
+        u = pd.concat(frames, ignore_index=True).drop_duplicates()  # UNION distinct
+        g = u.groupby(["d_year", "i_brand_id", "i_class_id", "i_category_id",
+                       "i_manufact_id"], as_index=False).agg(
+            sales_cnt=("sales_cnt", "sum"), sales_amt=("sales_amt", "sum"))
+        keys = ["i_brand_id", "i_class_id", "i_category_id", "i_manufact_id"]
+        cur = g[g.d_year == 2002].merge(
+            g[g.d_year == 2001], on=keys, suffixes=("_c", "_p"))
+        cur = cur[cur.sales_cnt_c / cur.sales_cnt_p < 0.9]
+        out = pd.DataFrame({
+            "prev_year": 2001, "year_": 2002, "i_brand_id": cur.i_brand_id,
+            "i_class_id": cur.i_class_id, "i_category_id": cur.i_category_id,
+            "i_manufact_id": cur.i_manufact_id, "prev_yr_cnt": cur.sales_cnt_p,
+            "curr_yr_cnt": cur.sales_cnt_c,
+            "sales_cnt_diff": cur.sales_cnt_c - cur.sales_cnt_p,
+            "sales_amt_diff": cur.sales_amt_c - cur.sales_amt_p})
+        return out.sort_values(["sales_cnt_diff", "sales_amt_diff"]
+                               ).head(100).reset_index(drop=True)
+    if q == 77:
+        lo, hi = dt.date(2000, 8, 23), dt.date(2000, 9, 22)
+        dsel = dd[(dd.d_date >= lo) & (dd.d_date <= hi)][["d_date_sk"]]
+
+        def agg(fact, dkey, gkey, cols):
+            mm = fact.merge(dsel, left_on=dkey, right_on="d_date_sk")
+            if gkey is None:
+                return pd.DataFrame({k: [mm[v].sum()] for k, v in cols.items()})
+            return mm.groupby(gkey, as_index=False).agg(
+                **{k: (v, "sum") for k, v in cols.items()})
+
+        ssx = agg(ss, "ss_sold_date_sk", "ss_store_sk",
+                  {"sales": "ss_ext_sales_price", "profit": "ss_net_profit"})
+        srx = agg(t["store_returns"], "sr_returned_date_sk", "sr_store_sk",
+                  {"returns_": "sr_return_amt", "profit_loss": "sr_net_loss"})
+        csx = agg(t["catalog_sales"], "cs_sold_date_sk", "cs_call_center_sk",
+                  {"sales": "cs_ext_sales_price", "profit": "cs_net_profit"})
+        crx = agg(t["catalog_returns"], "cr_returned_date_sk", None,
+                  {"returns_": "cr_return_amt", "profit_loss": "cr_net_loss"})
+        wsx = agg(t["web_sales"], "ws_sold_date_sk", "ws_web_page_sk",
+                  {"sales": "ws_ext_sales_price", "profit": "ws_net_profit"})
+        wrx = agg(t["web_returns"], "wr_returned_date_sk", "wr_web_page_sk",
+                  {"returns_": "wr_return_amt", "profit_loss": "wr_net_loss"})
+        s = ssx.merge(srx, left_on="ss_store_sk", right_on="sr_store_sk", how="left")
+        sdf = pd.DataFrame({"channel": "store channel", "id": s.ss_store_sk,
+                            "sales": s.sales, "returns_": s.returns_.fillna(0),
+                            "profit": s.profit - s.profit_loss.fillna(0)})
+        c = csx.assign(returns_=crx.returns_[0], profit_loss=crx.profit_loss[0])
+        cdf = pd.DataFrame({"channel": "catalog channel", "id": c.cs_call_center_sk,
+                            "sales": c.sales, "returns_": c.returns_,
+                            "profit": c.profit - c.profit_loss})
+        w = wsx.merge(wrx, left_on="ws_web_page_sk", right_on="wr_web_page_sk", how="left")
+        wdf = pd.DataFrame({"channel": "web channel", "id": w.ws_web_page_sk,
+                            "sales": w.sales, "returns_": w.returns_.fillna(0),
+                            "profit": w.profit - w.profit_loss.fillna(0)})
+        u = pd.concat([sdf, cdf, wdf], ignore_index=True)
+        out = _rollup(u, ["channel", "id"], ["sales", "returns_", "profit"], "sum")
+        out = out.drop(columns=["lochierarchy"])
+        return out[["channel", "id", "sales", "returns_", "profit"]].sort_values(
+            ["channel", "id"], na_position="last").head(100).reset_index(drop=True)
+    if q == 78:
+        def yr(fact, rets, skey, rkey, dkey, ikey, ckey, qty, wc, sp, pfx):
+            mm = fact.merge(rets[rkey].to_frame().assign(__hit=1),
+                            left_on=skey, right_on=rkey, how="left")
+            mm = mm[mm.__hit.isna()]
+            mm = mm.merge(dd[["d_date_sk", "d_year"]], left_on=dkey, right_on="d_date_sk")
+            g = mm.groupby(["d_year", ikey, ckey], as_index=False).agg(
+                **{f"{pfx}_qty": (qty, "sum"), f"{pfx}_wc": (wc, "sum"),
+                   f"{pfx}_sp": (sp, "sum")})
+            return g.rename(columns={"d_year": f"{pfx}_sold_year", ikey: f"{pfx}_item_sk",
+                                     ckey: f"{pfx}_customer_sk"})
+
+        # join on the PAIR keys, not a single column (a sale is returned if a
+        # return row matches both its order/ticket and item)
+        def yr2(fact, rets, skeys, rkeys, dkey, ikey, ckey, qty, wc, sp, pfx):
+            rsub = rets[rkeys].drop_duplicates().assign(__hit=1)
+            mm = fact.merge(rsub, left_on=skeys, right_on=rkeys, how="left")
+            mm = mm[mm.__hit.isna()]
+            mm = mm.merge(dd[["d_date_sk", "d_year"]], left_on=dkey, right_on="d_date_sk")
+            g = mm.groupby(["d_year", ikey, ckey], as_index=False).agg(
+                **{f"{pfx}_qty": (qty, "sum"), f"{pfx}_wc": (wc, "sum"),
+                   f"{pfx}_sp": (sp, "sum")})
+            return g.rename(columns={"d_year": f"{pfx}_sold_year", ikey: f"{pfx}_item_sk",
+                                     ckey: f"{pfx}_customer_sk"})
+
+        wsy = yr2(t["web_sales"], t["web_returns"], ["ws_order_number", "ws_item_sk"],
+                  ["wr_order_number", "wr_item_sk"], "ws_sold_date_sk", "ws_item_sk",
+                  "ws_bill_customer_sk", "ws_quantity", "ws_wholesale_cost",
+                  "ws_sales_price", "ws")
+        csy = yr2(t["catalog_sales"], t["catalog_returns"],
+                  ["cs_order_number", "cs_item_sk"], ["cr_order_number", "cr_item_sk"],
+                  "cs_sold_date_sk", "cs_item_sk", "cs_bill_customer_sk",
+                  "cs_quantity", "cs_wholesale_cost", "cs_sales_price", "cs")
+        ssy = yr2(ss, t["store_returns"], ["ss_ticket_number", "ss_item_sk"],
+                  ["sr_ticket_number", "sr_item_sk"], "ss_sold_date_sk", "ss_item_sk",
+                  "ss_customer_sk", "ss_quantity", "ss_wholesale_cost",
+                  "ss_sales_price", "ss")
+        j = ssy.merge(wsy, left_on=["ss_sold_year", "ss_item_sk", "ss_customer_sk"],
+                      right_on=["ws_sold_year", "ws_item_sk", "ws_customer_sk"],
+                      how="left")
+        j = j.merge(csy, left_on=["ss_sold_year", "ss_item_sk", "ss_customer_sk"],
+                    right_on=["cs_sold_year", "cs_item_sk", "cs_customer_sk"],
+                    how="left")
+        j = j[(j.ws_qty.fillna(0) > 0) | (j.cs_qty.fillna(0) > 0)]
+        j = j[j.ss_sold_year == 2000]
+        out = pd.DataFrame({
+            "ss_sold_year": j.ss_sold_year, "ss_item_sk": j.ss_item_sk,
+            "ss_customer_sk": j.ss_customer_sk,
+            "ratio": np.round(j.ss_qty / (j.ws_qty.fillna(0) + j.cs_qty.fillna(0)), 2),
+            "store_qty": j.ss_qty, "store_wholesale_cost": j.ss_wc,
+            "store_sales_price": j.ss_sp,
+            "other_chan_qty": j.ws_qty.fillna(0) + j.cs_qty.fillna(0),
+            "other_chan_wholesale_cost": j.ws_wc.fillna(0) + j.cs_wc.fillna(0),
+            "other_chan_sales_price": j.ws_sp.fillna(0) + j.cs_sp.fillna(0)})
+        out = out.sort_values(
+            ["ss_sold_year", "ss_item_sk", "ss_customer_sk", "store_qty",
+             "store_wholesale_cost", "store_sales_price", "other_chan_qty",
+             "other_chan_wholesale_cost", "other_chan_sales_price", "ratio"],
+            ascending=[True, True, True, False, False, False, True, True, True, True])
+        return out.head(100).reset_index(drop=True)
+    if q == 80:
+        lo, hi = dt.date(2000, 8, 23), dt.date(2000, 9, 22)
+        dsel = dd[(dd.d_date >= lo) & (dd.d_date <= hi)][["d_date_sk"]]
+        pr = t["promotion"]
+
+        def chan(fact, rets, skeys, rkeys, dkey, ikey, pkey, lkey, dim, dkey2,
+                 idc, price, prof, ramt, loss, label):
+            mm = fact.merge(rets[rkeys + [ramt, loss]], left_on=skeys,
+                            right_on=rkeys, how="left")
+            mm = mm.merge(dsel, left_on=dkey, right_on="d_date_sk")
+            mm = mm.merge(it[it.i_current_price > 50][["i_item_sk"]],
+                          left_on=ikey, right_on="i_item_sk")
+            mm = mm.merge(pr[pr.p_channel_tv == "N"][["p_promo_sk"]],
+                          left_on=pkey, right_on="p_promo_sk")
+            mm = mm.merge(dim[[dkey2, idc]], left_on=lkey, right_on=dkey2)
+            g = mm.groupby(idc, as_index=False).apply(
+                lambda x: pd.Series({
+                    "sales": x[price].sum(),
+                    "returns_": x[ramt].fillna(0).sum(),
+                    "profit": (x[prof] - x[loss].fillna(0)).sum()}),
+                include_groups=False)
+            return pd.DataFrame({"channel": label, "id": g[idc], "sales": g.sales,
+                                 "returns_": g.returns_, "profit": g.profit})
+
+        sdf = chan(ss, t["store_returns"], ["ss_item_sk", "ss_ticket_number"],
+                   ["sr_item_sk", "sr_ticket_number"], "ss_sold_date_sk",
+                   "ss_item_sk", "ss_promo_sk", "ss_store_sk", t["store"],
+                   "s_store_sk", "s_store_id", "ss_ext_sales_price",
+                   "ss_net_profit", "sr_return_amt", "sr_net_loss", "store channel")
+        cdf = chan(t["catalog_sales"], t["catalog_returns"],
+                   ["cs_item_sk", "cs_order_number"], ["cr_item_sk", "cr_order_number"],
+                   "cs_sold_date_sk", "cs_item_sk", "cs_promo_sk",
+                   "cs_call_center_sk", t["call_center"], "cc_call_center_sk",
+                   "cc_call_center_id", "cs_ext_sales_price", "cs_net_profit",
+                   "cr_return_amt", "cr_net_loss", "catalog channel")
+        wdf = chan(t["web_sales"], t["web_returns"], ["ws_item_sk", "ws_order_number"],
+                   ["wr_item_sk", "wr_order_number"], "ws_sold_date_sk",
+                   "ws_item_sk", "ws_promo_sk", "ws_web_site_sk", t["web_site"],
+                   "web_site_sk", "web_site_id", "ws_ext_sales_price",
+                   "ws_net_profit", "wr_return_amt", "wr_net_loss", "web channel")
+        u = pd.concat([sdf, cdf, wdf], ignore_index=True)
+        out = _rollup(u, ["channel", "id"], ["sales", "returns_", "profit"], "sum")
+        out = out.drop(columns=["lochierarchy"])
+        return out[["channel", "id", "sales", "returns_", "profit"]].sort_values(
+            ["channel", "id"], na_position="last").head(100).reset_index(drop=True)
+    if q == 14:
+        def brand_sets(fact, ikey, dkey):
+            mm = fact.merge(dd[(dd.d_year >= 1999) & (dd.d_year <= 2001)][["d_date_sk"]],
+                            left_on=dkey, right_on="d_date_sk")
+            mm = mm.merge(it[["i_item_sk", "i_brand_id", "i_class_id", "i_category_id"]],
+                          left_on=ikey, right_on="i_item_sk")
+            return set(map(tuple, mm[["i_brand_id", "i_class_id", "i_category_id"]]
+                           .drop_duplicates().values))
+
+        common = (brand_sets(ss, "ss_item_sk", "ss_sold_date_sk")
+                  & brand_sets(t["catalog_sales"], "cs_item_sk", "cs_sold_date_sk")
+                  & brand_sets(t["web_sales"], "ws_item_sk", "ws_sold_date_sk"))
+        trip = it[["i_item_sk", "i_brand_id", "i_class_id", "i_category_id"]].copy()
+        cross_items = set(trip[[tuple(r) in common for r in
+                                trip[["i_brand_id", "i_class_id", "i_category_id"]].values]]
+                          .i_item_sk)
+        ql = []
+        for fact, qty, lp, dkey in ((ss, "ss_quantity", "ss_list_price", "ss_sold_date_sk"),
+                                    (t["catalog_sales"], "cs_quantity", "cs_list_price", "cs_sold_date_sk"),
+                                    (t["web_sales"], "ws_quantity", "ws_list_price", "ws_sold_date_sk")):
+            mm = fact.merge(dd[(dd.d_year >= 1999) & (dd.d_year <= 2001)][["d_date_sk"]],
+                            left_on=dkey, right_on="d_date_sk")
+            ql.append(mm[qty] * mm[lp])
+        average_sales = pd.concat(ql, ignore_index=True).mean()
+        frames = []
+        for label, fact, ikey, qty, lp, dkey in (
+            ("store", ss, "ss_item_sk", "ss_quantity", "ss_list_price", "ss_sold_date_sk"),
+            ("catalog", t["catalog_sales"], "cs_item_sk", "cs_quantity", "cs_list_price", "cs_sold_date_sk"),
+            ("web", t["web_sales"], "ws_item_sk", "ws_quantity", "ws_list_price", "ws_sold_date_sk"),
+        ):
+            mm = fact[fact[ikey].isin(cross_items)]
+            mm = mm.merge(dd[(dd.d_year == 2001) & (dd.d_moy == 11)][["d_date_sk"]],
+                          left_on=dkey, right_on="d_date_sk")
+            mm = mm.merge(it[["i_item_sk", "i_brand_id", "i_class_id", "i_category_id"]],
+                          left_on=ikey, right_on="i_item_sk")
+            mm["v"] = mm[qty] * mm[lp]
+            g = mm.groupby(["i_brand_id", "i_class_id", "i_category_id"],
+                           as_index=False).agg(sales=("v", "sum"), number_sales=("v", "size"))
+            g = g[g.sales > average_sales]
+            g.insert(0, "channel", label)
+            frames.append(g)
+        u = pd.concat(frames, ignore_index=True)
+        out = _rollup(u, ["channel", "i_brand_id", "i_class_id", "i_category_id"],
+                      ["sales", "number_sales"], "sum").drop(columns=["lochierarchy"])
+        out = out[["channel", "i_brand_id", "i_class_id", "i_category_id",
+                   "sales", "number_sales"]]
+        return out.sort_values(["channel", "i_brand_id", "i_class_id", "i_category_id"],
+                               na_position="last").head(100).reset_index(drop=True)
+    if q == 24:
+        sr, st, cu, ca = t["store_returns"], t["store"], t["customer"], t["customer_address"]
+        m = ss.merge(sr[["sr_ticket_number", "sr_item_sk"]],
+                     left_on=["ss_ticket_number", "ss_item_sk"],
+                     right_on=["sr_ticket_number", "sr_item_sk"])
+        m = m.merge(st[st.s_market_id == 8], left_on="ss_store_sk", right_on="s_store_sk")
+        m = m.merge(it, left_on="ss_item_sk", right_on="i_item_sk")
+        m = m.merge(cu, left_on="ss_customer_sk", right_on="c_customer_sk")
+        m = m.merge(ca, left_on="c_current_addr_sk", right_on="ca_address_sk")
+        m = m[(m.c_birth_country != m.ca_country.str.upper()) & (m.s_zip == m.ca_zip)]
+        keys = ["c_last_name", "c_first_name", "s_store_name", "ca_state", "s_state",
+                "i_color", "i_current_price", "i_manager_id", "i_units", "i_size"]
+        ssales = m.groupby(keys, as_index=False).agg(netpaid=("ss_net_paid", "sum"))
+        thresh = 0.05 * ssales.netpaid.mean()
+        peach = ssales[ssales.i_color == "peach"]
+        g = peach.groupby(["c_last_name", "c_first_name", "s_store_name"],
+                          as_index=False).agg(paid=("netpaid", "sum"))
+        g = g[g.paid > thresh]
+        return g.sort_values(["c_last_name", "c_first_name", "s_store_name"]
+                             ).reset_index(drop=True)
+    if q == 72:
+        cs, inv, wh = t["catalog_sales"], t["inventory"], t["warehouse"]
+        cd, hd, pr, cr = (t["customer_demographics"], t["household_demographics"],
+                          t["promotion"], t["catalog_returns"])
+        m = cs.merge(dd[dd.d_year == 1999][["d_date_sk", "d_week_seq", "d_date"]]
+                     .rename(columns={"d_date_sk": "d1_sk", "d_week_seq": "wk1",
+                                      "d_date": "date1"}),
+                     left_on="cs_sold_date_sk", right_on="d1_sk")
+        m = m.merge(cd[cd.cd_marital_status == "D"][["cd_demo_sk"]],
+                    left_on="cs_bill_cdemo_sk", right_on="cd_demo_sk")
+        m = m.merge(hd[hd.hd_buy_potential == ">10000"][["hd_demo_sk"]],
+                    left_on="cs_bill_hdemo_sk", right_on="hd_demo_sk")
+        m = m.merge(dd[["d_date_sk", "d_date"]].rename(
+            columns={"d_date_sk": "d3_sk", "d_date": "date3"}),
+            left_on="cs_ship_date_sk", right_on="d3_sk")
+        m = m[[d3 > d1 + dt.timedelta(days=5)
+               for d1, d3 in zip(m.date1, m.date3)]]
+        m = m.merge(inv, left_on="cs_item_sk", right_on="inv_item_sk")
+        m = m.merge(dd[["d_date_sk", "d_week_seq"]].rename(
+            columns={"d_date_sk": "d2_sk", "d_week_seq": "wk2"}),
+            left_on="inv_date_sk", right_on="d2_sk")
+        m = m[(m.wk1 == m.wk2) & (m.inv_quantity_on_hand < m.cs_quantity)]
+        m = m.merge(wh[["w_warehouse_sk", "w_warehouse_name"]],
+                    left_on="inv_warehouse_sk", right_on="w_warehouse_sk")
+        m = m.merge(it[["i_item_sk", "i_item_desc"]], left_on="cs_item_sk",
+                    right_on="i_item_sk")
+        m = m.merge(pr[["p_promo_sk"]], left_on="cs_promo_sk", right_on="p_promo_sk",
+                    how="left")
+        m = m.merge(cr[["cr_item_sk", "cr_order_number"]],
+                    left_on=["cs_item_sk", "cs_order_number"],
+                    right_on=["cr_item_sk", "cr_order_number"], how="left")
+        g = m.groupby(["i_item_desc", "w_warehouse_name", "wk1"], as_index=False).agg(
+            no_promo=("p_promo_sk", lambda s: int(s.isna().sum())),
+            promo=("p_promo_sk", lambda s: int(s.notna().sum())),
+            total_cnt=("p_promo_sk", "size"))
+        out = g.rename(columns={"wk1": "d_week_seq"})
+        return out.sort_values(["total_cnt", "i_item_desc", "w_warehouse_name",
+                                "d_week_seq"], ascending=[False, True, True, True]
+                               ).head(100).reset_index(drop=True)
+    if q == 64:
+        cs, cr, sr = t["catalog_sales"], t["catalog_returns"], t["store_returns"]
+        st, cu, ca = t["store"], t["customer"], t["customer_address"]
+        cd, hd, pr, ib = (t["customer_demographics"], t["household_demographics"],
+                          t["promotion"], t["income_band"])
+        ui = cs.merge(cr, left_on=["cs_item_sk", "cs_order_number"],
+                      right_on=["cr_item_sk", "cr_order_number"])
+        ui["refund"] = ui.cr_return_amt + ui.cr_net_loss
+        g = ui.groupby("cs_item_sk", as_index=False).agg(
+            sale=("cs_ext_list_price", "sum"), refund=("refund", "sum"))
+        cs_ui_items = set(g[g.sale > 2 * g.refund].cs_item_sk)
+
+        itf = it[(it.i_color.isin(["maroon", "burnished", "dim", "frosted",
+                                   "papaya", "peach"]))
+                 & (it.i_current_price >= 65) & (it.i_current_price <= 74)]
+        m = ss.merge(sr[["sr_item_sk", "sr_ticket_number"]],
+                     left_on=["ss_item_sk", "ss_ticket_number"],
+                     right_on=["sr_item_sk", "sr_ticket_number"])
+        m = m[m.ss_item_sk.isin(cs_ui_items)]
+        m = m.merge(itf[["i_item_sk", "i_product_name"]], left_on="ss_item_sk",
+                    right_on="i_item_sk")
+        m = m.merge(st[["s_store_sk", "s_store_name", "s_zip"]],
+                    left_on="ss_store_sk", right_on="s_store_sk")
+        m = m.merge(dd[["d_date_sk", "d_year"]].rename(
+            columns={"d_date_sk": "d1", "d_year": "syear"}),
+            left_on="ss_sold_date_sk", right_on="d1")
+        m = m.merge(cu[["c_customer_sk", "c_current_cdemo_sk", "c_current_hdemo_sk",
+                        "c_current_addr_sk", "c_first_sales_date_sk",
+                        "c_first_shipto_date_sk"]],
+                    left_on="ss_customer_sk", right_on="c_customer_sk")
+        m = m.merge(cd[["cd_demo_sk", "cd_marital_status"]].add_prefix("x1_"),
+                    left_on="ss_cdemo_sk", right_on="x1_cd_demo_sk")
+        m = m.merge(cd[["cd_demo_sk", "cd_marital_status"]].add_prefix("x2_"),
+                    left_on="c_current_cdemo_sk", right_on="x2_cd_demo_sk")
+        m = m[m.x1_cd_marital_status != m.x2_cd_marital_status]
+        m = m.merge(hd[["hd_demo_sk", "hd_income_band_sk"]].add_prefix("h1_"),
+                    left_on="ss_hdemo_sk", right_on="h1_hd_demo_sk")
+        m = m.merge(hd[["hd_demo_sk", "hd_income_band_sk"]].add_prefix("h2_"),
+                    left_on="c_current_hdemo_sk", right_on="h2_hd_demo_sk")
+        m = m.merge(ib[["ib_income_band_sk"]].add_prefix("b1_"),
+                    left_on="h1_hd_income_band_sk", right_on="b1_ib_income_band_sk")
+        m = m.merge(ib[["ib_income_band_sk"]].add_prefix("b2_"),
+                    left_on="h2_hd_income_band_sk", right_on="b2_ib_income_band_sk")
+        m = m.merge(ca.add_prefix("a1_"), left_on="ss_addr_sk",
+                    right_on="a1_ca_address_sk")
+        m = m.merge(ca.add_prefix("a2_"), left_on="c_current_addr_sk",
+                    right_on="a2_ca_address_sk")
+        m = m.merge(pr[["p_promo_sk"]], left_on="ss_promo_sk", right_on="p_promo_sk")
+        m = m.merge(dd[["d_date_sk", "d_year"]].rename(
+            columns={"d_date_sk": "d2", "d_year": "fsyear"}),
+            left_on="c_first_sales_date_sk", right_on="d2")
+        m = m.merge(dd[["d_date_sk", "d_year"]].rename(
+            columns={"d_date_sk": "d3", "d_year": "s2year"}),
+            left_on="c_first_shipto_date_sk", right_on="d3")
+        keys = ["i_product_name", "ss_item_sk", "s_store_name", "s_zip",
+                "a1_ca_street_number", "a1_ca_street_name", "a1_ca_zip",
+                "a2_ca_street_number", "a2_ca_street_name", "a2_ca_zip",
+                "syear", "fsyear", "s2year"]
+        cross = m.groupby(keys, as_index=False).agg(
+            cnt=("ss_item_sk", "size"), s1=("ss_wholesale_cost", "sum"),
+            s2=("ss_list_price", "sum"), s3=("ss_coupon_amt", "sum"))
+        c1 = cross[cross.syear == 1999]
+        c2 = cross[cross.syear == 2000]
+        j = c1.merge(c2, left_on=["ss_item_sk", "s_store_name", "s_zip"],
+                     right_on=["ss_item_sk", "s_store_name", "s_zip"],
+                     suffixes=("", "_2"))
+        j = j[j.cnt_2 <= j.cnt]
+        out = pd.DataFrame({
+            "product_name": j.i_product_name, "store_name": j.s_store_name,
+            "store_zip": j.s_zip, "b_street_number": j.a1_ca_street_number,
+            "b_street_name": j.a1_ca_street_name, "b_zip": j.a1_ca_zip,
+            "c_street_number": j.a2_ca_street_number,
+            "c_street_name": j.a2_ca_street_name, "c_zip": j.a2_ca_zip,
+            "syear": j.syear, "cnt": j.cnt, "s11": j.s1, "s21": j.s2,
+            "s31": j.s3, "s12": j.s1_2, "s22": j.s2_2, "s32": j.s3_2,
+            "syear2": j.syear_2, "cnt2": j.cnt_2})
+        return out.sort_values(["product_name", "store_name", "cnt2", "s11", "s12"]
+                               ).head(100).reset_index(drop=True)
     raise ValueError(f"no oracle for q{q}")
 
 

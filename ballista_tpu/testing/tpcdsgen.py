@@ -89,6 +89,7 @@ def generate_tpcds(out_dir: str, scale: float = 1.0, seed: int = 17,
         np.where((hours >= 17) & (hours <= 20), "dinner", ""))
     time_dim = pa.table({
         "t_time_sk": pa.array(secs, pa.int64()),
+        "t_time": pa.array(secs, pa.int64()),  # seconds since midnight (spec)
         "t_hour": pa.array(hours, pa.int64()),
         "t_minute": pa.array((secs % 3600) // 60, pa.int64()),
         "t_meal_time": pa.array(meal),
@@ -137,6 +138,7 @@ def generate_tpcds(out_dir: str, scale: float = 1.0, seed: int = 17,
         "s_state": pa.array([STATES[i % len(STATES)] for i in range(n_stores)]),
         "s_zip": pa.array([ZIP_POOL[i * 7 % len(ZIP_POOL)] for i in range(n_stores)]),
         "s_gmt_offset": pa.array([[-5.0, -6.0, -7.0, -8.0][i % 4] for i in range(n_stores)]),
+        "s_market_id": pa.array([i % 10 + 1 for i in range(n_stores)], pa.int64()),
         "s_company_id": pa.array([1] * n_stores, pa.int64()),
         "s_company_name": pa.array(["Unknown"] * n_stores),
         "s_street_number": pa.array([str(100 + i) for i in range(n_stores)]),
@@ -169,11 +171,14 @@ def generate_tpcds(out_dir: str, scale: float = 1.0, seed: int = 17,
 
     # ---- customer_address / customer ------------------------------------
     _ra = np.random.default_rng(seed + 12)
+    # county/state follow the same cyclic pairing as stores, so the
+    # "customer's county has a store" join (q54) is satisfiable
+    _ca_idx = rng.integers(0, 10_000, n_addresses)
     customer_address = pa.table({
         "ca_address_sk": pa.array(range(1, n_addresses + 1), pa.int64()),
         "ca_city": pa.array(rng.choice(CITIES, n_addresses)),
-        "ca_county": pa.array(rng.choice(COUNTIES, n_addresses)),
-        "ca_state": pa.array(rng.choice(STATES, n_addresses)),
+        "ca_county": pa.array([COUNTIES[i % len(COUNTIES)] for i in _ca_idx]),
+        "ca_state": pa.array([STATES[i % len(STATES)] for i in _ca_idx]),
         "ca_zip": pa.array(rng.choice(ZIP_POOL, n_addresses)),
         "ca_country": pa.array(["United States"] * n_addresses),
         "ca_gmt_offset": pa.array(rng.choice([-5.0, -6.0, -7.0, -8.0], n_addresses)),
@@ -196,14 +201,21 @@ def generate_tpcds(out_dir: str, scale: float = 1.0, seed: int = 17,
         "c_current_addr_sk": pa.array(rng.integers(1, n_addresses + 1, n_customers), pa.int64()),
         "c_current_cdemo_sk": pa.array(rng.integers(1, n_cd + 1, n_customers), pa.int64()),
         "c_current_hdemo_sk": pa.array(rng.integers(1, n_hd + 1, n_customers), pa.int64()),
-        "c_birth_country": pa.array(["UNITED STATES"] * n_customers),
         **(lambda r: {
+            # mostly-domestic with a foreign tail: q24's
+            # `c_birth_country <> upper(ca_country)` must be satisfiable
+            "c_birth_country": pa.array(np.where(
+                r.random(n_customers) < 0.9, "UNITED STATES", "CANADA")),
             "c_birth_day": pa.array(r.integers(1, 29, n_customers), pa.int64()),
             "c_birth_month": pa.array(r.integers(1, 13, n_customers), pa.int64()),
             "c_birth_year": pa.array(r.integers(1930, 1993, n_customers), pa.int64()),
             "c_email_address": pa.array(
                 [f"c{i}@example.com" for i in range(1, n_customers + 1)]),
             "c_login": pa.array([f"login{i}" for i in range(1, n_customers + 1)]),
+            "c_first_sales_date_sk": pa.array(
+                r.integers(2450815, 2450815 + 365, n_customers), pa.int64()),
+            "c_first_shipto_date_sk": pa.array(
+                r.integers(2450815, 2450815 + 730, n_customers), pa.int64()),
         })(np.random.default_rng(seed + 13)),
     })
 
@@ -372,6 +384,7 @@ def generate_tpcds(out_dir: str, scale: float = 1.0, seed: int = 17,
             f"{prefix}_ext_sales_price": pa.array(ext),
             f"{prefix}_ext_list_price": pa.array(ext_list),
             f"{prefix}_ext_discount_amt": pa.array(np.round(ext_list - ext, 2)),
+            f"{prefix}_ext_ship_cost": pa.array(np.round(ext * r.uniform(0.01, 0.2, rows), 2)),
             f"{prefix}_net_paid": pa.array(np.round(ext - coupon, 2)),
             f"{prefix}_net_profit": pa.array(np.round(ext * r.uniform(-0.2, 0.4, rows), 2)),
         }
@@ -462,6 +475,13 @@ def generate_tpcds(out_dir: str, scale: float = 1.0, seed: int = 17,
         "wr_refunded_addr_sk": "ws_bill_addr_sk",
         "wr_web_page_sk": "ws_web_page_sk",
     })
+    _rwr = np.random.default_rng(seed + 18)
+    _wr_amt = web_returns.column("wr_return_amt").to_numpy()
+    web_returns = web_returns.append_column(
+        "wr_fee", pa.array(np.round(_rwr.uniform(0.5, 100.0, len(_wr_amt)), 2)))
+    web_returns = web_returns.append_column(
+        "wr_refund_cash",
+        pa.array(np.round(_wr_amt * _rwr.uniform(0.2, 1.0, len(_wr_amt)), 2)))
 
     tables = {
         "date_dim": date_dim, "time_dim": time_dim, "item": item, "store": store,

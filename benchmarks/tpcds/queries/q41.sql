@@ -1,0 +1,24 @@
+select distinct i_product_name
+from item i1
+where i_manufact_id between 70 and 70 + 40
+  and (select count(*) as item_cnt
+       from item
+       where i_manufact = i1.i_manufact
+         and ((i_category = 'Women'
+               and (i_color = 'papaya' or i_color = 'frosted')
+               and (i_units = 'Ounce' or i_units = 'Ton')
+               and (i_size = 'medium' or i_size = 'extra large'))
+              or (i_category = 'Women'
+                  and (i_color = 'chiffon' or i_color = 'lace')
+                  and (i_units = 'Pound' or i_units = 'Dram')
+                  and (i_size = 'economy' or i_size = 'small'))
+              or (i_category = 'Men'
+                  and (i_color = 'orchid' or i_color = 'peach')
+                  and (i_units = 'Bundle' or i_units = 'Gross')
+                  and (i_size = 'N/A' or i_size = 'large'))
+              or (i_category = 'Men'
+                  and (i_color = 'smoke' or i_color = 'dim')
+                  and (i_units = 'Each' or i_units = 'Oz')
+                  and (i_size = 'medium' or i_size = 'petite')))) > 0
+order by i_product_name
+limit 100

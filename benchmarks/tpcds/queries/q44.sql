@@ -1,0 +1,32 @@
+select asceding.rnk, i1.i_product_name best_performing,
+       i2.i_product_name worst_performing
+from (select item_sk, rnk
+      from (select ss_item_sk item_sk, avg(ss_net_profit) rank_col,
+                   rank() over (order by avg(ss_net_profit) asc) rnk
+            from store_sales ss1
+            where ss_store_sk = 4
+            group by ss_item_sk
+            having avg(ss_net_profit) > 0.9 * (select avg(ss_net_profit) rank_col
+                                               from store_sales
+                                               where ss_store_sk = 4
+                                                 and ss_addr_sk is null
+                                               group by ss_store_sk)) v1
+      where rnk < 11) asceding,
+     (select item_sk, rnk
+      from (select ss_item_sk item_sk, avg(ss_net_profit) rank_col,
+                   rank() over (order by avg(ss_net_profit) desc) rnk
+            from store_sales ss1
+            where ss_store_sk = 4
+            group by ss_item_sk
+            having avg(ss_net_profit) > 0.9 * (select avg(ss_net_profit) rank_col
+                                               from store_sales
+                                               where ss_store_sk = 4
+                                                 and ss_addr_sk is null
+                                               group by ss_store_sk)) v2
+      where rnk < 11) descending,
+     item i1, item i2
+where asceding.rnk = descending.rnk
+  and i1.i_item_sk = asceding.item_sk
+  and i2.i_item_sk = descending.item_sk
+order by asceding.rnk
+limit 100
