@@ -39,6 +39,7 @@ from ballista_tpu.plan.expressions import (
     Literal,
     Negative,
     Not,
+    ScalarFunction,
     ScalarSubquery,
     and_,
     collect_columns,
@@ -423,8 +424,17 @@ class Decorrelator:
             value_expr = value_expr.expr
 
         if not corr_keys:
-            # uncorrelated: single-row aggregate, cross join
             new_agg = Aggregate(new_below, list(agg.group_exprs), list(agg.agg_exprs))
+            if agg.group_exprs:
+                # grouped: may yield 0 or >1 rows — evaluate eagerly so an
+                # empty result becomes NULL (a CrossJoin would wipe every
+                # outer row) and >1 rows raises per SQL
+                vals = _eval_uncorrelated_column(
+                    Projection(new_agg, [Alias(value_expr, "__value")]),
+                    dedup=False, max_values=1, what="scalar subquery",
+                    overflow_hint=" (SQL allows at most one row)")
+                return outer, Literal(vals[0] if vals else None)
+            # ungrouped aggregate: exactly one row, cross join
             value = Projection(new_agg, [Alias(value_expr, "__value")])
             aliased = SubqueryAlias(value, alias_name)
             return CrossJoin(outer, aliased), Column("__value", alias_name)
@@ -432,14 +442,38 @@ class Decorrelator:
         inner_cols = [ik for (_, ik) in corr_keys]
         group_exprs = list(agg.group_exprs) + [c for c in inner_cols if c not in agg.group_exprs]
         new_agg = Aggregate(new_below, group_exprs, list(agg.agg_exprs))
-        proj_exprs: list[Expr] = [Column(c.output_name(), c.qualifier if isinstance(c, Column) else None) for c in inner_cols]
+        # correlation keys get INTERNAL names: re-exposing e.g. `k` through
+        # the __sqN alias makes any later unqualified `k` ambiguous
+        proj_exprs: list[Expr] = [
+            Alias(Column(c.output_name(), c.qualifier if isinstance(c, Column) else None),
+                  f"__ck{i}")
+            for i, c in enumerate(inner_cols)
+        ]
         proj_exprs.append(Alias(value_expr, "__value"))
         value = Projection(new_agg, proj_exprs)
         aliased = SubqueryAlias(value, alias_name)
         join_on = [
-            (ok, Column(ik.output_name(), alias_name)) for (ok, ik) in corr_keys
+            (ok, Column(f"__ck{i}", alias_name))
+            for i, (ok, _) in enumerate(corr_keys)
         ]
-        return Join(outer, aliased, join_on, join_type, None), Column("__value", alias_name)
+        repl: Expr = Column("__value", alias_name)
+        if join_type == "left" and _is_count_only(agg):
+            # COUNT over no matching rows is 0, not NULL (the left join's
+            # null marker must not leak as the count)
+            repl = ScalarFunction("coalesce", (repl, Literal(0)))
+        return Join(outer, aliased, join_on, join_type, None), repl
+
+
+def _is_count_only(agg: Aggregate) -> bool:
+    """True when every aggregate in the node is a count (the no-match value
+    under a left join must then be 0, not NULL)."""
+    from ballista_tpu.plan.expressions import AggregateFunction
+
+    def fn(e: Expr):
+        e = e.expr if isinstance(e, Alias) else e
+        return isinstance(e, AggregateFunction) and e.func in ("count", "count_distinct")
+
+    return bool(agg.agg_exprs) and all(fn(a) for a in agg.agg_exprs)
 
 
 def _find_agg_pattern(sub: LogicalPlan):

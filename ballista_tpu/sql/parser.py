@@ -226,13 +226,12 @@ class Parser:
         while self.peek().is_kw("UNION", "EXCEPT", "INTERSECT"):
             kw = self.next().value
             all_ = self.accept_kw("ALL")
-            if kw != "UNION" and all_:
-                raise SqlParseError(f"{kw} ALL is unsupported (set semantics only)")
             # standard SQL: set-op branches take no bare ORDER BY/LIMIT —
             # trailing clauses bind to the whole chain
             rhs = self._parse_query_term(allow_order=False)
             op = {"UNION": "union_all" if all_ else "union",
-                  "EXCEPT": "except", "INTERSECT": "intersect"}[kw]
+                  "EXCEPT": "except_all" if all_ else "except",
+                  "INTERSECT": "intersect_all" if all_ else "intersect"}[kw]
             cur.set_op = (op, rhs)
             cur = rhs
         # trailing ORDER BY / LIMIT of a set operation

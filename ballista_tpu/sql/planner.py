@@ -77,9 +77,9 @@ class SqlPlanner:
                 cur = rhs
             terms: list[tuple[str | None, LogicalPlan]] = []
             for op, p_ in chain:
-                if op == "intersect" and terms:
+                if op in ("intersect", "intersect_all") and terms:
                     lop, lp = terms[-1]
-                    terms[-1] = (lop, self._set_op_join(lp, p_, "intersect"))
+                    terms[-1] = (lop, self._set_op_join(lp, p_, op))
                 else:
                     terms.append((op, p_))
             plan = terms[0][1]
@@ -88,8 +88,8 @@ class SqlPlanner:
                     plan = Union([plan, p_], all=(op == "union_all"))
                     if op == "union":
                         plan = Distinct(plan)
-                else:  # except
-                    plan = self._set_op_join(plan, p_, "except")
+                else:  # except / except_all
+                    plan = self._set_op_join(plan, p_, op)
             if stmt.order_by:
                 keys = []
                 for sk in stmt.order_by:
@@ -212,14 +212,19 @@ class SqlPlanner:
 
     def _set_op_join(self, left: LogicalPlan, right: LogicalPlan, op: str) -> LogicalPlan:
         """INTERSECT = distinct left SEMI-joined to right on every column;
-        EXCEPT = distinct left ANTI-joined. Keys are null-safe: each column
-        contributes (IS NULL flag, COALESCE(col, typed default)) so NULLs
-        compare equal per SQL set semantics without sentinel collisions."""
+        EXCEPT = distinct left ANTI-joined. The ALL (bag) forms number
+        duplicate rows per side with row_number() partitioned by the whole
+        row and include the number in the join key — the k-th copy on the
+        left matches only a k-th copy on the right (standard lowering).
+        Keys are null-safe: each column contributes (IS NULL flag,
+        COALESCE(col, typed default)) so NULLs compare equal per SQL set
+        semantics without sentinel collisions."""
         import datetime as _dt
 
         import pyarrow as _pa
 
-        from ballista_tpu.plan.expressions import IsNull, ScalarFunction
+        from ballista_tpu.plan.expressions import IsNull, ScalarFunction, WindowFunction
+        from ballista_tpu.plan.logical import Window
 
         if len(left.schema.fields) != len(right.schema.fields):
             raise PlanningError(f"{op.upper()} arity mismatch")
@@ -242,18 +247,36 @@ class SqlPlanner:
                 return Literal(_dt.date(1970, 1, 1))
             return Literal("")
 
-        lw = SubqueryAlias(Distinct(left), "__setl")
-        rw = SubqueryAlias(right, "__setr")
+        bag = op.endswith("_all")
+        n_cols = len(left.schema.fields)
+
+        def numbered(side: LogicalPlan) -> LogicalPlan:
+            part = tuple(Column(f.name, f.qualifier) for f in side.schema.fields)
+            w = Window(side, [WindowFunction("row_number", (), part, ())])
+            # __win0 → a stable name distinct from user columns
+            return Projection(w, [Column(f.name, f.qualifier)
+                                  for f in side.schema.fields]
+                              + [Alias(Column("__win0"), "__dup_n")])
+
+        if bag:
+            lw = SubqueryAlias(numbered(left), "__setl")
+            rw = SubqueryAlias(numbered(right), "__setr")
+        else:
+            lw = SubqueryAlias(Distinct(left), "__setl")
+            rw = SubqueryAlias(right, "__setr")
         on = []
-        for lf, rf in zip(lw.schema.fields, rw.schema.fields):
+        for lf, rf in list(zip(lw.schema.fields, rw.schema.fields))[:n_cols]:
             lc, rc = Column(lf.name, "__setl"), Column(rf.name, "__setr")
             on.append((IsNull(lc), IsNull(rc)))
             on.append((ScalarFunction("coalesce", (lc, default_for(lf.dtype))),
                        ScalarFunction("coalesce", (rc, default_for(rf.dtype)))))
-        jt = "left_semi" if op == "intersect" else "left_anti"
+        if bag:
+            on.append((Column("__dup_n", "__setl"), Column("__dup_n", "__setr")))
+        jt = "left_semi" if op.startswith("intersect") else "left_anti"
         joined = Join(lw, rw, on, jt, None)
         return Projection(joined, [
-            Alias(Column(f.name, "__setl"), f.name) for f in lw.schema.fields
+            Alias(Column(f.name, "__setl"), f.name)
+            for f in lw.schema.fields[:n_cols]
         ])
 
     def _plan_grouping_sets(self, plan: LogicalPlan, sets: list[list[int]],

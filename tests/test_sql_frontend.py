@@ -193,6 +193,55 @@ def test_except_and_intersect():
     assert out3.x.tolist() == [1, 2] and out3.y.tolist() == ["q", "p"]
 
 
+def test_select_list_scalar_subquery_edges():
+    """SELECT-list scalar subqueries: correlated COUNT yields 0 (not NULL)
+    for no-match rows, outer rows survive via LEFT join, a same-named
+    correlation key stays unambiguous, and an empty grouped uncorrelated
+    subquery yields NULL without wiping the outer rows."""
+    import pandas as pd
+    import pyarrow as pa
+
+    from ballista_tpu.client.context import SessionContext
+
+    ctx = SessionContext()
+    ctx.register_arrow_table("t", pa.table({"k": [1, 2, 3]}))
+    ctx.register_arrow_table("s", pa.table({"k": [1, 1], "v": [10.0, 20.0]}))
+    r = ctx.sql("select k, (select count(*) from s where s.k = t.k) c "
+                "from t order by k").collect().to_pandas()
+    assert r.c.tolist() == [2, 0, 0]
+    r2 = ctx.sql("select k, (select max(v) from s where s.k = t.k) mv "
+                 "from t order by k").collect().to_pandas()
+    assert r2.mv[0] == 20.0 and pd.isna(r2.mv[1]) and pd.isna(r2.mv[2])
+    r3 = ctx.sql("select k, (select sum(v) from s where s.k = 10 group by s.k) sv "
+                 "from t order by k").collect().to_pandas()
+    assert len(r3) == 3 and pd.isna(r3.sv).all()
+
+
+def test_except_intersect_all_bag_semantics():
+    """INTERSECT ALL keeps min(count_l, count_r) copies; EXCEPT ALL keeps
+    count_l - count_r copies (row_number bag lowering); NULL rows count as
+    equal duplicates like the set forms."""
+    import pandas as pd
+    import pyarrow as pa
+
+    from ballista_tpu.client.context import SessionContext
+
+    ctx = SessionContext()
+    ctx.register_arrow_table("ba", pa.table({"x": [1, 1, 1, 2, 2, 3, None]}))
+    ctx.register_arrow_table("bb", pa.table({"x": [1, 1, 2, 4, None, None]}))
+    r = ctx.sql("select x from ba intersect all select x from bb order by x"
+                ).collect().to_pandas()
+    assert r.x.fillna(-1).tolist() == [1.0, 1.0, 2.0, -1.0]
+    r2 = ctx.sql("select x from ba except all select x from bb order by x"
+                 ).collect().to_pandas()
+    assert r2.x.tolist() == [1, 2, 3] and not pd.isna(r2.x).any()
+    # mixed chain: ALL and set forms compose with INTERSECT precedence
+    r3 = ctx.sql("select x from ba except all select x from bb "
+                 "intersect all select x from bb order by x").collect().to_pandas()
+    # rhs of except_all = bb ∩all bb = bb itself
+    assert r3.x.tolist() == [1, 2, 3]
+
+
 def test_intersect_distributed(tmp_path):
     import numpy as np
     import pyarrow as pa
