@@ -281,6 +281,7 @@ class PhysicalPlanner:
             for g in group_exprs
         ]
         acc_fields: list[DFField] = []
+        welford_triples: dict[str, tuple[str, str, str]] = {}
         i = 0
         for a in node.agg_exprs:
             out_name = a.output_name()
@@ -327,14 +328,22 @@ class PhysicalPlanner:
                 # HashAggregateExec's final mode merges them as a unit.
                 if a.distinct:
                     raise PlanningError(f"{a.func}(DISTINCT) is unsupported")
-                cname, mname, qname = f"__acc{i}_cnt", f"__acc{i}_mean", f"__acc{i}_m2"
-                x = Cast(a.arg, pa.float64())
-                partial_aggs.append(AggDesc("count", a.arg, cname))
-                partial_aggs.append(AggDesc("welford_mean", x, mname))
-                partial_aggs.append(AggDesc("welford_m2", x, qname))
-                acc_fields.append(DFField(cname, pa.int64(), False))
-                acc_fields.append(DFField(mname, pa.float64(), True))
-                acc_fields.append(DFField(qname, pa.float64(), True))
+                # var_samp(v), var_pop(v), stddev(v) over the same argument
+                # share ONE (cnt, mean, m2) accumulator triple — the final
+                # expressions differ only in denominator/sqrt
+                cached = welford_triples.get(str(a.arg))
+                if cached is not None:
+                    cname, mname, qname = cached
+                else:
+                    cname, mname, qname = f"__acc{i}_cnt", f"__acc{i}_mean", f"__acc{i}_m2"
+                    x = Cast(a.arg, pa.float64())
+                    partial_aggs.append(AggDesc("count", a.arg, cname))
+                    partial_aggs.append(AggDesc("welford_mean", x, mname))
+                    partial_aggs.append(AggDesc("welford_m2", x, qname))
+                    acc_fields.append(DFField(cname, pa.int64(), False))
+                    acc_fields.append(DFField(mname, pa.float64(), True))
+                    acc_fields.append(DFField(qname, pa.float64(), True))
+                    welford_triples[str(a.arg)] = (cname, mname, qname)
                 n_f = Cast(Column(cname), pa.float64())
                 denom = (
                     n_f if a.func in ("var_pop", "stddev_pop")

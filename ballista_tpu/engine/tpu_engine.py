@@ -131,6 +131,7 @@ def _static_ok(agg: HashAggregateExec) -> bool:
         if not isinstance(inner, Column):
             return False
     for d in agg.aggs:
-        if d.func not in ("sum", "min", "max", "count", "count_all"):
+        if d.func not in ("sum", "min", "max", "count", "count_all",
+                          "welford_mean", "welford_m2"):
             return False
     return True

@@ -861,7 +861,13 @@ class TpuStageExec(ExecutionPlan):
         agg_fns = []
         agg_modes = []  # "row" | "build_cnt" (count of a mult-join build col)
         for ai, d in enumerate(agg.aggs):
-            if d.func not in ("sum", "min", "max", "count", "count_all"):
+            if d.func in ("welford_mean", "welford_m2"):
+                # mean/M2 partials are not additive across expansion lanes and
+                # have no weighted form: only plain (single-lane, unweighted)
+                # stages carry variance on device; others re-run on cpu
+                if mult_weight_fn is not None or len(ctx.lane_sets) != 1:
+                    raise Unsupported("welford through expansion join")
+            elif d.func not in ("sum", "min", "max", "count", "count_all"):
                 raise Unsupported(f"agg {d.func}")
             if ai in count_build_aggs:
                 agg_fns.append(None)
@@ -1004,7 +1010,8 @@ class TpuStageExec(ExecutionPlan):
                             cols_out.append(_masked_reduce_w(jnp, v, gm, d.func, m_eff))
                     outs_lane.append(jnp.stack(cols_out, axis=1))  # [P, G]
                     if (v is not None and v.valid is not None
-                            and d.func in ("sum", "min", "max")):
+                            and d.func in ("sum", "min", "max",
+                                           "welford_mean", "welford_m2")):
                         # valid-count companion: a group whose inputs are all
                         # NULL must decode to NULL, not 0 / ±inf
                         nullcnt_map[ai] = len(nullcnt_lane)
@@ -1147,6 +1154,9 @@ class TpuStageExec(ExecutionPlan):
                 pays = []
                 pay_plan = []
                 out_meta = []
+                # the welford (mean, m2) pair shares one Cast expr object:
+                # ship its value/validity lanes through the sort ONCE
+                welford_pay: dict[int, tuple] = {}
                 for ai, (d, af) in enumerate(zip(aggs, agg_fns)):
                     if agg_modes is not None and agg_modes[ai] == "build_cnt":
                         # count of a mult-join build column == match count
@@ -1172,6 +1182,11 @@ class TpuStageExec(ExecutionPlan):
                                         .reshape(-1).astype(jnp.int64))
                             pay_plan.append((len(pays) - 1, None))
                         continue
+                    if (d.func in ("welford_mean", "welford_m2")
+                            and id(d.expr) in welford_pay):
+                        out_meta.append(("f64", 0))
+                        pay_plan.append(welford_pay[id(d.expr)])
+                        continue
                     out_meta.append((v.kind, v.scale))
                     arr = v.arr
                     if m_eff is not None and d.func == "sum":
@@ -1181,7 +1196,7 @@ class TpuStageExec(ExecutionPlan):
                         # null-skip: neutralize invalid slots for the reduce,
                         # and carry a valid-count so all-NULL groups decode
                         # to NULL rather than 0 / ±inf
-                        if d.func == "sum":
+                        if d.func in ("sum", "welford_mean", "welford_m2"):
                             neutral = jnp.zeros((), dtype=arr.dtype)
                         elif d.func == "min":
                             neutral = (jnp.iinfo(arr.dtype).max
@@ -1195,6 +1210,8 @@ class TpuStageExec(ExecutionPlan):
                         ncnt_idx = len(pays) - 1
                     pays.append(jnp.broadcast_to(arr, mask.shape).reshape(-1))
                     pay_plan.append((len(pays) - 1, ncnt_idx))
+                    if d.func in ("welford_mean", "welford_m2"):
+                        welford_pay[id(d.expr)] = pay_plan[-1]
                 meta_holder["out"] = out_meta
                 meta_holder["pay_plan"] = pay_plan
                 lane_pays.append(pays)
@@ -1252,6 +1269,7 @@ class TpuStageExec(ExecutionPlan):
             agg_outs = []
             ncnt_outs = []
             ncnt_map: dict[int, int] = {}
+            welford_stats: dict[int, tuple] = {}  # pay_idx → (c_c, mean_c, ncnt_pos)
             for ai, (d, (pay_idx, ncnt_idx)) in enumerate(
                 zip(aggs, meta_holder["pay_plan"])
             ):
@@ -1259,6 +1277,39 @@ class TpuStageExec(ExecutionPlan):
                     agg_outs.append(compact((arange - start + 1).astype(jnp.int64)))
                     continue
                 sv = spays[pay_idx]
+                if d.func in ("welford_mean", "welford_m2"):
+                    # two-pass variance partial over sorted segments: segment
+                    # mean via float segscan, then gather the mean back per
+                    # row (seg indexes the compacted [C] space) for the
+                    # centered square sum — stable, no cancellation. The
+                    # (mean, m2) pair shares payload lanes and stats.
+                    if pay_idx in welford_stats:
+                        c_c, mean_c, ncnt_pos = welford_stats[pay_idx]
+                    else:
+                        if ncnt_idx is not None:
+                            c_c = int_segsum(spays[ncnt_idx])
+                        else:
+                            c_c = compact((arange - start + 1).astype(jnp.int64))
+                        s1_c = compact(_segscan(jnp, sv, boundary, "sum"))
+                        mean_c = s1_c / jnp.maximum(c_c, 1).astype(sv.dtype)
+                        ncnt_pos = None
+                        if ncnt_idx is not None:
+                            ncnt_pos = len(ncnt_outs)
+                            ncnt_outs.append(c_c)
+                        welford_stats[pay_idx] = (c_c, mean_c, ncnt_pos)
+                    if d.func == "welford_mean":
+                        agg_outs.append(mean_c)
+                    else:
+                        mean_row = mean_c[jnp.clip(seg, 0, C - 1)]
+                        d2 = (sv - mean_row) ** 2
+                        if ncnt_idx is not None:
+                            # null x slots were sum-neutralized to 0; keep
+                            # them out of the square sum too
+                            d2 = jnp.where(spays[ncnt_idx] > 0, d2, 0.0)
+                        agg_outs.append(compact(_segscan(jnp, d2, boundary, "sum")))
+                    if ncnt_pos is not None:
+                        ncnt_map[ai] = ncnt_pos
+                    continue
                 fname = "sum" if d.func in ("count", "count_all") else d.func
                 if fname == "sum" and jnp.issubdtype(sv.dtype, jnp.integer):
                     agg_outs.append(int_segsum(sv))
@@ -1549,6 +1600,18 @@ def _masked_reduce(jnp, v, gm, func: str):
     if func == "sum":
         zero = jnp.zeros((), dtype=arr.dtype)
         return jnp.where(gm, arr, zero).sum(axis=1)
+    if func in ("welford_mean", "welford_m2"):
+        # variance partials (physical_planner's (cnt, mean, M2) triple): the
+        # true two-pass form — group mean first, then the mean-centered
+        # square sum — numerically stable at f64 with no Welford recurrence
+        # (which would serialize; this stays two fused VPU passes)
+        c = gm.sum(axis=1)
+        s = jnp.where(gm, arr, 0.0).sum(axis=1)
+        mean = s / jnp.maximum(c, 1)
+        if func == "welford_mean":
+            return mean
+        d2 = (arr - mean[:, None]) ** 2
+        return jnp.where(gm, d2, 0.0).sum(axis=1)
     if func == "min":
         big = jnp.iinfo(arr.dtype).max if jnp.issubdtype(arr.dtype, jnp.integer) else jnp.inf
         return jnp.where(gm, arr, big).min(axis=1)

@@ -390,6 +390,12 @@ class Decorrelator:
         alias_name = f"__sq{self.counter}"
         # locate [Projection] -> Aggregate -> [Filter] -> input
         proj, agg, below = _find_agg_pattern(sub)
+        if (agg is not None and join_type == "inner"
+                and not agg.group_exprs and _is_count_only(agg)):
+            # the inner-join premise (no-match NULL filters the row anyway)
+            # is FALSE for COUNT: its no-match value is 0, so e.g.
+            # `WHERE (SELECT count(*) ...) = 0` must keep the row
+            join_type = "left"
         if agg is None:
             if not _plan_references_outer(sub, outer.schema):
                 # uncorrelated non-aggregate subquery (e.g. SELECT col FROM
@@ -440,6 +446,13 @@ class Decorrelator:
             return CrossJoin(outer, aliased), Column("__value", alias_name)
 
         inner_cols = [ik for (_, ik) in corr_keys]
+        if agg.group_exprs and any(g not in inner_cols for g in agg.group_exprs):
+            # grouping by anything beyond the correlation keys can yield
+            # several rows per outer row; the join lowering would silently
+            # duplicate outer rows instead of raising SQL's one-row error
+            raise PlanningError(
+                "correlated scalar subquery with GROUP BY over "
+                "non-correlation columns may return more than one row")
         group_exprs = list(agg.group_exprs) + [c for c in inner_cols if c not in agg.group_exprs]
         new_agg = Aggregate(new_below, group_exprs, list(agg.agg_exprs))
         # correlation keys get INTERNAL names: re-exposing e.g. `k` through
@@ -449,18 +462,43 @@ class Decorrelator:
                   f"__ck{i}")
             for i, c in enumerate(inner_cols)
         ]
-        proj_exprs.append(Alias(value_expr, "__value"))
+        count_fallback = (
+            join_type == "left" and not agg.group_exprs and _is_count_only(agg)
+        )
+        if count_fallback:
+            # COUNT over no matching rows is 0, not NULL — but the 0 must
+            # feed the subquery's post-aggregate computation (count(*)+1
+            # over no rows is 1, not 0), so the subquery side exports the
+            # RAW count columns and the value expression re-evaluates above
+            # the join over coalesced counts. A user-grouped subquery
+            # (agg.group_exprs non-empty) keeps NULL: its empty group set
+            # yields no row at all per SQL.
+            out_names = [
+                new_agg.schema.field(len(group_exprs) + i).name
+                for i in range(len(agg.agg_exprs))
+            ]
+            av_map = {nm: f"__av{i}" for i, nm in enumerate(out_names)}
+            for nm, av in av_map.items():
+                proj_exprs.append(Alias(Column(nm), av))
+
+            def _coalesced(e: Expr) -> Expr:
+                if isinstance(e, Column) and e.output_name() in av_map:
+                    return ScalarFunction(
+                        "coalesce",
+                        (Column(av_map[e.output_name()], alias_name), Literal(0)),
+                    )
+                return e
+
+            repl = transform_expr(value_expr, _coalesced)
+        else:
+            proj_exprs.append(Alias(value_expr, "__value"))
+            repl = Column("__value", alias_name)
         value = Projection(new_agg, proj_exprs)
         aliased = SubqueryAlias(value, alias_name)
         join_on = [
             (ok, Column(f"__ck{i}", alias_name))
             for i, (ok, _) in enumerate(corr_keys)
         ]
-        repl: Expr = Column("__value", alias_name)
-        if join_type == "left" and _is_count_only(agg):
-            # COUNT over no matching rows is 0, not NULL (the left join's
-            # null marker must not leak as the count)
-            repl = ScalarFunction("coalesce", (repl, Literal(0)))
         return Join(outer, aliased, join_on, join_type, None), repl
 
 

@@ -202,6 +202,7 @@ def test_select_list_scalar_subquery_edges():
     import pyarrow as pa
 
     from ballista_tpu.client.context import SessionContext
+    from ballista_tpu.errors import PlanningError
 
     ctx = SessionContext()
     ctx.register_arrow_table("t", pa.table({"k": [1, 2, 3]}))
@@ -215,6 +216,25 @@ def test_select_list_scalar_subquery_edges():
     r3 = ctx.sql("select k, (select sum(v) from s where s.k = 10 group by s.k) sv "
                  "from t order by k").collect().to_pandas()
     assert len(r3) == 3 and pd.isna(r3.sv).all()
+    # the no-match 0 must feed the subquery's post-aggregate arithmetic:
+    # count(*)+1 over no rows is 1, not 0 (and not NULL)
+    r4 = ctx.sql("select k, (select count(*) + 1 from s where s.k = t.k) c "
+                 "from t order by k").collect().to_pandas()
+    assert r4.c.tolist() == [3, 1, 1]
+    # grouping beyond the correlation keys can return >1 row per outer row;
+    # the lowering must refuse rather than silently duplicate outer rows
+    with pytest.raises(PlanningError, match="more than one row"):
+        ctx.sql("select k, (select count(*) from s where s.k = t.k group by s.v) c "
+                "from t").collect()
+    # grouping BY the correlation key is provably single-row — still works
+    r5 = ctx.sql("select k, (select sum(v) from s where s.k = t.k group by s.k) sv "
+                 "from t order by k").collect().to_pandas()
+    assert r5.sv[0] == 30.0 and pd.isna(r5.sv[1]) and pd.isna(r5.sv[2])
+    # WHERE-context correlated COUNT: the no-match value is 0 (not NULL), so
+    # `= 0` must KEEP the no-match rows — an inner-join lowering drops them
+    r6 = ctx.sql("select k from t where (select count(*) from s where s.k = t.k) = 0 "
+                 "order by k").collect().to_pandas()
+    assert r6.k.tolist() == [2, 3]
 
 
 def test_except_intersect_all_bag_semantics():

@@ -745,3 +745,75 @@ def test_all_22_tpch_queries_run_device_stages(tpch_mid_dir):
         if not runs or fb:
             bad.append((q, f"runs={runs} fallbacks={fb}"))
     assert not bad, bad
+
+
+def test_variance_on_device_sorted_path():
+    """var/stddev partials (Welford (cnt, mean, M2) triple) computed on
+    device via the sorted segmented two-pass, including an all-NULL group
+    and the n<2 sample-variance guard — vs the CPU engine."""
+    rng = np.random.default_rng(17)
+    n = 6000
+    g = rng.integers(0, 40, n).astype("int64")
+    v = np.round(rng.normal(1000.0, 25.0, n), 4)
+    null_v = rng.random(n) < 0.25
+    # group 39: all inputs NULL; group 38: exactly one non-null row
+    null_v[g == 39] = True
+    one = np.nonzero(g == 38)[0]
+    null_v[one] = True
+    null_v[one[0]] = False
+    tbl = pa.table({
+        "g": pa.array(g, pa.int64()),
+        "v": pa.array(v, pa.float64(), mask=null_v),
+    })
+    sql = ("SELECT g, var_samp(v) AS vs, var_pop(v) AS vp, "
+           "stddev(v) AS sd, count(v) AS c FROM t GROUP BY g ORDER BY g")
+    tpu, cpu = _device_oracle(sql, {"t": tbl})
+    tp, cp = tpu.to_pandas(), cpu.to_pandas()
+    assert tp.g.tolist() == cp.g.tolist()
+    assert tp.c.tolist() == cp.c.tolist()
+    # group 39 (no inputs): NULL everywhere; group 38 (n=1): samp NULL, pop 0
+    assert tp.vs.isna().tolist() == cp.vs.isna().tolist()
+    assert tp.vp.isna().tolist() == cp.vp.isna().tolist()
+    assert np.allclose(tp.vs.fillna(0).values, cp.vs.fillna(0).values,
+                       rtol=1e-9, atol=1e-9)
+    assert np.allclose(tp.vp.fillna(0).values, cp.vp.fillna(0).values,
+                       rtol=1e-9, atol=1e-9)
+    assert np.allclose(tp.sd.fillna(0).values, cp.sd.fillna(0).values,
+                       rtol=1e-9, atol=1e-9)
+
+
+def test_variance_on_device_unrolled_path():
+    """Variance over a low-cardinality dictionary group key rides the
+    unrolled masked-reduction path (two fused passes, no sort)."""
+    rng = np.random.default_rng(23)
+    n = 8000
+    cat = rng.integers(0, 4, n)
+    # large offset stresses the centered form: naive sum-of-squares loses
+    # all significant digits at 1e8 magnitude with unit variance
+    v = 1.0e8 + rng.normal(0.0, 1.0, n)
+    tbl = pa.table({
+        "cat": pa.array([f"c{i}" for i in cat]),
+        "v": pa.array(v, pa.float64()),
+    })
+    sql = ("SELECT cat, stddev_samp(v) AS sd, var_pop(v) AS vp "
+           "FROM t GROUP BY cat ORDER BY cat")
+    tpu, cpu = _device_oracle(sql, {"t": tbl})
+    tp, cp = tpu.to_pandas(), cpu.to_pandas()
+    assert tp.cat.tolist() == cp.cat.tolist()
+    assert np.allclose(tp.sd.values, cp.sd.values, rtol=1e-6)
+    assert np.allclose(tp.vp.values, cp.vp.values, rtol=1e-6)
+    # the data really does have ~unit stddev — catastrophic cancellation
+    # would produce 0 or wild values here
+    assert (np.abs(tp.sd.values - 1.0) < 0.1).all()
+
+
+def test_variance_global_no_groups_on_device():
+    rng = np.random.default_rng(29)
+    v = rng.normal(50.0, 7.0, 5000)
+    tbl = pa.table({"v": pa.array(v, pa.float64())})
+    sql = "SELECT var_samp(v) AS vs, stddev_pop(v) AS sp, avg(v) AS m FROM t"
+    tpu, cpu = _device_oracle(sql, {"t": tbl})
+    tp, cp = tpu.to_pandas(), cpu.to_pandas()
+    assert np.allclose(tp.vs[0], cp.vs[0], rtol=1e-9)
+    assert np.allclose(tp.sp[0], cp.sp[0], rtol=1e-9)
+    assert np.allclose(tp.m[0], cp.m[0], rtol=1e-12)
