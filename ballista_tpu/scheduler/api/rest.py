@@ -9,8 +9,9 @@ routes.
 GET  /api/state                 GET  /api/executors
 GET  /api/jobs                  GET  /api/job/{id}
 GET  /api/job/{id}/stages       GET  /api/job/{id}/dot
-POST /api/job/{id}/cancel       GET  /api/metrics
-GET  /health
+GET  /api/job/{id}/graph        POST /api/job/{id}/cancel
+GET  /api/metrics               GET  /health
+GET  / (and /ui)                — web cluster monitor (webui.py)
 """
 
 from __future__ import annotations
@@ -73,6 +74,10 @@ def start_rest_api(scheduler: SchedulerServer, metrics: InMemoryMetricsCollector
 
         def do_GET(self):  # noqa: N802
             p = self.path.rstrip("/")
+            if p in ("", "/ui"):
+                from ballista_tpu.scheduler.api.webui import WEBUI_HTML
+
+                return self._send(200, WEBUI_HTML, "text/html; charset=utf-8")
             if p == "/health":
                 return self._json({"status": "healthy"})
             if p == "/api/state":
@@ -131,6 +136,29 @@ def start_rest_api(scheduler: SchedulerServer, metrics: InMemoryMetricsCollector
                         "metric_percentiles": _metric_percentiles(raw),
                     })
                 return self._json(stages)
+            m = re.match(r"^/api/job/([^/]+)/graph$", p)
+            if m:
+                # stage DAG as JSON (the web monitor's client-side renderer;
+                # the dot endpoint below stays for graphviz tooling)
+                with scheduler._jobs_lock:
+                    g = scheduler.jobs.get(m.group(1))
+                if g is None:
+                    return self._json({"error": "not found"}, 404)
+                stages = []
+                for sid in sorted(g.stages):
+                    s = g.stages[sid]
+                    stages.append({
+                        "stage_id": sid, "state": s.state.value,
+                        "partitions": s.spec.partitions,
+                        "completed": len(s.completed),
+                        "summary": s.spec.plan.node_str(),
+                    })
+                edges = [[sid, o] for sid, outs in g.output_links.items()
+                         for o in outs]
+                return self._json({
+                    "job_id": g.job_id, "status": g.status.value,
+                    "stages": stages, "edges": edges,
+                })
             m = re.match(r"^/api/job/([^/]+)/dot$", p)
             if m:
                 with scheduler._jobs_lock:

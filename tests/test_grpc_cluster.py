@@ -76,6 +76,14 @@ def test_rest_api(grpc_cluster, remote_ctx):
     assert dot.startswith("digraph")
     metrics = urllib.request.urlopen(f"http://127.0.0.1:{port}/api/metrics").read().decode()
     assert "ballista_scheduler_jobs_completed_total" in metrics
+    # web monitor page + its JSON stage-graph endpoint
+    page = urllib.request.urlopen(f"http://127.0.0.1:{port}/").read().decode()
+    assert "cluster monitor" in page and "/api/jobs" in page
+    graph = json.load(urllib.request.urlopen(f"http://127.0.0.1:{port}/api/job/{job_id}/graph"))
+    assert graph["job_id"] == job_id and graph["stages"]
+    assert all(len(e) == 2 for e in graph["edges"])
+    sids = {s["stage_id"] for s in graph["stages"]}
+    assert all(a in sids and b in sids for a, b in graph["edges"])
 
 
 def test_native_data_plane_forced_remote(grpc_cluster, tpch_dir, tpch_ref_tables):
