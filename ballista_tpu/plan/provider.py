@@ -84,7 +84,7 @@ class ParquetTable(TableProvider):
             self.files = [path]
         if not self.files:
             raise FileNotFoundError(f"no parquet files under {path}")
-        self._schema = _read_schema(self.files[0])
+        self._schema = _normalize_schema(_read_schema(self.files[0]))
         self._stats: TableStats | None = None
         if collect_statistics:
             self._collect_stats()
@@ -137,6 +137,26 @@ class ParquetTable(TableProvider):
         return parts
 
 
+def _normalize_schema(schema: pa.Schema) -> pa.Schema:
+    """Engine decimal policy: decimal columns surface as float64 everywhere
+    (ops/tpu/columnar.py — exact money arithmetic comes back on device via
+    the scaled-int64 fixed-point proof). Normalizing at the provider
+    boundary keeps user parquet written with decimal128 — e.g. data from
+    the reference's TPC-H generators — loadable with consistent types:
+    without this, pyarrow group_by returns Decimal objects that contradict
+    the planned float64 schema (global sum over decimal raised
+    ArrowInvalid; min/max leaked decimal.Decimal values)."""
+    fields = []
+    changed = False
+    for f in schema:
+        if pa.types.is_decimal(f.type):
+            fields.append(pa.field(f.name, pa.float64(), f.nullable, f.metadata))
+            changed = True
+        else:
+            fields.append(f)
+    return pa.schema(fields, metadata=schema.metadata) if changed else schema
+
+
 def _read_schema(path: str) -> pa.Schema:
     if path.startswith("s3://"):
         from ballista_tpu.plan.object_store import resolve_filesystem
@@ -157,8 +177,12 @@ def _read_metadata(path: str):
 
 class MemoryTable(TableProvider):
     def __init__(self, batches: list[pa.RecordBatch], schema: pa.Schema | None = None, partitions: int = 1):
+        raw = schema or (batches[0].schema if batches else pa.schema([]))
+        self._schema = _normalize_schema(raw)
+        if self._schema is not raw and batches:
+            tbl = pa.Table.from_batches(batches, raw).cast(self._schema)
+            batches = tbl.to_batches()
         self.batches = batches
-        self._schema = schema or (batches[0].schema if batches else pa.schema([]))
         self.partitions = max(1, partitions)
 
     @classmethod

@@ -149,9 +149,12 @@ def start_rest_api(scheduler: SchedulerServer, metrics: InMemoryMetricsCollector
                     s = g.stages[sid]
                     stages.append({
                         "stage_id": sid, "state": s.state.value,
+                        "attempt": s.attempt,
                         "partitions": s.spec.partitions,
                         "completed": len(s.completed),
                         "summary": s.spec.plan.node_str(),
+                        "metric_percentiles": _metric_percentiles(
+                            g.stage_metrics.get(sid, [])),
                     })
                 edges = [[sid, o] for sid, outs in g.output_links.items()
                          for o in outs]

@@ -113,8 +113,10 @@ const J = (u) => fetch(u).then(r => { if (!r.ok) throw new Error(u + ": " + r.st
 
 function stBadge(s) { return `<span class="st ${esc(s)}">${esc(s)}</span>`; }
 
+let busy = false;
 async function refresh() {
-  if (paused) return;
+  if (paused || busy) return;
+  busy = true;
   try {
     const [state, jobs, execs] = await Promise.all([
       J("/api/state"), J("/api/jobs"), J("/api/executors")]);
@@ -129,6 +131,7 @@ async function refresh() {
     await renderProm();
     if (selected) await renderDetail(selected);
   } catch (e) { $("#h-upd").textContent = "refresh failed: " + e.message; }
+  finally { busy = false; }
 }
 
 function renderJobs(jobs) {
@@ -152,7 +155,8 @@ function renderJobs(jobs) {
       `<td>${["queued","running"].includes(j.state) ? '<button class="danger" data-cancel="' + esc(j.job_id) + '">cancel</button>' : ""}</td>`;
     tr.addEventListener("click", (ev) => {
       if (ev.target.dataset.cancel) return;
-      selected = j.job_id; renderDetail(selected); refresh();
+      selected = j.job_id;
+      if (paused || busy) renderDetail(selected); else refresh();
     });
     tb.appendChild(tr);
   }
@@ -181,6 +185,9 @@ async function renderProm() {
 }
 
 async function renderDetail(jobId) {
+  // ONE lean request: /graph carries everything the detail pane shows
+  // (the /stages endpoint with full plans + raw task metrics stays for
+  // API tooling, but polling it per tab would re-ship hundreds of KB)
   let g;
   try { g = await J("/api/job/" + jobId + "/graph"); }
   catch { $("#detail").hidden = true; return; }
@@ -189,10 +196,9 @@ async function renderDetail(jobId) {
   $("#d-status").textContent = g.status;
   $("#d-status").className = "st " + g.status;
   drawDag(g);
-  const stages = await J("/api/job/" + jobId + "/stages");
   const tb = $("#stages tbody");
   tb.innerHTML = "";
-  for (const s of stages) {
+  for (const s of g.stages) {
     const ops = (s.metric_percentiles || []).slice()
       .sort((a, b) => b.elapsed_ms_p50 - a.elapsed_ms_p50).slice(0, 3)
       .map(p => `${esc(p.name)} ${p.elapsed_ms_p50.toFixed(1)}/${p.elapsed_ms_p99.toFixed(1)}ms · ${p.output_rows_total} rows`)
@@ -200,7 +206,7 @@ async function renderDetail(jobId) {
     const tr = document.createElement("tr");
     tr.innerHTML = `<td>${s.stage_id}</td><td>${stBadge(s.state)}</td><td>${s.attempt}</td>` +
       `<td>${s.partitions}</td><td>${s.completed}</td>` +
-      `<td title="${esc(s.plan)}">${ops || '<span class="muted">–</span>'}</td>`;
+      `<td title="${esc(s.summary)}">${ops || '<span class="muted">–</span>'}</td>`;
     tb.appendChild(tr);
   }
 }

@@ -118,3 +118,31 @@ def test_hash_date64_columns():
     nat = native.hash_arrays_native([ms])
     if nat is not None:
         assert (h == nat).all()
+
+
+def test_decimal_parquet_reads_as_float64_policy(tmp_path):
+    """decimal128 parquet (what the reference's TPC-H generators emit) and
+    decimal arrow tables normalize to the engine's float64 decimal policy at
+    the provider boundary — global sums, grouped aggs, and min/max all work
+    with consistent float64 typing (no decimal.Decimal leakage)."""
+    import decimal
+
+    import pyarrow.parquet as pq
+
+    from ballista_tpu.client.context import SessionContext
+
+    D = decimal.Decimal
+    tbl = pa.table({
+        "g": pa.array(["a", "b", "a"]),
+        "price": pa.array([D("10.25"), None, D("7.75")], pa.decimal128(15, 2)),
+    })
+    pq.write_table(tbl, tmp_path / "d.parquet")
+    ctx = SessionContext()
+    ctx.register_parquet("d", str(tmp_path / "d.parquet"))
+    assert ctx.catalog.get("d").arrow_schema().field("price").type == pa.float64()
+    r = ctx.sql("SELECT sum(price) s, min(price) mn, count(price) c FROM d"
+                ).collect().to_pandas()
+    assert float(r.s[0]) == 18.0 and float(r.mn[0]) == 7.75 and int(r.c[0]) == 2
+    ctx.register_arrow_table("m", tbl)
+    r2 = ctx.sql("SELECT g, sum(price) s FROM m GROUP BY g ORDER BY g").collect()
+    assert r2.column("s").to_pylist() == [18.0, None]
