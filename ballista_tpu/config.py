@@ -42,6 +42,7 @@ AQE_MIN_PARTITION_BYTES = "ballista.planner.adaptive.coalesce.min.bytes"
 AQE_COALESCE_MERGED_FACTOR = "ballista.planner.adaptive.coalesce.merged.factor"
 AQE_EMPTY_PROPAGATION = "ballista.planner.adaptive.empty.propagation"
 AQE_DYNAMIC_JOIN_SELECTION = "ballista.planner.adaptive.join.selection"
+AQE_ALTER_FANOUT = "ballista.planner.adaptive.alter.fanout"
 GRPC_CLIENT_MAX_MESSAGE_SIZE = "ballista.grpc.client.max.message.size.bytes"
 GRPC_SERVER_MAX_MESSAGE_SIZE = "ballista.grpc.server.max.message.size.bytes"
 FLIGHT_PROXY = "ballista.client.flight.proxy"
@@ -143,6 +144,7 @@ _ENTRIES: list[ConfigEntry] = [
     ConfigEntry(AQE_COALESCE_MERGED_FACTOR, "AQE coalescing: merged-partition slack factor.", float, 1.2, _pos),
     ConfigEntry(AQE_EMPTY_PROPAGATION, "AQE: prune stages proven empty by runtime stats.", bool, True),
     ConfigEntry(AQE_DYNAMIC_JOIN_SELECTION, "AQE: choose join strategy at runtime from actual input sizes.", bool, True),
+    ConfigEntry(AQE_ALTER_FANOUT, "AQE: shrink a resolving stage's hash fan-out when observed input volume proves the planned bucket count too high.", bool, True),
     ConfigEntry(GRPC_CLIENT_MAX_MESSAGE_SIZE, "Client-side gRPC message ceiling.", int, 256 * 1024 * 1024, _pos),
     ConfigEntry(CLIENT_JOB_TIMEOUT_S, "How long a client waits for a submitted job before giving up.", int, 600, _pos),
     ConfigEntry(GRPC_SERVER_MAX_MESSAGE_SIZE, "Server-side gRPC message ceiling.", int, 256 * 1024 * 1024, _pos),

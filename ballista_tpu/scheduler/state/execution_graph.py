@@ -469,12 +469,110 @@ class ExecutionGraph:
             stage.pending = list(range(stage.spec.partitions))
             stage.effective_partitions = stage.spec.partitions
 
+    def _try_shrink_fanout(self, stage: ExecutionStage, inputs) -> None:
+        """Stage-alteration replanning (state/aqe/planner.rs:349,
+        alter_stages.rs analog): at resolution — after this stage's inputs
+        finished but before any of its tasks launch — shrink its hash
+        fan-out K when the observed input volume proves the planned bucket
+        count absurd, and patch the still-unresolved consumers' leaves to
+        the new K. Read-side coalescing (CoalescePartitionsRule) already
+        merges tiny reduce reads; this removes the WRITE-side cost: K
+        sort-shuffle buckets, K index entries, K files per map task.
+
+        Guards: every consumer must still be UNRESOLVED and have this stage
+        as its ONLY input, so co-partitioned join alignment (two producers
+        hashed to the same K) can never break."""
+        from ballista_tpu.config import (
+            AQE_ALTER_FANOUT,
+            AQE_TARGET_PARTITION_BYTES,
+            PLANNER_ADAPTIVE_ENABLED,
+        )
+        from ballista_tpu.shuffle.reader import UnresolvedShuffleExec
+        from ballista_tpu.shuffle.writer import ShuffleWriterExec
+
+        if not (bool(self.config.get(PLANNER_ADAPTIVE_ENABLED))
+                and bool(self.config.get(AQE_ALTER_FANOUT))):
+            return
+        writer = stage.spec.plan
+        if not isinstance(writer, ShuffleWriterExec) or writer.output_partitions <= 1:
+            return
+        if stage.spec.broadcast:
+            return
+
+        def leaves(node):
+            kids = node.children()
+            if not kids:
+                yield node
+            for c in kids:
+                yield from leaves(c)
+
+        # every leaf must be a shuffle input: a stage that also SCANS a
+        # table (e.g. broadcast-join probe) has volume the input stats
+        # cannot see
+        if any(not isinstance(l, UnresolvedShuffleExec) for l in leaves(writer.input)):
+            return
+        consumers = [self.stages.get(c) for c in self.output_links.get(stage.stage_id, [])]
+        if not consumers or any(
+            c is None or c.state is not StageState.UNRESOLVED
+            or set(c.spec.input_stage_ids) != {stage.stage_id}
+            for c in consumers
+        ):
+            return
+        total_bytes = sum(
+            l.stats.num_bytes for inp in inputs for l in inp.output_locations()
+        )
+        target = max(1, int(self.config.get(AQE_TARGET_PARTITION_BYTES)))
+        # input volume bounds this stage's output for scan/filter/agg
+        # pipelines; expansion joins can exceed it, so shrink only with a
+        # 2x margin and only when the drop is at least 2x (mis-guessing low
+        # costs read-side balance, never correctness)
+        k = writer.output_partitions
+        new_k = max(1, -(-2 * total_bytes // target))  # ceil(2·bytes/target)
+        if new_k > k // 2:
+            return
+        stage.spec.plan = ShuffleWriterExec(
+            writer.input, self.job_id, writer.stage_id, new_k, writer.keys,
+            writer.sort_shuffle,
+        )
+        stage.spec.output_partitions = new_k
+
+        def patch(node):
+            if (isinstance(node, UnresolvedShuffleExec)
+                    and node.stage_id == stage.stage_id and not node.broadcast):
+                return UnresolvedShuffleExec(
+                    node.stage_id, node.df_schema, new_k, broadcast=False)
+            kids = node.children()
+            if not kids:
+                return node
+            new_kids = [patch(c) for c in kids]
+            if all(a is b for a, b in zip(new_kids, kids)):
+                return node
+            return node.with_children(new_kids)
+
+        for c in consumers:
+            c.spec.plan = patch(c.spec.plan)
+            new_parts = c.spec.plan.input.output_partition_count()
+            c.spec.partitions = new_parts
+            if c.spec.plan.output_partitions <= 0:
+                # passthrough writers materialize one output per task: the
+                # advertised output count must follow the new task count or
+                # downstream readers size against the stale K
+                c.spec.output_partitions = new_parts
+            c.pending = list(range(new_parts))
+            c.effective_partitions = new_parts
+        log.info(
+            "incremental AQE: stage %d inputs totalled %d bytes — hash "
+            "fan-out altered %d → %d buckets (consumers repartitioned)",
+            stage.stage_id, total_bytes, k, new_k,
+        )
+
     def _try_resolve(self, stage: ExecutionStage) -> None:
         if stage.state is not StageState.UNRESOLVED:
             return
         inputs = [self.stages[i] for i in stage.spec.input_stage_ids]
         if not all(i.state is StageState.SUCCESSFUL for i in inputs):
             return
+        self._try_shrink_fanout(stage, inputs)
         resolved: dict[int, ShuffleReaderExec] = {}
         for inp in inputs:
             resolved[inp.stage_id] = self._build_reader(inp)

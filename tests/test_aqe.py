@@ -307,3 +307,105 @@ def test_incremental_empty_cascade_skips_and_cancels():
             break
         _fake_success(g, t)
     assert g.status.value == "successful"
+
+
+def test_alter_fanout_virtual():
+    """Stage-alteration replanning (alter_stages.rs analog): a middle
+    stage's hash fan-out shrinks at resolution when its observed input
+    volume proves the planned bucket count too high, and the downstream
+    consumer is repartitioned to the new K before it resolves."""
+    import numpy as np
+
+    from ballista_tpu.client.context import SessionContext
+    from ballista_tpu.scheduler.planner import DistributedPlanner
+    from ballista_tpu.scheduler.state.execution_graph import ExecutionGraph
+
+    from .test_distributed import _fake_success
+
+    rng = np.random.default_rng(7)
+    cfg = BallistaConfig({
+        DEFAULT_SHUFFLE_PARTITIONS: 32,
+        PLANNER_ADAPTIVE_ENABLED: True,
+    })
+    ctx = SessionContext(cfg)
+    ctx.register_arrow_table("t", pa.table({
+        "k": rng.integers(0, 1000, 20_000), "v": rng.integers(0, 100, 20_000),
+    }), partitions=4)
+    sql = ("select k2, sum(s) t from (select k % 10 k2, sum(v) s from t group by k) q "
+           "group by k2")
+    physical = ctx.create_physical_plan(ctx.sql(sql).plan)
+    stages = DistributedPlanner("jobf").plan_query_stages(physical)
+    g = ExecutionGraph("jobf", "", "s1", stages, cfg)
+    # find the middle stage: hash writer whose every leaf is a shuffle input
+    from ballista_tpu.shuffle.reader import UnresolvedShuffleExec
+
+    def leaves(n):
+        kids = n.children()
+        if not kids:
+            yield n
+        for c in kids:
+            yield from leaves(c)
+
+    mids = [
+        s for s in g.stages.values()
+        if s.spec.plan.output_partitions > 1
+        and s.spec.input_stage_ids
+        and all(isinstance(l, UnresolvedShuffleExec) for l in leaves(s.spec.plan.input))
+    ]
+    assert mids, g.display()
+    mid = mids[0]
+    planned_k = mid.spec.plan.output_partitions
+    assert planned_k == 32
+    consumer = g.stages[g.output_links[mid.stage_id][0]]
+    assert consumer.spec.partitions == planned_k
+    # run the upstream (leaf) stages; _fake_success reports ~10-byte outputs
+    guard = 0
+    while mid.state.value == "unresolved" and guard < 200:
+        guard += 1
+        t = g.pop_next_task("e1")
+        assert t is not None
+        _fake_success(g, t)
+    # resolution must have altered the fan-out and repartitioned the consumer
+    new_k = mid.spec.plan.output_partitions
+    assert 0 < new_k <= planned_k // 2, f"fan-out not altered: {new_k}"
+    assert mid.spec.output_partitions == new_k
+    assert consumer.spec.partitions == new_k
+    assert len(consumer.pending) == new_k
+    # the graph still runs to completion with the altered stages
+    guard = 0
+    while g.status.value == "running" and guard < 1000:
+        guard += 1
+        t = g.pop_next_task("e1")
+        if t is None:
+            break
+        _fake_success(g, t)
+    assert g.status.value == "successful", g.display()
+
+
+def test_alter_fanout_end_to_end(tpch_dir, tpch_ref_tables):
+    """Same alteration through a real standalone cluster: tiny data with an
+    oversized shuffle partition count — results must match the oracle and
+    some middle stage must have shrunk its fan-out."""
+    from ballista_tpu.client.context import SessionContext
+    from ballista_tpu.testing.tpchgen import register_tpch
+
+    cfg = BallistaConfig({
+        DEFAULT_SHUFFLE_PARTITIONS: 24,
+        PLANNER_ADAPTIVE_ENABLED: True,
+    })
+    ctx = SessionContext.standalone(cfg, num_executors=1, vcores=2)
+    register_tpch(ctx, tpch_dir)
+    try:
+        eng = ctx.sql(tpch_query(13)).collect()  # nested agg: customer × orders → distribution
+        problems = compare_results(eng, run_reference(13, tpch_ref_tables), 13)
+        assert not problems, "\n".join(problems)
+        sched = ctx._cluster.scheduler
+        with sched._jobs_lock:
+            g = list(sched.jobs.values())[-1]
+        altered = [
+            s for s in g.stages.values()
+            if 0 < s.spec.plan.output_partitions < 24 and s.spec.input_stage_ids
+        ]
+        assert altered, g.display()
+    finally:
+        ctx.shutdown()
