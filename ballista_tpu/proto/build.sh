@@ -1,3 +1,3 @@
 #!/bin/sh
 # regenerate ballista_pb2.py from ballista.proto
-cd "$(dirname "$0")" && protoc --python_out=. ballista.proto
+cd "$(dirname "$0")" && protoc --python_out=. ballista.proto keda.proto

@@ -124,6 +124,14 @@ class SchedulerProcess:
         )
         self.service = SchedulerGrpcService(self.scheduler)
         add_scheduler_service(self.grpc_server, self.service)
+        from ballista_tpu.scheduler.external_scaler import (
+            ExternalScalerService,
+            add_external_scaler_service,
+        )
+
+        # KEDA autoscaling endpoint on the same port (external_scaler.rs)
+        add_external_scaler_service(
+            self.grpc_server, ExternalScalerService(self.scheduler))
         from ballista_tpu.utils.grpc_util import bind_server_port
 
         self.tls = (tls_cert, tls_key, tls_client_ca)

@@ -511,13 +511,32 @@ class ExecutionGraph:
         # cannot see
         if any(not isinstance(l, UnresolvedShuffleExec) for l in leaves(writer.input)):
             return
-        consumers = [self.stages.get(c) for c in self.output_links.get(stage.stage_id, [])]
-        if not consumers or any(
-            c is None or c.state is not StageState.UNRESOLVED
-            or set(c.spec.input_stage_ids) != {stage.stage_id}
-            for c in consumers
-        ):
+        # transitively collect the consumers whose task count must follow
+        # the altered output count: a PASSTHROUGH consumer's own output
+        # count equals its task count (one file per task), so ITS consumers
+        # — e.g. a join stage left behind by broadcast elision — must be
+        # repartitioned too, or they schedule tasks past the shrunken
+        # reader. Abort entirely if any transitive consumer fails the
+        # safety guards (unresolved + single-input): a half-patched chain
+        # would execute partitions that no longer exist.
+        affected: list[tuple[int, ExecutionStage]] = []  # (producer_id, consumer)
+        seen: set[int] = set()
+        frontier = [(stage.stage_id, cid) for cid in self.output_links.get(stage.stage_id, [])]
+        if not frontier:
             return
+        while frontier:
+            pid, cid = frontier.pop(0)
+            c = self.stages.get(cid)
+            if (c is None or cid in seen
+                    or c.state is not StageState.UNRESOLVED
+                    or set(c.spec.input_stage_ids) != {pid}):
+                return
+            seen.add(cid)
+            affected.append((pid, c))
+            if c.spec.plan.output_partitions <= 0 and not c.spec.broadcast:
+                # broadcast outputs are read whole regardless of count;
+                # only non-broadcast passthrough output counts propagate
+                frontier.extend((cid, g) for g in self.output_links.get(cid, []))
         total_bytes = sum(
             l.stats.num_bytes for inp in inputs for l in inp.output_locations()
         )
@@ -536,21 +555,22 @@ class ExecutionGraph:
         )
         stage.spec.output_partitions = new_k
 
-        def patch(node):
+        def patch(node, pid: int, count: int):
             if (isinstance(node, UnresolvedShuffleExec)
-                    and node.stage_id == stage.stage_id and not node.broadcast):
+                    and node.stage_id == pid and not node.broadcast):
                 return UnresolvedShuffleExec(
-                    node.stage_id, node.df_schema, new_k, broadcast=False)
+                    node.stage_id, node.df_schema, count, broadcast=False)
             kids = node.children()
             if not kids:
                 return node
-            new_kids = [patch(c) for c in kids]
+            new_kids = [patch(c, pid, count) for c in kids]
             if all(a is b for a, b in zip(new_kids, kids)):
                 return node
             return node.with_children(new_kids)
 
-        for c in consumers:
-            c.spec.plan = patch(c.spec.plan)
+        new_out = {stage.stage_id: new_k}
+        for pid, c in affected:
+            c.spec.plan = patch(c.spec.plan, pid, new_out[pid])
             new_parts = c.spec.plan.input.output_partition_count()
             c.spec.partitions = new_parts
             if c.spec.plan.output_partitions <= 0:
@@ -558,6 +578,7 @@ class ExecutionGraph:
                 # advertised output count must follow the new task count or
                 # downstream readers size against the stale K
                 c.spec.output_partitions = new_parts
+                new_out[c.stage_id] = new_parts
             c.pending = list(range(new_parts))
             c.effective_partitions = new_parts
         log.info(
