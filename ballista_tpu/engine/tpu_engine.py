@@ -36,6 +36,20 @@ def maybe_compile_tpu(physical: ExecutionPlan, config: BallistaConfig) -> Execut
                 ops, scan = chain
                 if _static_ok(node):
                     return TpuStageExec(node, ops, scan, config)
+                hoisted = _hoist_expr_group_keys(node)
+                if hoisted is not None and _static_ok(hoisted.input):
+                    inner = TpuStageExec(hoisted.input, ops, scan, config)
+                    return hoisted.with_children([inner])
+            elif _static_ok(node):
+                # a UNION on the probe chain (TPC-DS cross-channel shapes:
+                # q2/q5/q71/q75/q76) blocks the single-scan stage form —
+                # push the partial agg through the union so each branch
+                # compiles its own device chain. Per-partition outputs are
+                # identical: union partitions map 1:1 onto branch
+                # partitions, and partials merge downstream either way.
+                pushed = _push_agg_through_union(node)
+                if pushed is not None:
+                    return walk(pushed)
         kids = node.children()
         if not kids:
             return node
@@ -121,6 +135,98 @@ def _match_chain(node: ExecutionPlan):
             cur = cur.right  # probe side continues the device chain
             continue
         return None
+
+
+def _hoist_expr_group_keys(agg: HashAggregateExec):
+    """Rewrite a partial agg whose group keys are single-column expressions
+    (TPC-DS q62/q99's `substr(w_warehouse_name, 1, 20)`) so the DEVICE
+    groups by the raw column — a strict refinement — and the expression is
+    applied by a tiny CPU projection over the (few) partial group rows.
+    Correct because the FINAL aggregation re-groups by the expression's
+    value and every partial accumulator (sum/min/max/count and the Welford
+    triple) merges across the finer groups. Returns the projection node
+    (child = the rewritten partial agg) or None."""
+    from ballista_tpu.plan.expressions import Alias, Column, transform_expr
+    from ballista_tpu.plan.schema import DFField, DFSchema
+
+    in_schema = agg.input.df_schema
+    new_groups = []
+    post_exprs = []
+    group_fields = []
+    changed = False
+    for i, g in enumerate(agg.group_exprs):
+        out_name = g.output_name()
+        out_field = agg.df_schema.field(i)
+        inner = g.expr if isinstance(g, Alias) else g
+        if isinstance(inner, Column):
+            new_groups.append(g)
+            group_fields.append(out_field)
+            post_exprs.append(Alias(Column(out_name), out_name))
+            continue
+        cols = [e for e in _walk_exprs(inner) if isinstance(e, Column)]
+        if len({(c.name, c.qualifier) for c in cols}) != 1:
+            return None  # multi-column or constant group expr: no raw key
+        raw = cols[0]
+        raw_field = in_schema.field(in_schema.index_of(raw.name, raw.qualifier))
+        gk = f"__gk{i}"
+        new_groups.append(Alias(Column(raw.name, raw.qualifier), gk))
+        group_fields.append(DFField(gk, raw_field.dtype, raw_field.nullable))
+        rewritten = transform_expr(
+            inner, lambda e: Column(gk) if isinstance(e, Column) else e)
+        post_exprs.append(Alias(rewritten, out_name))
+        changed = True
+    if not changed:
+        return None
+    n_group = len(agg.group_exprs)
+    acc_fields = list(agg.df_schema)[n_group:]
+    inner_schema = DFSchema(group_fields + acc_fields)
+    for f in acc_fields:
+        post_exprs.append(Alias(Column(f.name), f.name))
+    new_agg = HashAggregateExec(agg.input, new_groups, agg.aggs, "partial", inner_schema)
+    return ProjectionExec(new_agg, post_exprs, agg.df_schema)
+
+
+def _walk_exprs(e):
+    yield e
+    for c in e.children():
+        yield from _walk_exprs(c)
+
+
+def _push_agg_through_union(agg: HashAggregateExec):
+    """HashAgg(partial) over [ops...] over Union(b1..bn) →
+    Union(HashAgg(partial) over [ops...] over b_i). Applied only when every
+    branch schema matches the union schema exactly (names + types), so
+    dropping the union's per-branch alignment cast changes nothing."""
+    from ballista_tpu.plan.physical import HashJoinExec, UnionExec
+
+    path: list[ExecutionPlan] = []  # chain nodes, agg-side first
+    cur = agg.input
+    while not isinstance(cur, UnionExec):
+        if isinstance(cur, (FilterExec, ProjectionExec, CoalesceBatchesExec)):
+            path.append(cur)
+            cur = cur.children()[0]
+        elif isinstance(cur, HashJoinExec) and cur.mode == "collect_left":
+            path.append(cur)
+            cur = cur.right
+        else:
+            return None
+    union = cur
+    us = union.schema()
+    for b in union.inputs:
+        bs = b.schema()
+        if [(f.name, f.type) for f in bs] != [(f.name, f.type) for f in us]:
+            return None
+    branch_aggs = []
+    for b in union.inputs:
+        node: ExecutionPlan = b
+        for p in reversed(path):
+            if isinstance(p, HashJoinExec):
+                node = p.with_children([p.left, node])
+            else:
+                node = p.with_children([node])
+        branch_aggs.append(
+            HashAggregateExec(node, agg.group_exprs, agg.aggs, "partial", agg.df_schema))
+    return UnionExec(branch_aggs, agg.df_schema)
 
 
 def _static_ok(agg: HashAggregateExec) -> bool:

@@ -327,10 +327,21 @@ class TpuStageExec(ExecutionPlan):
         self._results: dict[int, list[pa.RecordBatch]] | None = None
         self._results_lock = threading.Lock()
         # structural fingerprint: identical stages across queries share XLA
-        # compilations (plan objects are rebuilt per query, ids are not)
+        # compilations (plan objects are rebuilt per query, ids are not).
+        # Join ops must contribute their FULL build subtree: node_str()
+        # alone prints only keys/type, so two joins against differently
+        # FILTERED builds (q39's d_moy=1 vs d_moy=2 date_dim sides) would
+        # collide in the build/LUT caches and reuse the wrong build table.
+        def op_fp(op) -> str:
+            from ballista_tpu.plan.physical import HashJoinExec
+
+            if isinstance(op, HashJoinExec):
+                return op.node_str() + "«" + op.left.display() + "»"
+            return op.node_str()
+
         self.fingerprint = "|".join(
             [partial_agg.node_str()]
-            + [op.node_str() for op in ops]
+            + [op_fp(op) for op in ops]
             + [scan.node_str(), repr(scan.df_schema)]
         )
 

@@ -817,3 +817,73 @@ def test_variance_global_no_groups_on_device():
     assert np.allclose(tp.vs[0], cp.vs[0], rtol=1e-9)
     assert np.allclose(tp.sp[0], cp.sp[0], rtol=1e-9)
     assert np.allclose(tp.m[0], cp.m[0], rtol=1e-12)
+
+
+def test_same_shape_stages_with_different_builds_do_not_collide():
+    """Two stages identical except for the FILTER on a join's build side
+    (TPC-DS q39's d_moy=1 vs d_moy=2 date_dim sides) must not share build
+    tables: the stage fingerprint carries the full build subtree."""
+    rng = np.random.default_rng(41)
+    n = 5000
+    fact = pa.table({
+        "fk": rng.integers(0, 200, n).astype("int64"),
+        "v": rng.integers(0, 100, n).astype("int64"),
+    })
+    dim = pa.table({
+        "id": pa.array(np.arange(200), pa.int64()),
+        "moy": pa.array((np.arange(200) % 12) + 1, pa.int64()),
+    })
+    t1 = "SELECT count(*) c, sum(v) s FROM fact JOIN dim ON fk = id WHERE moy = 1"
+    t2 = "SELECT count(*) c, sum(v) s FROM fact JOIN dim ON fk = id WHERE moy = 2"
+    tpu1, cpu1 = _device_oracle(t1, {"fact": fact, "dim": dim})
+    tpu2, cpu2 = _device_oracle(t2, {"fact": fact, "dim": dim})
+    p1, p2 = tpu1.to_pandas(), tpu2.to_pandas()
+    assert p1.c[0] == cpu1.to_pandas().c[0]
+    assert p2.c[0] == cpu2.to_pandas().c[0]
+    assert (p1.c[0], p1.s[0]) != (p2.c[0], p2.s[0])
+
+
+def test_union_pushdown_device_stages():
+    """Partial aggregation over a UNION (TPC-DS cross-channel shapes)
+    pushes through the union so each branch runs a device stage; results
+    match the CPU engine."""
+    rng = np.random.default_rng(43)
+    a = pa.table({
+        "g": pa.array([f"g{i%5}" for i in rng.integers(0, 5, 4000)]),
+        "v": rng.integers(0, 50, 4000).astype("int64"),
+    })
+    b = pa.table({
+        "g": pa.array([f"g{i%5}" for i in rng.integers(0, 5, 3000)]),
+        "v": rng.integers(50, 99, 3000).astype("int64"),
+    })
+    sql = ("SELECT g, count(*) c, sum(v) s FROM "
+           "(SELECT g, v FROM a UNION ALL SELECT g, v FROM b) u "
+           "GROUP BY g ORDER BY g")
+    tpu, cpu = _device_oracle(sql, {"a": a, "b": b})
+    tp, cp = tpu.to_pandas(), cpu.to_pandas()
+    assert tp.g.tolist() == cp.g.tolist()
+    assert tp.c.tolist() == cp.c.tolist()
+    assert tp.s.tolist() == cp.s.tolist()
+
+
+def test_expression_group_key_hoisted_to_device():
+    """Group keys that are single-column expressions (q62/q99's substr)
+    hoist: the device groups by the raw column, a CPU projection applies
+    the expression over the few partial rows, the final agg merges."""
+    rng = np.random.default_rng(47)
+    n = 6000
+    names = [f"warehouse-{i:02d}-site" for i in range(30)]
+    tbl = pa.table({
+        "w": pa.array([names[i] for i in rng.integers(0, 30, n)]),
+        "v": rng.integers(0, 100, n).astype("int64"),
+    })
+    sql = ("SELECT substr(w, 1, 11) wk, count(*) c, sum(v) s "
+           "FROM t GROUP BY substr(w, 1, 11) ORDER BY wk")
+    tpu, cpu = _device_oracle(sql, {"t": tbl})
+    tp, cp = tpu.to_pandas(), cpu.to_pandas()
+    assert tp.wk.tolist() == cp.wk.tolist()
+    assert tp.c.tolist() == cp.c.tolist()
+    assert tp.s.tolist() == cp.s.tolist()
+    # the 11-char prefix folds 30 warehouses into 3 groups — the hoist must
+    # actually merge finer device groups downstream
+    assert len(tp) == 3
