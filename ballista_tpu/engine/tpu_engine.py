@@ -205,7 +205,14 @@ def _push_agg_through_union(agg: HashAggregateExec):
         if isinstance(cur, (FilterExec, ProjectionExec, CoalesceBatchesExec)):
             path.append(cur)
             cur = cur.children()[0]
-        elif isinstance(cur, HashJoinExec) and cur.mode == "collect_left":
+        elif (
+            isinstance(cur, HashJoinExec)
+            and cur.mode == "collect_left"
+            and cur.join_type in ("inner", "right", "right_semi", "right_anti")
+        ):
+            # probe-side-emitting joins only: cloning a build-side-emitting
+            # join (left/full/left_semi/left_anti) per union branch would
+            # emit the unmatched-build tail once per branch
             path.append(cur)
             cur = cur.right
         else:

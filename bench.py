@@ -11,11 +11,22 @@ over this framework's CPU engine (pyarrow C++ operators) on the same host —
 the "CPU-executor baseline" the north-star gate compares against
 (BASELINE.json: ≥3x target at SF100/v5e-8).
 
-Failure policy: a dead accelerator tunnel must NOT look like parity. The
-device leg runs in a subprocess under a hard timeout; if it cannot run, the
-JSON carries value=0, vs_baseline=0.0 and a "device_error" field with the
-probe diagnostics, so the driver artifact records a loud, diagnosable
-failure instead of "TPU == CPU".
+Tunnel-hostile design (the axon device link has ~70ms RTT and has been
+observed dead for whole rounds):
+  * ONE persistent device-leg subprocess, spawned at bench launch, that
+    initializes the device exactly once and then runs the whole leg —
+    no separate probe process paying init twice.
+  * Device init gets the WHOLE BENCH_DEVICE_TIMEOUT budget (default
+    1500s) because datagen + the CPU baseline run concurrently in the
+    parent while the device initializes.
+  * The leg streams progress events (init / fill / per-iteration times)
+    to a JSONL file; whatever happened before a timeout or crash is
+    folded into the final artifact under "device_progress", so even a
+    half-dead tunnel yields evidence.
+
+Failure policy: a dead accelerator tunnel must NOT look like parity. If
+the device leg cannot produce a time, the JSON carries value=0,
+vs_baseline=0.0, a "device_error" field, and the progress trail.
 """
 
 import json
@@ -25,16 +36,16 @@ import sys
 import tempfile
 import time
 
-_pt = os.environ.get("BENCH_PROBE_TIMEOUTS", "240,360")
-PROBE_TIMEOUTS = tuple(int(x) for x in _pt.split(","))  # try, then retry
-DEVICE_LEG_TIMEOUT = int(os.environ.get("BENCH_DEVICE_TIMEOUT", "1800"))
+DEVICE_LEG_TIMEOUT = int(os.environ.get("BENCH_DEVICE_TIMEOUT", "1500"))
+T0 = time.time()
 
 
 def log(msg: str) -> None:
-    print(msg, file=sys.stderr, flush=True)
+    print(f"[{time.time() - T0:7.1f}s] {msg}", file=sys.stderr, flush=True)
 
 
-def best_time(engine: str, data_dir: str, sql: str, warmups: int, iters: int) -> tuple[float, int]:
+def best_time(engine: str, data_dir: str, sql: str, warmups: int, iters: int,
+              progress=None) -> tuple[float, int]:
     from ballista_tpu.client.context import SessionContext
     from ballista_tpu.config import BallistaConfig, EXECUTOR_ENGINE
     from ballista_tpu.testing.tpchgen import register_tpch
@@ -42,111 +53,185 @@ def best_time(engine: str, data_dir: str, sql: str, warmups: int, iters: int) ->
     ctx = SessionContext(BallistaConfig({EXECUTOR_ENGINE: engine}))
     register_tpch(ctx, data_dir)
     rows = ctx.catalog.get("lineitem").statistics().num_rows or 0
-    for _ in range(warmups):
+    for w in range(warmups):
+        t0 = time.time()
         ctx.sql(sql).collect()
+        if progress:
+            progress("warmup", i=w, s=round(time.time() - t0, 3))
     best = float("inf")
-    for _ in range(iters):
+    for i in range(iters):
         t0 = time.time()
         out = ctx.sql(sql).collect()
-        best = min(best, time.time() - t0)
+        dt = time.time() - t0
+        best = min(best, dt)
+        if progress:
+            progress("iter", i=i, s=round(dt, 3))
         assert out.num_rows > 0
     return best, rows
 
 
-def probe_device() -> tuple[bool, str]:
-    """Initialize the accelerator and run one tiny compiled op, in a
-    subprocess under a hard timeout. Returns (ok, diagnostics)."""
-    probe_src = (
-        "import os, jax\n"
-        "p = os.environ.get('JAX_PLATFORMS')\n"
-        "if p: jax.config.update('jax_platforms', p)\n"
-        "d = jax.devices()[0]\n"
-        "import jax.numpy as jnp\n"
-        "x = jnp.ones((256, 256), dtype=jnp.bfloat16)\n"
-        "(x @ x).block_until_ready()\n"
-        "print(d.platform, d.device_kind)\n"
-    )
-    notes = []
-    for i, t in enumerate(PROBE_TIMEOUTS):
-        try:
-            probe = subprocess.run(
-                [sys.executable, "-c", probe_src],
-                capture_output=True, timeout=t, text=True,
-            )
-        except subprocess.TimeoutExpired:
-            notes.append(f"attempt {i + 1}: device init TIMED OUT after {t}s "
-                         f"(JAX_PLATFORMS={os.environ.get('JAX_PLATFORMS')!r}; dead tunnel?)")
-            log(notes[-1])
-            continue
-        if probe.returncode == 0:
-            log(f"device probe ok: {probe.stdout.strip()}")
-            return True, probe.stdout.strip()
-        notes.append(f"attempt {i + 1}: probe exited {probe.returncode}: "
-                     f"{(probe.stderr or probe.stdout).strip()[-500:]}")
-        log(notes[-1])
-    return False, " | ".join(notes)
+# ---------------------------------------------------------------- device leg
 
+def device_leg_main(data_dir: str, sql_path: str, out_path: str,
+                    progress_path: str, ready_path: str) -> None:
+    """Runs in the subprocess. Phase 1: device init (the slow, fragile part —
+    started before data even exists). Phase 2: wait for the parent's
+    data-ready sentinel. Phase 3: warmup (cache fill) + timed iterations.
+    Every phase appends a JSONL progress event immediately."""
+    pf = open(progress_path, "a", buffering=1)
 
-def run_device_leg(data_dir: str, sql_path: str) -> tuple[float, str | None]:
-    """TPU q1 in a subprocess with a hard timeout (a wedged device run must
-    not hang the bench). Returns (best_seconds, error)."""
-    with tempfile.NamedTemporaryFile("r", suffix=".json", delete=False) as f:
-        out_path = f.name
-    cmd = [sys.executable, os.path.abspath(__file__), "--device-leg", data_dir, sql_path, out_path]
-    try:
-        r = subprocess.run(cmd, capture_output=True, timeout=DEVICE_LEG_TIMEOUT, text=True)
-    except subprocess.TimeoutExpired:
-        return 0.0, f"device leg TIMED OUT after {DEVICE_LEG_TIMEOUT}s"
-    if r.stderr:
-        log(r.stderr[-1500:])
-    if r.returncode != 0:
-        return 0.0, f"device leg exited {r.returncode}: {(r.stderr or r.stdout).strip()[-500:]}"
-    with open(out_path) as f:
-        leg = json.load(f)
-    return leg["best_s"], None
+    def progress(event: str, **kw):
+        kw.update(event=event, t=round(time.time() - T0, 1))
+        pf.write(json.dumps(kw) + "\n")
+        pf.flush()
+        os.fsync(pf.fileno())
 
+    progress("leg_start", pid=os.getpid())
+    import jax
+    p = os.environ.get("JAX_PLATFORMS")
+    if p:
+        jax.config.update("jax_platforms", p)
+    t0 = time.time()
+    d = jax.devices()[0]
+    progress("devices_ok", platform=d.platform, kind=d.device_kind,
+             init_s=round(time.time() - t0, 1))
+    import jax.numpy as jnp
+    t0 = time.time()
+    x = jnp.ones((256, 256), dtype=jnp.bfloat16)
+    (x @ x).block_until_ready()
+    progress("first_compile_ok", s=round(time.time() - t0, 1))
 
-def device_leg_main(data_dir: str, sql_path: str, out_path: str) -> None:
+    ppid = os.getppid()
+    while not os.path.exists(ready_path):
+        if os.getppid() != ppid:  # parent died before the sentinel: don't
+            progress("orphaned")  # hold the accelerator forever
+            sys.exit(3)
+        time.sleep(1.0)
+    progress("data_ready_seen")
+
     sql = open(sql_path).read()
-    best, _rows = best_time("tpu", data_dir, sql, warmups=1, iters=3)
+    best, _rows = best_time("tpu", data_dir, sql, warmups=1, iters=3,
+                            progress=progress)
+    progress("leg_done", best_s=round(best, 3))
     with open(out_path, "w") as f:
         json.dump({"best_s": best}, f)
 
 
+def _stderr_tail(path: str, n: int = 600) -> str:
+    try:
+        with open(path) as f:
+            return f.read().strip()[-n:] or "(empty stderr)"
+    except OSError:
+        return "(no stderr captured)"
+
+
+def read_progress(progress_path: str) -> list[dict]:
+    events = []
+    try:
+        with open(progress_path) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    try:
+                        events.append(json.loads(line))
+                    except ValueError:
+                        pass
+    except OSError:
+        pass
+    return events
+
+
 def main() -> None:
     if len(sys.argv) > 1 and sys.argv[1] == "--device-leg":
-        device_leg_main(sys.argv[2], sys.argv[3], sys.argv[4])
+        device_leg_main(*sys.argv[2:7])
         return
 
     scale = float(os.environ.get("TPCH_SCALE", "10"))
     sf_tag = f"sf{scale:g}".replace(".", "p")
     data_dir = os.environ.get("TPCH_DATA", f"/tmp/ballista_tpch_{sf_tag}")
-    if not os.path.isdir(os.path.join(data_dir, "lineitem")):
-        log(f"generating TPC-H sf={scale} at {data_dir} ...")
-        from ballista_tpu.testing.tpchgen import generate_tpch
-
-        t0 = time.time()
-        generate_tpch(data_dir, scale=scale, files_per_table=8)
-        log(f"datagen {time.time() - t0:.1f}s")
-
     sql_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                             "benchmarks", "tpch", "queries", "q1.sql")
-    sql = open(sql_path).read()
 
-    log("running cpu engine baseline ...")
-    cpu_t, rows = best_time("cpu", data_dir, sql, warmups=1, iters=3)
-    log(f"cpu q1 sf{scale:g}: {cpu_t:.3f}s ({rows / cpu_t:,.0f} rows/s)")
+    # spawn the device leg FIRST: device init starts at t=0 and overlaps
+    # datagen + the CPU baseline below
+    tmp = tempfile.mkdtemp(prefix="bench_leg_")
+    out_path = os.path.join(tmp, "leg.json")
+    progress_path = os.path.join(tmp, "progress.jsonl")
+    ready_path = os.path.join(tmp, "data_ready")
+    stderr_path = os.path.join(tmp, "leg.stderr")
+    stderr_f = open(stderr_path, "w")
+    leg = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--device-leg",
+         data_dir, sql_path, out_path, progress_path, ready_path],
+        stdout=subprocess.DEVNULL, stderr=stderr_f,
+    )
+    stderr_f.close()  # child holds its own duplicated fd
+    log(f"device leg spawned (pid {leg.pid}); budget {DEVICE_LEG_TIMEOUT}s")
 
-    device_ok, diag = probe_device()
-    device_error = None
+    try:
+        if not os.path.isdir(os.path.join(data_dir, "lineitem")):
+            log(f"generating TPC-H sf={scale:g} at {data_dir} ...")
+            from ballista_tpu.testing.tpchgen import generate_tpch
+
+            t0 = time.time()
+            generate_tpch(data_dir, scale=scale, files_per_table=8)
+            log(f"datagen {time.time() - t0:.1f}s")
+
+        sql = open(sql_path).read()
+        log("running cpu engine baseline ...")
+        cpu_t, rows = best_time("cpu", data_dir, sql, warmups=1, iters=3)
+        log(f"cpu q1 sf{scale:g}: {cpu_t:.3f}s ({rows / cpu_t:,.0f} rows/s)")
+
+        # release the leg only now: its timed iterations must not contend
+        # with the CPU baseline's timed iterations on the same host (init
+        # and the baseline DID overlap — the point of the early spawn)
+        with open(ready_path, "w") as f:
+            f.write("ok")
+        t_ready = time.time()
+
+        # budget: the full window from launch, but never less than half of
+        # it after data-ready — datagen + baseline time must not starve the
+        # leg's query phase (at SF100 parent work alone can eat the window)
+        deadline = max(T0 + DEVICE_LEG_TIMEOUT, t_ready + DEVICE_LEG_TIMEOUT / 2)
+        seen = 0
+        device_error = None
+        while True:
+            events = read_progress(progress_path)
+            for e in events[seen:]:
+                log(f"device: {json.dumps(e)}")
+            seen = len(events)
+            rc = leg.poll()
+            if rc is not None:
+                if rc != 0:
+                    device_error = f"device leg exited {rc}: {_stderr_tail(stderr_path)}"
+                break
+            if time.time() > deadline:
+                # a leg that finished its work but wedged in runtime
+                # teardown still produced a valid result: check first
+                if os.path.exists(out_path):
+                    log("leg hit deadline after writing its result; using it")
+                    leg.kill()
+                    break
+                leg.kill()
+                elapsed = round(time.time() - T0)
+                stage = events[-1]["event"] if events else "no progress at all"
+                device_error = (f"device leg TIMED OUT after {elapsed}s "
+                                f"(budget {DEVICE_LEG_TIMEOUT}s); last progress: {stage}")
+                log(device_error)
+                break
+            time.sleep(2.0)
+    except BaseException:
+        leg.kill()  # never leave an orphan polling for the sentinel
+        raise
+
     tpu_t = 0.0
-    if device_ok:
-        log("running tpu engine ...")
-        tpu_t, device_error = run_device_leg(data_dir, sql_path)
-        if device_error is None:
+    if device_error is None:
+        try:
+            with open(out_path) as f:
+                tpu_t = json.load(f)["best_s"]
             log(f"tpu q1 sf{scale:g}: {tpu_t:.3f}s ({cpu_t / tpu_t:.1f}x)")
-    else:
-        device_error = diag
+        except (OSError, ValueError, KeyError) as e:
+            device_error = f"device leg produced no output: {e}"
 
     result = {
         "metric": f"tpch_q1_{sf_tag}_rows_per_sec_per_chip",
@@ -161,6 +246,11 @@ def main() -> None:
         result["value"] = 0
         result["vs_baseline"] = 0.0
         result["device_error"] = device_error
+    # partial evidence survives either way: the leg's progress trail shows
+    # exactly how far the tunnel let us get (init / fill / per-iter times)
+    progress_trail = read_progress(progress_path)
+    if progress_trail:
+        result["device_progress"] = progress_trail
     print(json.dumps(result))
 
 
