@@ -27,9 +27,17 @@ from ballista_tpu.plan.physical import (
 
 
 def maybe_compile_tpu(physical: ExecutionPlan, config: BallistaConfig) -> ExecutionPlan:
+    from ballista_tpu.ops.tpu.final_stage import TpuFinalStageExec, match_final_stage
     from ballista_tpu.ops.tpu.stage_compiler import TpuStageExec
 
     def walk(node: ExecutionPlan) -> ExecutionPlan:
+        fs = match_final_stage(node)
+        if fs is not None:
+            # final-agg/sort stage shape: merge partials + ORDER BY/LIMIT in
+            # HBM; the child (shuffle reader, or repartition in local plans)
+            # keeps its own device opportunities
+            sort, post_ops, agg, child, coalesce = fs
+            return TpuFinalStageExec(sort, post_ops, agg, walk(child), config, coalesce)
         if isinstance(node, HashAggregateExec) and node.mode == "partial":
             chain = _match_chain(node.input)
             if chain is not None:

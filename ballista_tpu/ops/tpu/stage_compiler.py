@@ -384,8 +384,8 @@ class TpuStageExec(ExecutionPlan):
                         self.partial_agg.node_str(), exc_info=True,
                     )
                     self._results = {}
-        if partition in self._results:
-            return self._results.pop(partition)
+            if partition in self._results:
+                return self._results.pop(partition)
         return self._fallback(partition, ctx)
 
     def _fallback(self, partition: int, ctx: TaskContext) -> list[pa.RecordBatch]:

@@ -58,7 +58,9 @@ def partial_then_psum(values, gmask_fn, num_groups: int, mesh, axis: str = "part
     values: [rows] array sharded on `axis`; gmask_fn(local_rows) -> bool
     masks [num_groups, local_rows].
     """
-    import jax
+    from ballista_tpu.ops.tpu.runtime import ensure_jax
+
+    jax = ensure_jax()
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
     from jax.experimental.shard_map import shard_map
@@ -74,6 +76,26 @@ def partial_then_psum(values, gmask_fn, num_groups: int, mesh, axis: str = "part
     return shard_map(local, mesh=mesh, in_specs=(P(axis),), out_specs=(P(), P()))(values)
 
 
+def exchange_capacity_fits(key_arrays, n_devices: int, capacity: int) -> bool:
+    """Host-side capacity check (the gate the docstring above promises):
+    True iff, for every (sending device, destination) pair, the number of
+    rows routed there fits in `capacity` slots. Uses the engine-wide key
+    hash (ops/hashing.py — bit-exact twin of the device hash64), so the
+    verdict matches what the device kernel will do. `key_arrays` is the
+    per-device list of host int64 key arrays; rows beyond capacity would be
+    dropped by the fixed-shape kernel, so a False verdict must route the
+    exchange down the file-shuffle path instead."""
+    from ballista_tpu.ops.hashing import splitmix64
+
+    for k in key_arrays:
+        k = np.asarray(k)
+        dest = splitmix64(k.astype(np.uint64)) % np.uint64(n_devices)
+        counts = np.bincount(dest.astype(np.int64), minlength=n_devices)
+        if counts.max(initial=0) > capacity:
+            return False
+    return True
+
+
 def hash_exchange_all_to_all(keys, payload, mesh, axis: str = "part", capacity: int | None = None):
     """Route (key, payload) rows to device hash(key) % n via all_to_all.
 
@@ -81,8 +103,16 @@ def hash_exchange_all_to_all(keys, payload, mesh, axis: str = "part", capacity: 
     rows whose key hashes to it, in fixed-capacity slots:
     returns (keys_out, payload_out, valid_out) with per-device shape
     [n_dev * capacity] where valid marks real rows.
+
+    Overflow rows (more than `capacity` for one destination) land in a
+    dump slot that is sliced away before the exchange — they can NEVER
+    clobber a valid row. Callers gate dispatch with
+    `exchange_capacity_fits` and fall back to the file shuffle when the
+    data does not fit.
     """
-    import jax
+    from ballista_tpu.ops.tpu.runtime import ensure_jax
+
+    jax = ensure_jax()  # x64: the key hash works on uint64 lanes
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
     from jax.experimental.shard_map import shard_map
@@ -97,23 +127,20 @@ def hash_exchange_all_to_all(keys, payload, mesh, axis: str = "part", capacity: 
         dest = (hash64(k.astype(jnp.uint64)) % jnp.uint64(n)).astype(jnp.int32)
         # stable slot assignment per destination bucket
         slot = jnp.zeros_like(dest)
-        eye = []
         for d in range(n):
             is_d = dest == d
             slot = jnp.where(is_d, jnp.cumsum(is_d) - 1, slot)
-            eye.append(is_d)
-        # scatter into [n, cap] send buffers (overflow rows dropped — caller
-        # guarantees capacity; the file shuffle path is the escape hatch)
-        send_k = jnp.zeros((n, cap), dtype=k.dtype)
-        send_v = jnp.zeros((n, cap), dtype=v.dtype)
-        send_ok = jnp.zeros((n, cap), dtype=bool)
+        # scatter into [n, cap+1] send buffers: slot `cap` is a write-only
+        # dump for overflow rows (duplicate-index .at[].set ordering is
+        # unspecified, so overflow must never share a slot with valid data)
         ok = slot < cap
-        send_k = send_k.at[dest, jnp.where(ok, slot, cap - 1)].set(jnp.where(ok, k, 0))
-        send_v = send_v.at[dest, jnp.where(ok, slot, cap - 1)].set(jnp.where(ok, v, 0))
-        send_ok = send_ok.at[dest, jnp.where(ok, slot, cap - 1)].set(ok)
-        rk = jax.lax.all_to_all(send_k, axis, 0, 0, tiled=True)
-        rv = jax.lax.all_to_all(send_v, axis, 0, 0, tiled=True)
-        ro = jax.lax.all_to_all(send_ok, axis, 0, 0, tiled=True)
+        slot_w = jnp.where(ok, slot, cap)
+        send_k = jnp.zeros((n, cap + 1), dtype=k.dtype).at[dest, slot_w].set(k)
+        send_v = jnp.zeros((n, cap + 1), dtype=v.dtype).at[dest, slot_w].set(v)
+        send_ok = jnp.zeros((n, cap + 1), dtype=bool).at[dest, slot_w].set(ok)
+        rk = jax.lax.all_to_all(send_k[:, :cap], axis, 0, 0, tiled=True)
+        rv = jax.lax.all_to_all(send_v[:, :cap], axis, 0, 0, tiled=True)
+        ro = jax.lax.all_to_all(send_ok[:, :cap], axis, 0, 0, tiled=True)
         return rk.reshape(-1), rv.reshape(-1), ro.reshape(-1)
 
     return shard_map(

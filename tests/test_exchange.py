@@ -1,0 +1,128 @@
+"""ICI collective exchange: all_to_all hash routing on the virtual 8-device
+CPU mesh (the driver's multi-chip dryrun validates the same path).
+
+Reference parity: exchange semantics of ShuffleWriter(hash K) →
+ShuffleReader (ballista/core/src/execution_plans/shuffle_reader.rs:762),
+expressed as XLA collectives; the file shuffle remains the escape hatch
+when the host-side capacity check says the data does not fit."""
+
+import numpy as np
+import pytest
+
+from ballista_tpu.parallel.exchange import (
+    exchange_capacity_fits,
+    hash_exchange_all_to_all,
+    make_mesh,
+    partial_then_psum,
+)
+
+
+def _mesh8():
+    mesh = make_mesh(8)
+    if mesh.devices.size < 8:
+        pytest.skip("need 8 virtual devices")
+    return mesh
+
+
+def _expected_routing(keys_np, n):
+    from ballista_tpu.ops.hashing import splitmix64
+
+    return splitmix64(keys_np.astype(np.uint64)) % np.uint64(n)
+
+
+def test_hash_exchange_routes_every_row_once():
+    import jax.numpy as jnp
+
+    mesh = _mesh8()
+    n = mesh.devices.size
+    rows = 64 * n
+    rng = np.random.default_rng(3)
+    keys_np = rng.integers(0, 10_000, rows).astype(np.int64)
+    vals_np = np.arange(rows, dtype=np.int64)
+
+    rk, rv, ro = hash_exchange_all_to_all(
+        jnp.asarray(keys_np), jnp.asarray(vals_np), mesh, capacity=rows)
+    rk, rv, ro = np.asarray(rk), np.asarray(rv), np.asarray(ro)
+    # every input row arrives exactly once, on the device its key hashes to
+    got = sorted(rv[ro].tolist())
+    assert got == vals_np.tolist()
+    dest = _expected_routing(keys_np, n)
+    per_dev = rk.reshape(n, -1)
+    per_ok = ro.reshape(n, -1)
+    for d in range(n):
+        want = sorted(keys_np[dest == d].tolist())
+        assert sorted(per_dev[d][per_ok[d]].tolist()) == want
+
+
+def test_hash_exchange_overflow_never_clobbers_valid_rows():
+    """Force overflow: surviving rows must be an intact SUBSET of the
+    input — an overflow write must never zero a valid slot (the round-2
+    data-loss bug: overflow used to share slot cap-1 with real rows)."""
+    import jax.numpy as jnp
+
+    mesh = _mesh8()
+    n = mesh.devices.size
+    rows = 64 * n
+    # all keys hash-route somewhere; capacity 8 per (sender, dest) pair is
+    # far below the ~64/8 rows per pair on average → guaranteed overflow
+    # for at least some pairs with 10k distinct keys
+    rng = np.random.default_rng(5)
+    keys_np = rng.integers(0, 37, rows).astype(np.int64)  # few keys → skew
+    vals_np = np.arange(1, rows + 1, dtype=np.int64)  # all nonzero
+    cap = 4
+
+    assert not exchange_capacity_fits(
+        [keys_np[i * 64:(i + 1) * 64] for i in range(n)], n, cap)
+
+    rk, rv, ro = hash_exchange_all_to_all(
+        jnp.asarray(keys_np), jnp.asarray(vals_np), mesh, capacity=cap)
+    rk, rv, ro = np.asarray(rk), np.asarray(rv), np.asarray(ro)
+    surv_vals = rv[ro]
+    # every surviving value is a real input row (no zeroed/clobbered slots)
+    assert len(surv_vals) > 0
+    assert set(surv_vals.tolist()) <= set(vals_np.tolist())
+    # and its key traveled with it to the right destination
+    dest = {v: d for v, d in zip(vals_np, _expected_routing(keys_np, n))}
+    key_of = dict(zip(vals_np.tolist(), keys_np.tolist()))
+    per = ro.reshape(n, -1)
+    vals_per = rv.reshape(n, -1)
+    keys_per = rk.reshape(n, -1)
+    for d in range(n):
+        for v, k in zip(vals_per[d][per[d]].tolist(), keys_per[d][per[d]].tolist()):
+            assert key_of[v] == k
+            assert dest[v] == d
+
+
+def test_exchange_capacity_fits_gate():
+    n = 8
+    rng = np.random.default_rng(7)
+    keys = [rng.integers(0, 1 << 40, 256).astype(np.int64) for _ in range(n)]
+    # 256 rows over 8 destinations ≈ 32/dest; 96 slots is comfortably enough
+    assert exchange_capacity_fits(keys, n, 96)
+    assert not exchange_capacity_fits(keys, n, 8)
+
+
+def test_partial_then_psum_merges_globally():
+    import jax.numpy as jnp
+
+    mesh = _mesh8()
+    rows = 128 * mesh.devices.size
+    rng = np.random.default_rng(11)
+    g = rng.integers(0, 4, rows)
+    v = rng.integers(0, 100, rows).astype(np.float32)
+    G = 4
+
+    def gmask_fn(vals):
+        # group id rides in the value's fractional tag for the test: instead
+        # derive masks from value ranges — here simply recompute from a
+        # broadcasted device-side copy is impossible, so encode group in
+        # the integer part: v = group * 1000 + x
+        return jnp.stack([(vals // 1000) == grp for grp in range(G)])
+
+    enc = (g * 1000 + (v % 1000).astype(np.int64)).astype(np.float32)
+    sums, cnts = partial_then_psum(jnp.asarray(enc), gmask_fn, G, mesh)
+    sums, cnts = np.asarray(sums), np.asarray(cnts)
+    for grp in range(G):
+        sel = g == grp
+        assert cnts[grp] == sel.sum()
+        assert np.isclose(sums[grp], enc[sel].sum(), rtol=1e-6)
