@@ -34,9 +34,6 @@ log = logging.getLogger(__name__)
 
 MAX_STAGE_ATTEMPTS = 4
 MAX_TASK_FAILURES = 4
-# runtime broadcast decisions apply this safety factor to the configured
-# planner threshold (see _try_broadcast_elision / aqe SelectJoinRule)
-ELISION_MARGIN = 8
 
 
 class StageState(Enum):
@@ -133,6 +130,12 @@ class ExecutionGraph:
             for inp in s.input_stage_ids:
                 self.output_links[inp].append(s.stage_id)
         self._lock = threading.RLock()
+        # the adaptive replanning pipeline (reference: AdaptivePlanner,
+        # state/aqe/planner.rs) — invoked after finalizations and at
+        # resolution, always under self._lock
+        from ballista_tpu.scheduler.aqe.replanner import AdaptiveReplanner
+
+        self.replanner = AdaptiveReplanner()
         self.stage_metrics: dict[int, list] = {}
         # (executor_id, task_id, stage_id) of tasks obsoleted by incremental
         # replanning or job cancellation, awaiting a CancelTasks rpc
@@ -265,75 +268,27 @@ class ExecutionGraph:
             self.ended_at = time.time()
             events.append("job_finished")
             return
-        self._cascade_empty_stages(stage, events)
+        # the adaptive replanning pass over the remaining plan (empty
+        # propagation → runtime join selection → obsolete-stage
+        # cancellation); no-op unless ballista.planner.adaptive.enabled
+        self.replanner.replan_after_finalize(self, stage, events)
         if self.status is not JobState.RUNNING:
             return
         for out_id in self.output_links.get(stage.stage_id, []):
             consumer = self.stages.get(out_id)
             if consumer is None:
                 continue
-            self._try_broadcast_elision(consumer)
             self._try_resolve(consumer)
 
-    def _cascade_empty_stages(self, finished: ExecutionStage, events: list[str]) -> None:
-        """Incremental replanning after a stage finalizes EMPTY: collapse
-        joins in every still-unresolved stage spec, SKIP stages proven to
-        yield zero rows (they complete without scheduling a single task),
-        and CANCEL stages nothing references anymore (reference: stage
-        alteration + cancellation, state/aqe/planner.rs:349)."""
-        from ballista_tpu.config import AQE_EMPTY_PROPAGATION, PLANNER_ADAPTIVE_ENABLED
-        from ballista_tpu.scheduler.aqe.rules import (
-            propagate_empty_unresolved,
-            provably_empty,
-        )
-        from ballista_tpu.scheduler.planner import _find_input_stages
-
-        if not (bool(self.config.get(PLANNER_ADAPTIVE_ENABLED))
-                and bool(self.config.get(AQE_EMPTY_PROPAGATION))):
-            return
-        if any(l.stats.num_rows for l in finished.output_locations()):
-            return
-
-        def empty_ids() -> set[int]:
-            return {
-                sid for sid, s in self.stages.items()
-                if s.state is StageState.SUCCESSFUL
-                and not any(l.stats.num_rows for l in s.output_locations())
-            }
-
-        changed = True
-        while changed and self.status is JobState.RUNNING:
-            changed = False
-            ids = empty_ids()
-            for s in self.stages.values():
-                if s.state is not StageState.UNRESOLVED:
-                    continue
-                new_plan = propagate_empty_unresolved(s.spec.plan, ids)
-                if new_plan is s.spec.plan:
-                    continue
-                s.spec.plan = new_plan
-                s.spec.input_stage_ids = _find_input_stages(s.spec.plan)
-                changed = True
-                if s.stage_id != self.final_stage_id and provably_empty(s.spec.plan.input):
-                    log.info(
-                        "incremental AQE: stage %d proven empty after stage %d "
-                        "finished with 0 rows — skipped without scheduling",
-                        s.stage_id, finished.stage_id,
-                    )
-                    s.pending = []
-                    s.completed = {p: [] for p in range(s.effective_partitions)}
-                    s.state = StageState.SUCCESSFUL
-                    s.skipped = True
-                    events.append("stage_completed")
-                    self._on_stage_success(s, events)
-                else:
-                    # the collapse may have removed the LAST pending input
-                    # (e.g. a group-less aggregate over the emptied join
-                    # still has to run to emit its zero-count row): nothing
-                    # else will trigger resolution, so try it here
-                    self._try_resolve(s)
-        self._rebuild_output_links()
-        self._cancel_obsolete_stages(events)
+    def complete_stage_skipped(self, stage: ExecutionStage, events: list[str]) -> None:
+        """Finalize a stage the replanner proved empty: it completes with
+        zero-row outputs without ever scheduling a task."""
+        stage.pending = []
+        stage.completed = {p: [] for p in range(stage.effective_partitions)}
+        stage.state = StageState.SUCCESSFUL
+        stage.skipped = True
+        events.append("stage_completed")
+        self._on_stage_success(stage, events)
 
     def _rebuild_output_links(self) -> None:
         self.output_links = {sid: [] for sid in self.stages}
@@ -367,233 +322,14 @@ class ExecutionGraph:
             s.completed = {p: [] for p in range(s.effective_partitions)}
             events.append("stage_cancelled")
 
-    def _try_broadcast_elision(self, stage: ExecutionStage) -> None:
-        """Incremental AQE replanning (AdaptivePlanner::replan_stages analog,
-        state/aqe/planner.rs:304): when a partitioned join's BUILD input just
-        finished tiny while the PROBE-side hash shuffle hasn't started, the
-        remaining plan is replanned — the join becomes CollectLeft over a
-        broadcast build, and the probe stage's hash writer is rewritten to a
-        passthrough, ELIDING the probe-side shuffle entirely. This is the
-        win resolution-time rewrites cannot reach: by resolution the probe
-        rows have already been hashed, bucketed, and written."""
-        from ballista_tpu.config import (
-            AQE_DYNAMIC_JOIN_SELECTION,
-            BROADCAST_JOIN_ROWS_THRESHOLD,
-            PLANNER_ADAPTIVE_ENABLED,
-        )
-        from ballista_tpu.plan.physical import HashJoinExec
-        from ballista_tpu.shuffle.reader import UnresolvedShuffleExec
-        from ballista_tpu.shuffle.writer import ShuffleWriterExec
-
-        if stage.state is not StageState.UNRESOLVED:
-            return
-        if not (
-            bool(self.config.get(PLANNER_ADAPTIVE_ENABLED))
-            and bool(self.config.get(AQE_DYNAMIC_JOIN_SELECTION))
-        ):
-            return
-        # deliberately conservative: runtime elision rewrites TWO stages, so
-        # it only fires well below the planner's broadcast threshold (matches
-        # the resolution-time SelectJoinRule's margin in aqe/rules.py)
-        threshold = int(self.config.get(BROADCAST_JOIN_ROWS_THRESHOLD)) // ELISION_MARGIN
-
-        def passthrough(writer: ShuffleWriterExec) -> ShuffleWriterExec:
-            return ShuffleWriterExec(
-                writer.input, self.job_id, writer.stage_id, 0, [], sort_shuffle=False
-            )
-
-        def rewrite(node):
-            changed = False
-            kids = node.children()
-            if kids:
-                new_kids = []
-                for c in kids:
-                    nc, ch = rewrite(c)
-                    new_kids.append(nc)
-                    changed = changed or ch
-                if changed:
-                    node = node.with_children(new_kids)
-            if (
-                isinstance(node, HashJoinExec)
-                and node.mode == "partitioned"
-                and node.join_type in ("inner", "right", "right_semi", "right_anti")
-                and isinstance(node.left, UnresolvedShuffleExec)
-                and isinstance(node.right, UnresolvedShuffleExec)
-                and node.left.stage_id != node.right.stage_id
-            ):
-                build = self.stages.get(node.left.stage_id)
-                probe = self.stages.get(node.right.stage_id)
-                if build is None or probe is None or build.state is not StageState.SUCCESSFUL:
-                    return node, changed
-                if (
-                    probe.running or probe.completed
-                    or probe.state not in (StageState.UNRESOLVED, StageState.RESOLVED)
-                    or probe.spec.plan.output_partitions <= 0
-                ):
-                    return node, changed  # probe started (or already passthrough)
-                rows = sum(loc.stats.num_rows for loc in build.output_locations())
-                if rows > threshold:
-                    return node, changed
-                probe.spec.plan = passthrough(probe.spec.plan)
-                probe.spec.output_partitions = probe.spec.partitions
-                if probe.resolved_plan is not None:
-                    probe.resolved_plan = passthrough(probe.resolved_plan)
-                build.spec.broadcast = True
-                new_left = UnresolvedShuffleExec(
-                    build.stage_id, node.left.df_schema, node.left.output_partitions,
-                    broadcast=True,
-                )
-                new_right = UnresolvedShuffleExec(
-                    probe.stage_id, node.right.df_schema, probe.spec.partitions,
-                    broadcast=False,
-                )
-                log.info(
-                    "incremental AQE: build stage %d finished with %d rows → "
-                    "CollectLeft broadcast; probe stage %d hash shuffle elided "
-                    "(passthrough, %d partitions)",
-                    build.stage_id, rows, probe.stage_id, probe.spec.partitions,
-                )
-                return (
-                    HashJoinExec(
-                        new_left, new_right, node.on, node.join_type, node.filter,
-                        "collect_left", node.df_schema,
-                    ),
-                    True,
-                )
-            return node, changed
-
-        new_plan, changed = rewrite(stage.spec.plan)
-        if changed:
-            stage.spec.plan = new_plan
-            stage.spec.partitions = new_plan.input.output_partition_count()
-            stage.pending = list(range(stage.spec.partitions))
-            stage.effective_partitions = stage.spec.partitions
-
-    def _try_shrink_fanout(self, stage: ExecutionStage, inputs) -> None:
-        """Stage-alteration replanning (state/aqe/planner.rs:349,
-        alter_stages.rs analog): at resolution — after this stage's inputs
-        finished but before any of its tasks launch — shrink its hash
-        fan-out K when the observed input volume proves the planned bucket
-        count absurd, and patch the still-unresolved consumers' leaves to
-        the new K. Read-side coalescing (CoalescePartitionsRule) already
-        merges tiny reduce reads; this removes the WRITE-side cost: K
-        sort-shuffle buckets, K index entries, K files per map task.
-
-        Guards: every consumer must still be UNRESOLVED and have this stage
-        as its ONLY input, so co-partitioned join alignment (two producers
-        hashed to the same K) can never break."""
-        from ballista_tpu.config import (
-            AQE_ALTER_FANOUT,
-            AQE_TARGET_PARTITION_BYTES,
-            PLANNER_ADAPTIVE_ENABLED,
-        )
-        from ballista_tpu.shuffle.reader import UnresolvedShuffleExec
-        from ballista_tpu.shuffle.writer import ShuffleWriterExec
-
-        if not (bool(self.config.get(PLANNER_ADAPTIVE_ENABLED))
-                and bool(self.config.get(AQE_ALTER_FANOUT))):
-            return
-        writer = stage.spec.plan
-        if not isinstance(writer, ShuffleWriterExec) or writer.output_partitions <= 1:
-            return
-        if stage.spec.broadcast:
-            return
-
-        def leaves(node):
-            kids = node.children()
-            if not kids:
-                yield node
-            for c in kids:
-                yield from leaves(c)
-
-        # every leaf must be a shuffle input: a stage that also SCANS a
-        # table (e.g. broadcast-join probe) has volume the input stats
-        # cannot see
-        if any(not isinstance(l, UnresolvedShuffleExec) for l in leaves(writer.input)):
-            return
-        # transitively collect the consumers whose task count must follow
-        # the altered output count: a PASSTHROUGH consumer's own output
-        # count equals its task count (one file per task), so ITS consumers
-        # — e.g. a join stage left behind by broadcast elision — must be
-        # repartitioned too, or they schedule tasks past the shrunken
-        # reader. Abort entirely if any transitive consumer fails the
-        # safety guards (unresolved + single-input): a half-patched chain
-        # would execute partitions that no longer exist.
-        affected: list[tuple[int, ExecutionStage]] = []  # (producer_id, consumer)
-        seen: set[int] = set()
-        frontier = [(stage.stage_id, cid) for cid in self.output_links.get(stage.stage_id, [])]
-        if not frontier:
-            return
-        while frontier:
-            pid, cid = frontier.pop(0)
-            c = self.stages.get(cid)
-            if (c is None or cid in seen
-                    or c.state is not StageState.UNRESOLVED
-                    or set(c.spec.input_stage_ids) != {pid}):
-                return
-            seen.add(cid)
-            affected.append((pid, c))
-            if c.spec.plan.output_partitions <= 0 and not c.spec.broadcast:
-                # broadcast outputs are read whole regardless of count;
-                # only non-broadcast passthrough output counts propagate
-                frontier.extend((cid, g) for g in self.output_links.get(cid, []))
-        total_bytes = sum(
-            l.stats.num_bytes for inp in inputs for l in inp.output_locations()
-        )
-        target = max(1, int(self.config.get(AQE_TARGET_PARTITION_BYTES)))
-        # input volume bounds this stage's output for scan/filter/agg
-        # pipelines; expansion joins can exceed it, so shrink only with a
-        # 2x margin and only when the drop is at least 2x (mis-guessing low
-        # costs read-side balance, never correctness)
-        k = writer.output_partitions
-        new_k = max(1, -(-2 * total_bytes // target))  # ceil(2·bytes/target)
-        if new_k > k // 2:
-            return
-        stage.spec.plan = ShuffleWriterExec(
-            writer.input, self.job_id, writer.stage_id, new_k, writer.keys,
-            writer.sort_shuffle,
-        )
-        stage.spec.output_partitions = new_k
-
-        def patch(node, pid: int, count: int):
-            if (isinstance(node, UnresolvedShuffleExec)
-                    and node.stage_id == pid and not node.broadcast):
-                return UnresolvedShuffleExec(
-                    node.stage_id, node.df_schema, count, broadcast=False)
-            kids = node.children()
-            if not kids:
-                return node
-            new_kids = [patch(c, pid, count) for c in kids]
-            if all(a is b for a, b in zip(new_kids, kids)):
-                return node
-            return node.with_children(new_kids)
-
-        new_out = {stage.stage_id: new_k}
-        for pid, c in affected:
-            c.spec.plan = patch(c.spec.plan, pid, new_out[pid])
-            new_parts = c.spec.plan.input.output_partition_count()
-            c.spec.partitions = new_parts
-            if c.spec.plan.output_partitions <= 0:
-                # passthrough writers materialize one output per task: the
-                # advertised output count must follow the new task count or
-                # downstream readers size against the stale K
-                c.spec.output_partitions = new_parts
-                new_out[c.stage_id] = new_parts
-            c.pending = list(range(new_parts))
-            c.effective_partitions = new_parts
-        log.info(
-            "incremental AQE: stage %d inputs totalled %d bytes — hash "
-            "fan-out altered %d → %d buckets (consumers repartitioned)",
-            stage.stage_id, total_bytes, k, new_k,
-        )
-
     def _try_resolve(self, stage: ExecutionStage) -> None:
         if stage.state is not StageState.UNRESOLVED:
             return
         inputs = [self.stages[i] for i in stage.spec.input_stage_ids]
         if not all(i.state is StageState.SUCCESSFUL for i in inputs):
             return
-        self._try_shrink_fanout(stage, inputs)
+        # stage-alteration replanning (fan-out shrink) before readers build
+        self.replanner.replan_at_resolution(self, stage, inputs)
         resolved: dict[int, ShuffleReaderExec] = {}
         for inp in inputs:
             resolved[inp.stage_id] = self._build_reader(inp)
