@@ -67,6 +67,13 @@ _COMPILE_LOCK = threading.Lock()
 _LUT_CACHE: dict = {}  # (table_key, fingerprint) → device arrays
 _BUILD_CACHE: dict = {}  # (table_key, fingerprint, join_idx) → BuildTable
 
+# Diagnostics for the benchmark/roofline harness: timings + bytes of the most
+# recent device stage run in this process. Best-effort (unlocked — readers
+# want a snapshot, not coordination): fill_s = host→HBM table upload,
+# device_bytes = resident column bytes, compile_s = trace+lower+jit,
+# exec_s = dispatch + batched fetch of the last _tpu_run_all.
+RUN_STATS: dict = {}
+
 KEY_SHIFT = 21  # multi-key combine: k = k1 << 21 | k2 (guarded ranges)
 
 
