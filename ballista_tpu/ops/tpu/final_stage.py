@@ -289,8 +289,19 @@ class TpuFinalStageExec(ExecutionPlan):
             # overhead for this kernel: it re-groups globally anyway. Read
             # the repartition's input directly and emit the merged result
             # on output partition 0 (others empty) — the in-process form of
-            # replacing the exchange with a device-side merge; downstream
-            # merge operators handle the empty partitions naturally.
+            # replacing the exchange with a device-side merge.
+            #
+            # CONTRACT (pinned by test_tpu_final_stage.py::
+            # test_bypass_partitioning_contract): output_partition_count()
+            # still advertises K, but rows do NOT follow the hash scheme —
+            # they all land on partition 0. This is sound because no
+            # consumer in this engine trusts declared hash placement:
+            # partition-sensitive consumers (partitioned joins, repartition
+            # writers) always get a FRESH RepartitionExec inserted above
+            # them by the physical planner (physical_planner.py:556-558),
+            # and everything else merges/concatenates partitions. A future
+            # partitioning-property optimization that elides "redundant"
+            # repartitions MUST exclude TpuFinalStageExec outputs.
             child = child.input
             bypass = True
         P_in = child.output_partition_count()
@@ -310,11 +321,30 @@ class TpuFinalStageExec(ExecutionPlan):
         N = next_bucket(max(max(part_rows), 1), self.buckets)
         P = len(part_rows)
 
-        kinds, scales, dicts, cols_np, valids_np = [], [], [], [], []
+        # encode first (cheap dtype/validity info), then enforce the HBM
+        # budget BEFORE any host stacking or device upload: the partial
+        # path's discipline (stage_compiler.py:586) — a stage the budget
+        # rejects falls back cleanly instead of relying on catching a
+        # device OOM that can wedge the client on real runtimes
+        encoded = []
         for name in full.column_names:
             dc = encode_column(full.column(name))
             if dc is None:
                 raise Unsupported(f"unencodable column {name}")
+            encoded.append(dc)
+        cell_bytes = P * N
+        proj_bytes = cell_bytes  # [P, N] bool row mask
+        for dc in encoded:
+            proj_bytes += cell_bytes * dc.data.dtype.itemsize
+            if dc.valid is not None:
+                proj_bytes += cell_bytes  # bool validity plane
+        max_bytes = int(self.config.get(TPU_MAX_DEVICE_BYTES))
+        if proj_bytes > max_bytes:
+            raise Unsupported(
+                f"final stage needs {proj_bytes} device bytes (> cap {max_bytes})")
+
+        kinds, scales, dicts, cols_np, valids_np = [], [], [], [], []
+        for dc in encoded:
             kinds.append(dc.kind)
             scales.append(dc.scale)
             dicts.append(dc.dictionary)
@@ -347,17 +377,24 @@ class TpuFinalStageExec(ExecutionPlan):
         with _FINAL_COMPILE_LOCK:
             cached = _FINAL_COMPILE_CACHE.get(key)
             if cached is None:
-                cached = self._compile(kinds, scales, dicts, valids_np, cols_np,
-                                       P, N, merge_all=bypass)
+                fn, lowering, meta = self._compile(
+                    kinds, scales, dicts, valids_np, cols_np, P, N,
+                    merge_all=bypass)
+                # per-entry run lock: the jitted closure mutates its shared
+                # trace-time `cell` dict if jax ever retraces it (e.g. jit
+                # cache eviction); serializing execution of THIS entry keeps
+                # any retrace single-threaded without a global choke point
+                cached = (fn, lowering, meta, threading.Lock())
                 _FINAL_COMPILE_CACHE[key] = cached
-        fn, lowering, meta = cached
+        fn, lowering, meta, run_lock = cached
 
         luts = [_put(None, l) for l in lowering.build_luts(dicts)]
         flat = [_put(None, c) for c in cols_np] + [
             _put(None, v) for v in valids_np if v is not None
         ]
         mask = _put(None, mask_np)
-        outs = fn(flat, luts, mask)
+        with run_lock:
+            outs = fn(flat, luts, mask)
         return self._decode(outs, meta, P_result, dicts)
 
     # ------------------------------------------------------------------

@@ -29,6 +29,7 @@ from __future__ import annotations
 import itertools
 import logging
 import threading
+import time
 from typing import Iterator
 
 import numpy as np
@@ -217,7 +218,10 @@ class DeviceTableCache:
                 raise Unsupported("peer encode failed")
             return hit
         try:
+            t0 = time.time()
             dt = self._load(scan, buckets, ctx, mesh)
+            RUN_STATS["fill_s"] = round(time.time() - t0, 3)
+            RUN_STATS["device_bytes"] = dt.nbytes
             with self._lock:
                 total = sum(v.nbytes for v in self._cache.values())
                 while self._cache and total + dt.nbytes > max_bytes:
@@ -609,7 +613,9 @@ class TpuStageExec(ExecutionPlan):
         with _COMPILE_LOCK:
             cached = _COMPILE_CACHE.get(key)
             if cached is None:
+                t0 = time.time()
                 cached = self._compile(dt, kinds, dicts, P, N, builds)
+                RUN_STATS["compile_s"] = round(time.time() - t0, 3)
                 _COMPILE_CACHE[key] = cached
         fn, lowering, meta = cached
 
@@ -623,11 +629,15 @@ class TpuStageExec(ExecutionPlan):
             _LUT_CACHE[lut_key] = luts
 
         build_args = [b.flat_arrays() for b in builds]
+        t0 = time.time()
         outs = fn(dt.flat_cols(), luts, dt.mask, build_args)
         if meta["mode"] == "sorted":
-            return self._decode_sorted(outs, meta, P, dicts, [b.dicts for b in builds])
-        outs = jax.device_get(list(outs))  # ONE batched fetch
-        return self._decode_all(outs, meta, P, dicts, [b.dicts for b in builds])
+            res = self._decode_sorted(outs, meta, P, dicts, [b.dicts for b in builds])
+        else:
+            outs = jax.device_get(list(outs))  # ONE batched fetch
+            res = self._decode_all(outs, meta, P, dicts, [b.dicts for b in builds])
+        RUN_STATS["exec_s"] = round(time.time() - t0, 3)
+        return res
 
     # ------------------------------------------------------------------
 

@@ -11,44 +11,60 @@ over this framework's CPU engine (pyarrow C++ operators) on the same host —
 the "CPU-executor baseline" the north-star gate compares against
 (BASELINE.json: ≥3x target at SF100/v5e-8).
 
-Tunnel-hostile design, round 4 (the axon device link has ~70ms RTT and has
-been observed dead for three consecutive driver runs; rounds 2-3 produced
-ZERO device evidence because the leg hung somewhere inside init):
-  * The device leg emits a progress event around EVERY fragile statement:
-    import_jax_start/ok, devices_start/ok, first_compile_ok, fills, iters.
-    A hang is therefore pinned to a single statement in the autopsy.
-  * Parent-side staged watchdog: if a leg attempt does not reach
-    `devices_ok` within BENCH_INIT_STAGE_TIMEOUT (default 420s), it is
-    killed and respawned (BENCH_INIT_ATTEMPTS, default 3) — later attempts
-    run with verbose relay/PJRT logging so the stderr tail shows WHY the
-    claim loop is stuck. Device init overlaps datagen + the CPU baseline
-    in the parent, so attempts are nearly free until data is ready.
+Tunnel-hostile design, round 5. Autopsy of the rounds-2-4 failure (three
+driver runs, zero device data): the hang is inside `jax.devices()` — the
+axon PJRT plugin's claim loop polls the loopback relay for a device grant
+in 1 s nanosleep cycles (live /proc evidence: main thread in
+clock_nanosleep, plugin's tokio worker in epoll_wait, no established TCP —
+each poll is a short-lived request that completes; the pool simply never
+grants). Design consequences:
+
+  * A pending claim is NEVER killed-and-respawned: if the pool queues
+    claims, a respawn forfeits queue position. Attempt 1 persists for the
+    whole budget. (Rounds 2-4 killed the claim every 420 s — likely
+    re-queueing at the back three times.)
+  * Hedged claim: if no grant after HEDGE_AFTER, a SECOND leg spawns in
+    parallel (covers a wedged first connection); the first leg to report
+    devices_ok wins and every other leg is killed AT GRANT TIME, so the
+    winner's timed iterations never share the host with a second leg.
+  * Verbose relay/PJRT logging from attempt 1 (ADVICE r4) — the stderr
+    tail is autopsy material, not a retry luxury.
+  * Syscall-level autopsy: on failure the artifact carries, per leg, a
+    /proc snapshot (thread comms, wchan, syscall numbers) taken while the
+    claim is hung, a relay TCP probe result, and the stderr tail — enough
+    to prove where it blocks without strace.
+  * The leg is watched from spawn (ADVICE r4): a leg that DIES during
+    datagen/CPU-baseline is respawned immediately (crash ≠ hang; crashes
+    don't hold queue position).
   * Reduced-scale fallback: the parent generates BOTH SF<scale> and SF1
     data and times the CPU baseline on both. The ready-file hands the leg
-    a `fallback_at` wall-clock: if data becomes ready too late for the
+    a `fallback_at` wall-clock: if the grant lands too late for the
     full-scale timed phase, the leg runs SF1 instead, so *some* hot-path
     device datum lands. A device OOM at full scale also retries at SF1.
-  * Roofline evidence: each device iteration event carries the engine's
-    RUN_STATS (device-table fill seconds, resident bytes, dispatch+fetch
-    seconds) so achieved HBM GB/s is computable from the artifact alone.
+  * Roofline evidence: each device iteration event nests the engine's
+    RUN_STATS under "stats" (fill_s, device_bytes, compile_s, exec_s) so
+    achieved HBM GB/s is computable from the artifact alone.
 
-Failure policy: a dead accelerator tunnel must NOT look like parity. If
-the device leg cannot produce a time, the JSON carries value=0,
-vs_baseline=0.0, a "device_error" field, the per-attempt progress trail,
-and each attempt's stderr tail.
+Failure policy: a dead accelerator pool must NOT look like parity. If the
+device leg cannot produce a time, the JSON carries value=0,
+vs_baseline=0.0, "device_error", the FULL init-event trail (iteration
+events truncated, init events never — ADVICE r4), per-leg /proc autopsies
+and stderr tails.
 """
 
 import json
 import os
 import signal
+import socket
 import subprocess
 import sys
 import tempfile
+import threading
 import time
 
 DEVICE_LEG_TIMEOUT = int(os.environ.get("BENCH_DEVICE_TIMEOUT", "1500"))
-INIT_STAGE_TIMEOUT = int(os.environ.get("BENCH_INIT_STAGE_TIMEOUT", "420"))
-INIT_ATTEMPTS = int(os.environ.get("BENCH_INIT_ATTEMPTS", "3"))
+HEDGE_AFTER = int(os.environ.get("BENCH_HEDGE_AFTER", "300"))
+MAX_LEGS = int(os.environ.get("BENCH_INIT_ATTEMPTS", "3"))
 # estimated seconds the full-scale device phase needs after data-ready
 # (cache fill over the tunnel + 1 warmup + 3 iters); beyond this the leg
 # drops to SF1 which needs ~1/10th of it
@@ -84,7 +100,8 @@ def best_time(engine: str, data_dir: str, sql: str, warmups: int, iters: int,
         t0 = time.time()
         ctx.sql(sql).collect()
         if progress:
-            progress("warmup", i=w, s=round(time.time() - t0, 3), **run_stats())
+            progress("warmup", i=w, s=round(time.time() - t0, 3),
+                     stats=run_stats())
     best = float("inf")
     for i in range(iters):
         t0 = time.time()
@@ -92,7 +109,7 @@ def best_time(engine: str, data_dir: str, sql: str, warmups: int, iters: int,
         dt = time.time() - t0
         best = min(best, dt)
         if progress:
-            progress("iter", i=i, s=round(dt, 3), **run_stats())
+            progress("iter", i=i, s=round(dt, 3), stats=run_stats())
         assert out.num_rows > 0
     return best, rows
 
@@ -124,7 +141,7 @@ def device_leg_main(out_path: str, progress_path: str, ready_path: str,
         jax.config.update("jax_platforms", p)
     progress("import_jax_ok", platforms=p or "(default)")
     t0 = time.time()
-    progress("devices_start")  # ← the statement that hung rounds 1-3
+    progress("devices_start")  # ← the statement that hung rounds 1-4
     d = jax.devices()[0]
     progress("devices_ok", platform=d.platform, kind=d.device_kind,
              init_s=round(time.time() - t0, 1))
@@ -170,8 +187,85 @@ def device_leg_main(out_path: str, progress_path: str, ready_path: str,
         progress("retry_at_fallback", scale=leg_cfg["scale"])
         best = run(leg_cfg)
     progress("leg_done", best_s=round(best, 3), scale=leg_cfg["scale"])
-    with open(out_path, "w") as f:
-        json.dump({"best_s": best, "scale": leg_cfg["scale"]}, f)
+    tmp_out = out_path + f".a{attempt}"
+    with open(tmp_out, "w") as f:
+        json.dump({"best_s": best, "scale": leg_cfg["scale"],
+                   "attempt": attempt}, f)
+    try:
+        os.link(tmp_out, out_path)  # atomic, FAILS if a winner exists:
+    except FileExistsError:  # genuinely first-finisher-wins (rename
+        pass  # would silently replace the full-scale datum with SF1)
+
+
+# --------------------------------------------------------------- diagnostics
+
+def proc_autopsy(pid: int) -> dict:
+    """Snapshot where a (presumably hung) claim process is blocked, from
+    /proc alone (no strace in the image): per-thread comm/state/wchan and
+    current syscall number, plus the TCP connections THIS process holds
+    (matched via its /proc/pid/fd socket inodes — net/tcp is namespace-
+    wide and would otherwise show unrelated processes' sockets).
+    nanosleep + no owned TCP = a poll loop the pool never answers."""
+    out: dict = {"pid": pid, "threads": [], "tcp": []}
+    base = f"/proc/{pid}"
+    try:
+        for tid in sorted(os.listdir(f"{base}/task")):
+            t = f"{base}/task/{tid}"
+            try:
+                comm = open(f"{t}/comm").read().strip()
+                wchan = open(f"{t}/wchan").read().strip()
+                syscall = open(f"{t}/syscall").read().split()[0]
+                state = open(f"{t}/stat").read().split()[2]
+                out["threads"].append(
+                    {"tid": int(tid), "comm": comm, "state": state,
+                     "wchan": wchan, "syscall": syscall})
+            except OSError:
+                pass
+        inodes = set()
+        for fd in os.listdir(f"{base}/fd"):
+            try:
+                tgt = os.readlink(f"{base}/fd/{fd}")
+            except OSError:
+                continue
+            if tgt.startswith("socket:["):
+                inodes.add(tgt[8:-1])
+        for line in open(f"{base}/net/tcp").read().splitlines()[1:]:
+            f = line.split()
+            if f[9] in inodes:
+                out["tcp"].append({"local": f[1], "remote": f[2], "st": f[3]})
+    except OSError as e:
+        out["error"] = str(e)
+    return out
+
+
+# env vars whose VALUES are known non-secret config; anything else
+# matching the prefixes is reported by key only (a pool credential in an
+# AXON_*/TPU_* var must not leak into the printed artifact)
+_SAFE_ENV = frozenset({
+    "JAX_PLATFORMS", "PALLAS_AXON_TPU_GEN", "PALLAS_AXON_POOL_IPS",
+    "PALLAS_AXON_REMOTE_COMPILE", "AXON_LOOPBACK_RELAY",
+    "TPU_SKIP_MDS_QUERY", "TPU_WORKER_HOSTNAMES", "AXON_POOL_SVC_OVERRIDE",
+})
+
+
+def relay_probe() -> dict:
+    """Preflight the axon loopback relay: env summary + TCP connect."""
+    env = {}
+    for k, v in os.environ.items():
+        if k.startswith(("PALLAS_AXON", "AXON_", "TPU_", "JAX_PLATFORMS")):
+            env[k] = v if k in _SAFE_ENV else f"<set, {len(v)} chars>"
+    probe: dict = {"env": env}
+    for port in (2024,):
+        s = socket.socket()
+        s.settimeout(3)
+        try:
+            s.connect(("127.0.0.1", port))
+            probe[f"relay_tcp_{port}"] = "connect_ok"
+        except OSError as e:
+            probe[f"relay_tcp_{port}"] = f"FAIL: {e}"
+        finally:
+            s.close()
+    return probe
 
 
 def _stderr_tail(path: str, n: int = 600) -> str:
@@ -201,12 +295,12 @@ def read_progress(progress_path: str) -> list[dict]:
 def spawn_leg(tmp: str, attempt: int, paths: dict) -> subprocess.Popen:
     stderr_path = os.path.join(tmp, f"leg{attempt}.stderr")
     env = dict(os.environ)
-    if attempt > 1:
-        # verbose relay/PJRT logging: if the claim loop is stuck, the
-        # stderr tail becomes the autopsy (rust plugin + libtpu + XLA)
-        env.setdefault("RUST_LOG", "info")
-        env.setdefault("TPU_STDERR_LOG_LEVEL", "0")
-        env.setdefault("TF_CPP_MIN_LOG_LEVEL", "0")
+    # verbose relay/PJRT logging from attempt 1 (ADVICE r4): if the claim
+    # loop is stuck the stderr tail becomes the autopsy, and attempt 1 is
+    # the attempt most likely to hold the best queue position
+    env.setdefault("RUST_LOG", "info")
+    env.setdefault("TPU_STDERR_LOG_LEVEL", "0")
+    env.setdefault("TF_CPP_MIN_LOG_LEVEL", "0")
     with open(stderr_path, "w") as stderr_f:
         leg = subprocess.Popen(
             [sys.executable, os.path.abspath(__file__), "--device-leg",
@@ -216,6 +310,89 @@ def spawn_leg(tmp: str, attempt: int, paths: dict) -> subprocess.Popen:
         )
     log(f"device leg attempt {attempt} spawned (pid {leg.pid})")
     return leg
+
+
+class LegPool:
+    """All live device-leg processes. One persistent primary claim; a
+    hedge leg after HEDGE_AFTER without a grant; crash-respawn anytime
+    (crashed claims hold no queue position, so respawn is free)."""
+
+    def __init__(self, tmp: str, paths: dict):
+        self.tmp = tmp
+        self.paths = paths
+        self.legs: dict[int, subprocess.Popen] = {}
+        self.next_attempt = 1
+        self.errors: list[str] = []
+        self.autopsies: list[dict] = []
+        self.lock = threading.Lock()
+
+    def spawn(self) -> None:
+        with self.lock:
+            if self.next_attempt > MAX_LEGS:
+                return
+            a = self.next_attempt
+            self.next_attempt += 1
+            self.legs[a] = spawn_leg(self.tmp, a, self.paths)
+
+    def reap_crashes(self) -> None:
+        """Respawn legs that exited without producing the result file."""
+        with self.lock:
+            dead = [(a, p) for a, p in self.legs.items()
+                    if p.poll() is not None]
+            for a, p in dead:
+                del self.legs[a]
+        for a, p in dead:
+            if os.path.exists(self.paths["out"]):
+                continue
+            err = (f"attempt {a} exited {p.returncode}: "
+                   f"{_stderr_tail(os.path.join(self.tmp, f'leg{a}.stderr'))}")
+            log(err)
+            self.errors.append(err)
+            self.spawn()
+
+    def autopsy_all(self, label: str) -> None:
+        with self.lock:
+            live = [(a, p) for a, p in self.legs.items() if p.poll() is None]
+        for a, p in live:
+            snap = proc_autopsy(p.pid)
+            snap["attempt"] = a
+            snap["label"] = label
+            snap["stderr_tail"] = _stderr_tail(
+                os.path.join(self.tmp, f"leg{a}.stderr"), 400)
+            self.autopsies.append(snap)
+            log(f"autopsy[{label}] attempt {a}: "
+                + json.dumps(snap["threads"])[:300])
+
+    def kill_except(self, winner_attempt: int) -> None:
+        """A leg won the device grant: kill every OTHER leg immediately so
+        the winner's timed iterations never contend with a second leg's
+        host-side work (the same reason the CPU baseline blocks the legs).
+        Also stops spawning: a hedge after a grant is pure contention."""
+        with self.lock:
+            self.next_attempt = MAX_LEGS + 1
+            losers = [(a, p) for a, p in self.legs.items()
+                      if a != winner_attempt]
+            for a, _ in losers:
+                del self.legs[a]
+        for a, p in losers:
+            log(f"killing losing leg attempt {a} (attempt "
+                f"{winner_attempt} holds the grant)")
+            try:
+                p.send_signal(signal.SIGKILL)
+                p.wait(timeout=10)
+            except Exception:  # noqa: BLE001
+                pass
+
+    def kill_all(self) -> None:
+        with self.lock:
+            legs = list(self.legs.values())
+            self.legs.clear()
+        for p in legs:
+            try:
+                p.send_signal(signal.SIGKILL)
+                p.wait(timeout=10)
+            except Exception:  # noqa: BLE001
+                pass
 
 
 def main() -> None:
@@ -230,7 +407,10 @@ def main() -> None:
     sql_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                             "benchmarks", "tpch", "queries", "q1.sql")
 
-    # spawn the device leg FIRST: device init starts at t=0 and overlaps
+    preflight = relay_probe()
+    log(f"relay preflight: {json.dumps(preflight)[:400]}")
+
+    # spawn the device leg FIRST: the claim starts at t=0 and overlaps
     # datagen + the CPU baselines below
     tmp = tempfile.mkdtemp(prefix="bench_leg_")
     paths = {
@@ -238,19 +418,23 @@ def main() -> None:
         "progress": os.path.join(tmp, "progress.jsonl"),
         "ready": os.path.join(tmp, "data_ready"),
     }
-    attempt = 1
-    leg = spawn_leg(tmp, attempt, paths)
-    attempt_t0 = time.time()
-    log(f"budget {DEVICE_LEG_TIMEOUT}s; init stage timeout {INIT_STAGE_TIMEOUT}s"
-        f" x {INIT_ATTEMPTS} attempts")
+    pool = LegPool(tmp, paths)
+    pool.spawn()
+    deadline = T0 + DEVICE_LEG_TIMEOUT
+    log(f"budget {DEVICE_LEG_TIMEOUT}s; hedge after {HEDGE_AFTER}s; "
+        f"max legs {MAX_LEGS}")
 
-    def kill_leg(p):
-        try:
-            p.send_signal(signal.SIGKILL)
-            p.wait(timeout=10)
-        except Exception:  # noqa: BLE001
-            pass
+    # watch the leg DURING datagen/baseline (ADVICE r4): crashes respawn
+    # immediately instead of burning the post-data-ready window
+    watcher_stop = threading.Event()
 
+    def watcher():
+        while not watcher_stop.wait(5.0):
+            pool.reap_crashes()
+
+    threading.Thread(target=watcher, daemon=True).start()
+
+    device_error = None
     try:
         from ballista_tpu.testing.tpchgen import generate_tpch
 
@@ -273,12 +457,13 @@ def main() -> None:
         else:
             cpu_t_fb, rows_fb = cpu_t, rows
 
-        # release the leg only now: its timed iterations must not contend
-        # with the CPU baseline's timed iterations on the same host (init
-        # and the baseline DID overlap — the point of the early spawn).
-        # fallback_at: the wall-clock beyond which the full-scale phase
-        # no longer fits the window — the leg then drops to SF1.
-        deadline = max(T0 + DEVICE_LEG_TIMEOUT, time.time() + DEVICE_LEG_TIMEOUT / 3)
+        watcher_stop.set()
+        # release the legs only now: their timed iterations must not
+        # contend with the CPU baseline's timed iterations on the same
+        # host (the claim and the baseline DID overlap — the point of the
+        # early spawn). fallback_at: the wall-clock beyond which the
+        # full-scale phase no longer fits the window.
+        deadline = max(deadline, time.time() + DEVICE_LEG_TIMEOUT / 3)
         ready = {
             "primary": {"data_dir": data_dir, "scale": scale, "sql_path": sql_path},
             "fallback": ({"data_dir": fb_dir, "scale": 1.0, "sql_path": sql_path}
@@ -290,60 +475,49 @@ def main() -> None:
         os.rename(paths["ready"] + ".tmp", paths["ready"])
 
         seen = 0
-        device_error = None
-        attempt_errors: list[str] = []
         devices_ok = False
+        hedged = False
+        mid_autopsy_done = False
         while True:
             events = read_progress(paths["progress"])
             for e in events[seen:]:
                 log(f"device: {json.dumps(e)}")
-                if e.get("event") == "devices_ok" and e.get("attempt") == attempt:
+                if e.get("event") == "devices_ok" and not devices_ok:
                     devices_ok = True
+                    pool.kill_except(int(e.get("attempt", 1)))
             seen = len(events)
-            rc = leg.poll()
+            pool.reap_crashes()
             now = time.time()
-            if rc is not None:
-                if rc == 0 or os.path.exists(paths["out"]):
-                    # a leg that wrote its result but died in runtime
-                    # teardown still produced a valid datum (ADVICE r3)
-                    break
-                err = (f"attempt {attempt} exited {rc}: "
-                       f"{_stderr_tail(os.path.join(tmp, f'leg{attempt}.stderr'))}")
-            elif not devices_ok and now - attempt_t0 > INIT_STAGE_TIMEOUT:
-                kill_leg(leg)
-                err = (f"attempt {attempt}: no devices_ok within "
-                       f"{INIT_STAGE_TIMEOUT}s (hung statement: see trail); "
-                       f"stderr: {_stderr_tail(os.path.join(tmp, f'leg{attempt}.stderr'), 300)}")
-            elif now > deadline:
-                if os.path.exists(paths["out"]):
-                    log("leg hit deadline after writing its result; using it")
-                    kill_leg(leg)
-                    break
-                kill_leg(leg)
+            if os.path.exists(paths["out"]):
+                break
+            with pool.lock:
+                any_live = any(p.poll() is None for p in pool.legs.values())
+            if not any_live and pool.next_attempt > MAX_LEGS:
+                device_error = ("all device legs crashed: "
+                                + "; ".join(pool.errors[-3:]))
+                break
+            if not devices_ok and not hedged and now - T0 > HEDGE_AFTER:
+                # hedge: a SECOND claim in parallel — never kill the
+                # first (it may hold a queue position)
+                hedged = True
+                log("no grant yet — spawning hedge leg (primary stays up)")
+                pool.spawn()
+            if not devices_ok and not mid_autopsy_done and now - T0 > 2 * HEDGE_AFTER:
+                mid_autopsy_done = True
+                pool.autopsy_all("mid")
+            if now > deadline:
+                pool.autopsy_all("deadline")
                 stage = events[-1]["event"] if events else "no progress at all"
-                device_error = (f"device leg TIMED OUT after {round(now - T0)}s "
-                                f"(budget {DEVICE_LEG_TIMEOUT}s); last progress: "
-                                f"{stage}; attempts: {attempt_errors}")
+                device_error = (
+                    f"device leg(s) produced no result in {round(now - T0)}s "
+                    f"(budget {DEVICE_LEG_TIMEOUT}s); last progress: {stage}; "
+                    f"crashes: {pool.errors[-2:]}")
                 log(device_error)
                 break
-            else:
-                time.sleep(2.0)
-                continue
-            # an attempt just failed (bad exit or init stall)
-            log(err)
-            attempt_errors.append(err)
-            remaining = deadline - time.time()
-            if attempt < INIT_ATTEMPTS and remaining > 120:
-                attempt += 1
-                devices_ok = False
-                leg = spawn_leg(tmp, attempt, paths)
-                attempt_t0 = time.time()
-            else:
-                device_error = "; ".join(attempt_errors) or "device leg failed"
-                break
-    except BaseException:
-        kill_leg(leg)  # never leave an orphan polling for the sentinel
-        raise
+            time.sleep(2.0)
+    finally:
+        watcher_stop.set()
+        pool.kill_all()  # never leave an orphan polling for the sentinel
 
     tpu_t, leg_scale = 0.0, scale
     if device_error is None or os.path.exists(paths["out"]):
@@ -379,11 +553,16 @@ def main() -> None:
         result["value"] = 0
         result["vs_baseline"] = 0.0
         result["device_error"] = device_error
-    # partial evidence survives either way: the leg's progress trail shows
-    # exactly how far the tunnel let us get (init / fill / per-iter times)
-    progress_trail = read_progress(paths["progress"])
-    if progress_trail:
-        result["device_progress"] = progress_trail[-40:]
+        result["relay_preflight"] = preflight
+        result["autopsies"] = pool.autopsies
+    # partial evidence survives either way. Init-stage events are few and
+    # load-bearing — keep ALL of them; only warmup/iter events truncate
+    # (ADVICE r4).
+    trail = read_progress(paths["progress"])
+    if trail:
+        init_ev = [e for e in trail if e.get("event") not in ("warmup", "iter")]
+        run_ev = [e for e in trail if e.get("event") in ("warmup", "iter")]
+        result["device_progress"] = init_ev + run_ev[-40:]
     print(json.dumps(result))
 
 

@@ -232,6 +232,142 @@ def test_final_stage_date_group_and_sort():
     assert tp.c.tolist() == cp.c.tolist()
 
 
+def test_final_stage_hbm_cap_enforced():
+    """A final stage whose [P, N] stacking exceeds TPU_MAX_DEVICE_BYTES is
+    rejected with Unsupported BEFORE dispatch (no device OOM reliance) and
+    the query falls back to the CPU subtree with the right answer."""
+    from ballista_tpu.client.context import SessionContext
+    from ballista_tpu.config import TPU_MAX_DEVICE_BYTES
+    from ballista_tpu.engine.tpu_engine import maybe_compile_tpu
+    from ballista_tpu.ops.tpu.final_stage import TpuFinalStageExec
+    from ballista_tpu.ops.tpu.kernels import Unsupported
+    from ballista_tpu.plan.physical import TaskContext
+
+    rng = np.random.default_rng(43)
+    n = 4000
+    t = pa.table({
+        "g": rng.integers(0, 50, n).astype("int64"),
+        "v": rng.integers(0, 100, n).astype("int64"),
+    })
+    sql = "SELECT g, sum(v) AS s FROM t GROUP BY g ORDER BY s DESC LIMIT 5"
+    cfg = BallistaConfig({EXECUTOR_ENGINE: "tpu", TPU_MIN_ROWS: 0,
+                          TPU_MAX_DEVICE_BYTES: 1024})
+    ctx = SessionContext(cfg)
+    ctx.register_arrow_table("t", t, partitions=2)
+    phys = maybe_compile_tpu(ctx.create_physical_plan(ctx.sql(sql).plan), cfg)
+    stages = [nd for nd in _walk(phys) if isinstance(nd, TpuFinalStageExec)]
+    assert len(stages) == 1, phys.display()
+    # the budget check raises Unsupported cleanly (not a device error)
+    with pytest.raises(Unsupported, match="device bytes"):
+        stages[0]._tpu_run_all(TaskContext(cfg))
+    # ... and the full query answers correctly through the CPU fallback
+    out = ctx.sql(sql).collect().to_pandas()
+    cfg_cpu = BallistaConfig({EXECUTOR_ENGINE: "cpu"})
+    ctx_cpu = SessionContext(cfg_cpu)
+    ctx_cpu.register_arrow_table("t", t, partitions=2)
+    exp = ctx_cpu.sql(sql).collect().to_pandas()
+    assert out.g.tolist() == exp.g.tolist()
+    assert out.s.tolist() == exp.s.tolist()
+    assert all(s.tpu_count == 0 for s in stages)
+
+
+def test_bypass_partitioning_contract():
+    """Pin the hash-repartition bypass contract (final_stage.py): the exec
+    still advertises the repartition's K output partitions, but ALL rows
+    come out on partition 0 and partitions 1..K-1 are empty — consumers
+    must never trust declared hash placement of this node's output."""
+    from ballista_tpu.client.context import SessionContext
+    from ballista_tpu.engine.tpu_engine import maybe_compile_tpu
+    from ballista_tpu.ops.tpu.final_stage import TpuFinalStageExec
+    from ballista_tpu.plan.physical import RepartitionExec, TaskContext
+
+    rng = np.random.default_rng(47)
+    n = 6000
+    t = pa.table({
+        "g": rng.integers(0, 100, n).astype("int64"),
+        "v": rng.integers(0, 10, n).astype("int64"),
+    })
+    sql = "SELECT g, sum(v) AS s FROM t GROUP BY g"
+    cfg = BallistaConfig({EXECUTOR_ENGINE: "tpu", TPU_MIN_ROWS: 0})
+    ctx = SessionContext(cfg)
+    ctx.register_arrow_table("t", t, partitions=2)
+    phys = maybe_compile_tpu(ctx.create_physical_plan(ctx.sql(sql).plan), cfg)
+    stages = [nd for nd in _walk(phys) if isinstance(nd, TpuFinalStageExec)]
+    assert len(stages) == 1, phys.display()
+    fs = stages[0]
+    if not isinstance(fs.child, RepartitionExec):
+        pytest.skip("local plan no longer places a hash repartition here")
+    k = fs.output_partition_count()
+    assert k > 1  # the advertised partition count is the repartition's K
+    tc = TaskContext(cfg)
+    rows_by_part = [
+        sum(b.num_rows for b in fs.execute(p, tc)) for p in range(k)
+    ]
+    assert fs.tpu_count == 1 and fs.fallback_count == 0
+    assert rows_by_part[0] == 100  # every group lands on partition 0
+    assert all(r == 0 for r in rows_by_part[1:])
+
+
+def test_final_stage_concurrent_contexts_share_cache_entry():
+    """Two sessions with identical stage shapes share one compile-cache
+    entry; concurrent execution is serialized by the per-entry run lock and
+    both answers are exact (pins the retrace/`cell`-race guard)."""
+    import threading
+
+    from ballista_tpu.client.context import SessionContext
+    from ballista_tpu.engine.tpu_engine import maybe_compile_tpu
+    from ballista_tpu.ops.tpu.final_stage import TpuFinalStageExec
+    from ballista_tpu.plan.physical import TaskContext
+
+    rng = np.random.default_rng(53)
+    n = 8000
+    sql = "SELECT g, sum(v) AS s FROM t GROUP BY g ORDER BY s DESC LIMIT 7"
+
+    def make_table(seed):
+        r = np.random.default_rng(seed)
+        return pa.table({
+            "g": r.integers(0, 60, n).astype("int64"),
+            "v": r.integers(0, 100, n).astype("int64"),
+        })
+
+    tables = [make_table(s) for s in (1, 2)]
+    outs: dict = {}
+    errs: list = []
+
+    def run(i):
+        try:
+            cfg = BallistaConfig({EXECUTOR_ENGINE: "tpu", TPU_MIN_ROWS: 0})
+            ctx = SessionContext(cfg)
+            ctx.register_arrow_table("t", tables[i], partitions=2)
+            phys = maybe_compile_tpu(
+                ctx.create_physical_plan(ctx.sql(sql).plan), cfg)
+            stages = [nd for nd in _walk(phys)
+                      if isinstance(nd, TpuFinalStageExec)]
+            assert len(stages) == 1
+            tc = TaskContext(cfg)
+            batches = []
+            for p in range(phys.output_partition_count()):
+                batches.extend(phys.execute(p, tc))
+            assert stages[0].tpu_count == 1 and stages[0].fallback_count == 0
+            outs[i] = pa.Table.from_batches(
+                [b for b in batches if b.num_rows], phys.schema())
+        except Exception as e:  # noqa: BLE001 — surfaced below
+            errs.append(e)
+
+    threads = [threading.Thread(target=run, args=(i,)) for i in range(2)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    assert not errs, errs
+    for i in range(2):
+        got = outs[i].to_pandas()
+        df = tables[i].to_pandas().groupby("g", as_index=False).v.sum()
+        exp = df.sort_values(["v", "g"], ascending=[False, True]).head(7)
+        assert got.g.tolist() == exp.g.tolist()
+        assert got.s.tolist() == exp.v.tolist()
+
+
 def test_final_stage_distributed_standalone():
     """The staged (distributed) path: a standalone cluster on the tpu
     engine produces the same q3-class answer as the cpu engine."""
