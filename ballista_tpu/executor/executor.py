@@ -43,6 +43,9 @@ class ExecutorMetadata:
     flight_port: int = 0
     vcores: int = 4
     wire_version: str = WIRE_PROTOCOL_VERSION
+    # chip this executor is pinned to (-1 = unpinned); when pinned with
+    # engine=tpu the daemon defaults vcores to 1 so scheduler slots = chips
+    device_ordinal: int = -1
 
 
 @dataclass
@@ -138,6 +141,7 @@ class Executor:
                 if self._is_cancelled(task.job_id, task.stage_id):
                     raise Cancelled(f"task {task.task_id} cancelled")
                 ctx = TaskContext(cfg, task_id=f"{task.task_id}", work_dir=self.work_dir)
+                ctx.device_ordinal = self.metadata.device_ordinal
                 if self.session_pools is not None:
                     # concurrent tasks of one session share the pool: idle
                     # tasks lend spill budget to a heavy sort (try_grow)

@@ -150,13 +150,18 @@ class ExecutorProcess:
                  work_dir: str | None = None, engine: str = "cpu",
                  policy: str = "push", work_dir_ttl_s: float = 4 * 3600,
                  memory_pool_bytes: int = 0, memory_fraction: float = 0.6,
-                 flight_impl: str = "auto",
+                 flight_impl: str = "auto", device_ordinal: int = -1,
                  tls_cert: str | None = None, tls_key: str | None = None,
                  tls_ca: str | None = None):
         self.scheduler_addr = scheduler_addr
         self.work_dir = work_dir or tempfile.mkdtemp(prefix="ballista-tpu-executor-")
         self.policy = policy
         self.work_dir_ttl_s = work_dir_ttl_s
+        if vcores is None and engine == "tpu" and device_ordinal >= 0:
+            # one executor per chip ⇒ scheduler slot = chip (SURVEY §7 step
+            # 7; reference vcore slot model, executor_process.rs:261): a
+            # pinned device runs one stage task at a time
+            vcores = 1
         vcores = vcores or (os.cpu_count() or 4)
         host = external_host or socket.gethostname()
 
@@ -191,7 +196,8 @@ class ExecutorProcess:
 
         self.memory_pool_bytes = memory_pool_bytes or int(detect_memory_limit() * memory_fraction)
         self.metadata = ExecutorMetadata(
-            id=str(new_executor_id()), host=host, flight_port=bound_flight, vcores=vcores
+            id=str(new_executor_id()), host=host, flight_port=bound_flight, vcores=vcores,
+            device_ordinal=device_ordinal,
         )
         self.executor = Executor(self.work_dir, self.metadata, config=config)
         # per-task static floor (backstop when no session pool is present)
@@ -244,9 +250,11 @@ class ExecutorProcess:
         if self.policy == "pull":
             threading.Thread(target=self._poll_loop, daemon=True, name="poll").start()
         log.info(
-            "executor %s up: grpc=%d flight=%d vcores=%d work_dir=%s",
+            "executor %s up: grpc=%d flight=%d vcores=%d device=%s work_dir=%s",
             self.metadata.id, self.grpc_port, self.metadata.flight_port,
-            self.metadata.vcores, self.work_dir,
+            self.metadata.vcores,
+            self.metadata.device_ordinal if self.metadata.device_ordinal >= 0 else "unpinned",
+            self.work_dir,
         )
 
     def _register(self) -> None:
@@ -373,6 +381,10 @@ def main(argv=None) -> None:
                     help="CA for verifying the scheduler and requiring client certs (mTLS)")
     ap.add_argument("--flight-server", choices=("auto", "python", "native"), default="auto",
                     help="shuffle data plane: native C++ (preferred), python, or auto-fallback")
+    ap.add_argument("--device-ordinal", type=int,
+                    default=int(os.environ.get("BALLISTA_DEVICE_ORDINAL", "-1")),
+                    help="pin this executor to one accelerator chip (one executor per "
+                         "chip; defaults vcores to 1 with --engine tpu). -1 = unpinned")
     ap.add_argument("--memory-pool-bytes", type=int, default=0,
                     help="fixed memory pool size (0 = fraction of cgroup/host)")
     ap.add_argument("--memory-fraction", type=float, default=0.6,
@@ -386,11 +398,20 @@ def main(argv=None) -> None:
 
     init_logging(args.log_level, args.log_file, args.log_rotation)
 
+    if args.device_ordinal >= 0:
+        # must happen before jax's backend initialises: on real TPU hardware
+        # each chip is claimed exclusively, so a pinned daemon filters its
+        # runtime visibility down to its one chip
+        from ballista_tpu.ops.tpu.runtime import bind_process_ordinal
+
+        if bind_process_ordinal(args.device_ordinal):
+            log.info("process bound to device ordinal %d", args.device_ordinal)
+
     proc = ExecutorProcess(
         args.scheduler, args.bind_host, args.external_host, args.grpc_port,
         args.flight_port, args.concurrent_tasks, args.work_dir, args.engine, args.policy,
         memory_pool_bytes=args.memory_pool_bytes, memory_fraction=args.memory_fraction,
-        flight_impl=args.flight_server,
+        flight_impl=args.flight_server, device_ordinal=args.device_ordinal,
         tls_cert=args.tls_cert, tls_key=args.tls_key, tls_ca=args.tls_ca,
     )
     signal.signal(signal.SIGTERM, lambda *_: proc.shutdown())

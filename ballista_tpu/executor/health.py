@@ -26,6 +26,7 @@ def start_health_server(executor, stopping_event, host: str = "0.0.0.0", port: i
                 "executor_id": executor.metadata.id,
                 "tasks_run": executor.tasks_run,
                 "tasks_failed": executor.tasks_failed,
+                "device_ordinal": executor.metadata.device_ordinal,
             }).encode()
             self.send_response(200)
             self.send_header("Content-Type", "application/json")

@@ -240,10 +240,13 @@ class TpuFinalStageExec(ExecutionPlan):
     def _run(self, partition: int, ctx: TaskContext) -> list[pa.RecordBatch]:
         import logging
 
+        from ballista_tpu.ops.tpu.runtime import device_scope
+
         with self._results_lock:
             if self._results is None:
                 try:
-                    self._results = self._tpu_run_all(ctx)
+                    with device_scope(ctx.device_ordinal):
+                        self._results = self._tpu_run_all(ctx)
                     self.tpu_count += 1
                 except Unsupported as e:
                     logging.getLogger(__name__).info(

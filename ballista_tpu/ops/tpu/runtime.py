@@ -40,3 +40,67 @@ def device_kind() -> str:
         return jax.devices()[0].platform
     except Exception:
         return "cpu"
+
+
+# ---------------------------------------------------------------- binding
+# Per-chip executor pinning (SURVEY §7 step 7: one executor per chip,
+# scheduler slot = chip; reference analog: the vcore slot model in
+# executor/src/executor_process.rs:261). Two layers:
+#
+#  * process level — on real TPU hardware a chip is claimed exclusively at
+#    backend init, so a pinned daemon must filter visibility BEFORE jax
+#    initialises (bind_process_ordinal, called from executor_process.main);
+#  * dispatch level — on shared-runtime platforms (CPU test mesh, an
+#    in-process standalone cluster) every executor sees all devices, so
+#    each stage commits its arrays via jax.default_device
+#    (device_scope, threaded through TaskContext.device_ordinal).
+
+def bind_process_ordinal(ordinal: int) -> bool:
+    """Restrict this PROCESS to one TPU chip. Must run before jax's backend
+    initialises; returns False (and binds nothing) when jax is already in."""
+    import sys
+
+    if ordinal is None or ordinal < 0:
+        return False
+    if "jax" in sys.modules:
+        return False
+    # libtpu / the PJRT TPU plugin read these at backend-init time; both
+    # spellings are honored across runtime generations. Harmless on CPU.
+    # The explicit --device-ordinal wins over any inherited host-wide value:
+    # setdefault here would silently leave multiple daemons seeing (and on
+    # real TPU, exclusively claiming) each other's chips.
+    os.environ["TPU_VISIBLE_DEVICES"] = str(ordinal)
+    os.environ["TPU_VISIBLE_CHIPS"] = str(ordinal)
+    os.environ["TPU_PROCESS_BOUNDS"] = "1,1,1"
+    return True
+
+
+def bound_device(ordinal: int):
+    """Resolve the jax.Device for an ordinal. After process-level filtering
+    only one device is visible and it wins regardless of ordinal; otherwise
+    index the local device list."""
+    if ordinal is None or ordinal < 0:
+        return None
+    jax = ensure_jax()
+    devs = jax.local_devices()
+    if len(devs) == 1:
+        return devs[0]
+    if ordinal >= len(devs):
+        # never alias a misconfigured ordinal onto someone else's chip: the
+        # slot=chip model requires disjoint placement, so fail loudly (the
+        # stage dispatcher logs this and falls back to CPU)
+        raise ValueError(
+            f"device ordinal {ordinal} out of range: {len(devs)} local devices")
+    return devs[ordinal]
+
+
+def device_scope(ordinal: int):
+    """Context manager committing jax ops to the pinned device (no-op when
+    unpinned). Wrap every device dispatch path in this."""
+    import contextlib
+
+    dev = bound_device(ordinal)
+    if dev is None:
+        return contextlib.nullcontext()
+    jax = ensure_jax()
+    return jax.default_device(dev)

@@ -199,7 +199,10 @@ class DeviceTableCache:
 
     def get(self, scan, buckets: list[int], ctx, max_bytes: int,
             mesh=None) -> DeviceTable:
-        key = self.key_of(scan) + ((mesh.devices.size,) if mesh is not None else ())
+        # device_ordinal in the key: an in-process cluster of differently
+        # pinned executors must not share tables committed to one chip
+        key = (self.key_of(scan) + ((mesh.devices.size,) if mesh is not None else ())
+               + (ctx.device_ordinal,))
         with self._lock:
             hit = self._cache.get(key)
             if hit is not None:
@@ -379,10 +382,15 @@ class TpuStageExec(ExecutionPlan):
     # ------------------------------------------------------------------
 
     def _run(self, partition: int, ctx: TaskContext) -> list[pa.RecordBatch]:
+        from ballista_tpu.ops.tpu.runtime import device_scope
+
         with self._results_lock:
             if self._results is None:
                 try:
-                    self._results = self._tpu_run_all(ctx)
+                    # per-chip pinning: commit every upload/dispatch in this
+                    # call tree to the executor's bound device
+                    with device_scope(ctx.device_ordinal):
+                        self._results = self._tpu_run_all(ctx)
                     self.tpu_count += 1
                 except Unsupported as e:
                     log.info("tpu fallback (%s): %s", e, self.partial_agg.node_str())
@@ -425,7 +433,8 @@ class TpuStageExec(ExecutionPlan):
 
         jax = ensure_jax()
         jnp = jax.numpy
-        cache_key = (table_key, self.fingerprint, jidx, mesh.devices.size if mesh else 0)
+        cache_key = (table_key, self.fingerprint, jidx, mesh.devices.size if mesh else 0,
+                     ctx.device_ordinal)
         hit = _BUILD_CACHE.get(cache_key)
         if hit is not None:
             return hit
@@ -621,7 +630,8 @@ class TpuStageExec(ExecutionPlan):
 
         # device LUTs cached per (table, stage): zero uploads when hot;
         # replicated across the mesh so probe gathers stay local
-        lut_key = (table_key, self.fingerprint, mesh.devices.size if mesh else 0, emit_key)
+        lut_key = (table_key, self.fingerprint, mesh.devices.size if mesh else 0, emit_key,
+                   ctx.device_ordinal)
         luts = _LUT_CACHE.get(lut_key)
         if luts is None:
             raw_luts = lowering.build_luts(dicts, [b.dicts for b in builds])

@@ -56,6 +56,9 @@ class TaskContext:
         # session-shared MemoryPool (try_grow semantics) when running under
         # an executor; None = static per-task limits only
         self.memory_pool = None
+        # per-chip pinning: jax device ordinal this task must dispatch to
+        # (-1 = unpinned); set by Executor.execute_task from its metadata
+        self.device_ordinal = -1
 
 
 class ExecutionPlan:

@@ -98,6 +98,7 @@ def start_rest_api(scheduler: SchedulerServer, metrics: InMemoryMetricsCollector
                         "grpc_port": e.metadata.grpc_port, "flight_port": e.metadata.flight_port,
                         "total_slots": e.total_slots, "free_slots": e.free_slots,
                         "last_seen": e.last_seen,
+                        "device_ordinal": e.metadata.device_ordinal,
                     })
                 return self._json(out)
             if p == "/api/jobs":

@@ -21,16 +21,20 @@ from ballista_tpu.serde import (
 
 
 def encode_executor_metadata(m: ExecutorMetadata) -> pb.ExecutorMetadataProto:
-    return pb.ExecutorMetadataProto(
+    out = pb.ExecutorMetadataProto(
         id=m.id, host=m.host, grpc_port=m.grpc_port, flight_port=m.flight_port,
         vcores=m.vcores, wire_version=m.wire_version,
     )
+    if m.device_ordinal >= 0:  # explicit presence: ordinal 0 is a valid chip
+        out.device_ordinal = m.device_ordinal
+    return out
 
 
 def decode_executor_metadata(p: pb.ExecutorMetadataProto) -> ExecutorMetadata:
     return ExecutorMetadata(
         id=p.id, host=p.host, grpc_port=p.grpc_port, flight_port=p.flight_port,
         vcores=p.vcores, wire_version=p.wire_version,
+        device_ordinal=p.device_ordinal if p.HasField("device_ordinal") else -1,
     )
 
 

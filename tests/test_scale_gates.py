@@ -72,6 +72,74 @@ def test_sf1_all22_distributed(q, sf1_cluster):
 _SF1_REF = None
 
 
+@pytest.fixture(scope="module")
+def pinned8_cluster(tmp_path_factory):
+    """8 real executor daemon subprocesses on one 8-device host, each pinned
+    to a distinct device ordinal with slots=chips (SURVEY §7 step 7)."""
+    from ballista_tpu.scheduler.process import SchedulerProcess
+
+    from .test_device_binding import _daemon_stderr_tail, _spawn_executor_daemon
+
+    sched = SchedulerProcess(bind_host="127.0.0.1", port=0, rest_port=0)
+    sched.start()
+    addr = f"127.0.0.1:{sched.port}"
+    root = tmp_path_factory.mktemp("pinned8")
+    procs = [_spawn_executor_daemon(addr, i, str(root / f"ex{i}")) for i in range(8)]
+    import json
+    import urllib.request
+
+    deadline = time.time() + 180
+    n = 0
+    while time.time() < deadline:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{sched.rest_port}/api/executors", timeout=5) as r:
+            n = len(json.load(r))
+        if n == 8:
+            break
+        dead = [(p.args[-7], _daemon_stderr_tail(p)) for p in procs if p.poll() is not None]
+        assert not dead, f"daemon(s) died during startup: {dead}"
+        time.sleep(1.0)
+    assert n == 8, (f"only {n}/8 pinned daemons registered; stderr tails: "
+                    f"{[_daemon_stderr_tail(p) for p in procs]}")
+    yield addr
+    import subprocess
+
+    for p in procs:
+        p.terminate()
+    for p in procs:
+        try:
+            p.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            p.kill()
+    sched.shutdown()
+
+
+@pytest.mark.pinned8
+@pytest.mark.parametrize("q", range(1, 23))
+def test_pinned8_all22_sf1(q, pinned8_cluster):
+    """All 22 TPC-H queries at SF1 over 8 per-chip-pinned daemon
+    subprocesses with the tpu engine, oracle-checked against pandas."""
+    from ballista_tpu.client.context import SessionContext
+    from ballista_tpu.config import (
+        CLIENT_JOB_TIMEOUT_S,
+        EXECUTOR_ENGINE,
+        BallistaConfig,
+    )
+    from ballista_tpu.testing.reference import compare_results, load_tables, run_reference
+    from ballista_tpu.testing.tpchgen import register_tpch
+
+    data = _dataset(1.0, "sf1")
+    global _SF1_REF
+    if "_SF1_REF" not in globals() or _SF1_REF is None:
+        _SF1_REF = load_tables(data)
+    cfg = BallistaConfig({EXECUTOR_ENGINE: "tpu", CLIENT_JOB_TIMEOUT_S: 2400})
+    ctx = SessionContext.remote(pinned8_cluster, cfg)
+    register_tpch(ctx, data)
+    eng = ctx.sql(tpch_query(q)).collect()
+    problems = compare_results(eng, run_reference(q, _SF1_REF), q)
+    assert not problems, "\n".join(problems)
+
+
 @pytest.mark.sf10
 @pytest.mark.parametrize("q", [1, 6])
 def test_sf10_single_query(q):
