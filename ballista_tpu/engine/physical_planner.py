@@ -18,10 +18,13 @@ from typing import Optional
 import pyarrow as pa
 
 from ballista_tpu.config import (
+    AQE_DYNAMIC_JOIN_SELECTION,
     BROADCAST_JOIN_ROWS_THRESHOLD,
+    BROADCAST_JOIN_THRESHOLD,
     BROADCAST_SEMI_KEYS_THRESHOLD,
     DEFAULT_SHUFFLE_PARTITIONS,
     EXECUTOR_ENGINE,
+    PLANNER_ADAPTIVE_ENABLED,
     TARGET_PARTITIONS,
     BallistaConfig,
 )
@@ -558,7 +561,21 @@ class PhysicalPlanner:
             probe = RepartitionExec(probe, "hash", n, [r for _, r in on])
 
         exec_schema = _join_exec_schema(build_schema, probe_schema, exec_jt)
-        j = HashJoinExec(build, probe, on, exec_jt, node.filter, mode, exec_schema)
+        if (mode == "partitioned"
+                and bool(self.config.get(PLANNER_ADAPTIVE_ENABLED))
+                and bool(self.config.get(AQE_DYNAMIC_JOIN_SELECTION))
+                and int(self.config.get(BROADCAST_JOIN_THRESHOLD)) > 0):
+            # the partitioned decision rests on row ESTIMATES: defer it.
+            # The node resolves to a concrete join either at stage
+            # resolution (stats known, scheduler/aqe/rules.py) or at
+            # first-batch time inside the stage (ops/cpu/dynamic_join.py) —
+            # the reference's DelayJoinSelectionRule + dynamic_join.rs pair.
+            from ballista_tpu.ops.cpu.dynamic_join import DynamicJoinSelectionExec
+
+            j: ExecutionPlan = DynamicJoinSelectionExec(
+                build, probe, on, exec_jt, node.filter, exec_schema)
+        else:
+            j = HashJoinExec(build, probe, on, exec_jt, node.filter, mode, exec_schema)
 
         if swap and exec_jt in ("inner", "left", "right", "full"):
             order = [Column(f.name, f.qualifier) for f in node.schema]

@@ -51,6 +51,7 @@ from ballista_tpu.config import (
     BROADCAST_JOIN_ROWS_THRESHOLD,
     PLANNER_ADAPTIVE_ENABLED,
 )
+from ballista_tpu.ops.cpu.dynamic_join import DynamicJoinSelectionExec
 
 log = logging.getLogger(__name__)
 
@@ -153,8 +154,11 @@ class RuntimeJoinSelectionRule:
                         changed = changed or ch
                     if changed:
                         node = node.with_children(new_kids)
+                # the planner's deferred-decision node carries the same join
+                # fields as a partitioned HashJoinExec; the cascade rewrite
+                # concretizes either into a CollectLeft broadcast
                 if (
-                    isinstance(node, HashJoinExec)
+                    isinstance(node, (HashJoinExec, DynamicJoinSelectionExec))
                     and node.mode == "partitioned"
                     and node.join_type in ("inner", "right", "right_semi", "right_anti")
                     and isinstance(node.left, UnresolvedShuffleExec)

@@ -35,9 +35,11 @@ from ballista_tpu.config import (
     AQE_MIN_PARTITION_BYTES,
     AQE_TARGET_PARTITION_BYTES,
     BROADCAST_JOIN_ROWS_THRESHOLD,
+    BROADCAST_JOIN_THRESHOLD,
     PLANNER_ADAPTIVE_ENABLED,
     BallistaConfig,
 )
+from ballista_tpu.ops.cpu.dynamic_join import DynamicJoinSelectionExec
 from ballista_tpu.plan.physical import EmptyExec, ExecutionPlan, HashJoinExec
 from ballista_tpu.shuffle.reader import ShuffleReaderExec
 
@@ -189,7 +191,9 @@ def _propagate_empty(plan: ExecutionPlan, input_stats) -> ExecutionPlan:
         kids = n.children()
         if kids:
             n = n.with_children([walk(c) for c in kids])
-        if isinstance(n, HashJoinExec):
+        # the deferred-decision node collapses under the same rules as a
+        # concrete hash join: emptiness does not depend on build strategy
+        if isinstance(n, (HashJoinExec, DynamicJoinSelectionExec)):
             l_empty, r_empty = is_empty(n.left), is_empty(n.right)
             jt = n.join_type
             if jt == "inner" and (l_empty or r_empty):
@@ -205,13 +209,58 @@ def _propagate_empty(plan: ExecutionPlan, input_stats) -> ExecutionPlan:
     return walk(plan)
 
 
+def _broadcast_build_reader(resolved: ExecutionPlan) -> ExecutionPlan:
+    """A collect_left build over a plain partitioned reader collects its
+    partitions one sequential execute(p) at a time; the broadcast reader
+    flattens every location into ONE concurrently governed fetch. Rebuild
+    the build-side reader accordingly (the resolved node may sit under the
+    swap-restoring projection)."""
+    join = resolved
+    if not isinstance(join, HashJoinExec):
+        kids = join.children()
+        if len(kids) != 1 or not isinstance(kids[0], HashJoinExec):
+            return resolved
+        join = kids[0]
+    if join.mode != "collect_left" or not isinstance(join.left, ShuffleReaderExec) \
+            or join.left.broadcast:
+        return resolved
+    bcast = ShuffleReaderExec(join.left.df_schema, join.left.partition_locations,
+                              broadcast=True)
+    bcast.source_stage_id = getattr(join.left, "source_stage_id", None)
+    new_join = join.with_children([bcast, join.right])
+    if join is resolved:
+        return new_join
+    return resolved.with_children([new_join])
+
+
 def _select_joins(plan: ExecutionPlan, input_stats, config: BallistaConfig) -> ExecutionPlan:
     rows_threshold = int(config.get(BROADCAST_JOIN_ROWS_THRESHOLD))
+    byte_threshold = int(config.get(BROADCAST_JOIN_THRESHOLD))
 
     def walk(n: ExecutionPlan) -> ExecutionPlan:
         kids = n.children()
         if kids:
             n = n.with_children([walk(c) for c in kids])
+        if isinstance(n, DynamicJoinSelectionExec):
+            # the planner's deferred decision, resolved here when BOTH input
+            # stages finished with known sizes (the reference's optimizer-
+            # rule replacement of dynamic_join.rs); otherwise the node stays
+            # and decides mid-stage at first-batch time
+            ls = _stats_of(n.left, input_stats) if isinstance(n.left, ShuffleReaderExec) else None
+            rs = _stats_of(n.right, input_stats) if isinstance(n.right, ShuffleReaderExec) else None
+            if ls is not None and rs is not None:
+                resolved = n.resolve_with_stats(
+                    ls.total_bytes, ls.total_rows, rs.total_bytes, rs.total_rows,
+                    byte_threshold, rows_threshold,
+                )
+                resolved = _broadcast_build_reader(resolved)
+                log.info(
+                    "AQE dynamic join resolved at stage resolution: %s "
+                    "(left %d B/%d rows, right %d B/%d rows)", n.decision,
+                    ls.total_bytes, ls.total_rows, rs.total_bytes, rs.total_rows,
+                )
+                return resolved
+            return n
         if (
             isinstance(n, HashJoinExec)
             and n.mode == "partitioned"
@@ -258,7 +307,7 @@ def propagate_empty_unresolved(plan: ExecutionPlan, empty_ids: set[int]) -> Exec
             new_kids = [walk(c) for c in kids]
             if any(a is not b for a, b in zip(new_kids, kids)):
                 n = n.with_children(new_kids)
-        if isinstance(n, HashJoinExec):
+        if isinstance(n, (HashJoinExec, DynamicJoinSelectionExec)):
             l_empty, r_empty = is_empty(n.left), is_empty(n.right)
             jt = n.join_type
             if jt == "inner" and (l_empty or r_empty):
@@ -308,7 +357,7 @@ def provably_empty(plan: ExecutionPlan) -> bool:
         return bool(plan.group_exprs) and provably_empty(plan.children()[0])
     if isinstance(plan, UnionExec):
         return all(provably_empty(c) for c in plan.children())
-    if isinstance(plan, HashJoinExec):
+    if isinstance(plan, (HashJoinExec, DynamicJoinSelectionExec)):
         jt = plan.join_type
         if jt in ("inner", "left_semi", "right_semi"):
             return provably_empty(plan.left) or provably_empty(plan.right)

@@ -42,6 +42,7 @@ from ballista_tpu.plan.physical import (
     SortPreservingMergeExec,
     UnionExec,
 )
+from ballista_tpu.ops.cpu.dynamic_join import DynamicJoinSelectionExec
 from ballista_tpu.shuffle.reader import ShuffleReaderExec
 
 _COLLAPSE_ALL_CHILDREN = (
@@ -88,6 +89,12 @@ def restrict_plan_to_partitions(plan: ExecutionPlan, partitions: list[int],
             if isinstance(node, _COLLAPSE_ALL_CHILDREN):
                 child_scoped = False
             elif isinstance(node, HashJoinExec) and node.mode == "collect_left" and idx == 0:
+                child_scoped = False
+            elif isinstance(node, DynamicJoinSelectionExec):
+                # the deferred decision may promote EITHER side to a
+                # collected build at first-batch time — both children keep
+                # full location lists (restriction is a size optimization,
+                # never a correctness requirement)
                 child_scoped = False
             elif isinstance(node, CrossJoinExec) and idx == 0:
                 child_scoped = False

@@ -323,6 +323,12 @@ def decode_location(p: pb.PartitionLocationProto) -> PartitionLocation:
 # -- physical plan ------------------------------------------------------------
 
 
+def _is_dynamic_join(plan) -> bool:
+    from ballista_tpu.ops.cpu.dynamic_join import DynamicJoinSelectionExec
+
+    return isinstance(plan, DynamicJoinSelectionExec)
+
+
 def encode_plan(plan: ExecutionPlan) -> pb.PhysicalPlanNode:
     out = pb.PhysicalPlanNode()
     if isinstance(plan, ParquetScanExec):
@@ -377,7 +383,7 @@ def encode_plan(plan: ExecutionPlan) -> pb.PhysicalPlanNode:
                 dp.expr.CopyFrom(encode_expr(d.expr))
         n.mode = plan.mode
         n.schema.CopyFrom(encode_schema(plan.df_schema))
-    elif isinstance(plan, HashJoinExec):
+    elif isinstance(plan, HashJoinExec) or _is_dynamic_join(plan):
         n = out.hash_join
         n.left.CopyFrom(encode_plan(plan.left))
         n.right.CopyFrom(encode_plan(plan.right))
@@ -390,6 +396,7 @@ def encode_plan(plan: ExecutionPlan) -> pb.PhysicalPlanNode:
             n.filter.CopyFrom(encode_expr(plan.filter))
         n.mode = plan.mode
         n.schema.CopyFrom(encode_schema(plan.df_schema))
+        n.dynamic = _is_dynamic_join(plan)
     elif isinstance(plan, CrossJoinExec):
         out.cross_join.left.CopyFrom(encode_plan(plan.left))
         out.cross_join.right.CopyFrom(encode_plan(plan.right))
@@ -509,6 +516,13 @@ def decode_plan(p: pb.PhysicalPlanNode) -> ExecutionPlan:
         n = p.hash_join
         on = [(decode_expr(kp.left), decode_expr(kp.right)) for kp in n.on]
         filt = decode_expr(n.filter) if n.HasField("filter") else None
+        if n.dynamic:
+            from ballista_tpu.ops.cpu.dynamic_join import DynamicJoinSelectionExec
+
+            return DynamicJoinSelectionExec(
+                decode_plan(n.left), decode_plan(n.right), on, n.join_type, filt,
+                decode_schema(n.schema), n.mode,
+            )
         return HashJoinExec(
             decode_plan(n.left), decode_plan(n.right), on, n.join_type, filt,
             n.mode, decode_schema(n.schema),

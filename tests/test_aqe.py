@@ -146,9 +146,13 @@ def test_incremental_broadcast_elision_virtual():
     }), partitions=2)
     sql = "select fact.k, sum(v) s from fact, dim where fact.k = dim.k and x = 1 group by fact.k"
     physical = ctx.create_physical_plan(ctx.sql(sql).plan)
-    # the planner must have chosen partitioned mode (estimates too big)
+    # the planner must have chosen partitioned mode (estimates too big) —
+    # deferred behind a DynamicJoinSelectionExec since the planner emits
+    # the decision node for partitioned joins
+    from ballista_tpu.ops.cpu.dynamic_join import DynamicJoinSelectionExec
+
     def find_joins(n):
-        if isinstance(n, HashJoinExec):
+        if isinstance(n, (HashJoinExec, DynamicJoinSelectionExec)):
             yield n
         for c in n.children():
             yield from find_joins(c)
@@ -160,7 +164,8 @@ def test_incremental_broadcast_elision_virtual():
     # join stage consumes both; build was planned first (lower id)
     join_stage = next(
         s for s in stages
-        if any(isinstance(n, HashJoinExec) for n in _walk_plan(s.plan))
+        if any(isinstance(n, (HashJoinExec, DynamicJoinSelectionExec))
+               for n in _walk_plan(s.plan))
     )
     b_id, p_id = sorted(join_stage.input_stage_ids)[:2]
     # run ONLY the build stage to completion (tiny actual output)
@@ -262,11 +267,14 @@ def test_incremental_empty_cascade_skips_and_cancels():
     }), partitions=2)
     sql = "select fact.k, sum(v) s from fact, dim where fact.k = dim.k and x = 1 group by fact.k"
     physical = ctx.create_physical_plan(ctx.sql(sql).plan)
+    from ballista_tpu.ops.cpu.dynamic_join import DynamicJoinSelectionExec
+
     stages = DistributedPlanner("jobe").plan_query_stages(physical)
     g = ExecutionGraph("jobe", "", "s1", stages, cfg)
     join_stage = next(
         s for s in stages
-        if any(isinstance(n, HashJoinExec) for n in _walk_plan(s.plan))
+        if any(isinstance(n, (HashJoinExec, DynamicJoinSelectionExec))
+               for n in _walk_plan(s.plan))
     )
     b_id, p_id = sorted(join_stage.input_stage_ids)[:2]
 

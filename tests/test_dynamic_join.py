@@ -1,0 +1,289 @@
+"""Dynamic join selection: the decision-boundary matrix plus mid-stage
+(first-batch-time) behavior.
+
+Mirrors the reference's AQE join-selection test harness — the
+stats-injecting fake table and broadcast-threshold matrices of
+scheduler/src/state/aqe/test/{stats_table.rs,broadcast_thresholds.rs} —
+against this engine's pure decision function and executable operator
+(ops/cpu/dynamic_join.py)."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from ballista_tpu.config import (
+    BROADCAST_JOIN_ROWS_THRESHOLD,
+    BROADCAST_JOIN_THRESHOLD,
+    DEFAULT_SHUFFLE_PARTITIONS,
+    BallistaConfig,
+)
+from ballista_tpu.ops.cpu.dynamic_join import (
+    DynamicJoinSelectionExec,
+    select_strategy,
+)
+from ballista_tpu.plan.expressions import Column
+from ballista_tpu.plan.physical import MemoryScanExec, RepartitionExec, TaskContext
+from ballista_tpu.plan.schema import DFField, DFSchema
+
+KB = 1024
+MB = 1024 * 1024
+
+
+# ------------------------------------------------------- threshold matrix
+
+
+@pytest.mark.parametrize(
+    "l_bytes,l_rows,r_bytes,r_rows,expect",
+    [
+        # build (left) under both thresholds → broadcast as-is
+        (1 * MB, 1_000, 100 * MB, 9_000_000, ("Broadcast", False, "collect_left")),
+        # right smaller → swap, under thresholds → broadcast swapped
+        (100 * MB, 9_000_000, 1 * MB, 1_000, ("BroadcastSwapped", True, "collect_left")),
+        # byte boundary: exactly AT the threshold broadcasts ...
+        (10 * MB, 1_000, 100 * MB, 2_000, ("Broadcast", False, "collect_left")),
+        # ... one byte over does not (build still smaller → plain partitioned)
+        (10 * MB + 1, 1_000, 100 * MB, 2_000, ("Partitioned", False, "partitioned")),
+        # rows are a conjunct: small bytes but too many rows → no broadcast
+        (1 * MB, 1_000_001, 100 * MB, 9_000_000, ("Partitioned", False, "partitioned")),
+        # rows exactly at the threshold broadcast
+        (1 * MB, 1_000_000, 100 * MB, 9_000_000, ("Broadcast", False, "collect_left")),
+        # both over byte threshold → partitioned, smaller side builds
+        (50 * MB, 10, 40 * MB, 10, ("PartitionedSwapped", True, "partitioned")),
+        (40 * MB, 10, 50 * MB, 10, ("Partitioned", False, "partitioned")),
+        # equal sizes → keep planned orientation
+        (40 * MB, 10, 40 * MB, 10, ("Partitioned", False, "partitioned")),
+    ],
+)
+def test_threshold_matrix_inner(l_bytes, l_rows, r_bytes, r_rows, expect):
+    got = select_strategy(l_bytes, l_rows, True, r_bytes, r_rows, True,
+                          "inner", False, 10 * MB, 1_000_000)
+    assert got == expect
+
+
+def test_zero_byte_threshold_disables_promotion():
+    """A 0 byte threshold disables dynamic promotion entirely — including
+    the row-based path (reference dynamic_join.rs:266-270)."""
+    got = select_strategy(1 * KB, 10, True, 100 * MB, 500, True,
+                          "inner", False, 0, 1_000_000)
+    assert got == ("AsPlanned", False, "partitioned")
+
+
+def test_unknown_sides():
+    # both unknown → nothing proven, run as planned
+    assert select_strategy(99 * MB, 0, False, 99 * MB, 0, False,
+                           "inner", False, 10 * MB, 10**6)[0] == "AsPlanned"
+    # only right proven small → build from it
+    assert select_strategy(99 * MB, 0, False, 1 * MB, 100, True,
+                           "inner", False, 10 * MB, 10**6)[0] == "BroadcastSwapped"
+    # only left proven → build from it, no swap
+    assert select_strategy(1 * MB, 100, True, 99 * MB, 0, False,
+                           "inner", False, 10 * MB, 10**6)[0] == "Broadcast"
+
+
+@pytest.mark.parametrize("jt,swapped_safe,unswapped_safe", [
+    ("inner", True, True),
+    ("right", False, True),    # swapped right→left emits build rows
+    ("left", True, False),     # left emits build rows; swapped→right is safe
+    ("full", False, False),
+    ("right_semi", False, True),
+    ("left_semi", True, False),
+    ("right_anti", False, True),
+    ("left_anti", True, False),
+])
+def test_collect_safety_by_join_type(jt, swapped_safe, unswapped_safe):
+    """Broadcast collection is only safe for join types that never emit
+    rows on behalf of the (shared) build — evaluated AGAINST the post-swap
+    type (reference dynamic_join.rs:278-292 collect_left_broadcast_safe)."""
+    # unswapped: left is the small side
+    d, _, mode = select_strategy(1 * KB, 10, True, 100 * MB, 10**7, True,
+                                 jt, False, 10 * MB, 10**6)
+    assert (mode == "collect_left") == unswapped_safe, (jt, d)
+    # swapped: right is the small side
+    d, _, mode = select_strategy(100 * MB, 10**7, True, 1 * KB, 10, True,
+                                 jt, False, 10 * MB, 10**6)
+    assert (mode == "collect_left") == swapped_safe, (jt, d)
+
+
+def test_single_partition_probe_relaxes_safety():
+    """With a single-partition probe there is exactly one join instance, so
+    even build-emitting types may collect (planner rule at
+    physical_planner.py:548-550)."""
+    d, _, mode = select_strategy(1 * KB, 10, True, 100 * MB, 10**7, True,
+                                 "full", True, 10 * MB, 10**6)
+    assert mode == "collect_left" and d == "Broadcast"
+
+
+# ------------------------------------------------ mid-stage (dam) behavior
+
+
+def _mk_scan(name, n_rows, partitions, key_mod, seed):
+    rng = np.random.default_rng(seed)
+    tbl = pa.table({
+        f"{name}_k": rng.integers(0, key_mod, n_rows),
+        f"{name}_v": rng.integers(0, 1000, n_rows),
+    })
+    schema = DFSchema([DFField(f"{name}_k", pa.int64(), False, name),
+                       DFField(f"{name}_v", pa.int64(), False, name)])
+    return MemoryScanExec(schema, tbl.to_batches(), partitions)
+
+
+def _dyn_join(left, right, jt="inner"):
+    from ballista_tpu.engine.physical_planner import _join_exec_schema
+
+    on = [(Column(left.df_schema.field(0).name, left.df_schema.field(0).qualifier),
+           Column(right.df_schema.field(0).name, right.df_schema.field(0).qualifier))]
+    schema = _join_exec_schema(left.df_schema, right.df_schema, jt)
+    return DynamicJoinSelectionExec(left, right, on, jt, None, schema)
+
+
+def _partitioned(node, n=4):
+    keys = [Column(node.df_schema.field(0).name, node.df_schema.field(0).qualifier)]
+    return RepartitionExec(node, "hash", n, keys)
+
+
+def _collect(plan, cfg=None):
+    ctx = TaskContext(cfg or BallistaConfig())
+    batches = []
+    for p in range(plan.output_partition_count()):
+        batches.extend(b for b in plan.execute(p, ctx) if b.num_rows)
+    return pa.Table.from_batches(batches, schema=plan.schema())
+
+
+@pytest.mark.parametrize("jt", ["inner", "left", "right", "full",
+                                "left_semi", "right_semi", "left_anti", "right_anti"])
+def test_mid_stage_matches_static_all_types(jt):
+    """The dam-decided join must agree with the statically planned
+    partitioned join for every join type, with the small side on the RIGHT
+    so a swap is exercised where legal."""
+    from ballista_tpu.plan.physical import HashJoinExec
+
+    big = _mk_scan("b", 20_000, 4, 500, 1)
+    small = _mk_scan("s", 300, 2, 500, 2)
+    dyn = _dyn_join(_partitioned(big), _partitioned(small), jt)
+    want_join = HashJoinExec(_partitioned(big), _partitioned(small), dyn.on, jt,
+                             None, "partitioned", dyn.df_schema)
+    got = _collect(dyn).to_pandas()
+    want = _collect(want_join).to_pandas()
+    sort_cols = list(want.columns)
+    got = got.sort_values(sort_cols).reset_index(drop=True)
+    want = want.sort_values(sort_cols).reset_index(drop=True)
+    assert got.equals(want), (jt, dyn.decision, len(got), len(want))
+    assert dyn.decision, "operator must record its decision"
+
+
+def test_mid_stage_swaps_to_small_right():
+    # byte threshold below the left side's ~800 KB so the dam overflows on
+    # it, proving only the right side small → swapped broadcast
+    cfg = BallistaConfig({BROADCAST_JOIN_THRESHOLD: 64 * KB})
+    big = _mk_scan("b", 50_000, 4, 1000, 3)
+    small = _mk_scan("s", 100, 2, 1000, 4)
+    dyn = _dyn_join(_partitioned(big), _partitioned(small), "inner")
+    out = _collect(dyn, cfg)
+    assert dyn.decision == "BroadcastSwapped", dyn.decision
+    # column order preserved despite the internal swap
+    assert out.schema.names == [f.name for f in dyn.df_schema]
+
+
+def test_mid_stage_short_circuit_skips_probe_observation():
+    """A planned build proven small must not dam the probe side at all."""
+    big = _mk_scan("b", 50_000, 4, 1000, 3)
+    small = _mk_scan("s", 100, 2, 1000, 4)
+    dyn = _dyn_join(_partitioned(small), _partitioned(big), "inner")
+    probe_calls = []
+    orig = dyn.right.execute
+
+    def counting(p, ctx):
+        probe_calls.append(p)
+        return orig(p, ctx)
+
+    dyn.right.execute = counting
+    ctx = TaskContext(BallistaConfig())
+    list(dyn.execute(0, ctx))
+    assert dyn.decision == "Broadcast"
+    # only the join's own probe of partition 0 ran — no dam sweep over all
+    # probe partitions before the decision
+    assert probe_calls == [0], probe_calls
+
+
+def test_mid_stage_both_big_runs_as_planned():
+    cfg = BallistaConfig({BROADCAST_JOIN_THRESHOLD: 4 * KB,
+                          BROADCAST_JOIN_ROWS_THRESHOLD: 50})
+    a = _mk_scan("a", 30_000, 4, 200, 5)
+    b = _mk_scan("c", 30_000, 4, 200, 6)
+    dyn = _dyn_join(_partitioned(a), _partitioned(b), "inner")
+    out = _collect(dyn, cfg)
+    assert dyn.decision == "AsPlanned", dyn.decision
+    assert out.num_rows > 0
+
+
+def test_mid_stage_zero_threshold_short_circuits():
+    cfg = BallistaConfig({BROADCAST_JOIN_THRESHOLD: 0})
+    a = _mk_scan("a", 1_000, 2, 100, 7)
+    b = _mk_scan("c", 1_000, 2, 100, 8)
+    dyn = _dyn_join(_partitioned(a), _partitioned(b), "inner")
+    _collect(dyn, cfg)
+    assert dyn.decision == "AsPlanned"
+
+
+# --------------------------------------------------------- integration
+
+
+def test_planner_emits_dynamic_node_and_query_is_correct():
+    """End-to-end: the planner defers partitioned joins; execution decides
+    and the result matches a non-adaptive run."""
+    from ballista_tpu.client.context import SessionContext
+    from ballista_tpu.config import PLANNER_ADAPTIVE_ENABLED
+
+    rng = np.random.default_rng(9)
+    fact = pa.table({"k": rng.integers(0, 5_000, 80_000),
+                     "v": rng.integers(0, 100, 80_000)})
+    dim = pa.table({"k": np.arange(5_000), "x": rng.integers(0, 50, 5_000)})
+    sql = ("select fact.k, sum(v) s from fact, dim "
+           "where fact.k = dim.k and x < 5 group by fact.k order by s desc, fact.k limit 20")
+
+    cfg = BallistaConfig({DEFAULT_SHUFFLE_PARTITIONS: 4,
+                          BROADCAST_JOIN_ROWS_THRESHOLD: 100})  # force partitioned plan
+    ctx = SessionContext(cfg)
+    ctx.register_arrow_table("fact", fact, partitions=4)
+    ctx.register_arrow_table("dim", dim, partitions=2)
+    physical = ctx.create_physical_plan(ctx.sql(sql).plan)
+    assert "DynamicJoinSelectionExec" in physical.display()
+    got = ctx.sql(sql).collect().to_pandas()
+
+    cfg2 = BallistaConfig({DEFAULT_SHUFFLE_PARTITIONS: 4,
+                           BROADCAST_JOIN_ROWS_THRESHOLD: 100,
+                           PLANNER_ADAPTIVE_ENABLED: False})
+    ctx2 = SessionContext(cfg2)
+    ctx2.register_arrow_table("fact", fact, partitions=4)
+    ctx2.register_arrow_table("dim", dim, partitions=2)
+    assert "DynamicJoinSelectionExec" not in ctx2.create_physical_plan(ctx2.sql(sql).plan).display()
+    want = ctx2.sql(sql).collect().to_pandas()
+    assert got.equals(want)
+
+
+def test_serde_roundtrip_dynamic_node():
+    from ballista_tpu.serde import decode_plan, encode_plan
+
+    a = _mk_scan("a", 100, 2, 10, 10)
+    b = _mk_scan("c", 100, 2, 10, 11)
+    dyn = _dyn_join(_partitioned(a), _partitioned(b), "left")
+    back = decode_plan(encode_plan(dyn))
+    assert isinstance(back, DynamicJoinSelectionExec)
+    assert back.join_type == "left" and back.mode == "partitioned"
+    assert repr(back.df_schema) == repr(dyn.df_schema)
+
+
+def test_resolution_with_stats_concretizes():
+    """resolve_with_stats (the AQE resolution path) must produce a concrete
+    plan containing no deferred node, honoring the matrix."""
+    a = _mk_scan("a", 4_000, 2, 100, 12)
+    b = _mk_scan("c", 200, 2, 100, 13)
+    dyn = _dyn_join(_partitioned(a), _partitioned(b), "inner")
+    resolved = dyn.resolve_with_stats(50 * MB, 4_000, 2 * KB, 200, 10 * MB, 10**6)
+    assert dyn.decision == "BroadcastSwapped"
+    assert "DynamicJoinSelectionExec" not in resolved.display()
+    got = _collect(resolved).to_pandas().sort_values(
+        ["a_k", "a_v", "c_k", "c_v"]).reset_index(drop=True)
+    want = _collect(_dyn_join(_partitioned(a), _partitioned(b), "inner")).to_pandas(
+    ).sort_values(["a_k", "a_v", "c_k", "c_v"]).reset_index(drop=True)
+    assert got.equals(want)
