@@ -620,9 +620,9 @@ def _join_exec_schema(build_schema: DFSchema, probe_schema: DFSchema, jt: str) -
 
 
 def _sum_type(t: pa.DataType) -> pa.DataType:
-    if pa.types.is_integer(t):
-        return pa.int64()
-    return pa.float64()
+    from ballista_tpu.plan.expressions import sum_result_type
+
+    return sum_result_type(t)
 
 
 def _merge_func(f: str) -> str:

@@ -163,16 +163,49 @@ def _peer_last(new_peer: np.ndarray, n: int) -> np.ndarray:
     return np.repeat(ends, counts)
 
 
+def _decimal_prepare(arr, w, out_type):
+    """Exact decimal policy for window aggregates: narrow decimal128 input
+    becomes unscaled int64 (exact sums/extremes in integer space; the
+    emitter reconstructs the decimal); wide decimals and avg fall to
+    float64. Returns (arr, dec_scale_or_None)."""
+    t = arr.type
+    if (w.func in ("sum", "min", "max") and pa.types.is_decimal128(t)
+            and t.precision - t.scale <= 14 and pa.types.is_decimal(out_type)):
+        filled = pc.fill_null(arr, 0)
+        scaled = pc.multiply(filled, pa.scalar(10 ** t.scale, pa.int64())) if t.scale else filled
+        return pc.cast(scaled, pa.int64()), t.scale
+    return pc.cast(arr, pa.float64()), None
+
+
+def _emit_agg(out: np.ndarray, out_type, mask, dec_scale):
+    """Build the output array, reconstructing decimals from unscaled int64
+    (via decimal256 headroom) or from the float fallback."""
+    import decimal as _d
+
+    if pa.types.is_decimal(out_type):
+        if dec_scale is not None and out.dtype.kind == "i":
+            a = pa.array(out, pa.int64(), mask=mask).cast(pa.decimal256(38, 0))
+            if dec_scale:
+                a = pc.multiply(a, pc.cast(pa.scalar(_d.Decimal(1).scaleb(-dec_scale)),
+                                           pa.decimal256(1, dec_scale)))
+            return pc.cast(a, out_type)
+        return pa.array(out, pa.float64(), mask=mask).cast(out_type)
+    return pa.array(out, out_type, mask=mask)
+
+
 def _window_agg(batch, w, schema, fr: _Frame, n, out_type):
     seg_start = fr.seg_start
+    dec_scale = None
     if w.args:
         arr = evaluate_to_array(bind_expr(w.args[0], schema), batch).take(pa.array(fr.idx))
         valid = arr.is_valid().to_numpy(zero_copy_only=False).astype(bool)
+        if pa.types.is_decimal(arr.type):
+            arr, dec_scale = _decimal_prepare(arr, w, out_type)
     else:  # count(*)
         arr = None
         valid = np.ones(n, dtype=bool)
     if w.frame is not None:
-        return _rows_frame_agg(w, fr, arr, valid, n, out_type)
+        return _rows_frame_agg(w, fr, arr, valid, n, out_type, dec_scale)
     last = _peer_last(fr.new_peer, n)
 
     if w.func == "count":
@@ -185,7 +218,8 @@ def _window_agg(batch, w, schema, fr: _Frame, n, out_type):
 
     vals = arr.to_numpy(zero_copy_only=False)
     if w.func in ("sum", "avg"):
-        as_float = pa.types.is_floating(out_type) or w.func == "avg"
+        as_float = (pa.types.is_floating(out_type) or w.func == "avg"
+                    or np.issubdtype(np.asarray(vals).dtype, np.floating))
         v = np.asarray(vals, dtype=np.float64 if as_float else np.int64)
         v = np.where(valid, v, 0)
         cum = np.cumsum(v)
@@ -223,10 +257,10 @@ def _window_agg(batch, w, schema, fr: _Frame, n, out_type):
     out[fr.idx] = out_sorted
     mask = np.empty(n, dtype=bool)
     mask[fr.idx] = mask_sorted
-    return pa.array(out, out_type, mask=mask)
+    return _emit_agg(out, out_type, mask, dec_scale)
 
 
-def _rows_frame_agg(w, fr: _Frame, arr, valid, n, out_type):
+def _rows_frame_agg(w, fr: _Frame, arr, valid, n, out_type, dec_scale=None):
     """Explicit ROWS BETWEEN frames: per-row [lo, hi] windows clipped to the
     partition; sums/counts via prefix differences, min/max via per-row
     slices (frames are exact row offsets — no peer sharing)."""
@@ -249,7 +283,8 @@ def _rows_frame_agg(w, fr: _Frame, arr, valid, n, out_type):
         return pa.array(out, out_type)
 
     vals = arr.to_numpy(zero_copy_only=False)
-    as_float = pa.types.is_floating(out_type) or w.func == "avg"
+    as_float = (pa.types.is_floating(out_type) or w.func == "avg"
+                or np.issubdtype(np.asarray(vals).dtype, np.floating))
     if w.func in ("sum", "avg"):
         v = np.asarray(vals, dtype=np.float64 if as_float else np.int64)
         v = np.where(valid, v, 0)
@@ -296,7 +331,7 @@ def _rows_frame_agg(w, fr: _Frame, arr, valid, n, out_type):
     out[fr.idx] = out_sorted
     mask = np.empty(n, dtype=bool)
     mask[fr.idx] = mask_sorted
-    return pa.array(out, out_type, mask=mask)
+    return _emit_agg(out, out_type, mask, dec_scale)
 
 
 def _lag_lead(batch, w, schema, fr: _Frame, arange, n, out_type):
@@ -314,7 +349,9 @@ def _lag_lead(batch, w, schema, fr: _Frame, arange, n, out_type):
     shifted = arr.take(pa.array(srcc))
     if shifted.type != out_type:
         shifted = shifted.cast(out_type)
-    res_sorted = pc.if_else(pa.array(ok), shifted, pa.scalar(default, out_type))
+    from ballista_tpu.ops.phys_expr import py_for_type
+
+    res_sorted = pc.if_else(pa.array(ok), shifted, pa.scalar(py_for_type(default, out_type), out_type))
     # scatter back to original row order
     return res_sorted.take(pa.array(fr.inv))
 

@@ -73,6 +73,19 @@ def _int64_encoding(arr: pa.Array) -> tuple[np.ndarray, np.ndarray | None]:
         # normalize -0.0 to 0.0 so equal keys hash equal
         vals = np.where(vals == 0.0, 0.0, vals)
         return vals.view(np.uint64), mask
+    if pa.types.is_decimal(t):
+        # exact policy: decimal keys route by unscaled int64 when it fits;
+        # wider decimals route by their float64 image (routing only needs
+        # equal keys → equal hash, which a deterministic cast preserves)
+        filled = pc.fill_null(arr, 0) if arr.null_count else arr
+        if pa.types.is_decimal128(t) and t.precision <= 18:
+            scaled = pc.multiply(filled, pa.scalar(10 ** t.scale, pa.int64())) \
+                if t.scale else filled
+            vals = pc.cast(scaled, pa.int64()).to_numpy(zero_copy_only=False)
+            return vals.astype(np.int64, copy=False).view(np.uint64), mask
+        vals = filled.cast(pa.float64()).to_numpy(zero_copy_only=False)
+        vals = np.where(vals == 0.0, 0.0, vals)
+        return vals.view(np.uint64), mask
     if pa.types.is_string(t) or pa.types.is_large_string(t) or pa.types.is_binary(t):
         # FNV-1a over utf8 bytes, vectorized via offsets
         data = arr.cast(pa.large_binary())

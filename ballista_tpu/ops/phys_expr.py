@@ -86,6 +86,10 @@ class BinOp(PhysicalExpr):
     left: PhysicalExpr
     op: str
     right: PhysicalExpr
+    # planned arith result type (decimal policy): stamped by bind_expr so
+    # runtime coercion reproduces exactly what the planner typed; None for
+    # comparisons/bools and pre-decimal callers
+    out_type: pa.DataType | None = None
 
     def evaluate(self, batch: pa.RecordBatch):
         l = self.left.evaluate(batch)
@@ -96,6 +100,8 @@ class BinOp(PhysicalExpr):
             return pc.and_kleene(l, r)
         if self.op == "or":
             return pc.or_kleene(l, r)
+        if pa.types.is_decimal(_type_of(l)) or pa.types.is_decimal(_type_of(r)):
+            return _decimal_binop(self.op, l, r, self.out_type)
         if self.op == "+":
             return pc.add(l, r)
         if self.op == "-":
@@ -103,8 +109,7 @@ class BinOp(PhysicalExpr):
         if self.op == "*":
             return pc.multiply(l, r)
         if self.op == "/":
-            lt = l.type if isinstance(l, (pa.Array, pa.ChunkedArray)) else l.type
-            if pa.types.is_integer(lt):
+            if pa.types.is_integer(_type_of(l)):
                 l = pc.cast(l, pa.float64())
             return pc.divide(l, r)
         if self.op == "%":
@@ -113,6 +118,52 @@ class BinOp(PhysicalExpr):
 
     def __str__(self) -> str:
         return f"({self.left} {self.op} {self.right})"
+
+
+def _type_of(v) -> pa.DataType:
+    return v.type
+
+
+def _decimal_binop(op: str, l, r, planned: pa.DataType | None):
+    """Exact decimal arithmetic mirroring decimal_arith_type's branches
+    (plan/expressions.py). Planned float64 ⇒ compute in float (division,
+    float operands, precision overflow past decimal256). Planned decimal ⇒
+    re-type integer-literal scalars tightly, lift decimal128 inputs to
+    decimal256 when the planned type is, and pin the kernel's result to the
+    planned type so batch schemas never drift from the plan."""
+    import decimal as _d
+
+    if planned is None and op in ("/", "%"):
+        # a pre-decimal caller (bind-time typing failed): these ops always
+        # compute in float under the exact policy anyway
+        planned = pa.float64()
+    if planned is not None and pa.types.is_floating(planned):
+        if pa.types.is_decimal(l.type):
+            l = pc.cast(l, pa.float64())
+        if pa.types.is_decimal(r.type):
+            r = pc.cast(r, pa.float64())
+        if op == "/" and pa.types.is_integer(l.type):
+            l = pc.cast(l, pa.float64())
+        return _ARITH[op](l, r) if op == "%" else {
+            "+": pc.add, "-": pc.subtract, "*": pc.multiply, "/": pc.divide}[op](l, r)
+
+    def tighten(v):
+        # integer literal scalar → minimal decimal (the planner's
+        # _effective_decimal counterpart)
+        if isinstance(v, pa.Scalar) and pa.types.is_integer(v.type):
+            return pa.scalar(_d.Decimal(v.as_py()))
+        return v
+
+    l, r = tighten(l), tighten(r)
+    if planned is not None and pa.types.is_decimal256(planned):
+        if pa.types.is_decimal128(l.type):
+            l = pc.cast(l, pa.decimal256(l.type.precision, l.type.scale))
+        if pa.types.is_decimal128(r.type):
+            r = pc.cast(r, pa.decimal256(r.type.precision, r.type.scale))
+    out = {"+": pc.add, "-": pc.subtract, "*": pc.multiply}[op](l, r)
+    if planned is not None and out.type != planned:
+        out = pc.cast(out, planned)
+    return out
 
 
 @dataclass
@@ -209,7 +260,7 @@ class CaseOp(PhysicalExpr):
         if self.else_expr is not None:
             result = self.else_expr.evaluate(batch)
             if isinstance(result, pa.Scalar):
-                result = pa.array([result.as_py()] * n, self.out_type)
+                result = pa.array([py_for_type(result.as_py(), self.out_type)] * n, self.out_type)
             else:
                 result = result.cast(self.out_type)
         else:
@@ -223,7 +274,7 @@ class CaseOp(PhysicalExpr):
             cond = pc.and_(pc.fill_null(cond, False), pc.invert(decided))
             tv = then.evaluate(batch)
             if isinstance(tv, pa.Scalar):
-                tv = pa.array([tv.as_py()] * n).cast(self.out_type)
+                tv = pa.array([py_for_type(tv.as_py(), self.out_type)] * n, self.out_type)
             else:
                 tv = tv.cast(self.out_type)
             result = pc.if_else(cond, tv, result)
@@ -320,6 +371,24 @@ def _as_py(v):
     return v.as_py() if isinstance(v, pa.Scalar) else v
 
 
+def py_for_type(v, t: pa.DataType):
+    """Coerce a Python literal for materialization as type `t`: exact-policy
+    decimal literals flow into float/int slots (CASE branches, lag/lead
+    defaults) that pyarrow refuses to convert implicitly."""
+    import decimal as _d
+
+    if isinstance(v, _d.Decimal):
+        if pa.types.is_floating(t):
+            return float(v)
+        if pa.types.is_integer(t):
+            return int(v)
+    elif isinstance(v, (int, float)) and not isinstance(v, bool) and pa.types.is_decimal(t):
+        # float/int branch into a decimal slot (e.g. a sci-notation literal
+        # in a CASE whose other branches are decimal)
+        return _d.Decimal(str(v))
+    return v
+
+
 def bind_expr(e: Expr, schema: DFSchema) -> PhysicalExpr:
     if isinstance(e, Alias):
         return bind_expr(e.expr, schema)
@@ -336,7 +405,13 @@ def bind_expr(e: Expr, schema: DFSchema) -> PhysicalExpr:
         if isinstance(e.right, Literal) and isinstance(e.right.value, tuple) and e.op in ("+", "-"):
             n, unit = e.right.value
             return DateAddOp(bind_expr(e.left, schema), n, unit, -1 if e.op == "-" else 1)
-        return BinOp(bind_expr(e.left, schema), e.op, bind_expr(e.right, schema))
+        out_type = None
+        if e.op in ("+", "-", "*", "/", "%"):
+            try:
+                out_type = e.data_type(schema)  # decimal coercion contract
+            except Exception:  # noqa: BLE001 — typing is advisory for non-decimals
+                out_type = None
+        return BinOp(bind_expr(e.left, schema), e.op, bind_expr(e.right, schema), out_type)
     if isinstance(e, Not):
         return NotOp(bind_expr(e.expr, schema))
     if isinstance(e, Negative):

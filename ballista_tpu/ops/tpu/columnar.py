@@ -126,6 +126,17 @@ def encode_column(arr: pa.Array) -> Optional[DeviceCol]:
         return v(DeviceCol("date", arr.cast(pa.int32(), safe=False).to_numpy(zero_copy_only=False)))
     if pa.types.is_boolean(t):
         return v(DeviceCol("bool", arr.to_numpy(zero_copy_only=False)))
+    if pa.types.is_decimal(t):
+        # exact decimal policy: unscaled int64 goes straight to the device
+        # money lane — no float sniffing, the scale is declared. Wide or
+        # deep-scaled decimals fall to f64 (lossy only past 2^53).
+        s = t.scale
+        if pa.types.is_decimal128(t) and 0 <= s <= 4 and t.precision - s <= 14:
+            scaled = pc.multiply(arr, pa.scalar(10 ** s, pa.int64())) if s else arr
+            vals = pc.cast(scaled, pa.int64()).to_numpy(zero_copy_only=False)
+            return v(DeviceCol("money", _narrow_int(vals), scale=s))
+        vals = arr.cast(pa.float64()).to_numpy(zero_copy_only=False)
+        return v(DeviceCol("f64", vals))
     if pa.types.is_floating(t):
         vals = arr.cast(pa.float64()).to_numpy(zero_copy_only=False)
         if _is_fixed_point(vals, 2):

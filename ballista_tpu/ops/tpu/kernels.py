@@ -18,6 +18,7 @@ C++ shuffle readers.
 from __future__ import annotations
 
 import datetime as _dt
+import decimal as _decimal
 import fnmatch
 from dataclasses import dataclass
 from typing import Any, Callable
@@ -232,6 +233,16 @@ def lower_expr(e: Expr, ctx: Lowering) -> LoweredFn:
                 c = int(round(cents))
                 return lambda cols, luts: DevVal("money", _jnp().asarray(c, dtype=_jnp().int64), 2)
             return lambda cols, luts: DevVal("f64", _jnp().asarray(v, dtype=_jnp().float64))
+        if isinstance(v, _decimal.Decimal):
+            # exact-policy literal: the declared scale IS the fixed point
+            exp = -v.as_tuple().exponent
+            if 0 <= exp <= 4:
+                c = int(v.scaleb(exp))
+                return lambda cols, luts, c=c, exp=exp: DevVal(
+                    "money", _jnp().asarray(c, dtype=_jnp().int64), exp)
+            fv = float(v)
+            return lambda cols, luts, fv=fv: DevVal(
+                "f64", _jnp().asarray(fv, dtype=_jnp().float64))
         if isinstance(v, _dt.date):
             days = (v - _dt.date(1970, 1, 1)).days
             return lambda cols, luts: DevVal("date", _jnp().asarray(days, dtype=_jnp().int32))

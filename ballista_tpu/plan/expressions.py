@@ -11,6 +11,7 @@ decorrelates into joins before execution.
 from __future__ import annotations
 
 import datetime as _dt
+import decimal as _decimal
 from dataclasses import dataclass, field, replace
 from typing import Any, Sequence
 
@@ -138,6 +139,11 @@ def literal_type(v: Any) -> pa.DataType:
         return pa.int64()
     if isinstance(v, float):
         return pa.float64()
+    if isinstance(v, _decimal.Decimal):
+        # minimal precision/scale from the digits (pa.scalar's own typing):
+        # tight literal types keep decimal arithmetic chains under the
+        # precision caps — the lynchpin of the exact-decimal policy
+        return pa.scalar(v).type
     if isinstance(v, str):
         return pa.string()
     if isinstance(v, _dt.date):
@@ -166,6 +172,8 @@ class BinaryExpr(Expr):
         if self.op in _CMP_OPS or self.op in _BOOL_OPS:
             return pa.bool_()
         lt, rt = self.left.data_type(schema), self.right.data_type(schema)
+        if pa.types.is_decimal(lt) or pa.types.is_decimal(rt):
+            return decimal_arith_type(self.left, self.right, lt, rt, self.op)
         return arith_result_type(lt, rt, self.op)
 
     def __str__(self) -> str:
@@ -182,8 +190,65 @@ def arith_result_type(lt: pa.DataType, rt: pa.DataType, op: str) -> pa.DataType:
     if pa.types.is_floating(lt) or pa.types.is_floating(rt) or op == "/":
         return pa.float64()
     if pa.types.is_decimal(lt) or pa.types.is_decimal(rt):
-        return pa.float64()  # engine-wide decimal→float64 policy (see ops/cpu/scan)
+        # value-blind fallback (callers without the exprs); BinaryExpr uses
+        # the value-aware decimal_arith_type instead
+        return decimal_arith_type(None, None, lt, rt, op)
     return pa.int64()
+
+
+def _effective_decimal(expr: "Expr | None", t: pa.DataType):
+    """(precision, scale) a side contributes to Arrow's decimal arithmetic.
+    Integer LITERALS get minimal digits — matching the evaluator, which
+    re-types them as tight decimal scalars (ops/phys_expr.py) so chains like
+    price*(1-disc)*(1+tax) stay inside the 38/76 precision caps. Non-literal
+    integers take Arrow's own widths (int64→(19,0) etc.)."""
+    if pa.types.is_decimal(t):
+        return t.precision, t.scale
+    if expr is not None and isinstance(expr, Literal) and isinstance(expr.value, int) \
+            and not isinstance(expr.value, bool):
+        return max(1, len(str(abs(expr.value)))), 0
+    if pa.types.is_integer(t):
+        return {1: 3, 2: 5, 4: 10, 8: 19}.get(t.bit_width // 8, 19), 0
+    return None  # float/other: not a decimal operand
+
+
+def sum_result_type(t: pa.DataType) -> pa.DataType:
+    """SUM output typing, shared by aggregates, window functions and the
+    physical planner's accumulator schema (one rule — they must agree).
+    Exact decimal sums widen to max precision (DataFusion's rule; keeps
+    billion-row money sums from overflowing the input type)."""
+    if pa.types.is_integer(t):
+        return pa.int64()
+    if pa.types.is_decimal128(t):
+        return pa.decimal128(38, t.scale)
+    if pa.types.is_decimal256(t):
+        return pa.decimal256(76, t.scale)
+    return pa.float64()
+
+
+def decimal_arith_type(le: "Expr | None", re: "Expr | None",
+                       lt: pa.DataType, rt: pa.DataType, op: str) -> pa.DataType:
+    """Arrow's decimal result-type rules (exact decimal policy — reference
+    behavior: DataFusion decimal128 exactness, SURVEY §7 hard-part #2).
+    Division and chains past decimal256's cap degrade to float64; the
+    evaluator mirrors every branch (ops/phys_expr.py::_decimal_binop)."""
+    if op in ("/", "%"):
+        return pa.float64()
+    l = _effective_decimal(le, lt)
+    r = _effective_decimal(re, rt)
+    if l is None or r is None:  # mixed with float → float64 (Arrow promotes)
+        return pa.float64()
+    (lp, ls), (rp, rs) = l, r
+    if op == "*":
+        p, s = lp + rp + 1, ls + rs
+    else:  # + -
+        s = max(ls, rs)
+        p = max(lp - ls, rp - rs) + s + 1
+    if p <= 38 and not pa.types.is_decimal256(lt) and not pa.types.is_decimal256(rt):
+        return pa.decimal128(p, s)
+    if p <= 76:
+        return pa.decimal256(min(p, 76), s)
+    return pa.float64()
 
 
 def and_(*exprs: Expr) -> Expr:
@@ -432,6 +497,26 @@ def _widen(a: pa.DataType, b: pa.DataType) -> pa.DataType:
         return b
     if pa.types.is_null(b):
         return a
+    if pa.types.is_decimal(a) or pa.types.is_decimal(b):
+        # CASE branches mixing decimal with numerics: two decimals widen to
+        # cover both (integer digits and scale); decimal+int grows integer
+        # digits by Arrow's int width; decimal+float falls to float64
+        def dims(t):
+            if pa.types.is_decimal(t):
+                return t.precision - t.scale, t.scale
+            if pa.types.is_integer(t):
+                return {8: 3, 16: 5, 32: 10, 64: 19}.get(t.bit_width, 19), 0
+            return None
+        da, db = dims(a), dims(b)
+        if da is None or db is None:
+            return pa.float64()
+        ints, scale = max(da[0], db[0]), max(da[1], db[1])
+        p = ints + scale
+        if p <= 38 and not pa.types.is_decimal256(a) and not pa.types.is_decimal256(b):
+            return pa.decimal128(p, scale)
+        if p <= 76:
+            return pa.decimal256(p, scale)
+        return pa.float64()
     if (pa.types.is_integer(a) or pa.types.is_floating(a)) and (
         pa.types.is_integer(b) or pa.types.is_floating(b)
     ):
@@ -523,8 +608,8 @@ class WindowFunction(Expr):
         if self.func == "avg":
             return pa.float64()
         t = self.args[0].data_type(schema)
-        if self.func == "sum" and pa.types.is_integer(t):
-            return pa.int64()
+        if self.func == "sum":
+            return sum_result_type(t)
         return t
 
     def __str__(self) -> str:
@@ -575,8 +660,8 @@ class AggregateFunction(Expr):
             return pa.float64()
         assert self.arg is not None
         t = self.arg.data_type(schema)
-        if self.func == "sum" and pa.types.is_integer(t):
-            return pa.int64()
+        if self.func == "sum":
+            return sum_result_type(t)
         return t
 
     def output_name(self) -> str:

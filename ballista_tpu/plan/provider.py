@@ -138,23 +138,15 @@ class ParquetTable(TableProvider):
 
 
 def _normalize_schema(schema: pa.Schema) -> pa.Schema:
-    """Engine decimal policy: decimal columns surface as float64 everywhere
-    (ops/tpu/columnar.py — exact money arithmetic comes back on device via
-    the scaled-int64 fixed-point proof). Normalizing at the provider
-    boundary keeps user parquet written with decimal128 — e.g. data from
-    the reference's TPC-H generators — loadable with consistent types:
-    without this, pyarrow group_by returns Decimal objects that contradict
-    the planned float64 schema (global sum over decimal raised
-    ArrowInvalid; min/max leaked decimal.Decimal values)."""
-    fields = []
-    changed = False
-    for f in schema:
-        if pa.types.is_decimal(f.type):
-            fields.append(pa.field(f.name, pa.float64(), f.nullable, f.metadata))
-            changed = True
-        else:
-            fields.append(f)
-    return pa.schema(fields, metadata=schema.metadata) if changed else schema
+    """Exact decimal policy: decimal128 columns keep their type end-to-end —
+    parser literals carry minimal precision, arithmetic follows Arrow's
+    decimal rules with decimal256 widening (plan/expressions.py::
+    decimal_arith_type), and sums aggregate at max precision. This replaces
+    the round-4 float64 coercion policy (the reference gets the same
+    exactness from DataFusion decimal128; SURVEY §7 hard-part #2). Only
+    decimals beyond 256-bit range — which parquet cannot produce — would
+    need normalization, so this is now the identity."""
+    return schema
 
 
 def _read_schema(path: str) -> pa.Schema:

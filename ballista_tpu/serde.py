@@ -12,6 +12,7 @@ configured engine.
 from __future__ import annotations
 
 import datetime as _dt
+import decimal as _decimal
 import io
 from typing import Optional
 
@@ -80,6 +81,11 @@ _STR_TO_TYPE = {v: k for k, v in _TYPE_TO_STR.items()}
 def type_to_str(t: pa.DataType) -> str:
     s = _TYPE_TO_STR.get(t)
     if s is None:
+        # parameterized tags (exact decimal policy: money survives the wire)
+        if pa.types.is_decimal128(t):
+            return f"decimal128({t.precision},{t.scale})"
+        if pa.types.is_decimal256(t):
+            return f"decimal256({t.precision},{t.scale})"
         raise GeneralError(f"unserializable arrow type {t}")
     return s
 
@@ -87,6 +93,10 @@ def type_to_str(t: pa.DataType) -> str:
 def str_to_type(s: str) -> pa.DataType:
     t = _STR_TO_TYPE.get(s)
     if t is None:
+        if s.startswith("decimal128(") or s.startswith("decimal256("):
+            p, sc = s[s.index("(") + 1:-1].split(",")
+            mk = pa.decimal128 if s.startswith("decimal128") else pa.decimal256
+            return mk(int(p), int(sc))
         raise GeneralError(f"unknown arrow type tag {s}")
     return t
 
@@ -124,6 +134,8 @@ def encode_literal(v) -> pb.LiteralProto:
         out.string_v = v
     elif isinstance(v, _dt.date):
         out.date_days = (v - _dt.date(1970, 1, 1)).days
+    elif isinstance(v, _decimal.Decimal):
+        out.decimal_v = str(v)  # exact text round-trip
     elif isinstance(v, tuple) and len(v) == 2:
         out.interval.n = v[0]
         out.interval.unit = v[1]
@@ -146,6 +158,8 @@ def decode_literal(p: pb.LiteralProto):
         return p.string_v
     if which == "date_days":
         return _dt.date(1970, 1, 1) + _dt.timedelta(days=p.date_days)
+    if which == "decimal_v":
+        return _decimal.Decimal(p.decimal_v)
     if which == "interval":
         return (p.interval.n, p.interval.unit)
     raise GeneralError(f"bad literal {p}")

@@ -87,6 +87,24 @@ def fold_constants(e: Expr) -> Expr:
                 return Literal(_date_add(lv, rv, -1 if x.op == "-" else 1))
             if isinstance(rv, _dt.date) and isinstance(lv, tuple) and x.op == "+":
                 return Literal(_date_add(rv, lv, 1))
+            import decimal as _dec
+
+            if (isinstance(lv, (int, _dec.Decimal)) and isinstance(rv, (int, _dec.Decimal))
+                    and (isinstance(lv, _dec.Decimal) or isinstance(rv, _dec.Decimal))
+                    and not isinstance(lv, bool) and not isinstance(rv, bool)):
+                # exact decimal folding for +,-,* at decimal256's 76-digit
+                # cap (Python's default 28-digit context would silently
+                # round wide folds the runtime computes exactly); division
+                # folds nothing — the engine plans it as float64
+                with _dec.localcontext() as ctx76:
+                    ctx76.prec = 76
+                    if x.op == "+":
+                        return Literal(lv + rv)
+                    if x.op == "-":
+                        return Literal(lv - rv)
+                    if x.op == "*":
+                        return Literal(lv * rv)
+                return x
             if isinstance(lv, (int, float)) and isinstance(rv, (int, float)) and not isinstance(lv, bool) and not isinstance(rv, bool):
                 try:
                     if x.op == "+":
@@ -99,8 +117,11 @@ def fold_constants(e: Expr) -> Expr:
                         return Literal(lv / rv)
                 except ZeroDivisionError:
                     return x
-        if isinstance(x, Negative) and isinstance(x.expr, Literal) and isinstance(x.expr.value, (int, float)):
-            return Literal(-x.expr.value)
+        if isinstance(x, Negative) and isinstance(x.expr, Literal):
+            import decimal as _dec
+
+            if isinstance(x.expr.value, (int, float, _dec.Decimal)):
+                return Literal(-x.expr.value)
         return x
 
     return transform_expr(e, fn)

@@ -907,8 +907,15 @@ class Parser:
 
 
 def _num(s: str):
-    if "." in s or "e" in s or "E" in s:
-        return float(s)
+    if "e" in s or "E" in s:
+        return float(s)  # scientific notation: approximate by intent
+    if "." in s:
+        # exact decimal policy: plain decimal literals carry minimal
+        # precision/scale (0.06 → decimal(2,2)) so money arithmetic stays
+        # exact; Arrow promotes them transparently in float contexts
+        import decimal
+
+        return decimal.Decimal(s)
     return int(s)
 
 

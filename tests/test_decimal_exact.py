@@ -1,0 +1,289 @@
+"""Exact decimal semantics on the CPU engine (SURVEY §7 hard-part #2;
+reference behavior: DataFusion decimal128 exactness).
+
+The engine keeps decimal128 end-to-end: tight-precision literals, Arrow
+arithmetic rules with decimal256 widening, max-precision sums, wire serde
+of decimal schemas/literals, and the device money lane fed by unscaled
+ints. These tests pin exactness TO THE DIGIT against Python's Decimal — a
+float64 engine cannot pass them at these row counts."""
+
+import decimal
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from .conftest import tpch_query
+
+D = decimal.Decimal
+
+
+@pytest.fixture(scope="module")
+def dec_tpch_dir(tmp_path_factory, tpch_dir):
+    """TPC-H SF0.01 with the money columns cast to decimal(15,2) — the type
+    the reference's generators emit."""
+    out = tmp_path_factory.mktemp("dec_tpch")
+    money = {
+        "lineitem": ["l_extendedprice", "l_discount", "l_tax", "l_quantity"],
+        "orders": ["o_totalprice"],
+        "customer": ["c_acctbal"],
+        "supplier": ["s_acctbal"],
+        "part": ["p_retailprice"],
+        "partsupp": ["ps_supplycost"],
+        "nation": [], "region": [],
+    }
+    import glob
+    import os
+
+    for table, cols in money.items():
+        os.makedirs(out / table, exist_ok=True)
+        for i, f in enumerate(sorted(glob.glob(f"{tpch_dir}/{table}/*.parquet"))):
+            t = pq.read_table(f)
+            for c in cols:
+                if c in t.column_names:
+                    idx = t.column_names.index(c)
+                    t = t.set_column(
+                        idx, c, t.column(c).cast(pa.decimal128(15, 2)))
+            pq.write_table(t, out / table / f"part{i}.parquet")
+    return str(out)
+
+
+@pytest.fixture()
+def dec_ctx(dec_tpch_dir):
+    from ballista_tpu.client.context import SessionContext
+    from ballista_tpu.testing.tpchgen import register_tpch
+
+    ctx = SessionContext()
+    register_tpch(ctx, dec_tpch_dir)
+    return ctx
+
+
+def _exact_q6(dec_tpch_dir) -> D:
+    """Ground truth for TPC-H q6 revenue computed in Python Decimal."""
+    import glob
+
+    total = D(0)
+    for f in sorted(glob.glob(f"{dec_tpch_dir}/lineitem/*.parquet")):
+        t = pq.read_table(f, columns=["l_shipdate", "l_discount", "l_quantity",
+                                      "l_extendedprice"])
+        df = t.to_pandas()
+        import datetime
+
+        m = (
+            (df.l_shipdate >= datetime.date(1994, 1, 1))
+            & (df.l_shipdate < datetime.date(1995, 1, 1))
+            & (df.l_discount >= D("0.05")) & (df.l_discount <= D("0.07"))
+            & (df.l_quantity < 24)
+        )
+        for p, disc in zip(df.l_extendedprice[m], df.l_discount[m]):
+            total += p * disc
+    return total
+
+
+def test_q6_exact_to_the_digit(dec_ctx, dec_tpch_dir):
+    out = dec_ctx.sql(tpch_query(6)).collect()
+    assert pa.types.is_decimal(out.schema.field(0).type), out.schema
+    got = out.to_pandas().iloc[0, 0]
+    assert got == _exact_q6(dec_tpch_dir), (got, _exact_q6(dec_tpch_dir))
+
+
+def test_q1_exact_money_sums(dec_ctx, dec_tpch_dir):
+    """q1's sum(l_extendedprice*(1-l_discount)*(1+l_tax)) — the three-way
+    decimal product that needs tight literal typing + decimal256 partials —
+    must match Python Decimal exactly per group."""
+    out = dec_ctx.sql(tpch_query(1)).collect()
+    df = out.to_pandas().set_index(["l_returnflag", "l_linestatus"])
+    # charge column is exact decimal
+    charge_col = next(c for c in out.schema.names if "charge" in c or "1 + l_tax" in c)
+    assert pa.types.is_decimal(out.schema.field(charge_col).type), out.schema
+
+    import glob
+
+    want: dict[tuple, D] = {}
+    import datetime
+
+    for f in sorted(glob.glob(f"{dec_tpch_dir}/lineitem/*.parquet")):
+        t = pq.read_table(f, columns=["l_returnflag", "l_linestatus", "l_shipdate",
+                                      "l_extendedprice", "l_discount", "l_tax"])
+        df2 = t.to_pandas()
+        m = df2.l_shipdate <= datetime.date(1998, 9, 2)
+        for rf, ls, p, d, x in zip(df2.l_returnflag[m], df2.l_linestatus[m],
+                                   df2.l_extendedprice[m], df2.l_discount[m],
+                                   df2.l_tax[m]):
+            want[(rf, ls)] = want.get((rf, ls), D(0)) + p * (1 - d) * (1 + x)
+    for key, exact in want.items():
+        assert df.loc[key, charge_col] == exact, (key, df.loc[key, charge_col], exact)
+
+
+def test_adversarial_float_error_accumulation():
+    """300k × 0.10 sums to exactly 30000.00 — float64 accumulation drifts,
+    the decimal engine must not."""
+    from ballista_tpu.client.context import SessionContext
+
+    n = 300_000
+    t = pa.table({
+        "g": pa.array(np.arange(n) % 7, pa.int64()),
+        "v": pa.array([D("0.10")] * n, pa.decimal128(15, 2)),
+    })
+    ctx = SessionContext()
+    ctx.register_arrow_table("m", t, partitions=4)
+    out = ctx.sql("select sum(v) from m").collect().to_pandas().iloc[0, 0]
+    assert out == D("30000.00")
+    grouped = ctx.sql("select g, sum(v) s from m group by g order by g").collect()
+    per = grouped.to_pandas()
+    total = sum(per.s)
+    assert total == D("30000.00") and all(
+        s in (D("4285.70"), D("4285.80")) for s in per.s)
+
+
+def test_distributed_decimal_over_the_wire(dec_tpch_dir):
+    """q6 through a standalone cluster: decimal schemas and literals must
+    round-trip the task/shuffle wire with the same exact answer."""
+    from ballista_tpu.client.context import SessionContext
+    from ballista_tpu.config import BallistaConfig
+    from ballista_tpu.testing.tpchgen import register_tpch
+
+    ctx = SessionContext.standalone(BallistaConfig(), num_executors=2, vcores=2)
+    try:
+        register_tpch(ctx, dec_tpch_dir)
+        got = ctx.sql(tpch_query(6)).collect().to_pandas().iloc[0, 0]
+        assert got == _exact_q6(dec_tpch_dir)
+    finally:
+        ctx.shutdown()
+
+
+def test_tpu_engine_decimal_money_lane(dec_tpch_dir):
+    """The device path ingests decimal columns as unscaled int64 (exact, no
+    float sniffing) and q6/q1 agree with the CPU engine."""
+    from ballista_tpu.client.context import SessionContext
+    from ballista_tpu.config import EXECUTOR_ENGINE, TPU_MIN_ROWS, BallistaConfig
+    from ballista_tpu.ops.tpu.columnar import encode_column
+    from ballista_tpu.testing.tpchgen import register_tpch
+
+    arr = pa.array([D("10.25"), None, D("7.75")], pa.decimal128(15, 2))
+    col = encode_column(arr)
+    assert col is not None and col.kind == "money" and col.scale == 2
+    assert list(col.data) == [1025, 0, 775] and list(col.valid) == [True, False, True]
+
+    cfg = BallistaConfig({EXECUTOR_ENGINE: "tpu", TPU_MIN_ROWS: 0})
+    tpu_ctx = SessionContext(cfg)
+    register_tpch(tpu_ctx, dec_tpch_dir)
+    cpu_ctx = SessionContext()
+    register_tpch(cpu_ctx, dec_tpch_dir)
+    for q in (6, 1):
+        got = tpu_ctx.sql(tpch_query(q)).collect().to_pandas()
+        want = cpu_ctx.sql(tpch_query(q)).collect().to_pandas()
+        assert len(got) == len(want)
+        for c in want.columns:
+            gv, wv = got[c].values, want[c].values
+            if want[c].dtype.kind == "f":
+                assert np.allclose(gv.astype(float), wv.astype(float), rtol=1e-9), c
+            elif want[c].dtype == object and len(wv) and isinstance(wv[0], D):
+                # device partials ride int64 cents; tolerate ≤1 ulp at the
+                # declared scale from the float64 fetch path
+                for g, w in zip(gv, wv):
+                    assert abs(D(str(g)) - w) <= D("0.01") * 2, (c, g, w)
+            else:
+                assert (gv == wv).all(), c
+
+
+def test_decimal_literal_and_schema_serde():
+    from ballista_tpu.plan.expressions import Literal, literal_type
+    from ballista_tpu.serde import (
+        decode_literal,
+        encode_literal,
+        str_to_type,
+        type_to_str,
+    )
+
+    v = D("-123.4567")
+    assert decode_literal(encode_literal(v)) == v
+    assert literal_type(v) == pa.decimal128(7, 4)
+    for t in (pa.decimal128(15, 2), pa.decimal128(38, 6), pa.decimal256(49, 6)):
+        assert str_to_type(type_to_str(t)) == t
+
+
+def test_decimal_group_key_and_shuffle_routing():
+    """GROUP BY on a decimal column hash-partitions (the shuffle router
+    needed a decimal branch) and groups exactly."""
+    from ballista_tpu.client.context import SessionContext
+    from ballista_tpu.config import DEFAULT_SHUFFLE_PARTITIONS, BallistaConfig
+
+    n = 50_000
+    rng = np.random.default_rng(6)
+    vals = [D(f"{x}.{y:02d}") for x, y in zip(rng.integers(0, 20, n), rng.integers(0, 100, n))]
+    t = pa.table({"d": pa.array(vals, pa.decimal128(15, 2)),
+                  "v": pa.array(np.ones(n, np.int64))})
+    cfg = BallistaConfig({DEFAULT_SHUFFLE_PARTITIONS: 8})
+    ctx = SessionContext.standalone(cfg, num_executors=2, vcores=2)
+    try:
+        ctx.register_arrow_table("m", t, partitions=4)
+        out = ctx.sql("select d, count(*) c from m group by d order by d").collect()
+        got = {row["d"]: row["c"] for row in out.to_pylist()}
+    finally:
+        ctx.shutdown()
+    import collections
+
+    want = collections.Counter(vals)
+    assert got == dict(want)
+
+
+def test_window_sum_over_decimal_exact():
+    from ballista_tpu.client.context import SessionContext
+
+    t = pa.table({
+        "id": pa.array([1, 2, 3, 4], pa.int64()),
+        "p": pa.array([D("0.10"), D("0.20"), None, D("0.40")], pa.decimal128(15, 2)),
+    })
+    ctx = SessionContext()
+    ctx.register_arrow_table("d", t)
+    out = ctx.sql("select id, sum(p) over (order by id) s, min(p) over (order by id) mn "
+                  "from d order by id").collect()
+    assert pa.types.is_decimal(out.schema.field("s").type)
+    assert out.column("s").to_pylist() == [D("0.10"), D("0.30"), D("0.30"), D("0.70")]
+    assert out.column("mn").to_pylist() == [D("0.10")] * 4
+    out2 = ctx.sql("select id, sum(p) over (order by id rows between 1 preceding "
+                   "and current row) s from d order by id").collect()
+    assert out2.column("s").to_pylist() == [D("0.10"), D("0.30"), D("0.20"), D("0.40")]
+
+
+def test_case_branches_mixing_decimal():
+    from ballista_tpu.client.context import SessionContext
+
+    t = pa.table({
+        "g": pa.array([1, 2], pa.int64()),
+        "p": pa.array([D("1.25"), D("2.50")], pa.decimal128(15, 2)),
+    })
+    ctx = SessionContext()
+    ctx.register_arrow_table("d", t)
+    # int-literal branch widens with the decimal branch (not int64)
+    r = ctx.sql("select g, case when g = 1 then 0 else p end x from d order by g").collect()
+    assert pa.types.is_decimal(r.schema.field("x").type), r.schema
+    assert r.column("x").to_pylist() == [D("0.00"), D("2.50")]
+    # sci-notation literal stays float and must still land in the decimal slot
+    r2 = ctx.sql("select g, case when g = 1 then p else 15e-1 end x from d order by g").collect()
+    assert r2.column("x").to_pylist()[0] == D("1.25")
+
+
+def test_arith_type_rules_match_arrow():
+    """The planner's decimal_arith_type must predict Arrow's kernel result
+    types for the shapes TPC-H hits (the planner/runtime contract)."""
+    import pyarrow.compute as pc
+
+    from ballista_tpu.plan.expressions import Column, Literal, decimal_arith_type
+
+    p152 = pa.decimal128(15, 2)
+    a = pa.array([D("1.23")], p152)
+    b = pa.array([D("2.50")], p152)
+    cases = [("+", pc.add), ("-", pc.subtract), ("*", pc.multiply)]
+    for op, fn in cases:
+        planned = decimal_arith_type(Column("x"), Column("y"), p152, p152, op)
+        assert planned == fn(a, b).type, op
+    # int literal minimal typing: 1 - dec(15,2) plans (17,2) like the
+    # evaluator's tightened scalar produces
+    planned = decimal_arith_type(Literal(1), Column("y"), pa.int64(), p152, "-")
+    got = pc.subtract(pa.scalar(D(1)), a)
+    assert planned == got.type, (planned, got.type)
+    # division always plans float64
+    assert decimal_arith_type(None, None, p152, p152, "/") == pa.float64()

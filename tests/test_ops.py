@@ -120,11 +120,11 @@ def test_hash_date64_columns():
         assert (h == nat).all()
 
 
-def test_decimal_parquet_reads_as_float64_policy(tmp_path):
+def test_decimal_parquet_exact_policy(tmp_path):
     """decimal128 parquet (what the reference's TPC-H generators emit) and
-    decimal arrow tables normalize to the engine's float64 decimal policy at
-    the provider boundary — global sums, grouped aggs, and min/max all work
-    with consistent float64 typing (no decimal.Decimal leakage)."""
+    decimal arrow tables keep EXACT decimal semantics end-to-end: sums widen
+    to decimal128(38,s) like DataFusion's, min/max preserve the input type,
+    nulls flow, and no float rounding touches the money lane."""
     import decimal
 
     import pyarrow.parquet as pq
@@ -139,10 +139,12 @@ def test_decimal_parquet_reads_as_float64_policy(tmp_path):
     pq.write_table(tbl, tmp_path / "d.parquet")
     ctx = SessionContext()
     ctx.register_parquet("d", str(tmp_path / "d.parquet"))
-    assert ctx.catalog.get("d").arrow_schema().field("price").type == pa.float64()
-    r = ctx.sql("SELECT sum(price) s, min(price) mn, count(price) c FROM d"
-                ).collect().to_pandas()
-    assert float(r.s[0]) == 18.0 and float(r.mn[0]) == 7.75 and int(r.c[0]) == 2
+    assert ctx.catalog.get("d").arrow_schema().field("price").type == pa.decimal128(15, 2)
+    out = ctx.sql("SELECT sum(price) s, min(price) mn, count(price) c FROM d").collect()
+    assert out.schema.field("s").type == pa.decimal128(38, 2)
+    assert out.schema.field("mn").type == pa.decimal128(15, 2)
+    r = out.to_pandas()
+    assert r.s[0] == D("18.00") and r.mn[0] == D("7.75") and int(r.c[0]) == 2
     ctx.register_arrow_table("m", tbl)
     r2 = ctx.sql("SELECT g, sum(price) s FROM m GROUP BY g ORDER BY g").collect()
-    assert r2.column("s").to_pylist() == [18.0, None]
+    assert r2.column("s").to_pylist() == [D("18.00"), None]
