@@ -11,7 +11,7 @@ shrink when they spill or finish; a refusal means "spill first".
 from __future__ import annotations
 
 import threading
-import time
+import time  # noqa: F401 — monotonic used by grow_wait and the registry TTL
 
 
 class MemoryPool:
@@ -19,6 +19,10 @@ class MemoryPool:
         self.capacity = capacity
         self.reserved = 0
         self._lock = threading.Lock()
+        self._freed = threading.Condition(self._lock)
+        # forced reservations past capacity (observability: a non-zero value
+        # means the deadline backstop fired under real memory pressure)
+        self.overcommitted = 0
 
     def try_grow(self, nbytes: int) -> bool:
         with self._lock:
@@ -27,15 +31,30 @@ class MemoryPool:
             self.reserved += nbytes
             return True
 
-    def grow(self, nbytes: int) -> None:
-        """Unchecked growth — the liveness escape hatch after a consumer has
-        spilled everything it can and still needs one batch of headroom."""
-        with self._lock:
+    def grow_wait(self, nbytes: int, timeout_s: float) -> bool:
+        """Block until the reservation fits (another task shrinking notifies)
+        or the deadline passes; a deadline pass reserves anyway — liveness
+        over strictness — and is counted in `overcommitted`. Returns True
+        when the reservation stayed within capacity. A single reservation
+        larger than the whole pool can never be satisfied by peers
+        shrinking, so it overcommits immediately instead of sleeping out
+        the deadline (the write-side twin of the reader window's
+        oversized-singleton admission)."""
+        deadline = time.monotonic() + timeout_s
+        with self._freed:
+            while self.reserved + nbytes > self.capacity:
+                if nbytes > self.capacity or deadline - time.monotonic() <= 0:
+                    self.reserved += nbytes
+                    self.overcommitted += nbytes
+                    return False
+                self._freed.wait(timeout=deadline - time.monotonic())
             self.reserved += nbytes
+            return True
 
     def shrink(self, nbytes: int) -> None:
-        with self._lock:
+        with self._freed:
             self.reserved = max(0, self.reserved - nbytes)
+            self._freed.notify_all()
 
 
 class SessionPoolRegistry:

@@ -38,12 +38,16 @@ class Metrics:
         self.output_rows = 0
         self.output_batches = 0
         self.elapsed_ns = 0
+        # operator-specific counters (spilled_bytes, spill_count, ...) —
+        # the reference's labeled MetricsSet values beyond the core trio
+        self.extra: dict[str, int] = {}
 
     def as_dict(self) -> dict:
         return {
             "output_rows": self.output_rows,
             "output_batches": self.output_batches,
             "elapsed_ns": self.elapsed_ns,
+            **self.extra,
         }
 
 

@@ -77,29 +77,10 @@ class ShuffleReaderExec(ExecutionPlan):
         produced = False
         gov = _governor(ctx)
         if len(locs) > 1:
-            # fetch ALL upstream map outputs concurrently under the governor
-            # (reference: send_fetch_partitions spawns every fetch,
-            # shuffle_reader.rs:762-875); results YIELD in location order so
-            # order-sensitive float merges stay deterministic — later
-            # fetches overlap the consumption of earlier ones
-            import concurrent.futures as fut
-
-            pool = fut.ThreadPoolExecutor(
-                max_workers=min(len(locs), int(ctx.config.get(SHUFFLE_READER_MAX_REQUESTS))),
-                thread_name_prefix="shuffle-fetch",
-            )
-            try:
-                futures = [
-                    pool.submit(_fetch_buffered, loc, ctx, force_remote, gov)
-                    for loc in locs
-                ]
-                for f in futures:
-                    for b in f.result():
-                        if b.num_rows:
-                            produced = True
-                            yield b
-            finally:
-                pool.shutdown(wait=False, cancel_futures=True)
+            for b in _stream_locations(locs, ctx, force_remote, gov):
+                if b.num_rows:
+                    produced = True
+                    yield b
         else:
             for loc in locs:
                 for b in fetch_partition(loc, ctx, force_remote=force_remote, governor=gov):
@@ -168,8 +149,10 @@ class FetchGovernor:
         sem.acquire()
         nbytes = min(nbytes, self.max_bytes)  # oversized fetches admit alone
         with self._bytes_free:
+            # strict notify-driven accounting: every release() notifies under
+            # the lock (and runs in a finally), so no timed re-poll is needed
             while self.inflight_bytes > 0 and self.inflight_bytes + nbytes > self.max_bytes:
-                self._bytes_free.wait(timeout=5)
+                self._bytes_free.wait()
             self.inflight_bytes += nbytes
         return (sem, nbytes)
 
@@ -207,9 +190,87 @@ def _governor(ctx: TaskContext) -> FetchGovernor:
         return g
 
 
-def _fetch_buffered(loc: PartitionLocation, ctx: TaskContext, force_remote: bool,
-                    governor: FetchGovernor | None) -> list[pa.RecordBatch]:
-    return list(fetch_partition(loc, ctx, force_remote=force_remote, governor=governor))
+def _stream_locations(locs: list[PartitionLocation], ctx: TaskContext,
+                      force_remote: bool, gov: "FetchGovernor | None"):
+    """Bounded multi-location streaming merge (the reference's concurrent
+    reduce-side reader, sort_shuffle/multi_stream_reader.rs).
+
+    Remote locations prefetch concurrently; LOCAL locations stream lazily
+    inline when their turn comes (no buffering at all). Yield order stays
+    location order, so order-sensitive float merges are deterministic.
+    Unlike the old fetch-everything-then-drain shape, fetched-but-unconsumed
+    bytes are capped by the reader byte budget: a fetch's result counts
+    against the window until the CONSUMER drains it, and new fetches are
+    only admitted under the cap (one is always admitted when the window is
+    empty, so an oversized partition streams alone instead of deadlocking).
+    Per-location buffering is retained — a retry around a half-yielded
+    Flight stream would duplicate rows (shuffle_reader.rs:975)."""
+    import concurrent.futures as fut
+    from ballista_tpu.config import SHUFFLE_READER_MAX_BYTES
+
+    budget = int(ctx.config.get(SHUFFLE_READER_MAX_BYTES))
+    remote = [
+        i for i, loc in enumerate(locs)
+        if force_remote or not (loc.path and os.path.exists(loc.path))
+    ]
+    remote_set = set(remote)
+    if not remote:
+        for loc in locs:
+            yield from fetch_partition(loc, ctx, force_remote=force_remote, governor=gov)
+        return
+
+    cond = threading.Condition()
+    results: dict[int, list | Exception] = {}
+    state = {"buffered": 0, "next": 0}
+
+    def fetch(i: int) -> None:
+        try:
+            out: list | Exception = list(
+                fetch_partition(locs[i], ctx, force_remote=force_remote, governor=gov))
+        except Exception as e:  # noqa: BLE001 — surfaced at the consumer in order
+            out = e
+        with cond:
+            results[i] = out
+            if not isinstance(out, Exception):
+                got = sum(b.nbytes for b in out)
+                # replace the stats estimate with actual bytes
+                state["buffered"] += got - min(locs[i].stats.num_bytes, budget)
+            cond.notify_all()
+
+    pool = fut.ThreadPoolExecutor(
+        max_workers=min(len(remote), int(ctx.config.get(SHUFFLE_READER_MAX_REQUESTS))),
+        thread_name_prefix="shuffle-fetch",
+    )
+
+    def top_up_locked() -> None:
+        while state["next"] < len(remote):
+            est = min(locs[remote[state["next"]]].stats.num_bytes, budget)
+            if state["buffered"] > 0 and state["buffered"] + est > budget:
+                break
+            state["buffered"] += est
+            pool.submit(fetch, remote[state["next"]])
+            state["next"] += 1
+
+    try:
+        with cond:
+            top_up_locked()
+        for i, loc in enumerate(locs):
+            if i in remote_set:
+                with cond:
+                    while i not in results:
+                        cond.wait()
+                    batches = results.pop(i)
+                if isinstance(batches, Exception):
+                    raise batches
+                yield from batches
+                with cond:
+                    state["buffered"] -= sum(b.nbytes for b in batches)
+                    top_up_locked()
+            else:
+                # local: stream straight off disk, nothing buffered
+                yield from fetch_partition(loc, ctx, force_remote=False, governor=gov)
+    finally:
+        pool.shutdown(wait=False, cancel_futures=True)
 
 
 def fetch_partition(loc: PartitionLocation, ctx: TaskContext, force_remote: bool = False,

@@ -166,10 +166,15 @@ class ShuffleWriterExec(ExecutionPlan):
             sp = paths.sort_data_path(ctx.work_dir, self.job_id, self.stage_id, map_partition) + f".spill{len(spills[k])}.{k}"
             os.makedirs(os.path.dirname(sp), exist_ok=True)
             with open(sp, "wb") as f:
-                write_ipc_stream(buckets[k], schema, f, ctx)
+                _, sp_bytes = write_ipc_stream(buckets[k], schema, f, ctx)
             spills[k].append(sp)
             freed = sum(b.nbytes for b in buckets[k])
             buffered -= freed
+            # SpillManager-style accounting (sort_shuffle/spill.rs:46,110):
+            # cumulative spilled volume surfaces in EXPLAIN ANALYZE metrics
+            self.metrics.extra["spilled_bytes"] = (
+                self.metrics.extra.get("spilled_bytes", 0) + sp_bytes)
+            self.metrics.extra["spill_count"] = self.metrics.extra.get("spill_count", 0) + 1
             if pool is not None:
                 pool.shrink(min(freed, pool_held))
                 pool_held -= min(freed, pool_held)
@@ -182,10 +187,20 @@ class ShuffleWriterExec(ExecutionPlan):
                 return
             while not pool.try_grow(nbytes):
                 if not spill_largest():
-                    # nothing of ours left to spill: take the headroom
-                    # anyway (liveness over strictness; other tasks will
-                    # spill on their next refusal)
-                    pool.grow(nbytes)
+                    # nothing of ours left to spill: BLOCK with a deadline
+                    # for peer tasks of this session to shrink (their next
+                    # refusal makes them spill); only a deadline pass takes
+                    # the headroom unaccounted — bounded liveness instead of
+                    # the old unconditional grow()
+                    from ballista_tpu.config import SORT_SHUFFLE_POOL_WAIT_S
+
+                    wait_s = float(ctx.config.get(SORT_SHUFFLE_POOL_WAIT_S))
+                    if not pool.grow_wait(nbytes, timeout_s=wait_s):
+                        import logging
+
+                        logging.getLogger(__name__).warning(
+                            "memory pool overcommitted by %d bytes after %.1fs "
+                            "wait (session under real pressure)", nbytes, wait_s)
                     break
             pool_held += nbytes
 

@@ -438,7 +438,7 @@ def test_session_pool_registry_ttl_eviction():
 
     reg = SessionPoolRegistry(capacity_per_session=100, ttl_s=0.05)
     p1 = reg.get("s1")
-    p1.grow(90)  # a task dies holding a reservation
+    assert p1.try_grow(90)  # a task dies holding a reservation
     reg.get("s2")
     assert len(reg) == 2
     import time as _t
