@@ -209,6 +209,12 @@ class TpuFinalStageExec(ExecutionPlan):
         self.fallback_count = 0
         self._results: dict[int, list[pa.RecordBatch]] | None = None
         self._results_lock = threading.Lock()
+        self._device_ok = False
+        # child output materialized by a device attempt that then declined:
+        # (tables, child df_schema, merged?) — the CPU fallback aggregates
+        # THESE instead of re-executing the whole child subtree
+        self._mat_input: tuple | None = None
+        self._mat_node = None
         parts = [op.node_str() for op in ([sort] if sort else []) + post_ops]
         self.fingerprint = "|".join(
             parts + [agg.node_str(), repr(agg.input.df_schema), f"coalesce={coalesce}"]
@@ -248,6 +254,8 @@ class TpuFinalStageExec(ExecutionPlan):
                     with device_scope(ctx.device_ordinal):
                         self._results = self._tpu_run_all(ctx)
                     self.tpu_count += 1
+                    self._device_ok = True
+                    self._mat_input = None  # success: release the host copy
                 except Unsupported as e:
                     logging.getLogger(__name__).info(
                         "tpu final-stage fallback (%s): %s", e, self.agg.node_str())
@@ -258,15 +266,64 @@ class TpuFinalStageExec(ExecutionPlan):
                         self.agg.node_str(), exc_info=True,
                     )
                     self._results = {}
+            if partition not in self._results and self._device_ok:
+                # results were already consumed (a consumer re-executed this
+                # partition); caches are hot, so re-running the device path
+                # costs ~one dispatch — never a host re-aggregation
+                try:
+                    with device_scope(ctx.device_ordinal):
+                        self._results.update(self._tpu_run_all(ctx))
+                    self.tpu_count += 1
+                    self._mat_input = None
+                    # serve WITHOUT popping: one re-dispatch covers all K
+                    # re-reads of an already-consumed result
+                    if partition in self._results:
+                        return list(self._results[partition])
+                except Exception:  # noqa: BLE001
+                    logging.getLogger(__name__).warning(
+                        "tpu final-stage re-run failed; cpu fallback for %s",
+                        self.agg.node_str(), exc_info=True)
+                    self._device_ok = False
             if partition in self._results:
                 return self._results.pop(partition)
         return self._fallback(partition, ctx)
 
+    def _materialized_scan(self):
+        """Build (once) a MemoryScanExec over the child output a declined
+        device attempt already read, so the CPU fallback never re-executes
+        the child subtree. Returns (scan, merged?) or None."""
+        with self._results_lock:
+            if self._mat_node is None and self._mat_input is not None:
+                from ballista_tpu.plan.physical import MemoryScanExec
+
+                tables, dfs, merged = self._mat_input
+                batches = []
+                for t in tables:
+                    bs = t.combine_chunks().to_batches()
+                    batches.append(bs[0] if bs else _empty_batch(t.schema))
+                self._mat_node = (
+                    MemoryScanExec(dfs, batches, partitions=len(batches)), merged)
+                self._mat_input = None  # don't retain a second full copy
+            return self._mat_node
+
     def _fallback(self, partition: int, ctx: TaskContext) -> list[pa.RecordBatch]:
         self.fallback_count += 1
-        node: ExecutionPlan = self.child
-        if self.coalesce:
-            node = CoalescePartitionsExec(node)
+        mat = self._materialized_scan()
+        if mat is not None:
+            node, merged = mat
+            if merged:
+                # bypass-read input is NOT hash-placed: merge globally and
+                # emit on partition 0 (the device bypass contract)
+                if partition != 0 and not self.coalesce:
+                    return []
+                node = CoalescePartitionsExec(node)
+                partition = 0
+            elif self.coalesce:
+                node = CoalescePartitionsExec(node)
+        else:
+            node = self.child
+            if self.coalesce:
+                node = CoalescePartitionsExec(node)
         node = self.agg.with_children([node])
         for op in reversed(self.post_ops):
             node = op.with_children([node])
@@ -315,6 +372,12 @@ class TpuFinalStageExec(ExecutionPlan):
 
         with fut.ThreadPoolExecutor(max_workers=min(max(P_in, 1), 8)) as pool:
             tables = list(pool.map(read, range(P_in)))
+        # from here on the child's output is in hand: any decline below must
+        # aggregate THESE tables on the CPU, not re-execute the child (whose
+        # device results this read just consumed — re-deriving them on the
+        # host is the 100x overhead the profile pinned)
+        self._mat_input = (tables, child.df_schema, bypass)
+        self._mat_node = None
         part_rows = [t.num_rows for t in tables]
         total = sum(part_rows)
         if total < max(self.min_rows, 1):

@@ -340,6 +340,7 @@ class TpuStageExec(ExecutionPlan):
         self.pid_emitted = 0
         self._results: dict[int, list[pa.RecordBatch]] | None = None
         self._results_lock = threading.Lock()
+        self._device_ok = False
         # structural fingerprint: identical stages across queries share XLA
         # compilations (plan objects are rebuilt per query, ids are not).
         # Join ops must contribute their FULL build subtree: node_str()
@@ -392,6 +393,7 @@ class TpuStageExec(ExecutionPlan):
                     with device_scope(ctx.device_ordinal):
                         self._results = self._tpu_run_all(ctx)
                     self.tpu_count += 1
+                    self._device_ok = True
                 except Unsupported as e:
                     log.info("tpu fallback (%s): %s", e, self.partial_agg.node_str())
                     self._results = {}
@@ -403,6 +405,25 @@ class TpuStageExec(ExecutionPlan):
                         self.partial_agg.node_str(), exc_info=True,
                     )
                     self._results = {}
+            if partition not in self._results and self._device_ok:
+                # a consumer re-executed a partition whose device result was
+                # already popped (e.g. a parent's device attempt that later
+                # fell back): the device table cache and compiled entry are
+                # hot, so re-dispatching costs ~the exec time — never fall
+                # through to a full host re-scan of the subtree
+                try:
+                    with device_scope(ctx.device_ordinal):
+                        self._results.update(self._tpu_run_all(ctx))
+                    self.tpu_count += 1
+                    # serve WITHOUT popping: a consumer that re-reads one
+                    # partition tends to re-read them all — one re-dispatch
+                    # must cover all K re-reads, not K re-dispatches
+                    if partition in self._results:
+                        return list(self._results[partition])
+                except Exception:  # noqa: BLE001
+                    log.warning("tpu stage re-run failed; cpu fallback for %s",
+                                self.partial_agg.node_str(), exc_info=True)
+                    self._device_ok = False
             if partition in self._results:
                 return self._results.pop(partition)
         return self._fallback(partition, ctx)

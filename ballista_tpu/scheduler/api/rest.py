@@ -174,6 +174,26 @@ def start_rest_api(scheduler: SchedulerServer, metrics: InMemoryMetricsCollector
                 return self._send(200, graph_to_dot(g), "text/vnd.graphviz")
             if p == "/api/metrics":
                 return self._send(200, metrics.render_prometheus(), "text/plain; version=0.0.4")
+            if p == "/api/config":
+                # scheduler runtime settings + the typed session-config
+                # registry (reference: the TUI's scheduler-config screen);
+                # restricted keys are scrubbed like the session KV transport
+                from ballista_tpu.config import RESTRICTED_KEYS, VALID_ENTRIES
+
+                entries = [{
+                    "name": e.name, "type": e.ty.__name__,
+                    "default": e.default, "description": e.description,
+                    **({"choices": list(e.choices)} if e.choices else {}),
+                } for e in VALID_ENTRIES.values() if e.name not in RESTRICTED_KEYS]
+                return self._json({
+                    "scheduler_id": scheduler.scheduler_id,
+                    "version": BALLISTA_VERSION,
+                    "task_distribution": scheduler.executors.task_distribution,
+                    "executor_timeout_s": scheduler.executors.timeout_s,
+                    "job_state_backend": type(scheduler.job_state).__name__,
+                    "flight_proxy_port": getattr(scheduler, "flight_proxy_port", 0),
+                    "session_config_entries": sorted(entries, key=lambda e: e["name"]),
+                })
             return self._json({"error": "not found"}, 404)
 
         def do_POST(self):  # noqa: N802

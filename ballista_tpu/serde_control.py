@@ -7,6 +7,8 @@ serde/scheduler/{to,from}_proto.rs).
 
 from __future__ import annotations
 
+import logging
+
 from ballista_tpu.executor.executor import ExecutorMetadata, TaskResult
 from ballista_tpu.proto import pb
 from ballista_tpu.scheduler.state.execution_graph import TaskDescription
@@ -107,12 +109,23 @@ def encode_task_status(r: TaskResult, executor_id: str) -> pb.TaskStatusProto:
             )
         )
     for m in r.metrics or []:
-        out.metrics.append(
-            pb.OperatorMetricProto(
-                name=str(m.get("name", "")), output_rows=int(m.get("output_rows", 0)),
-                elapsed_ns=int(m.get("elapsed_ns", 0)), depth=int(m.get("depth", 0)),
-            )
+        mp = pb.OperatorMetricProto(
+            name=str(m.get("name", "")), output_rows=int(m.get("output_rows", 0)),
+            elapsed_ns=int(m.get("elapsed_ns", 0)), depth=int(m.get("depth", 0)),
         )
+        for k, v in m.items():
+            if k in ("name", "output_rows", "elapsed_ns", "depth"):
+                continue
+            if isinstance(v, (int, bool)):
+                mp.extra[str(k)] = int(v)
+            else:
+                # extras are integer counters by contract (Metrics.extra:
+                # dict[str, int]); anything else would vanish remotely, so
+                # say so instead of a silent local-vs-distributed skew
+                logging.getLogger(__name__).warning(
+                    "dropping non-integer operator metric extra %s=%r (%s)",
+                    k, v, m.get("name", ""))
+        out.metrics.append(mp)
     if r.locations:
         out.map_partition = r.locations[0].map_partition
     return out
@@ -141,7 +154,8 @@ def decode_task_status(p: pb.TaskStatusProto, executor_meta: ExecutorMetadata | 
         state=p.state, locations=locations, error=p.error,
         error_kind=p.error_kind, retryable=p.retryable,
         metrics=[
-            {"name": m.name, "output_rows": m.output_rows, "elapsed_ns": m.elapsed_ns, "depth": m.depth}
+            {"name": m.name, "output_rows": m.output_rows, "elapsed_ns": m.elapsed_ns,
+             "depth": m.depth, **dict(m.extra)}
             for m in p.metrics
         ],
         fetch_failed_executor_id=p.fetch_failed_executor_id,

@@ -235,3 +235,25 @@ def test_concurrent_writers_share_pool_without_unbounded_overcommit(tmp_path):
     assert pool.reserved == 0  # every hold (including overcommit) drained
     # at least one writer had to spill under the shared budget
     assert any((m or {}).get("spill_count", 0) >= 1 for _, _, m in results), results
+
+
+def test_extra_metrics_survive_control_plane_wire():
+    """Operator extras (spilled_bytes, spill_count, tpu counters, ...) must
+    round-trip TaskStatusProto — the distributed path feeding EXPLAIN
+    ANALYZE and the REST percentiles, not just in-process standalone."""
+    from ballista_tpu.executor.executor import TaskResult
+    from ballista_tpu.scheduler.state.executor_manager import ExecutorMetadata
+    from ballista_tpu.serde_control import decode_task_status, encode_task_status
+
+    r = TaskResult(
+        task_id=1, job_id="j", stage_id=2, stage_attempt=0, partitions=[0],
+        state="success",
+        metrics=[{"name": "ShuffleWriterExec: h", "output_rows": 10,
+                  "elapsed_ns": 123, "depth": 0,
+                  "spilled_bytes": 4096, "spill_count": 2}],
+    )
+    meta = ExecutorMetadata(id="e1", host="h", grpc_port=1, flight_port=2)
+    back = decode_task_status(encode_task_status(r, "e1"), meta)
+    (m,) = back.metrics
+    assert m["spilled_bytes"] == 4096 and m["spill_count"] == 2
+    assert m["name"] == "ShuffleWriterExec: h" and m["elapsed_ns"] == 123

@@ -392,3 +392,92 @@ def test_final_stage_distributed_standalone():
     tp, cp = results["tpu"].to_pandas(), results["cpu"].to_pandas()
     assert tp.g.tolist() == cp.g.tolist()
     assert np.allclose(tp.s.values, cp.s.values)
+
+
+def test_declined_final_stage_reuses_materialized_child():
+    """When the final stage declines the device (e.g. merged input below
+    TPU_MIN_ROWS), its CPU fallback must aggregate the child output the
+    device attempt ALREADY materialized — never re-execute the child
+    subtree (which would silently re-scan the whole input on the host:
+    the 100x-overhead bug the round-5 profile pinned). The child device
+    stage must therefore report zero CPU fallbacks."""
+    from ballista_tpu.client.context import SessionContext
+    from ballista_tpu.engine.tpu_engine import maybe_compile_tpu
+    from ballista_tpu.ops.tpu.final_stage import TpuFinalStageExec
+    from ballista_tpu.ops.tpu.stage_compiler import TpuStageExec
+    from ballista_tpu.plan.physical import TaskContext
+
+    rng = np.random.default_rng(7)
+    n = 40000
+    t = pa.table({
+        "g": rng.integers(0, 3, n).astype("int64"),  # 3 groups << min_rows
+        "v": rng.integers(0, 1000, n).astype("int64"),
+    })
+    sql = "SELECT g, sum(v) AS s, count(*) AS c FROM t GROUP BY g ORDER BY g"
+    # min_rows low enough for the 40k-row scan stage to take the device,
+    # high enough that the handful of merged partial rows decline it
+    cfg = BallistaConfig({EXECUTOR_ENGINE: "tpu", TPU_MIN_ROWS: 100})
+    ctx = SessionContext(cfg)
+    ctx.register_arrow_table("t", t, partitions=4)
+    phys = maybe_compile_tpu(ctx.create_physical_plan(ctx.sql(sql).plan), cfg)
+    finals = [nd for nd in _walk(phys) if isinstance(nd, TpuFinalStageExec)]
+    stages = [nd for nd in _walk(phys) if isinstance(nd, TpuStageExec)]
+    assert finals and stages, phys.display()
+    tc = TaskContext(cfg)
+    rows = []
+    for p in range(phys.output_partition_count()):
+        for b in phys.execute(p, tc):
+            rows.extend(b.to_pylist())
+    # the final stage declined (device roundtrip not worth 3 rows) ...
+    assert all(f.tpu_count == 0 and f.fallback_count > 0 for f in finals)
+    # ... and reused the materialized child output instead of re-scanning
+    assert all(f._mat_node is not None for f in finals), \
+        "fallback did not reuse the materialized child tables"
+    assert all(s.fallback_count == 0 for s in stages), \
+        "child stage re-executed on the host after its results were consumed"
+    # correctness against pandas
+    import pandas as pd
+
+    want = (t.to_pandas().groupby("g", as_index=False)
+            .agg(s=("v", "sum"), c=("v", "size")).sort_values("g"))
+    got = pd.DataFrame(rows).sort_values("g")
+    assert got.g.tolist() == want.g.tolist()
+    assert got.s.tolist() == want.s.tolist()
+    assert got.c.tolist() == want.c.tolist()
+
+
+def test_consumed_device_results_rerun_not_host_fallback():
+    """Re-executing a partition whose device result was already consumed
+    re-dispatches the (hot) device path once and serves every partition
+    from it — it must not degrade to a host re-scan of the subtree."""
+    from ballista_tpu.client.context import SessionContext
+    from ballista_tpu.engine.tpu_engine import maybe_compile_tpu
+    from ballista_tpu.ops.tpu.stage_compiler import TpuStageExec
+    from ballista_tpu.plan.physical import TaskContext
+
+    rng = np.random.default_rng(9)
+    n = 30000
+    t = pa.table({
+        "g": rng.integers(0, 8, n).astype("int64"),
+        "v": rng.integers(0, 1000, n).astype("int64"),
+    })
+    sql = "SELECT g, sum(v) AS s FROM t GROUP BY g"
+    cfg = BallistaConfig({EXECUTOR_ENGINE: "tpu", TPU_MIN_ROWS: 0})
+    ctx = SessionContext(cfg)
+    ctx.register_arrow_table("t", t, partitions=3)
+    phys = maybe_compile_tpu(ctx.create_physical_plan(ctx.sql(sql).plan), cfg)
+    stages = [nd for nd in _walk(phys) if isinstance(nd, TpuStageExec)]
+    assert stages
+    st = stages[0]
+    tc = TaskContext(cfg)
+    first = [[b.to_pydict() for b in st.execute(p, tc)]
+             for p in range(st.output_partition_count())]
+    runs_after_first = st.tpu_count
+    assert runs_after_first >= 1 and st.fallback_count == 0
+    # consume AGAIN: one extra device dispatch serves all partitions
+    second = [[b.to_pydict() for b in st.execute(p, tc)]
+              for p in range(st.output_partition_count())]
+    assert st.fallback_count == 0, "consumed re-read degraded to host fallback"
+    assert st.tpu_count == runs_after_first + 1, \
+        "re-read should cost exactly one re-dispatch"
+    assert first == second
