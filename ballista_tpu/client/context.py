@@ -312,7 +312,10 @@ class DataFrame:
             # reference's BallistaQueryPlanner flow
             physical = self.ctx.create_physical_plan(self.plan)
             job_id = scheduler.submit_physical_plan(physical, session_id)
-        status = scheduler.wait_for_job(job_id)
+        from ballista_tpu.config import CLIENT_JOB_TIMEOUT_S
+
+        status = scheduler.wait_for_job(
+            job_id, timeout=float(self.ctx.config.get(CLIENT_JOB_TIMEOUT_S)))
         if status["state"] != "successful":
             raise ExecutionError(f"job {job_id} {status['state']}: {status.get('error', '')}")
         return fetch_job_results(status, self.ctx.config)

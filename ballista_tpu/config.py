@@ -65,6 +65,7 @@ REPARTITION_JOINS = "ballista.repartition.joins"
 REPARTITION_AGGREGATIONS = "ballista.repartition.aggregations"
 PARQUET_PRUNING = "ballista.parquet.pruning"
 EXECUTOR_ENGINE = "ballista.executor.engine"
+EXECUTOR_TASK_ISOLATION = "ballista.executor.task.isolation"
 # TPU-native knobs
 TPU_SHAPE_BUCKETS = "ballista.tpu.shape.buckets"
 TPU_MAX_DEVICE_BYTES = "ballista.tpu.max.device.bytes"
@@ -200,6 +201,16 @@ _ENTRIES: list[ConfigEntry] = [
         "Operator engine for query stages: 'tpu' compiles supported subtrees to "
         "XLA with cpu fallback; 'cpu' is Arrow-native.",
         str, "cpu", choices=("cpu", "tpu"),
+    ),
+    ConfigEntry(
+        EXECUTOR_TASK_ISOLATION,
+        "Task execution mode: 'process' runs each task in a spawned worker "
+        "(true multi-core parallelism, native-crash isolation, preemptive "
+        "cancel — DedicatedExecutor parity); 'thread' runs in-process. A "
+        "session setting 'process' opts its tasks in on any executor; a "
+        "daemon started with --task-isolation process applies it to all "
+        "tasks and cannot be opted out per-session.",
+        str, "thread", choices=("thread", "process"),
     ),
     ConfigEntry(
         TPU_SHAPE_BUCKETS,

@@ -95,6 +95,10 @@ class Executor:
         self.tasks_run = 0
         self.tasks_failed = 0
         self.memory_limit_per_task = 0  # bytes; set by the executor process
+        # "thread" (in-process, shared GIL) or "process" (spawned worker per
+        # task: true parallelism, crash isolation, preemptive cancel —
+        # DedicatedExecutor parity, see process_worker.py)
+        self.isolation = "thread"
         # session-shared pools (runtime_cache.rs:59): set by the executor
         # process once the executor-wide capacity is known
         self.session_pools = None  # SessionPoolRegistry | None
@@ -114,6 +118,31 @@ class Executor:
             return (job_id, stage_id) in self._cancelled
 
     # ------------------------------------------------------------------
+
+    def run_task(self, task: TaskDescription, config: BallistaConfig | None = None) -> TaskResult:
+        """Dispatch honoring the isolation mode: in-thread, or a spawned
+        worker process (DedicatedExecutor parity). A session may OPT IN to
+        process isolation via ballista.executor.task.isolation (strictly
+        safer than threads); it cannot opt a daemon out of it."""
+        cfg = config or self.default_config
+        iso = self.isolation
+        if iso != "process":
+            from ballista_tpu.config import EXECUTOR_TASK_ISOLATION
+
+            iso = str(cfg.get(EXECUTOR_TASK_ISOLATION))
+        if iso == "process":
+            if type(self.engine) is not ExecutionEngine:
+                # a custom engine seam can't be reconstructed in the child;
+                # silently different lowering would be worse than the GIL
+                log.warning(
+                    "task %s/%s: custom ExecutionEngine %s is not available "
+                    "under process isolation; running in-thread",
+                    task.job_id, task.task_id, type(self.engine).__name__)
+            else:
+                from ballista_tpu.executor.process_worker import run_task_in_subprocess
+
+                return run_task_in_subprocess(self, task, cfg)
+        return self.execute_task(task, config)
 
     def execute_task(self, task: TaskDescription, config: BallistaConfig | None = None) -> TaskResult:
         cfg = config or self.default_config

@@ -152,7 +152,7 @@ class ExecutorProcess:
                  memory_pool_bytes: int = 0, memory_fraction: float = 0.6,
                  flight_impl: str = "auto", device_ordinal: int = -1,
                  tls_cert: str | None = None, tls_key: str | None = None,
-                 tls_ca: str | None = None):
+                 tls_ca: str | None = None, task_isolation: str = "thread"):
         self.scheduler_addr = scheduler_addr
         self.work_dir = work_dir or tempfile.mkdtemp(prefix="ballista-tpu-executor-")
         self.policy = policy
@@ -200,6 +200,7 @@ class ExecutorProcess:
             device_ordinal=device_ordinal,
         )
         self.executor = Executor(self.work_dir, self.metadata, config=config)
+        self.executor.isolation = task_isolation
         # per-task static floor (backstop when no session pool is present)
         self.executor.memory_limit_per_task = max(
             64 * 1024 * 1024, self.memory_pool_bytes // max(1, vcores)
@@ -381,6 +382,10 @@ def main(argv=None) -> None:
                     help="CA for verifying the scheduler and requiring client certs (mTLS)")
     ap.add_argument("--flight-server", choices=("auto", "python", "native"), default="auto",
                     help="shuffle data plane: native C++ (preferred), python, or auto-fallback")
+    ap.add_argument("--task-isolation", choices=("thread", "process"), default="thread",
+                    help="process: run each task in a spawned worker — true multi-core "
+                         "parallelism, native-crash isolation, preemptive cancel "
+                         "(DedicatedExecutor parity); thread: in-process (default)")
     ap.add_argument("--device-ordinal", type=int,
                     default=int(os.environ.get("BALLISTA_DEVICE_ORDINAL", "-1")),
                     help="pin this executor to one accelerator chip (one executor per "
@@ -413,6 +418,7 @@ def main(argv=None) -> None:
         memory_pool_bytes=args.memory_pool_bytes, memory_fraction=args.memory_fraction,
         flight_impl=args.flight_server, device_ordinal=args.device_ordinal,
         tls_cert=args.tls_cert, tls_key=args.tls_key, tls_ca=args.tls_ca,
+        task_isolation=args.task_isolation,
     )
     signal.signal(signal.SIGTERM, lambda *_: proc.shutdown())
     proc.start()

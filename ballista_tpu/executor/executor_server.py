@@ -46,7 +46,7 @@ class ExecutorGrpcService:
             except queue.Empty:
                 continue
             task, config = item
-            result = self.executor.execute_task(task, config)
+            result = self.executor.run_task(task, config)
             try:
                 self.status_sender([result])
             except Exception:  # noqa: BLE001

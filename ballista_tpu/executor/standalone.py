@@ -33,11 +33,20 @@ class InProcessTaskLauncher(TaskLauncher):
 
         def run(task: TaskDescription) -> None:
             cfg = server.sessions.get(task.session_id)
-            result = ex.execute_task(task, cfg)
+            result = ex.run_task(task, cfg)
             server.update_task_status(executor_id, [result])
 
         for t in tasks:
             self.pool.submit(run, t)
+
+    def cancel_tasks(self, executor_id: str, job_id: str,
+                     items: list, server: SchedulerServer) -> None:
+        """Propagate CancelTasks to the in-process executor exactly like the
+        daemon rpc does (preemptive for process-isolated tasks)."""
+        ex = self.executors.get(executor_id)
+        if ex is not None:
+            for _task_id, stage_id in items:
+                ex.cancel_task(job_id, stage_id)
 
 
 class StandaloneCluster:
@@ -58,7 +67,12 @@ class StandaloneCluster:
             # engine_factory: the ExecutionEngine extension seam
             # (execution_engine.rs:51) for library embedders
             eng = engine_factory() if engine_factory is not None else None
-            self.executors[meta.id] = Executor(self.work_dir, meta, config=config, engine=eng)
+            ex = Executor(self.work_dir, meta, config=config, engine=eng)
+            if config is not None:
+                from ballista_tpu.config import EXECUTOR_TASK_ISOLATION
+
+                ex.isolation = str(config.get(EXECUTOR_TASK_ISOLATION))
+            self.executors[meta.id] = ex
         self.launcher = InProcessTaskLauncher(self.executors)
         self.scheduler = SchedulerServer(self.launcher)
         self.scheduler.start()

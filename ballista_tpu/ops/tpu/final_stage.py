@@ -62,6 +62,11 @@ _FINAL_COMPILE_CACHE: dict = {}
 _FINAL_COMPILE_LOCK = threading.Lock()
 
 
+def clear_compile_cache() -> None:
+    with _FINAL_COMPILE_LOCK:
+        _FINAL_COMPILE_CACHE.clear()
+
+
 def match_final_stage(node: ExecutionPlan):
     """Match the final-stage shape rooted at `node`; return
     (sort, post_ops top-down, agg, child, coalesce) or None. Conservative:

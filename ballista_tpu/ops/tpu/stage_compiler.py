@@ -237,6 +237,10 @@ class DeviceTableCache:
                 self._inflight.pop(key, None)
             ev.set()
 
+    def clear(self) -> None:
+        with self._lock:
+            self._cache.clear()
+
     def key_of(self, scan) -> tuple:
         if isinstance(scan, ParquetScanExec):
             files = tuple(
@@ -319,6 +323,19 @@ class DeviceTableCache:
 
 
 DEVICE_CACHE = DeviceTableCache()
+
+
+def clear_device_caches() -> None:
+    """Release every module-level device cache: resident tables, compiled
+    entries, string LUTs, and join build tables. Frees HBM (or host RAM
+    under CPU-jax) between unrelated workloads; caches refill on demand."""
+    DEVICE_CACHE.clear()
+    _COMPILE_CACHE.clear()
+    _LUT_CACHE.clear()
+    _BUILD_CACHE.clear()
+    from ballista_tpu.ops.tpu import final_stage
+
+    final_stage.clear_compile_cache()
 
 
 class TpuStageExec(ExecutionPlan):

@@ -140,27 +140,45 @@ def test_pinned8_all22_sf1(q, pinned8_cluster):
     assert not problems, "\n".join(problems)
 
 
-_SF10_REF = None
+SF10_QUERIES = [1, 3, 6, 9]
+_SF10_WANTS: dict = {}
+
+
+@pytest.fixture(scope="module")
+def sf10_wants():
+    """Compute ALL oracle results up front, then FREE the ~30 GB of pandas
+    tables before any engine run: the engine phase (jax-CPU XLA working
+    sets at SF10) and the oracle must never be resident together —
+    their sum OOM-killed the combined run on a 125 GB host."""
+    import gc
+
+    from ballista_tpu.testing.reference import load_tables, run_reference
+
+    if not _SF10_WANTS:
+        tables = load_tables(_dataset(10.0, "sf10"))
+        for q in SF10_QUERIES:
+            _SF10_WANTS[q] = run_reference(q, tables)
+        del tables
+        gc.collect()
+    return _SF10_WANTS
 
 
 @pytest.mark.sf10
-@pytest.mark.parametrize("q", [1, 3, 6, 9])
-def test_sf10_single_query(q):
+@pytest.mark.parametrize("q", SF10_QUERIES)
+def test_sf10_single_query(q, sf10_wants):
     """SF10 leg with the TPU engine (CPU-jax under the conftest pin) and an
     INDEPENDENT pandas oracle — q1/q6 scan-agg plus q3/q9 join+agg, so
     device lowering, shuffle, and spill are all exercised at a scale where
     memory pressure is real (~60M lineitem rows)."""
+    import gc
+
     from ballista_tpu.client.context import SessionContext
     from ballista_tpu.config import CLIENT_JOB_TIMEOUT_S, EXECUTOR_ENGINE, BallistaConfig
-    from ballista_tpu.testing.reference import compare_results, load_tables, run_reference
+    from ballista_tpu.ops.tpu.stage_compiler import clear_device_caches
+    from ballista_tpu.testing.reference import compare_results
     from ballista_tpu.testing.tpchgen import register_tpch
 
     data = _dataset(10.0, "sf10")
-    global _SF10_REF
-    if _SF10_REF is None:
-        _SF10_REF = load_tables(data)
-    want = run_reference(q, _SF10_REF)
-
     ctx = SessionContext.standalone(
         BallistaConfig({EXECUTOR_ENGINE: "tpu", CLIENT_JOB_TIMEOUT_S: 3600}),
         num_executors=2, vcores=4)
@@ -169,5 +187,9 @@ def test_sf10_single_query(q):
         got = ctx.sql(tpch_query(q)).collect()
     finally:
         ctx.shutdown()
-    problems = compare_results(got, want, q)
+        # unbounded per-query state (join build tables, compiled entries)
+        # must not accumulate across the 4 queries on one host
+        clear_device_caches()
+        gc.collect()
+    problems = compare_results(got, sf10_wants[q], q)
     assert not problems, "\n".join(problems)
