@@ -131,7 +131,12 @@ class Executor:
 
             iso = str(cfg.get(EXECUTOR_TASK_ISOLATION))
         if iso == "process":
-            if type(self.engine) is not ExecutionEngine:
+            if str(cfg.get(EXECUTOR_ENGINE)) == "tpu":
+                # a spawned worker would re-claim the (exclusively owned)
+                # chip and rebuild the device caches per task; device
+                # stages stay in-thread where the claim and caches live
+                iso = "thread"
+            elif type(self.engine) is not ExecutionEngine:
                 # a custom engine seam can't be reconstructed in the child;
                 # silently different lowering would be worse than the GIL
                 log.warning(

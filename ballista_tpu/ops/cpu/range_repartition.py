@@ -38,6 +38,26 @@ def _as_float(arr: pa.Array) -> np.ndarray:
     return arr.cast(pa.float64(), safe=False).to_numpy(zero_copy_only=False)
 
 
+def _is_string_key(t: pa.DataType) -> bool:
+    return (pa.types.is_string(t) or pa.types.is_large_string(t)
+            or pa.types.is_dictionary(t))
+
+
+def _key_values(arr: pa.Array) -> np.ndarray:
+    """Sort-key values for digesting/routing: float64 for orderable numeric
+    and temporal types; object-dtype strings (lexicographic, NULL → "")
+    for string keys — a T-Digest cannot hold strings, but exact
+    quantile-position cuts over the dammed batches can."""
+    if _is_string_key(arr.type):
+        if pa.types.is_dictionary(arr.type):
+            arr = arr.cast(arr.type.value_type)
+        vals = arr.to_numpy(zero_copy_only=False)
+        if arr.null_count:
+            vals = np.array(["" if v is None else v for v in vals], dtype=object)
+        return vals
+    return _as_float(arr)
+
+
 class RuntimeStatsExec(ExecutionPlan):
     def __init__(self, input: ExecutionPlan, sort_expr: Optional[Expr] = None):
         super().__init__(input.df_schema)
@@ -72,7 +92,11 @@ class RuntimeStatsExec(ExecutionPlan):
                 with self._lock:
                     self.row_counts[partition] = self.row_counts.get(partition, 0) + b.num_rows
                     if bound is not None:
-                        self.digest.add_array(_as_float(evaluate_to_array(bound, b)))
+                        vals = evaluate_to_array(bound, b)
+                        # string keys can't be digested; the router computes
+                        # exact positional cuts from the dammed batches
+                        if not _is_string_key(vals.type):
+                            self.digest.add_array(_as_float(vals))
             yield b
 
 
@@ -104,6 +128,22 @@ class BufferExec(ExecutionPlan):
                 break
         yield from held
         yield from it
+
+
+def retarget_routers(plan: ExecutionPlan, n: int) -> ExecutionPlan:
+    """Rebuild every UnorderedRangeRepartitionExec in `plan` with `n`
+    buckets. INVARIANT shared by all AQE rewrites that change a stage's
+    task slate (reader coalescing, fan-out shrink): a passthrough task
+    drains exactly its own router bucket, so the router's bucket count
+    must equal the stage's task count or buckets >= that count are routed
+    but never read (silent row loss)."""
+    kids = plan.children()
+    new_kids = [retarget_routers(c, n) for c in kids]
+    if any(a is not b for a, b in zip(new_kids, kids)):
+        plan = plan.with_children(new_kids)
+    if isinstance(plan, UnorderedRangeRepartitionExec) and plan.n != n:
+        plan = UnorderedRangeRepartitionExec(plan.input, plan.key, n)
+    return plan
 
 
 class UnorderedRangeRepartitionExec(ExecutionPlan):
@@ -156,22 +196,40 @@ class UnorderedRangeRepartitionExec(ExecutionPlan):
             for p in range(self.input.output_partition_count()):
                 pending.extend(b for b in self.input.execute(p, ctx) if b.num_rows)
             stats = self._find_stats()
-            if stats is not None and stats.digest.count > 0:
+            # evaluate + convert each batch's key ONCE; reused for cuts
+            # (string path) and routing (object-array conversion is
+            # Python-speed — never run it twice over the data)
+            keyed = [(b, evaluate_to_array(bound, b)) for b in pending]
+            key_vals = [_key_values(arr) for _, arr in keyed]
+            string_key = bool(keyed) and _is_string_key(keyed[0][1].type)
+            if string_key:
+                # exact positional quantile cuts over the dammed NON-NULL
+                # values (nulls reroute to an end bucket below — counting
+                # them here would collapse leading cuts to "" and starve
+                # buckets); lexicographic searchsorted routes
+                nn = [v[~np.asarray(arr.is_null())] if arr.null_count else v
+                      for (_, arr), v in zip(keyed, key_vals)]
+                svals = np.sort(np.concatenate(nn)) if nn else np.zeros(0, dtype=object)
+                cuts = [svals[min(len(svals) - 1, (len(svals) * i) // self.n)]
+                        for i in range(1, self.n)] if len(svals) else []
+            elif stats is not None and stats.digest.count > 0:
                 cuts = stats.digest.quantile_cuts(self.n)
             else:
-                vals = np.concatenate(
-                    [_as_float(evaluate_to_array(bound, b)) for b in pending]
-                ) if pending else np.zeros(0)
+                vals = np.concatenate(key_vals) if key_vals else np.zeros(0)
                 d = TDigest()
                 d.add_array(vals)
                 cuts = d.quantile_cuts(self.n) if len(vals) else []
-            if not self.key.ascending:
-                pass  # cuts ordering handled by bucket assignment below
-            for b in pending:
-                v = _as_float(evaluate_to_array(bound, b))
-                bucket = np.searchsorted(np.array(cuts), v, side="right") if cuts else np.zeros(len(v), dtype=int)
+            cuts_arr = np.array(cuts, dtype=object if string_key else None)
+            for (b, arr), v in zip(keyed, key_vals):
+                bucket = np.searchsorted(cuts_arr, v, side="right") if cuts else np.zeros(len(v), dtype=int)
                 if not self.key.ascending:
                     bucket = (self.n - 1) - bucket
+                if arr.null_count:
+                    # concatenated-range order must equal the sort's null
+                    # placement: nulls to the first or last FINAL bucket
+                    nulls = np.asarray(arr.is_null())
+                    bucket = np.where(
+                        nulls, 0 if self.key.nulls_first else self.n - 1, bucket)
                 for k in np.unique(bucket):
                     sel = np.nonzero(bucket == k)[0]
                     outs[int(k)].append(b.take(pa.array(sel)))

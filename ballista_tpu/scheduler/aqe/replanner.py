@@ -314,7 +314,10 @@ class AlterFanoutRule:
 
         new_out = {stage.stage_id: new_k}
         for pid, c in affected:
-            c.spec.plan = patch(c.spec.plan, pid, new_out[pid])
+            from ballista_tpu.ops.cpu.range_repartition import retarget_routers
+
+            c.spec.plan = retarget_routers(
+                patch(c.spec.plan, pid, new_out[pid]), new_out[pid])
             new_parts = c.spec.plan.input.output_partition_count()
             c.spec.partitions = new_parts
             if c.spec.plan.output_partitions <= 0:

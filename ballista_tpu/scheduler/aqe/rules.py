@@ -139,6 +139,9 @@ def apply_aqe(plan: ExecutionPlan, input_stats: dict[int, InputStageStats],
                 replacements[id(r)] = nr
             plan = _replace_readers(plan, replacements)
             new_parts = len(groups)
+            from ballista_tpu.ops.cpu.range_repartition import retarget_routers
+
+            plan = retarget_routers(plan, new_parts)
             log.info("AQE coalesced %d reduce partitions into %d groups", k, len(groups))
     return plan, new_parts
 

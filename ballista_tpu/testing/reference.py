@@ -19,7 +19,13 @@ import numpy as np
 import pandas as pd
 
 
-def load_tables(data_dir: str) -> dict[str, pd.DataFrame]:
+def load_tables(data_dir: str,
+                columns: dict[str, list[str]] | None = None) -> dict[str, pd.DataFrame]:
+    """Load the 8 TPC-H tables into pandas. `columns` optionally restricts
+    each table to a projection — at SF10 the full tables cost ~40 GB
+    (object-dtype comment strings dominate) and per-query merge
+    intermediates stack on top, so large-scale gates must pass the union
+    of columns their queries actually reference."""
     import glob
     import os
 
@@ -28,7 +34,10 @@ def load_tables(data_dir: str) -> dict[str, pd.DataFrame]:
     out = {}
     for t in ("region", "nation", "supplier", "part", "partsupp", "customer", "orders", "lineitem"):
         files = sorted(glob.glob(os.path.join(data_dir, t, "*.parquet")))
-        df = pd.concat([pq.read_table(f).to_pandas(date_as_object=False) for f in files], ignore_index=True)
+        cols = (columns or {}).get(t)
+        df = pd.concat(
+            [pq.read_table(f, columns=cols).to_pandas(date_as_object=False) for f in files],
+            ignore_index=True)
         out[t] = df
     return out
 

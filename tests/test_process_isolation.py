@@ -131,3 +131,27 @@ def test_preemptive_cancel_terminates_worker(two_tables):
         assert errors and isinstance(errors[0], ExecutionError)
     finally:
         ctx.shutdown()
+
+
+def test_tpu_engine_stays_in_thread(two_tables):
+    """engine=tpu must NOT spawn per-task workers (each would re-claim the
+    exclusively-owned chip and rebuild the device caches): the dispatch
+    quietly stays in-thread and the query still answers correctly."""
+    from unittest import mock
+
+    from ballista_tpu.client.context import SessionContext
+    from ballista_tpu.config import EXECUTOR_ENGINE
+
+    cfg = BallistaConfig({EXECUTOR_TASK_ISOLATION: "process",
+                          EXECUTOR_ENGINE: "tpu"})
+    ctx = SessionContext.standalone(cfg, num_executors=1, vcores=2)
+    try:
+        ctx.register_parquet("t", two_tables[0])
+        with mock.patch(
+                "ballista_tpu.executor.process_worker.run_task_in_subprocess",
+                side_effect=AssertionError("device task must not spawn")) as m:
+            out = ctx.sql("SELECT count(*) AS c FROM t").collect()
+        assert out.column("c").to_pylist() == [20000]
+        assert m.call_count == 0
+    finally:
+        ctx.shutdown()
