@@ -196,31 +196,44 @@ class UnorderedRangeRepartitionExec(ExecutionPlan):
             for p in range(self.input.output_partition_count()):
                 pending.extend(b for b in self.input.execute(p, ctx) if b.num_rows)
             stats = self._find_stats()
-            # evaluate + convert each batch's key ONCE; reused for cuts
-            # (string path) and routing (object-array conversion is
-            # Python-speed — never run it twice over the data)
-            keyed = [(b, evaluate_to_array(bound, b)) for b in pending]
-            key_vals = [_key_values(arr) for _, arr in keyed]
-            string_key = bool(keyed) and _is_string_key(keyed[0][1].type)
+            string_key = bool(pending) and _is_string_key(
+                evaluate_to_array(bound, pending[0]).type)
+            keyed: list[tuple] = []
             if string_key:
-                # exact positional quantile cuts over the dammed NON-NULL
-                # values (nulls reroute to an end bucket below — counting
-                # them here would collapse leading cuts to "" and starve
-                # buckets); lexicographic searchsorted routes
+                # evaluate + convert each batch's key ONCE, reused for cuts
+                # and routing (object-array conversion is Python-speed);
+                # cuts are exact positional quantiles over the NON-NULL
+                # values — nulls reroute to an end bucket below, and
+                # counting them here would collapse leading cuts to "" and
+                # starve buckets. The numeric path stays lazy-per-batch
+                # (no up-front float copies of the whole pending set).
+                keyed = [(b, evaluate_to_array(bound, b)) for b in pending]
+                key_vals = [_key_values(arr) for _, arr in keyed]
                 nn = [v[~np.asarray(arr.is_null())] if arr.null_count else v
                       for (_, arr), v in zip(keyed, key_vals)]
                 svals = np.sort(np.concatenate(nn)) if nn else np.zeros(0, dtype=object)
                 cuts = [svals[min(len(svals) - 1, (len(svals) * i) // self.n)]
                         for i in range(1, self.n)] if len(svals) else []
-            elif stats is not None and stats.digest.count > 0:
-                cuts = stats.digest.quantile_cuts(self.n)
+                routed = zip(keyed, key_vals)
             else:
-                vals = np.concatenate(key_vals) if key_vals else np.zeros(0)
-                d = TDigest()
-                d.add_array(vals)
-                cuts = d.quantile_cuts(self.n) if len(vals) else []
+                if stats is not None and stats.digest.count > 0:
+                    cuts = stats.digest.quantile_cuts(self.n)
+                else:
+                    vals = np.concatenate(
+                        [_as_float(evaluate_to_array(bound, b)) for b in pending]
+                    ) if pending else np.zeros(0)
+                    d = TDigest()
+                    d.add_array(vals)
+                    cuts = d.quantile_cuts(self.n) if len(vals) else []
+
+                def lazy():
+                    for b in pending:
+                        arr = evaluate_to_array(bound, b)
+                        yield (b, arr), _as_float(arr)
+
+                routed = lazy()
             cuts_arr = np.array(cuts, dtype=object if string_key else None)
-            for (b, arr), v in zip(keyed, key_vals):
+            for (b, arr), v in routed:
                 bucket = np.searchsorted(cuts_arr, v, side="right") if cuts else np.zeros(len(v), dtype=int)
                 if not self.key.ascending:
                     bucket = (self.n - 1) - bucket

@@ -65,7 +65,8 @@ button.danger:hover { border-color: var(--err); color: var(--err); }
 .bar i { display: block; height: 6px; border-radius: 3px; background: var(--acc); }
 pre { white-space: pre-wrap; color: var(--dim); margin: 4px 0 0; font-size: 11px; }
 .muted { color: var(--dim); }
-#detail { grid-column: 1 / -1; }
+#detail, #config { grid-column: 1 / -1; }
+.spark { font-weight: 400; letter-spacing: -1px; color: var(--acc); }
 .row { display: flex; gap: 10px; align-items: center; margin-bottom: 8px; }
 </style>
 </head>
@@ -76,7 +77,10 @@ pre { white-space: pre-wrap; color: var(--dim); margin: 4px 0 0; font-size: 11px
   <span class="kv">version <b id="h-ver">–</b></span>
   <span class="kv">executors <b id="h-ex">–</b></span>
   <span class="kv">jobs <b id="h-jobs">–</b></span>
+  <span class="kv">act <b id="spark-act" class="spark">–</b></span>
+  <span class="kv">slots <b id="spark-slots" class="spark">–</b></span>
   <span class="kv"><button id="pause">pause</button></span>
+  <span class="kv"><button id="cfg-btn">config</button></span>
   <span class="kv muted" id="h-upd"></span>
 </header>
 <main>
@@ -90,10 +94,17 @@ pre { white-space: pre-wrap; color: var(--dim); margin: 4px 0 0; font-size: 11px
   <section>
     <h2>Executors</h2>
     <table id="execs"><thead><tr>
-      <th>id</th><th>host</th><th>grpc</th><th>flight</th><th>slots</th><th>seen</th>
+      <th>id</th><th>host</th><th>grpc</th><th>flight</th><th>slots</th><th>dev</th><th>seen</th>
     </tr></thead><tbody></tbody></table>
     <h2 style="margin-top:14px">Scheduler metrics</h2>
     <pre id="prom" class="muted"></pre>
+  </section>
+  <section id="config" hidden>
+    <h2>Scheduler config</h2>
+    <div class="muted" id="cfg-head"></div>
+    <table id="cfg-table"><thead><tr>
+      <th>session config key</th><th>type</th><th>default</th><th>description</th>
+    </tr></thead><tbody></tbody></table>
   </section>
   <section id="detail" hidden>
     <div class="row"><h2 style="margin:0" id="d-title">Job</h2>
@@ -113,6 +124,40 @@ const J = (u) => fetch(u).then(r => { if (!r.ok) throw new Error(u + ": " + r.st
 
 function stBadge(s) { return `<span class="st ${esc(s)}">${esc(s)}</span>`; }
 
+// cluster-history sparklines (the ratatui Sparkline widget analog)
+const SPARK = " ▁▂▃▄▅▆▇█", HWIN = 40, hist = { act: [], slots: [] };
+function sparkline(vals) {
+  const v = vals.slice(-HWIN), hi = Math.max(1, ...v);
+  return v.map(x => SPARK[Math.min(8, 1 + Math.round(x / hi * 7))]).join("");
+}
+function sample(jobs, execs) {
+  hist.act.push(jobs.filter(j => ["running", "queued"].includes(j.state)).length);
+  hist.slots.push(execs.reduce((a, e) => a + (e.total_slots - e.free_slots), 0));
+  for (const k in hist) if (hist[k].length > HWIN) hist[k].shift();
+  $("#spark-act").textContent = sparkline(hist.act) || "–";
+  $("#spark-slots").textContent = sparkline(hist.slots) || "–";
+}
+
+let cfgShown = false;
+async function toggleConfig() {
+  cfgShown = !cfgShown;
+  const el = $("#config");
+  el.hidden = !cfgShown;
+  if (!cfgShown || el.dataset.loaded) return;
+  el.dataset.loaded = "1";  // set BEFORE awaiting: no duplicate fetch/rows
+  const c = await J("/api/config");  // static payload: fetched once
+  $("#cfg-head").textContent =
+    `task-distribution=${c.task_distribution} · executor-timeout=${c.executor_timeout_s}s · ` +
+    `job-state=${c.job_state_backend}`;
+  const tb = $("#cfg-table tbody");
+  for (const e of c.session_config_entries || []) {
+    const tr = document.createElement("tr");
+    tr.innerHTML = `<td>${esc(e.name)}</td><td>${esc(e.type)}</td>` +
+      `<td>${esc(String(e.default))}</td><td class="muted">${esc(e.description)}</td>`;
+    tb.appendChild(tr);
+  }
+}
+
 let busy = false;
 async function refresh() {
   if (paused || busy) return;
@@ -126,6 +171,7 @@ async function refresh() {
     $("#h-jobs").textContent = state.jobs;
     $("#h-upd").textContent = "updated " + new Date().toLocaleTimeString();
     cachedJobs = jobs;
+    sample(jobs, execs);
     renderJobs(jobs);
     renderExecs(execs);
     await renderProm();
@@ -172,8 +218,10 @@ function renderExecs(execs) {
   for (const e of execs) {
     const seen = e.last_seen ? Math.max(0, Date.now() / 1e3 - e.last_seen).toFixed(0) + "s ago" : "";
     const tr = document.createElement("tr");
+    const dev = (e.device_ordinal == null || e.device_ordinal < 0) ? "–" : e.device_ordinal;
     tr.innerHTML = `<td>${esc(e.id)}</td><td>${esc(e.host)}</td><td>${e.grpc_port}</td>` +
-      `<td>${e.flight_port}</td><td>${e.total_slots - e.free_slots}/${e.total_slots}</td><td>${seen}</td>`;
+      `<td>${e.flight_port}</td><td>${e.total_slots - e.free_slots}/${e.total_slots}</td>` +
+      `<td>${dev}</td><td>${seen}</td>`;
     tb.appendChild(tr);
   }
 }
@@ -252,6 +300,7 @@ $("#pause").addEventListener("click", () => {
   paused = !paused;
   $("#pause").textContent = paused ? "resume" : "pause";
 });
+$("#cfg-btn").addEventListener("click", toggleConfig);
 $("#q").addEventListener("input", () => renderJobs(cachedJobs));
 refresh();
 setInterval(refresh, 2000);

@@ -76,9 +76,13 @@ def test_rest_api(grpc_cluster, remote_ctx):
     assert dot.startswith("digraph")
     metrics = urllib.request.urlopen(f"http://127.0.0.1:{port}/api/metrics").read().decode()
     assert "ballista_scheduler_jobs_completed_total" in metrics
-    # web monitor page + its JSON stage-graph endpoint
+    # web monitor page + its JSON stage-graph endpoint; the page embeds the
+    # sparkline/config features backed by /api/config
     page = urllib.request.urlopen(f"http://127.0.0.1:{port}/").read().decode()
     assert "cluster monitor" in page and "/api/jobs" in page
+    assert "spark-act" in page and "toggleConfig" in page
+    cfg = json.load(urllib.request.urlopen(f"http://127.0.0.1:{port}/api/config"))
+    assert cfg["session_config_entries"] and cfg["scheduler_id"]
     graph = json.load(urllib.request.urlopen(f"http://127.0.0.1:{port}/api/job/{job_id}/graph"))
     assert graph["job_id"] == job_id and graph["stages"]
     assert all(len(e) == 2 for e in graph["edges"])
