@@ -72,6 +72,7 @@ TPU_MAX_DEVICE_BYTES = "ballista.tpu.max.device.bytes"
 TPU_HASH_TABLE_LOAD = "ballista.tpu.hash.table.load.factor"
 TPU_ALLOW_F32_MONEY = "ballista.tpu.allow.f32.money"
 TPU_MIN_ROWS = "ballista.tpu.min.rows"
+TPU_BROADCAST_JOIN_ROWS = "ballista.tpu.broadcast.join.threshold.rows"
 TPU_COLLECTIVE_EXCHANGE = "ballista.tpu.collective.exchange"
 TPU_PALLAS = "ballista.tpu.pallas.enabled"
 
@@ -222,6 +223,7 @@ _ENTRIES: list[ConfigEntry] = [
     ConfigEntry(TPU_HASH_TABLE_LOAD, "Open-addressing hash table load factor for device joins/aggs.", float, 0.5, lambda v: 0.0 < v <= 0.9),
     ConfigEntry(TPU_ALLOW_F32_MONEY, "Allow lossy float32 for decimal columns (faster, inexact).", bool, False),
     ConfigEntry(TPU_MIN_ROWS, "Below this many input rows a stage stays on cpu (compile cost dominates).", int, 8192, _nonneg),
+    ConfigEntry(TPU_BROADCAST_JOIN_ROWS, "With engine=tpu: max build-side rows to collect a join build instead of co-partitioning. Device joins probe an HBM-resident sorted build table, so the collect budget is orders of magnitude past the CPU broadcast threshold; a partitioned join hides the chain from the stage compiler entirely.", int, 16_000_000, _nonneg),
     ConfigEntry(
         TPU_PALLAS,
         "Use the fused Pallas masked-group-reduction kernel for float "

@@ -88,6 +88,14 @@ class PhysicalPlanner:
         self.shuffle_partitions = int(self.config.get(DEFAULT_SHUFFLE_PARTITIONS))
         self.target_partitions = int(self.config.get(TARGET_PARTITIONS))
         self.broadcast_rows = int(self.config.get(BROADCAST_JOIN_ROWS_THRESHOLD))
+        if str(self.config.get(EXECUTOR_ENGINE)) == "tpu":
+            # device joins probe an HBM-resident sorted build: the collect
+            # budget scales to HBM, not to the CPU broadcast wire budget —
+            # and only collect-build chains compile into device stages
+            from ballista_tpu.config import TPU_BROADCAST_JOIN_ROWS
+
+            self.broadcast_rows = max(
+                self.broadcast_rows, int(self.config.get(TPU_BROADCAST_JOIN_ROWS)))
 
     def plan(self, logical: LogicalPlan) -> ExecutionPlan:
         return self._plan(logical)

@@ -26,9 +26,34 @@ from ballista_tpu.plan.physical import (
 )
 
 
+def _concretize_dynamic_joins(node: ExecutionPlan) -> ExecutionPlan:
+    """Rewrite every DynamicJoinSelectionExec into its planned HashJoinExec
+    before device compilation. The deferral exists so the CPU engine can
+    promote to a collected broadcast at first-batch time — but a deferred
+    node is opaque to the stage compiler, which silently pushes the whole
+    join chain back to the host (measured round 5: q3/q5/q9/q14/q19 hot
+    ran at ~1x the CPU engine while q1/q6 ran 40-100x). The device join
+    (direct-table gathers against an HBM-resident build) is what the
+    deferral would be deciding toward anyway; subtrees the device rejects
+    still fall back per-subtree, where the CPU join runs as planned."""
+    from ballista_tpu.ops.cpu.dynamic_join import DynamicJoinSelectionExec
+    from ballista_tpu.plan.physical import HashJoinExec
+
+    kids = node.children()
+    new_kids = [_concretize_dynamic_joins(c) for c in kids]
+    if any(a is not b for a, b in zip(new_kids, kids)):
+        node = node.with_children(new_kids)
+    if isinstance(node, DynamicJoinSelectionExec):
+        node = HashJoinExec(node.left, node.right, node.on, node.join_type,
+                            node.filter, node.mode, node.df_schema)
+    return node
+
+
 def maybe_compile_tpu(physical: ExecutionPlan, config: BallistaConfig) -> ExecutionPlan:
     from ballista_tpu.ops.tpu.final_stage import TpuFinalStageExec, match_final_stage
     from ballista_tpu.ops.tpu.stage_compiler import TpuStageExec
+
+    physical = _concretize_dynamic_joins(physical)
 
     def walk(node: ExecutionPlan) -> ExecutionPlan:
         fs = match_final_stage(node)

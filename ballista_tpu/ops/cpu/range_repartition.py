@@ -215,16 +215,10 @@ class UnorderedRangeRepartitionExec(ExecutionPlan):
                 cuts = [svals[min(len(svals) - 1, (len(svals) * i) // self.n)]
                         for i in range(1, self.n)] if len(svals) else []
                 routed = zip(keyed, key_vals)
-            else:
-                if stats is not None and stats.digest.count > 0:
-                    cuts = stats.digest.quantile_cuts(self.n)
-                else:
-                    vals = np.concatenate(
-                        [_as_float(evaluate_to_array(bound, b)) for b in pending]
-                    ) if pending else np.zeros(0)
-                    d = TDigest()
-                    d.add_array(vals)
-                    cuts = d.quantile_cuts(self.n) if len(vals) else []
+            elif stats is not None and stats.digest.count > 0:
+                # cuts come from the tap's digest: route lazily per batch,
+                # no up-front float copy of the whole pending set
+                cuts = stats.digest.quantile_cuts(self.n)
 
                 def lazy():
                     for b in pending:
@@ -232,6 +226,16 @@ class UnorderedRangeRepartitionExec(ExecutionPlan):
                         yield (b, arr), _as_float(arr)
 
                 routed = lazy()
+            else:
+                # no digest: the cuts need every value anyway — evaluate
+                # each batch ONCE and reuse the arrays for routing
+                keyed = [(b, evaluate_to_array(bound, b)) for b in pending]
+                key_vals = [_as_float(arr) for _, arr in keyed]
+                vals = np.concatenate(key_vals) if key_vals else np.zeros(0)
+                d = TDigest()
+                d.add_array(vals)
+                cuts = d.quantile_cuts(self.n) if len(vals) else []
+                routed = zip(keyed, key_vals)
             cuts_arr = np.array(cuts, dtype=object if string_key else None)
             for (b, arr), v in routed:
                 bucket = np.searchsorted(cuts_arr, v, side="right") if cuts else np.zeros(len(v), dtype=int)

@@ -145,7 +145,9 @@ async function toggleConfig() {
   el.hidden = !cfgShown;
   if (!cfgShown || el.dataset.loaded) return;
   el.dataset.loaded = "1";  // set BEFORE awaiting: no duplicate fetch/rows
-  const c = await J("/api/config");  // static payload: fetched once
+  let c;
+  try { c = await J("/api/config"); }  // static payload: fetched once
+  catch (e) { delete el.dataset.loaded; $("#cfg-head").textContent = "config fetch failed: " + e.message; return; }
   $("#cfg-head").textContent =
     `task-distribution=${c.task_distribution} · executor-timeout=${c.executor_timeout_s}s · ` +
     `job-state=${c.job_state_backend}`;

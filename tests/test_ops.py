@@ -76,6 +76,22 @@ def test_config_docs_generation():
     assert "ballista.tpu.shape.buckets" in docs
 
 
+def test_config_docs_file_is_fresh():
+    """docs-as-code means the COMMITTED file tracks the registry — the
+    generator only returns a string, so nothing else catches drift."""
+    import os
+
+    from ballista_tpu.config import generate_config_docs
+
+    path = os.path.join(os.path.dirname(__file__), "..", "docs", "configs.md")
+    with open(path) as f:
+        on_disk = f.read()
+    assert on_disk == generate_config_docs(), (
+        "docs/configs.md is stale; regenerate with "
+        "python -c \"from ballista_tpu.config import generate_config_docs; "
+        "open('docs/configs.md','w').write(generate_config_docs())\"")
+
+
 def test_hash_nullable_columns_match_clean_columns():
     """Wire contract under nulls: a nullable column's VALID slots must hash
     identically to the same values in a null-free column (and to the native
