@@ -155,3 +155,63 @@ def test_tpu_engine_stays_in_thread(two_tables):
         assert m.call_count == 0
     finally:
         ctx.shutdown()
+
+
+def test_daemon_flag_process_isolation_over_grpc(tmp_path):
+    """The --task-isolation process daemon flag, end-to-end over a real
+    gRPC cluster: a crashing UDF fails retryably while the daemon keeps
+    serving, and a healthy query follows — the standalone tests above
+    can't see the argparse wiring or the gRPC status path."""
+    import os
+    import subprocess
+    import sys
+
+    from ballista_tpu.client.context import SessionContext
+    from ballista_tpu.errors import ExecutionError
+    from ballista_tpu.scheduler.process import SchedulerProcess
+    from ballista_tpu.testing.udf_fixtures import hard_crash
+
+    sched = SchedulerProcess(bind_host="127.0.0.1", port=0, rest_port=-1)
+    sched.start()
+    addr = f"127.0.0.1:{sched.port}"
+    work = str(tmp_path / "exproc")
+    os.makedirs(work, exist_ok=True)
+    stderr_path = os.path.join(work, "daemon.stderr")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    stderr_f = open(stderr_path, "wb")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "ballista_tpu.executor",
+         "--scheduler", addr, "--bind-host", "127.0.0.1",
+         "--external-host", "127.0.0.1", "--concurrent-tasks", "2",
+         "--task-isolation", "process", "--work-dir", work,
+         "--flight-server", "python", "--log-level", "WARNING"],
+        env=env, stdout=subprocess.DEVNULL, stderr=stderr_f)
+    try:
+        deadline = time.time() + 60
+        while time.time() < deadline and not sched.scheduler.executors.alive_executors():
+            assert proc.poll() is None, open(stderr_path).read()[-2000:]
+            time.sleep(0.3)
+        assert sched.scheduler.executors.alive_executors()
+
+        d = tmp_path / "t"
+        d.mkdir()
+        pq.write_table(pa.table({"x": list(range(5000))}), str(d / "p.parquet"))
+        ctx = SessionContext.remote(addr, BallistaConfig())
+        ctx.register_parquet("t", str(d))
+        ctx.register_udf("hard_crash", hard_crash, pa.int64())
+        with pytest.raises(ExecutionError) as ei:
+            ctx.sql("SELECT sum(hard_crash(x)) FROM t").collect()
+        assert "worker died" in str(ei.value)
+        assert proc.poll() is None, "daemon died with the worker"
+        out = ctx.sql("SELECT count(*) AS c FROM t").collect()
+        assert out.column("c").to_pylist() == [5000]
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+        stderr_f.close()
+        sched.shutdown()
