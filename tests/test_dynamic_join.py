@@ -287,3 +287,57 @@ def test_resolution_with_stats_concretizes():
     want = _collect(_dyn_join(_partitioned(a), _partitioned(b), "inner")).to_pandas(
     ).sort_values(["a_k", "a_v", "c_k", "c_v"]).reset_index(drop=True)
     assert got.equals(want)
+
+
+def test_tpu_engine_raises_collect_budget():
+    """engine=tpu plans joins with the HBM-scale collect budget
+    (ballista.tpu.broadcast.join.threshold.rows): a build side far past
+    the CPU broadcast-rows threshold still plans as a collect build —
+    the only shape the device stage compiler takes — while engine=cpu
+    defers the same join for runtime selection."""
+    import numpy as np
+    import pyarrow as pa
+
+    from ballista_tpu.client.context import SessionContext
+    from ballista_tpu.config import EXECUTOR_ENGINE, BallistaConfig
+    from ballista_tpu.ops.cpu.dynamic_join import DynamicJoinSelectionExec
+    from ballista_tpu.plan.physical import HashJoinExec
+    from ballista_tpu.plan.provider import MemoryTable, TableStats
+
+    class BigStats(MemoryTable):
+        def __init__(self, batches, schema=None, partitions=1, rows=0):
+            super().__init__(batches, schema, partitions)
+            self._rows = rows
+
+        def statistics(self):
+            return TableStats(num_rows=self._rows, total_bytes=self._rows * 64)
+
+    build = pa.table({"k": np.arange(100, dtype="int64"), "v": np.arange(100.0)})
+    probe = pa.table({"k": np.arange(100, dtype="int64"), "w": np.arange(100.0)})
+    sql = "SELECT sum(w + v) AS s FROM p JOIN b ON p.k = b.k"
+
+    def plan_with(engine):
+        ctx = SessionContext(BallistaConfig({EXECUTOR_ENGINE: engine}))
+        # build 5M rows: past the CPU 1M-row broadcast cap, well under the
+        # 16M tpu collect budget; probe 40M keeps the build side the build
+        ctx.register_table("b", BigStats(build.to_batches(), build.schema,
+                                         partitions=4, rows=5_000_000))
+        ctx.register_table("p", BigStats(probe.to_batches(), probe.schema,
+                                         partitions=4, rows=40_000_000))
+        phys = ctx.create_physical_plan(ctx.sql(sql).plan)
+
+        def walk(n):
+            yield n
+            for c in n.children():
+                yield from walk(c)
+        return list(walk(phys))
+
+    tpu_nodes = plan_with("tpu")
+    joins = [n for n in tpu_nodes if isinstance(n, HashJoinExec)]
+    assert joins and all(j.mode == "collect_left" for j in joins), \
+        [n.node_str() for n in tpu_nodes]
+    assert not any(isinstance(n, DynamicJoinSelectionExec) for n in tpu_nodes)
+
+    cpu_nodes = plan_with("cpu")
+    assert any(isinstance(n, DynamicJoinSelectionExec) for n in cpu_nodes), \
+        [n.node_str() for n in cpu_nodes]

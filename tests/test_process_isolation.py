@@ -173,33 +173,38 @@ def test_daemon_flag_process_isolation_over_grpc(tmp_path):
 
     sched = SchedulerProcess(bind_host="127.0.0.1", port=0, rest_port=-1)
     sched.start()
-    addr = f"127.0.0.1:{sched.port}"
-    work = str(tmp_path / "exproc")
-    os.makedirs(work, exist_ok=True)
-    stderr_path = os.path.join(work, "daemon.stderr")
-    env = dict(os.environ, JAX_PLATFORMS="cpu")
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
-    stderr_f = open(stderr_path, "wb")
-    proc = subprocess.Popen(
-        [sys.executable, "-m", "ballista_tpu.executor",
-         "--scheduler", addr, "--bind-host", "127.0.0.1",
-         "--external-host", "127.0.0.1", "--concurrent-tasks", "2",
-         "--task-isolation", "process", "--work-dir", work,
-         "--flight-server", "python", "--log-level", "WARNING"],
-        env=env, stdout=subprocess.DEVNULL, stderr=stderr_f)
+    proc = None
+    stderr_f = None
     try:
+        addr = f"127.0.0.1:{sched.port}"
+        work = str(tmp_path / "exproc")
+        os.makedirs(work, exist_ok=True)
+        stderr_path = os.path.join(work, "daemon.stderr")
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+        stderr_f = open(stderr_path, "wb")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ballista_tpu.executor",
+             "--scheduler", addr, "--bind-host", "127.0.0.1",
+             "--external-host", "127.0.0.1", "--concurrent-tasks", "2",
+             "--task-isolation", "process", "--work-dir", work,
+             "--flight-server", "python", "--log-level", "WARNING"],
+            env=env, stdout=subprocess.DEVNULL, stderr=stderr_f)
+
+        def stderr_tail() -> str:
+            with open(stderr_path, "rb") as f:
+                return f.read()[-2000:].decode(errors="replace")
+
         deadline = time.time() + 60
         while time.time() < deadline and not sched.scheduler.executors.alive_executors():
-            assert proc.poll() is None, open(stderr_path).read()[-2000:]
+            assert proc.poll() is None, stderr_tail()
             time.sleep(0.3)
         assert sched.scheduler.executors.alive_executors()
 
-        d = tmp_path / "t"
-        d.mkdir()
-        pq.write_table(pa.table({"x": list(range(5000))}), str(d / "p.parquet"))
+        path = _write_table(tmp_path, "t", pa.table({"x": list(range(5000))}))
         ctx = SessionContext.remote(addr, BallistaConfig())
-        ctx.register_parquet("t", str(d))
+        ctx.register_parquet("t", path)
         ctx.register_udf("hard_crash", hard_crash, pa.int64())
         with pytest.raises(ExecutionError) as ei:
             ctx.sql("SELECT sum(hard_crash(x)) FROM t").collect()
@@ -208,10 +213,12 @@ def test_daemon_flag_process_isolation_over_grpc(tmp_path):
         out = ctx.sql("SELECT count(*) AS c FROM t").collect()
         assert out.column("c").to_pylist() == [5000]
     finally:
-        proc.terminate()
-        try:
-            proc.wait(timeout=10)
-        except subprocess.TimeoutExpired:
-            proc.kill()
-        stderr_f.close()
+        if proc is not None:
+            proc.terminate()
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        if stderr_f is not None:
+            stderr_f.close()
         sched.shutdown()
