@@ -48,3 +48,10 @@ def tpch_query(n: int) -> str:
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     with open(os.path.join(root, "benchmarks", "tpch", "queries", f"q{n}.sql")) as f:
         return f.read()
+
+
+def iter_plan(node):
+    """Depth-first walk of a physical plan (shared by plan-shape tests)."""
+    yield node
+    for c in node.children():
+        yield from iter_plan(c)

@@ -324,13 +324,9 @@ def test_tpu_engine_raises_collect_budget():
                                          partitions=4, rows=5_000_000))
         ctx.register_table("p", BigStats(probe.to_batches(), probe.schema,
                                          partitions=4, rows=40_000_000))
-        phys = ctx.create_physical_plan(ctx.sql(sql).plan)
+        from .conftest import iter_plan
 
-        def walk(n):
-            yield n
-            for c in n.children():
-                yield from walk(c)
-        return list(walk(phys))
+        return list(iter_plan(ctx.create_physical_plan(ctx.sql(sql).plan)))
 
     tpu_nodes = plan_with("tpu")
     joins = [n for n in tpu_nodes if isinstance(n, HashJoinExec)]

@@ -169,12 +169,10 @@ def test_aqe_fanout_shrink_rewrites_range_router():
         phys = ctx.create_physical_plan(ctx.sql(sql).plan)
         from ballista_tpu.ops.cpu.range_repartition import UnorderedRangeRepartitionExec
 
-        def walk(nd):
-            yield nd
-            for c in nd.children():
-                yield from walk(c)
-        assert any(isinstance(nd, UnorderedRangeRepartitionExec) for nd in walk(phys)), \
-            phys.display()
+        from .conftest import iter_plan
+
+        assert any(isinstance(nd, UnorderedRangeRepartitionExec)
+                   for nd in iter_plan(phys)), phys.display()
         got = ctx.sql(sql).collect().to_pandas()
     finally:
         ctx.shutdown()
