@@ -248,14 +248,20 @@ _SAFE_ENV = frozenset({
 })
 
 
+RELAY_DATA_PORTS = (8082, 8092, 8102)  # the loopback relay's listener set
+
+
 def relay_probe() -> dict:
-    """Preflight the axon loopback relay: env summary + TCP connect."""
+    """Preflight the axon loopback relay: env summary + TCP connects to the
+    harness port AND the relay's own data listeners — a dead relay (ports
+    refusing) is an ENVIRONMENT failure the artifact must name, because the
+    plugin's claim loop shows it only as an endless poll."""
     env = {}
     for k, v in os.environ.items():
         if k.startswith(("PALLAS_AXON", "AXON_", "TPU_", "JAX_PLATFORMS")):
             env[k] = v if k in _SAFE_ENV else f"<set, {len(v)} chars>"
     probe: dict = {"env": env}
-    for port in (2024,):
+    for port in (2024,) + RELAY_DATA_PORTS:
         s = socket.socket()
         s.settimeout(3)
         try:
@@ -265,6 +271,12 @@ def relay_probe() -> dict:
             probe[f"relay_tcp_{port}"] = f"FAIL: {e}"
         finally:
             s.close()
+    # only meaningful in loopback-relay mode: with direct pool access these
+    # ports are legitimately closed and say nothing about the environment
+    probe["relay_listeners_down"] = (
+        os.environ.get("AXON_LOOPBACK_RELAY") == "1"
+        and all(str(probe.get(f"relay_tcp_{p}", "")).startswith("FAIL")
+                for p in RELAY_DATA_PORTS))
     return probe
 
 
@@ -508,10 +520,16 @@ def main() -> None:
             if now > deadline:
                 pool.autopsy_all("deadline")
                 stage = events[-1]["event"] if events else "no progress at all"
+                relay_now = relay_probe()
+                relay_note = (
+                    " RELAY DOWN: the loopback relay's data listeners refuse "
+                    "connections — the tunnel process is dead, this is an "
+                    "environment failure, not an engine one."
+                    if relay_now.get("relay_listeners_down") else "")
                 device_error = (
                     f"device leg(s) produced no result in {round(now - T0)}s "
-                    f"(budget {DEVICE_LEG_TIMEOUT}s); last progress: {stage}; "
-                    f"crashes: {pool.errors[-2:]}")
+                    f"(budget {DEVICE_LEG_TIMEOUT}s); last progress: {stage};"
+                    f"{relay_note} crashes: {pool.errors[-2:]}")
                 log(device_error)
                 break
             time.sleep(2.0)
