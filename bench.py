@@ -261,7 +261,8 @@ def relay_probe() -> dict:
         if k.startswith(("PALLAS_AXON", "AXON_", "TPU_", "JAX_PLATFORMS")):
             env[k] = v if k in _SAFE_ENV else f"<set, {len(v)} chars>"
     probe: dict = {"env": env}
-    for port in (2024,) + RELAY_DATA_PORTS:
+    relay_mode = os.environ.get("AXON_LOOPBACK_RELAY") == "1"
+    for port in (2024,) + (RELAY_DATA_PORTS if relay_mode else ()):
         s = socket.socket()
         s.settimeout(3)
         try:
@@ -272,11 +273,11 @@ def relay_probe() -> dict:
         finally:
             s.close()
     # only meaningful in loopback-relay mode: with direct pool access these
-    # ports are legitimately closed and say nothing about the environment
-    probe["relay_listeners_down"] = (
-        os.environ.get("AXON_LOOPBACK_RELAY") == "1"
-        and all(str(probe.get(f"relay_tcp_{p}", "")).startswith("FAIL")
-                for p in RELAY_DATA_PORTS))
+    # ports are legitimately closed (and not probed) — they say nothing
+    # about the environment there
+    probe["relay_listeners_down"] = relay_mode and all(
+        str(probe.get(f"relay_tcp_{p}", "")).startswith("FAIL")
+        for p in RELAY_DATA_PORTS)
     return probe
 
 

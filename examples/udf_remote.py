@@ -22,8 +22,40 @@ from ballista_tpu.testing.udf_fixtures import double_it, shout
 addr = sys.argv[1] if len(sys.argv) > 1 else "localhost:50050"
 pq.write_table(pa.table({"x": [5, 6], "s": ["hey", "yo"]}), "/tmp/udf_demo.parquet")
 
+# no cluster at `addr`? start a demo scheduler + executor in-process so the
+# example runs out of the box (the documented daemons take precedence)
+import socket
+
+host, _, port = addr.partition(":")
+try:
+    socket.create_connection((host, int(port or "50050")), timeout=1).close()
+except (OSError, ValueError):
+    from ballista_tpu.executor.executor_process import ExecutorProcess
+    from ballista_tpu.scheduler.process import SchedulerProcess
+
+    print(f"no scheduler at {addr}; starting a demo cluster in-process")
+    _sched = SchedulerProcess(bind_host="127.0.0.1", port=0, rest_port=-1)
+    _sched.start()
+    addr = f"127.0.0.1:{_sched.port}"
+    _ex = ExecutorProcess(addr, bind_host="127.0.0.1", external_host="127.0.0.1", vcores=2)
+    _ex.start()
+    def _cleanup():
+        import contextlib
+
+        with contextlib.suppress(Exception):
+            _ex.shutdown()
+        with contextlib.suppress(Exception):
+            _sched.shutdown()
+
+    # run while the interpreter is still healthy: daemon teardown during
+    # interpreter exit races thread-pool shutdown and prints noise
+    demo_cleanup = _cleanup
+
 ctx = SessionContext.remote(addr)
 ctx.register_parquet("t", "/tmp/udf_demo.parquet")
 ctx.register_udf("double_it", double_it, pa.int64())
 ctx.register_udf("shout", shout, pa.string())
 print(ctx.sql("select double_it(x) d, shout(s) u from t order by d").collect().to_pandas())
+
+if "demo_cleanup" in dir():
+    demo_cleanup()
