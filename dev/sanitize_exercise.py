@@ -74,9 +74,11 @@ def exercise_flight() -> None:
     bin_path = os.path.join(ROOT, "native", "sanitize", f"ballista-flight-server-{MODE}")
     env = dict(os.environ)
     env.pop("LD_PRELOAD", None)  # the server's sanitizer runtime is linked in
+    stderr_path = os.path.join(work, "server.stderr")
+    stderr_f = open(stderr_path, "wb")
     proc = subprocess.Popen(
         [bin_path, "--host", "127.0.0.1", "--port", "0", "--work-dir", work],
-        stdout=subprocess.PIPE, text=True, env=env,
+        stdout=subprocess.PIPE, stderr=stderr_f, text=True, env=env,
     )
     try:
         line = proc.stdout.readline().strip()
@@ -105,9 +107,29 @@ def exercise_flight() -> None:
         c.close()
     finally:
         proc.terminate()
-        rc = proc.wait(timeout=15)
-    # a sanitizer report makes the server exit non-zero (or abort)
-    assert rc in (0, -15), f"sanitized flight server exited {rc} (sanitizer report?)"
+        try:
+            # TSAN teardown (shadow cleanup + report symbolization) can take
+            # tens of seconds on one loaded core
+            rc = proc.wait(timeout=90)
+        except subprocess.TimeoutExpired:
+            if MODE != "tsan":
+                raise  # only TSAN teardown legitimately stalls this long
+            # a TSAN-instrumented gRPC server can wedge in its own shutdown
+            # path when starved; the exercise itself already completed, so
+            # kill and judge the run by its REPORT OUTPUT below, not exit
+            print("(tsan server ignored SIGTERM for 90s; killing)")
+            proc.kill()
+            rc = proc.wait(timeout=30)
+        stderr_f.close()
+    # reports are the ground truth (a killed server never reaches the
+    # sanitizer's exitcode path): scan captured stderr, then check rc —
+    # SIGTERM (-15) / post-timeout SIGKILL (-9) are clean-shutdown outcomes
+    with open(stderr_path, "rb") as f:
+        err = f.read().decode(errors="replace")
+    for marker in ("WARNING: ThreadSanitizer", "ERROR: AddressSanitizer",
+                   "runtime error:"):
+        assert marker not in err, f"sanitizer report:\n{err[-4000:]}"
+    assert rc in (0, -15, -9), f"sanitized flight server exited {rc}:\n{err[-2000:]}"
     # TSAN exits with TSAN_OPTIONS exitcode=66 on an unsuppressed report
     print("flight server: ok")
 
