@@ -58,6 +58,16 @@ CHAOS_ENABLED = "ballista.chaos.enabled"
 CHAOS_SEED = "ballista.chaos.seed"
 CHAOS_PROBABILITY = "ballista.chaos.probability"
 CHAOS_MODE = "ballista.chaos.mode"
+CHAOS_STRAGGLER_DELAY_S = "ballista.chaos.straggler.delay.seconds"
+CHAOS_STRAGGLER_PARTITION = "ballista.chaos.straggler.partition"
+CHAOS_STRAGGLER_STAGE = "ballista.chaos.straggler.stage"
+# straggler defense (speculation / deadlines)
+SPECULATION_ENABLED = "ballista.scheduler.speculation.enabled"
+SPECULATION_QUANTILE = "ballista.scheduler.speculation.quantile"
+SPECULATION_MULTIPLIER = "ballista.scheduler.speculation.multiplier"
+SPECULATION_MIN_RUNTIME_S = "ballista.scheduler.speculation.min.runtime.seconds"
+TASK_DEADLINE_S = "ballista.scheduler.task.deadline.seconds"
+TASK_DEADLINE_MULTIPLIER = "ballista.scheduler.task.deadline.multiplier"
 COLLECT_STATISTICS = "ballista.collect_statistics"
 TARGET_PARTITIONS = "ballista.target.partitions"
 BATCH_SIZE = "ballista.batch.size"
@@ -189,7 +199,68 @@ _ENTRIES: list[ConfigEntry] = [
     ConfigEntry(CHAOS_PROBABILITY, "Per-task fault probability.", float, 0.05, lambda v: 0.0 <= v <= 1.0),
     ConfigEntry(
         CHAOS_MODE, "Fault kind to inject.", str, "transient",
-        choices=("transient", "fatal", "panic", "delay"),
+        choices=("transient", "fatal", "panic", "delay", "straggler"),
+    ),
+    ConfigEntry(
+        CHAOS_STRAGGLER_DELAY_S,
+        "chaos mode=straggler: seconds the straggling partition sleeps before "
+        "producing its batches (first task attempt only, so a speculative or "
+        "retried attempt escapes the injected delay).",
+        float, 5.0, _nonneg,
+    ),
+    ConfigEntry(
+        CHAOS_STRAGGLER_PARTITION,
+        "chaos mode=straggler: partition index to delay deterministically "
+        "(-1 = pick by seeded per-partition roll against the chaos probability).",
+        int, -1, lambda v: v >= -1,
+    ),
+    ConfigEntry(
+        CHAOS_STRAGGLER_STAGE,
+        "chaos mode=straggler: restrict injection to this stage id (-1 = every "
+        "stage). Partition indices repeat across stages — a shuffle reader in a "
+        "single-task final stage drives the same indices the scan did — so "
+        "tests that need exactly one straggling task pin the stage too.",
+        int, -1, lambda v: v >= -1,
+    ),
+    ConfigEntry(
+        SPECULATION_ENABLED,
+        "Launch duplicate attempts of a stage's slowest running tasks once the "
+        "stage is mostly complete; the first attempt to finish wins and the "
+        "loser is cancelled.",
+        bool, True,
+    ),
+    ConfigEntry(
+        SPECULATION_QUANTILE,
+        "Fraction of a stage's tasks that must have finished before its "
+        "remaining running tasks become speculation candidates.",
+        float, 0.75, lambda v: 0.0 < v <= 1.0,
+    ),
+    ConfigEntry(
+        SPECULATION_MULTIPLIER,
+        "A running task is speculated when its elapsed runtime exceeds this "
+        "multiple of the stage's median completed-task duration.",
+        float, 1.5, _pos,
+    ),
+    ConfigEntry(
+        SPECULATION_MIN_RUNTIME_S,
+        "Never speculate a task running for less than this many seconds "
+        "(guards against duplicating short tasks on noisy timings).",
+        float, 1.0, _nonneg,
+    ),
+    ConfigEntry(
+        TASK_DEADLINE_S,
+        "Hard per-task deadline floor in seconds (0 = no deadline). The "
+        "effective deadline is max(this, multiplier x observed median stage "
+        "task duration); the executor aborts the attempt at the deadline and "
+        "reports a retryable timeout.",
+        float, 0.0, _nonneg,
+    ),
+    ConfigEntry(
+        TASK_DEADLINE_MULTIPLIER,
+        "Adaptive deadline: multiple of the stage's median completed-task "
+        "duration allowed before a running task is timed out (only once "
+        "enough samples exist; 0 disables the adaptive part).",
+        float, 0.0, _nonneg,
     ),
     ConfigEntry(COLLECT_STATISTICS, "Collect table statistics at registration.", bool, True),
     ConfigEntry(TARGET_PARTITIONS, "Planner parallelism target (scan partitioning).", int, 8, _pos),
@@ -210,7 +281,10 @@ _ENTRIES: list[ConfigEntry] = [
         "cancel — DedicatedExecutor parity); 'thread' runs in-process. A "
         "session setting 'process' opts its tasks in on any executor; a "
         "daemon started with --task-isolation process applies it to all "
-        "tasks and cannot be opted out per-session.",
+        "tasks and cannot be opted out per-session. Exception: with "
+        "engine=tpu tasks always run in-thread (the spawned worker cannot "
+        "share the parent's TPU runtime), and the executor logs a warning "
+        "when that downgrades a forced 'process' setting.",
         str, "thread", choices=("thread", "process"),
     ),
     ConfigEntry(

@@ -91,7 +91,10 @@ class PhysicalPlanner:
         if str(self.config.get(EXECUTOR_ENGINE)) == "tpu":
             # device joins probe an HBM-resident sorted build: the collect
             # budget scales to HBM, not to the CPU broadcast wire budget —
-            # and only collect-build chains compile into device stages
+            # and only collect-build chains compile into device stages.
+            # If the device stage is later DECLINED, the oversized
+            # collect_left runs on the host; HashJoinExec._build_table
+            # warns when the built table exceeds the CPU rows threshold
             from ballista_tpu.config import TPU_BROADCAST_JOIN_ROWS
 
             self.broadcast_rows = max(

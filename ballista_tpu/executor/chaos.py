@@ -24,32 +24,42 @@ from ballista_tpu.config import (
     CHAOS_MODE,
     CHAOS_PROBABILITY,
     CHAOS_SEED,
+    CHAOS_STRAGGLER_DELAY_S,
+    CHAOS_STRAGGLER_PARTITION,
+    CHAOS_STRAGGLER_STAGE,
     BallistaConfig,
 )
-from ballista_tpu.errors import ExecutionError
+from ballista_tpu.errors import Cancelled, ExecutionError
 from ballista_tpu.plan.physical import ExecutionPlan, TaskContext
 
 
 class ChaosExec(ExecutionPlan):
     def __init__(self, input: ExecutionPlan, seed: int, probability: float, mode: str,
-                 stage_attempt: int = 0):
+                 stage_attempt: int = 0, straggler_delay_s: float = 5.0,
+                 straggler_partition: int = -1):
         super().__init__(input.df_schema)
         self.input = input
         self.seed = seed
         self.probability = probability
         self.mode = mode
         self.stage_attempt = stage_attempt
+        self.straggler_delay_s = straggler_delay_s
+        self.straggler_partition = straggler_partition
 
     def children(self):
         return [self.input]
 
     def with_children(self, c):
-        return ChaosExec(c[0], self.seed, self.probability, self.mode, self.stage_attempt)
+        return ChaosExec(c[0], self.seed, self.probability, self.mode, self.stage_attempt,
+                         self.straggler_delay_s, self.straggler_partition)
 
     def node_str(self) -> str:
         return f"ChaosExec: mode={self.mode} p={self.probability}"
 
     def execute(self, partition: int, ctx: TaskContext) -> Iterator:
+        if self.mode == "straggler":
+            self._maybe_straggle(partition, ctx)
+            return self.input.execute(partition, ctx)
         h = hashlib.sha256(
             f"{self.seed}|{ctx.task_id}|{partition}|{self.stage_attempt}".encode()
         ).digest()
@@ -65,6 +75,35 @@ class ChaosExec(ExecutionPlan):
                 time.sleep(0.2)
         return self.input.execute(partition, ctx)
 
+    def _maybe_straggle(self, partition: int, ctx: TaskContext) -> None:
+        """Deterministic slow-partition injection: the roll is keyed on the
+        PARTITION alone (task ids differ across attempts/schedulers, so
+        mixing them in would make 'which partition straggles' a lottery),
+        and only attempt 0 straggles — a speculative duplicate of the same
+        partition must be able to win."""
+        if getattr(ctx, "task_attempt", 0) != 0:
+            return
+        if self.straggler_partition >= 0:
+            hit = partition == self.straggler_partition
+        else:
+            h = hashlib.sha256(f"{self.seed}|straggler|{partition}".encode()).digest()
+            hit = int.from_bytes(h[:8], "big") / 2**64 < self.probability
+        if not hit:
+            return
+        # sleep in small increments so a CancelTasks push (speculation's
+        # loser-kill) or the task deadline preempts the straggler mid-nap
+        deadline_at = float(getattr(ctx, "deadline_at", 0.0) or 0.0)
+        cancel_check = getattr(ctx, "cancel_check", None)
+        end = time.time() + self.straggler_delay_s
+        while time.time() < end:
+            if cancel_check is not None and cancel_check():
+                raise Cancelled("chaos: straggler cancelled mid-delay")
+            if deadline_at and time.time() > deadline_at:
+                err = ExecutionError("chaos: straggler exceeded task deadline", retryable=True)
+                err.timed_out = True
+                raise err
+            time.sleep(min(0.05, max(0.0, end - time.time())))
+
 
 def maybe_inject_chaos(plan: ExecutionPlan, config: BallistaConfig, stage_attempt: int = 0) -> ExecutionPlan:
     if not bool(config.get(CHAOS_ENABLED)):
@@ -72,11 +111,19 @@ def maybe_inject_chaos(plan: ExecutionPlan, config: BallistaConfig, stage_attemp
     seed = int(config.get(CHAOS_SEED))
     prob = float(config.get(CHAOS_PROBABILITY))
     mode = str(config.get(CHAOS_MODE))
+    delay_s = float(config.get(CHAOS_STRAGGLER_DELAY_S))
+    straggler_part = int(config.get(CHAOS_STRAGGLER_PARTITION))
+    straggler_stage = int(config.get(CHAOS_STRAGGLER_STAGE))
+    if mode == "straggler" and straggler_stage >= 0:
+        # stage roots are ShuffleWriterExecs carrying their stage id; leave
+        # other stages' plans untouched so the straggle fires exactly once
+        if getattr(plan, "stage_id", -1) != straggler_stage:
+            return plan
 
     def walk(n: ExecutionPlan) -> ExecutionPlan:
         kids = n.children()
         if not kids:
-            return ChaosExec(n, seed, prob, mode, stage_attempt)
+            return ChaosExec(n, seed, prob, mode, stage_attempt, delay_s, straggler_part)
         return n.with_children([walk(c) for c in kids])
 
     return walk(plan)

@@ -64,6 +64,8 @@ class TaskResult:
     # ResultLost identity when a shuffle fetch failed
     fetch_failed_executor_id: str = ""
     fetch_failed_stage_id: int = 0
+    # the failure was a per-task deadline expiry (feeds quarantine scoring)
+    timed_out: bool = False
 
 
 class ExecutionEngine:
@@ -90,7 +92,10 @@ class Executor:
         self.metadata = metadata or ExecutorMetadata(id=new_executor_id())
         self.engine = engine or ExecutionEngine()
         self.default_config = config or BallistaConfig()
-        self._cancelled: set[tuple[str, int]] = set()
+        # (job_id, stage_id, task_id); task_id -1 cancels the whole stage.
+        # Task granularity matters for speculation: cancelling the LOSING
+        # attempt must not kill its sibling tasks on the same stage.
+        self._cancelled: set[tuple[str, int, int]] = set()
         self._lock = threading.Lock()
         self.tasks_run = 0
         self.tasks_failed = 0
@@ -102,20 +107,25 @@ class Executor:
         # session-shared pools (runtime_cache.rs:59): set by the executor
         # process once the executor-wide capacity is known
         self.session_pools = None  # SessionPoolRegistry | None
+        self._warned_tpu_downgrade = False
+        # process-isolated tasks currently inflight (spill budget is split
+        # across them; see process_worker.run_task_in_subprocess)
+        self.active_process_tasks = 0
 
     # ------------------------------------------------------------------
 
-    def cancel_task(self, job_id: str, stage_id: int) -> None:
+    def cancel_task(self, job_id: str, stage_id: int, task_id: int | None = None) -> None:
         with self._lock:
-            self._cancelled.add((job_id, stage_id))
+            self._cancelled.add((job_id, stage_id, -1 if task_id is None else task_id))
 
     def clear_cancellations(self, job_id: str) -> None:
         with self._lock:
             self._cancelled = {c for c in self._cancelled if c[0] != job_id}
 
-    def _is_cancelled(self, job_id: str, stage_id: int) -> bool:
+    def _is_cancelled(self, job_id: str, stage_id: int, task_id: int = -1) -> bool:
         with self._lock:
-            return (job_id, stage_id) in self._cancelled
+            return ((job_id, stage_id, -1) in self._cancelled
+                    or (task_id != -1 and (job_id, stage_id, task_id) in self._cancelled))
 
     # ------------------------------------------------------------------
 
@@ -135,6 +145,15 @@ class Executor:
                 # a spawned worker would re-claim the (exclusively owned)
                 # chip and rebuild the device caches per task; device
                 # stages stay in-thread where the claim and caches live
+                if self.isolation == "process" and not self._warned_tpu_downgrade:
+                    # daemon-forced isolation being silently weakened is an
+                    # operator surprise; say it loudly, once per executor
+                    self._warned_tpu_downgrade = True
+                    log.warning(
+                        "daemon-forced --task-isolation process is downgraded to "
+                        "in-thread for engine=tpu tasks (the spawned worker cannot "
+                        "share the parent's TPU runtime); crash isolation and "
+                        "preemptive cancel do NOT apply to device stages")
                 iso = "thread"
             elif type(self.engine) is not ExecutionEngine:
                 # a custom engine seam can't be reconstructed in the child;
@@ -166,16 +185,35 @@ class Executor:
             task_id=task.task_id, job_id=task.job_id, stage_id=task.stage_id,
             stage_attempt=task.stage_attempt, partitions=list(task.partitions), state="failed",
         )
+        start = time.time()
+        deadline = float(getattr(task, "deadline_seconds", 0.0) or 0.0)
+        deadline_at = start + deadline if deadline > 0 else 0.0
         try:
             plan = task.plan
             assert isinstance(plan, ShuffleWriterExec), f"stage root must be a shuffle writer: {plan}"
             prepared = self.engine.create_query_stage_exec(plan, cfg, task.stage_attempt)
             locations: list[PartitionLocation] = []
             for p in task.partitions:
-                if self._is_cancelled(task.job_id, task.stage_id):
+                if self._is_cancelled(task.job_id, task.stage_id, task.task_id):
                     raise Cancelled(f"task {task.task_id} cancelled")
+                if deadline_at and time.time() > deadline_at:
+                    self.tasks_failed += 1
+                    base.error = (f"task {task.task_id} exceeded its {deadline:.1f}s deadline "
+                                  f"after {time.time() - start:.1f}s")
+                    base.error_kind = "ExecutionError"
+                    base.retryable = True
+                    base.timed_out = True
+                    log.warning("task %s/%s timed out: %s", task.job_id, task.task_id, base.error)
+                    return base
                 ctx = TaskContext(cfg, task_id=f"{task.task_id}", work_dir=self.work_dir)
                 ctx.device_ordinal = self.metadata.device_ordinal
+                ctx.task_attempt = int(getattr(task, "task_attempt", 0))
+                ctx.deadline_at = deadline_at
+                # long-running operators (and chaos stragglers) poll this so
+                # a CancelTasks push preempts mid-partition, not between
+                ctx.cancel_check = (
+                    lambda j=task.job_id, s=task.stage_id, t=task.task_id: self._is_cancelled(j, s, t)
+                )
                 if self.session_pools is not None:
                     # concurrent tasks of one session share the pool: idle
                     # tasks lend spill budget to a heavy sort (try_grow)
@@ -205,6 +243,7 @@ class Executor:
             base.error = f"{type(e).__name__}: {e}\n{traceback.format_exc(limit=8)}"
             base.error_kind = error_to_proto_kind(e)
             base.retryable = bool(getattr(e, "retryable", False))
+            base.timed_out = bool(getattr(e, "timed_out", False))
             if isinstance(e, FetchFailed):
                 base.fetch_failed_executor_id = e.executor_id
                 base.fetch_failed_stage_id = e.stage_id

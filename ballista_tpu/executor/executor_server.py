@@ -91,7 +91,7 @@ class ExecutorGrpcService:
 
     def CancelTasks(self, request: pb.CancelTasksParams, context) -> pb.CancelTasksResult:
         for t in request.tasks:
-            self.executor.cancel_task(t.job_id, t.stage_id)
+            self.executor.cancel_task(t.job_id, t.stage_id, t.task_id)
         return pb.CancelTasksResult(cancelled=True)
 
     def RemoveJobData(self, request: pb.RemoveJobDataParams, context) -> pb.RemoveJobDataResult:

@@ -33,10 +33,23 @@ from __future__ import annotations
 
 import logging
 import multiprocessing as mp
+import time as _time
 
 log = logging.getLogger(__name__)
 
 CANCEL_POLL_S = 0.2
+# slack past the task deadline before the parent SIGTERMs the worker: the
+# child checks its own deadline cooperatively and reports a cleaner status;
+# the parent kill is the backstop for workers wedged in native code
+DEADLINE_GRACE_S = 1.0
+
+
+def _kill_child(child) -> None:
+    child.terminate()
+    child.join(timeout=5)
+    if child.is_alive():
+        child.kill()
+        child.join(timeout=5)
 
 
 def _child_main(conn, task_bytes: bytes, config_pairs: list, meta_fields: tuple,
@@ -103,42 +116,69 @@ def run_task_in_subprocess(executor, task, cfg):
     ctx = mp.get_context("spawn")
     rx, tx = ctx.Pipe(duplex=False)
     meta = executor.metadata
-    child = ctx.Process(
-        target=_child_main,
-        args=(tx, task_bytes, cfg.to_key_value_pairs(),
-              (meta.id, meta.host, meta.flight_port, meta.device_ordinal),
-              executor.work_dir, executor.memory_limit_per_task),
-        daemon=True, name=f"task-{task.job_id}-{task.task_id}",
-    )
-    child.start()
-    tx.close()
-    payload = None
-    while True:
-        if rx.poll(CANCEL_POLL_S):
-            try:
-                payload = rx.recv_bytes()
-            except EOFError:
-                pass  # child died before reporting
-            break
-        if executor._is_cancelled(task.job_id, task.stage_id):
-            child.terminate()
-            child.join(timeout=5)
-            if child.is_alive():
-                child.kill()
-                child.join(timeout=5)
-            base.state = "cancelled"
-            base.error = f"task {task.task_id} cancelled (worker terminated)"
-            return base
-        if not child.is_alive():
-            # drain any result raced in between poll and death
-            if rx.poll(0):
+    with executor._lock:
+        executor.active_process_tasks += 1
+        active = executor.active_process_tasks
+    # the session spill pool is EXECUTOR-wide; N concurrent isolated workers
+    # must split it, not each claim the full in-thread budget (which would
+    # let them reserve N× the executor's memory between them)
+    if executor.session_pools is not None:
+        child_budget = max(1, executor.session_pools.capacity // max(1, active))
+        if executor.memory_limit_per_task:
+            child_budget = min(child_budget, executor.memory_limit_per_task)
+    else:
+        child_budget = executor.memory_limit_per_task
+    deadline = float(getattr(task, "deadline_seconds", 0.0) or 0.0)
+    started = _time.time()
+    try:
+        child = ctx.Process(
+            target=_child_main,
+            args=(tx, task_bytes, cfg.to_key_value_pairs(),
+                  (meta.id, meta.host, meta.flight_port, meta.device_ordinal),
+                  executor.work_dir, child_budget),
+            daemon=True, name=f"task-{task.job_id}-{task.task_id}",
+        )
+        child.start()
+        tx.close()
+        payload = None
+        while True:
+            if rx.poll(CANCEL_POLL_S):
                 try:
                     payload = rx.recv_bytes()
                 except EOFError:
-                    pass
-            break
-    child.join(timeout=10)
-    rx.close()
+                    pass  # child died before reporting
+                break
+            if executor._is_cancelled(task.job_id, task.stage_id, task.task_id):
+                _kill_child(child)
+                base.state = "cancelled"
+                base.error = f"task {task.task_id} cancelled (worker terminated)"
+                return base
+            if deadline > 0 and _time.time() - started > deadline + DEADLINE_GRACE_S:
+                # preemptive deadline enforcement: the child may be wedged in
+                # native code where cooperative checkpoints never run
+                _kill_child(child)
+                executor.tasks_failed += 1
+                base.error = (f"task {task.task_id} exceeded its {deadline:.1f}s "
+                              f"deadline (worker terminated after "
+                              f"{_time.time() - started:.1f}s)")
+                base.error_kind = "ExecutionError"
+                base.retryable = True
+                base.timed_out = True
+                log.warning("task %s/%s timed out: %s", task.job_id, task.task_id, base.error)
+                return base
+            if not child.is_alive():
+                # drain any result raced in between poll and death
+                if rx.poll(0):
+                    try:
+                        payload = rx.recv_bytes()
+                    except EOFError:
+                        pass
+                break
+        child.join(timeout=10)
+        rx.close()
+    finally:
+        with executor._lock:
+            executor.active_process_tasks -= 1
     if payload is None:
         executor.tasks_failed += 1
         base.error = (f"task worker died without a status "
@@ -158,6 +198,7 @@ def run_task_in_subprocess(executor, task, cfg):
     base.error = result.error
     base.error_kind = result.error_kind
     base.retryable = result.retryable
+    base.timed_out = result.timed_out
     base.fetch_failed_executor_id = result.fetch_failed_executor_id
     base.fetch_failed_stage_id = result.fetch_failed_stage_id
     base.metrics = result.metrics

@@ -45,8 +45,8 @@ class InProcessTaskLauncher(TaskLauncher):
         daemon rpc does (preemptive for process-isolated tasks)."""
         ex = self.executors.get(executor_id)
         if ex is not None:
-            for _task_id, stage_id in items:
-                ex.cancel_task(job_id, stage_id)
+            for task_id, stage_id in items:
+                ex.cancel_task(job_id, stage_id, task_id)
 
 
 class StandaloneCluster:

@@ -14,6 +14,7 @@ replaces with shuffle writer/reader pairs at stage boundaries
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from dataclasses import dataclass, field
@@ -31,6 +32,8 @@ from ballista_tpu.ops.hashing import partition_indices
 from ballista_tpu.ops.phys_expr import bind_expr, evaluate_to_array
 from ballista_tpu.plan.expressions import Expr, SortKey
 from ballista_tpu.plan.schema import DFSchema
+
+log = logging.getLogger(__name__)
 
 
 class Metrics:
@@ -63,6 +66,13 @@ class TaskContext:
         # per-chip pinning: jax device ordinal this task must dispatch to
         # (-1 = unpinned); set by Executor.execute_task from its metadata
         self.device_ordinal = -1
+        # straggler-defense plumbing, set by Executor.execute_task:
+        # which attempt of the task this is (speculative duplicates > 0),
+        # a callable polled by long-running operators to honor preemptive
+        # cancels, and the absolute wall-clock deadline (0.0 = none)
+        self.task_attempt = 0
+        self.cancel_check = None
+        self.deadline_at = 0.0
 
 
 class ExecutionPlan:
@@ -721,6 +731,20 @@ class HashJoinExec(ExecutionPlan):
         else:
             batches = [b for b in self.left.execute(partition, ctx) if b.num_rows]
         tbl = _concat(batches, self.left.schema()).combine_chunks()
+        if self.mode == "collect_left":
+            # a collect_left planned under the tpu engine's HBM-scaled
+            # threshold can land here when the device stage is declined —
+            # EVERY probe task then collects this table into host memory.
+            # The cliff is survivable but must not be silent.
+            from ballista_tpu.config import BROADCAST_JOIN_ROWS_THRESHOLD
+            cpu_threshold = int(ctx.config.get(BROADCAST_JOIN_ROWS_THRESHOLD))
+            if tbl.num_rows > cpu_threshold:
+                log.warning(
+                    "collect_left join build side has %d rows, exceeding the CPU "
+                    "broadcast threshold of %d (%s); this join was likely planned "
+                    "for a device stage that fell back to host execution — every "
+                    "probe task materializes the full build table in host memory",
+                    tbl.num_rows, cpu_threshold, BROADCAST_JOIN_ROWS_THRESHOLD)
         with self._lock:
             self._build_cache[key] = tbl
         return tbl

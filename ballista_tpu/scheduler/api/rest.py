@@ -87,11 +87,13 @@ def start_rest_api(scheduler: SchedulerServer, metrics: InMemoryMetricsCollector
                     "version": BALLISTA_VERSION,
                     "scheduler_id": scheduler.scheduler_id,
                     "executors": len(scheduler.executors.alive_executors()),
+                    "quarantined_executors": scheduler.executors.quarantined_count(),
                     "jobs": jobs,
                     "flight_proxy_port": getattr(scheduler, "flight_proxy_port", 0),
                 })
             if p == "/api/executors":
                 out = []
+                health = scheduler.executors.health_snapshot()
                 for e in scheduler.executors.alive_executors():
                     out.append({
                         "id": e.metadata.id, "host": e.metadata.host,
@@ -99,6 +101,7 @@ def start_rest_api(scheduler: SchedulerServer, metrics: InMemoryMetricsCollector
                         "total_slots": e.total_slots, "free_slots": e.free_slots,
                         "last_seen": e.last_seen,
                         "device_ordinal": e.metadata.device_ordinal,
+                        **health.get(e.metadata.id, {}),
                     })
                 return self._json(out)
             if p == "/api/jobs":

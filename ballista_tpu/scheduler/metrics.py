@@ -28,6 +28,12 @@ class SchedulerMetricsCollector:
 
     def record_protocol_mismatch(self) -> None: ...
 
+    def record_speculative_launched(self, job_id: str, stage_id: int) -> None: ...
+
+    def record_task_timeout(self, executor_id: str) -> None: ...
+
+    def set_quarantined_executors(self, n: int) -> None: ...
+
 
 class NoopMetricsCollector(SchedulerMetricsCollector):
     pass
@@ -74,6 +80,9 @@ class InMemoryMetricsCollector(SchedulerMetricsCollector):
         self.cancelled = 0
         self.protocol_mismatches = 0
         self.pending_tasks = 0
+        self.speculative_launched = 0
+        self.task_timeouts = 0
+        self.quarantined_executors = 0
         self.exec_hist = _Histogram(_LATENCY_BUCKETS)
         self.plan_hist = _Histogram(_PLANNING_BUCKETS)
 
@@ -106,6 +115,18 @@ class InMemoryMetricsCollector(SchedulerMetricsCollector):
         with self._lock:
             self.protocol_mismatches += 1
 
+    def record_speculative_launched(self, job_id: str, stage_id: int) -> None:
+        with self._lock:
+            self.speculative_launched += 1
+
+    def record_task_timeout(self, executor_id: str) -> None:
+        with self._lock:
+            self.task_timeouts += 1
+
+    def set_quarantined_executors(self, n: int) -> None:
+        with self._lock:
+            self.quarantined_executors = n
+
     def render_prometheus(self) -> str:
         with self._lock:
             lines = []
@@ -115,10 +136,13 @@ class InMemoryMetricsCollector(SchedulerMetricsCollector):
                 ("ballista_scheduler_jobs_failed_total", self.failed, "Jobs failed"),
                 ("ballista_scheduler_jobs_cancelled_total", self.cancelled, "Jobs cancelled"),
                 ("ballista_scheduler_protocol_mismatch_total", self.protocol_mismatches, "Executor wire-version mismatches"),
+                ("ballista_scheduler_speculative_tasks_total", self.speculative_launched, "Speculative task attempts launched"),
+                ("ballista_scheduler_task_timeouts_total", self.task_timeouts, "Tasks expired past their deadline"),
                 ("ballista_scheduler_pending_tasks", self.pending_tasks, "Pending task gauge"),
+                ("ballista_scheduler_quarantined_executors", self.quarantined_executors, "Executors in quarantine/probation"),
             ]:
                 lines.append(f"# HELP {name} {help_}")
-                kind = "gauge" if name.endswith("pending_tasks") else "counter"
+                kind = "gauge" if name.endswith(("pending_tasks", "quarantined_executors")) else "counter"
                 lines.append(f"# TYPE {name} {kind}")
                 lines.append(f"{name} {v}")
             lines.extend(self.exec_hist.render(

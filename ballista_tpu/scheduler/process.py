@@ -92,7 +92,11 @@ class SchedulerProcess:
                  job_state_dir: str | None = None, scheduler_id: str = "scheduler-0",
                  force_recover: bool = False,
                  tls_cert: str | None = None, tls_key: str | None = None,
-                 tls_client_ca: str | None = None):
+                 tls_client_ca: str | None = None,
+                 quarantine_threshold: float = 0.5,
+                 quarantine_min_events: float = 4.0,
+                 health_half_life_s: float = 60.0,
+                 probe_backoff_s: float = 10.0):
         self.metrics = InMemoryMetricsCollector()
         job_state = None
         if job_state_dir:
@@ -116,6 +120,10 @@ class SchedulerProcess:
         self.scheduler = SchedulerServer(
             GrpcTaskLauncher(launcher_tls), self.metrics, task_distribution, executor_timeout_s,
             scheduler_id=scheduler_id, job_state=job_state,
+            quarantine_threshold=quarantine_threshold,
+            quarantine_min_events=quarantine_min_events,
+            health_half_life_s=health_half_life_s,
+            probe_backoff_s=probe_backoff_s,
         )
         from ballista_tpu.utils.grpc_util import server_options
 
@@ -216,6 +224,15 @@ def main(argv=None) -> None:
     ap.add_argument("--task-distribution", choices=("bias", "round-robin", "consistent-hash"),
                     default="bias")
     ap.add_argument("--executor-timeout-seconds", type=float, default=180.0)
+    ap.add_argument("--quarantine-threshold", type=float, default=0.5,
+                    help="decayed failure rate at which an executor stops receiving "
+                         "offers (0 disables quarantine)")
+    ap.add_argument("--quarantine-min-events", type=float, default=4.0,
+                    help="minimum decayed task outcomes before the threshold applies")
+    ap.add_argument("--health-half-life-seconds", type=float, default=60.0,
+                    help="half-life of the decayed per-executor failure/success counters")
+    ap.add_argument("--probe-backoff-seconds", type=float, default=10.0,
+                    help="how long a quarantined executor waits before a probe task")
     ap.add_argument("--log-level", default="INFO")
     ap.add_argument("--log-file", default=None, help="also log to this file (rotating)")
     ap.add_argument("--log-rotation", choices=("never", "minutely", "hourly", "daily"),
@@ -232,6 +249,10 @@ def main(argv=None) -> None:
         job_state_dir=args.job_state_dir, scheduler_id=args.scheduler_id,
         force_recover=args.force_recover,
         tls_cert=args.tls_cert, tls_key=args.tls_key, tls_client_ca=args.tls_client_ca,
+        quarantine_threshold=args.quarantine_threshold,
+        quarantine_min_events=args.quarantine_min_events,
+        health_half_life_s=args.health_half_life_seconds,
+        probe_backoff_s=args.probe_backoff_seconds,
     )
     signal.signal(signal.SIGTERM, lambda *_: proc.shutdown())
     proc.start()

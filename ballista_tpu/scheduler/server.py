@@ -72,11 +72,23 @@ class SchedulerServer:
                  task_distribution: str = "bias",
                  executor_timeout_s: float = 180.0,
                  scheduler_id: str = "scheduler-0",
-                 job_state=None):
+                 job_state=None,
+                 quarantine_threshold: float = 0.5,
+                 quarantine_min_events: float = 4.0,
+                 health_half_life_s: float = 60.0,
+                 probe_backoff_s: float = 10.0,
+                 sweep_interval_s: float = 0.5):
         from ballista_tpu.scheduler.state.job_state import InMemoryJobState
 
         self.scheduler_id = scheduler_id
-        self.executors = ExecutorManager(task_distribution, executor_timeout_s)
+        self.executors = ExecutorManager(
+            task_distribution, executor_timeout_s,
+            quarantine_threshold=quarantine_threshold,
+            quarantine_min_events=quarantine_min_events,
+            health_half_life_s=health_half_life_s,
+            probe_backoff_s=probe_backoff_s,
+        )
+        self.sweep_interval_s = sweep_interval_s
         self.sessions = SessionManager()
         self.jobs: dict[str, ExecutionGraph] = {}
         self.job_state = job_state or InMemoryJobState()
@@ -95,6 +107,18 @@ class SchedulerServer:
         self._running = True
         self._loop_thread = threading.Thread(target=self._event_loop, daemon=True, name="scheduler-events")
         self._loop_thread.start()
+        if self.sweep_interval_s > 0:
+            threading.Thread(target=self._sweep_timer, daemon=True, name="straggler-sweep").start()
+
+    def _sweep_timer(self) -> None:
+        """Periodic straggler sweep: deadline expiry, speculative launches,
+        quarantine probes. Posted as an event so all graph mutation stays on
+        the single event loop."""
+        while self._running:
+            time.sleep(self.sweep_interval_s)
+            if not self._running:
+                return
+            self.post(Event("sweep"))
 
     def stop(self) -> None:
         self._running = False
@@ -133,6 +157,8 @@ class SchedulerServer:
             self._offer_reservation()
         elif ev.kind == "cancel":
             self._cancel_job(ev.payload)
+        elif ev.kind == "sweep":
+            self._sweep_stragglers()
 
     # -- job submission --------------------------------------------------------
 
@@ -216,6 +242,7 @@ class SchedulerServer:
         demand = sum(g.available_task_count() for g in running)
         if demand == 0:
             return
+        self._offer_probes(running)
         if self.executors.task_distribution == "consistent-hash":
             self._offer_consistent(running)
             return
@@ -235,6 +262,23 @@ class SchedulerServer:
                 self.executors.free_slot(executor_id, unused)
             if tasks:
                 self._spawn_launch(executor_id, tasks)
+
+    def _offer_probes(self, running: list) -> None:
+        """Bind ONE real task to each quarantined executor whose probe
+        backoff elapsed; its outcome decides re-admission vs re-quarantine."""
+        for executor_id, _count in self.executors.probe_reservations():
+            probe: list[TaskDescription] = []
+            for g in running:
+                t = g.pop_next_task(executor_id)
+                if t is not None:
+                    probe.append(t)
+                    break
+            if probe:
+                log.info("probing quarantined executor %s with task %d", executor_id, probe[0].task_id)
+                self._spawn_launch(executor_id, probe)
+            else:
+                # nothing to bind: cancel_probe returns the slot itself
+                self.executors.cancel_probe(executor_id)
 
     def _offer_consistent(self, running: list) -> None:
         """Consistent-hash binding: each task's (job, stage, partition)
@@ -305,6 +349,19 @@ class SchedulerServer:
         for r in results:
             if free_slots_managed:
                 self.executors.free_slot(executor_id, 1)
+            timed_out = bool(getattr(r, "timed_out", False))
+            # cancelled tasks say nothing about executor health; success and
+            # failure (incl. timeout) feed the decayed quarantine score
+            if r.state in ("success", "failed"):
+                if timed_out:
+                    self.metrics.record_task_timeout(executor_id)
+                transition = self.executors.record_task_result(
+                    executor_id, ok=(r.state == "success"), timed_out=timed_out)
+                if transition is not None:
+                    log.warning("executor %s %s (failure_rate over window: %s)",
+                                executor_id, transition,
+                                self.executors.health_snapshot().get(executor_id, {}).get("failure_rate"))
+                    self.metrics.set_quarantined_executors(self.executors.quarantined_count())
             with self._jobs_lock:
                 g = self.jobs.get(r.job_id)
             if g is None:
@@ -313,6 +370,7 @@ class SchedulerServer:
                 r.task_id, r.stage_id, r.stage_attempt, r.state, r.partitions,
                 r.locations, r.error, r.retryable, r.metrics,
                 r.fetch_failed_executor_id, r.fetch_failed_stage_id,
+                timed_out=timed_out,
             )
             if events:
                 # checkpoint the graph at every stage/terminal transition:
@@ -347,6 +405,50 @@ class SchedulerServer:
                     log.warning("CancelTasks to %s failed: %s", executor_id, e)
 
         threading.Thread(target=run, daemon=True, name="cancel-push").start()
+
+    # -- straggler defense -------------------------------------------------------------
+
+    def _sweep_stragglers(self) -> None:
+        """Event-loop sweep: (1) expire tasks past deadline+grace (backstop
+        for executors too wedged to self-report the timeout), (2) launch
+        speculative duplicates of a nearly-done stage's slowest tasks on a
+        DIFFERENT executor, (3) re-offer when quarantine probes come due."""
+        now = time.time()
+        with self._jobs_lock:
+            running = [g for g in self.jobs.values() if g.status is JobState.RUNNING]
+        for g in running:
+            expired, job_failed = g.expire_overdue_tasks(now)
+            if expired:
+                for executor_id, task_id, stage_id in expired:
+                    log.warning("task %d of %s/%d on %s expired past deadline",
+                                task_id, g.job_id, stage_id, executor_id)
+                    self.executors.free_slot(executor_id, 1)
+                    self.metrics.record_task_timeout(executor_id)
+                    self.executors.record_task_result(executor_id, ok=False, timed_out=True)
+                self._push_cancellations(g)
+                if job_failed:
+                    self.job_state.save_graph(g)
+                    self.metrics.record_failed(g.job_id)
+                    self._notify(g.job_id)
+                else:
+                    self.post(Event("revive"))  # expired partitions re-pended
+            if self.launcher is None:
+                continue  # speculation is push-only; pull executors can't be targeted
+            for stage_id, task_id, victim in g.speculation_candidates(now):
+                executor_id = self.executors.reserve_one_avoiding({victim})
+                if executor_id is None:
+                    break  # no healthy slot anywhere else; retry next sweep
+                task = g.register_speculative(stage_id, task_id, executor_id)
+                if task is None:
+                    self.executors.free_slot(executor_id, 1)
+                    continue
+                log.info("speculative attempt %d of %s/%d task %d → %s (straggling on %s)",
+                         task.task_id, g.job_id, stage_id, task_id, executor_id, victim)
+                self.metrics.record_speculative_launched(g.job_id, stage_id)
+                self._spawn_launch(executor_id, [task])
+        if self.executors.probes_due():
+            self._offer_reservation()
+        self.metrics.set_quarantined_executors(self.executors.quarantined_count())
 
     # -- executor lifecycle -----------------------------------------------------------
 

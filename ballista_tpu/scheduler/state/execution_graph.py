@@ -61,6 +61,12 @@ class TaskDescription:
     partitions: list[int]
     plan: object  # ExecutionPlan (stage plan with resolved readers)
     session_id: str
+    # 0 = original attempt; >0 = speculative duplicate of another task
+    # covering the same partition slice
+    task_attempt: int = 0
+    # hard wall-clock budget (seconds, 0 = none); the executor aborts at
+    # the deadline and reports a retryable timeout
+    deadline_seconds: float = 0.0
 
 
 @dataclass
@@ -69,6 +75,11 @@ class RunningTask:
     partitions: list[int]
     executor_id: str
     launched_at: float = field(default_factory=time.time)
+    task_attempt: int = 0
+    deadline_seconds: float = 0.0
+    # the OTHER in-flight attempt of the same slice (original ↔ speculative);
+    # first success wins and queues the rival for CancelTasks
+    rival_task_id: int | None = None
 
 
 class ExecutionStage:
@@ -86,6 +97,16 @@ class ExecutionStage:
         self.failure_reasons: set[str] = set()
         self.task_failures = 0
         self.skipped = False  # completed by AQE pruning, never scheduled
+        # wall-clock durations of this attempt's completed tasks — the
+        # sample the speculation trigger and adaptive deadlines derive
+        # their median from
+        self.task_durations: list[float] = []
+        # partition → failed/expired attempts so far: a relaunched slice
+        # carries task_attempt = prior attempts, letting the executor side
+        # distinguish a retry from a first run (chaos straggler mode only
+        # delays attempt 0 — a retry must be able to escape the injected
+        # fault, same as a speculative duplicate)
+        self.retry_counts: dict[int, int] = {}
 
     @property
     def is_runnable(self) -> bool:
@@ -100,6 +121,8 @@ class ExecutionStage:
         self.effective_partitions = self.spec.partitions
         self.running.clear()
         self.completed.clear()
+        self.task_durations = []
+        self.retry_counts = {}
         self.state = StageState.UNRESOLVED if self.spec.input_stage_ids else StageState.RESOLVED
         if not self.spec.input_stage_ids:
             self.resolved_plan = self.spec.plan
@@ -168,6 +191,8 @@ class ExecutionGraph:
                 parts = stage.pending[:slice_size]
                 stage.pending = stage.pending[slice_size:]
                 self.next_task_id += 1
+                deadline = self._deadline_seconds(stage)
+                attempt = max((stage.retry_counts.get(p, 0) for p in parts), default=0)
                 task = TaskDescription(
                     job_id=self.job_id,
                     stage_id=stage.stage_id,
@@ -176,11 +201,32 @@ class ExecutionGraph:
                     partitions=parts,
                     plan=stage.resolved_plan,
                     session_id=self.session_id,
+                    task_attempt=attempt,
+                    deadline_seconds=deadline,
                 )
-                stage.running[task.task_id] = RunningTask(task.task_id, parts, executor_id)
+                stage.running[task.task_id] = RunningTask(
+                    task.task_id, parts, executor_id, task_attempt=attempt,
+                    deadline_seconds=deadline)
                 stage.state = StageState.RUNNING
                 return task
             return None
+
+    @staticmethod
+    def _median_duration(stage: ExecutionStage) -> float:
+        durs = sorted(stage.task_durations)
+        return durs[len(durs) // 2] if durs else 0.0
+
+    def _deadline_seconds(self, stage: ExecutionStage) -> float:
+        """Effective per-task deadline: the configured floor, raised by the
+        adaptive multiplier × observed median once enough samples exist."""
+        from ballista_tpu.config import TASK_DEADLINE_MULTIPLIER, TASK_DEADLINE_S
+
+        floor = float(self.config.get(TASK_DEADLINE_S))
+        mult = float(self.config.get(TASK_DEADLINE_MULTIPLIER))
+        if mult > 0 and len(stage.task_durations) >= 3:
+            adaptive = mult * self._median_duration(stage)
+            return max(floor, adaptive) if adaptive > 0 else floor
+        return floor
 
     def return_task(self, task: TaskDescription) -> None:
         """Un-pop a task (no executor could take it): partitions go back to
@@ -210,7 +256,8 @@ class ExecutionGraph:
                            error: str = "", retryable: bool = False,
                            metrics: list | None = None,
                            fetch_failed_executor_id: str = "",
-                           fetch_failed_stage_id: int = 0) -> list[str]:
+                           fetch_failed_stage_id: int = 0,
+                           timed_out: bool = False) -> list[str]:
         """Ingest one task status; returns job-level events
         ('stage_completed', 'job_finished', 'job_failed')."""
         events: list[str] = []
@@ -227,17 +274,30 @@ class ExecutionGraph:
                 return events
             running = stage.running.pop(task_id, None)
             if state == "success":
-                for p in partitions:
+                # FIRST ATTEMPT WINS: a duplicate (speculative) attempt
+                # finishing second must not replace the winner's committed
+                # locations — downstream readers may already hold them
+                fresh = [p for p in partitions if p not in stage.completed]
+                for p in fresh:
                     stage.completed[p] = [l for l in locations if l.map_partition == p]
-                if metrics:
+                if running is not None:
+                    stage.task_durations.append(max(0.0, time.time() - running.launched_at))
+                    self._cancel_rival(stage, running)
+                if metrics and fresh:
                     self.stage_metrics.setdefault(stage_id, []).extend(metrics)
                 if stage.all_done():
                     stage.state = StageState.SUCCESSFUL
                     events.append("stage_completed")
                     self._on_stage_success(stage, events)
             elif state in ("failed", "cancelled"):
+                if running is None and not fetch_failed_executor_id:
+                    # unknown/already-settled attempt (cancelled speculation
+                    # loser, deadline-swept task reporting late): its slice
+                    # is covered elsewhere — don't burn retry budget on it
+                    return events
                 if running is not None:
-                    stage.pending.extend(running.partitions)
+                    self._unlink_rival(stage, running)
+                    self._repend_uncovered(stage, running.partitions)
                 if error:
                     stage.failure_reasons.add(error.splitlines()[0][:200])
                 if fetch_failed_executor_id and fetch_failed_stage_id in self.stages:
@@ -261,6 +321,153 @@ class ExecutionGraph:
                     self._fail_job(f"stage {stage_id} failed: {error}")
                     events.append("job_failed")
             return events
+
+    def _cancel_rival(self, stage: ExecutionStage, winner: RunningTask) -> None:
+        """The other attempt of the winner's slice loses: drop it from
+        running and queue a CancelTasks push."""
+        if winner.rival_task_id is None:
+            return
+        rival = stage.running.pop(winner.rival_task_id, None)
+        if rival is not None:
+            log.info("task %d won over attempt %d of stage %d; cancelling the loser on %s",
+                     winner.task_id, rival.task_id, stage.stage_id, rival.executor_id)
+            self.cancelled_tasks.append((rival.executor_id, rival.task_id, stage.stage_id))
+
+    @staticmethod
+    def _unlink_rival(stage: ExecutionStage, task: RunningTask) -> None:
+        """A failed/cancelled attempt leaves its rival as the sole owner of
+        the slice (free to fail, finish, or be speculated again)."""
+        if task.rival_task_id is not None:
+            rival = stage.running.get(task.rival_task_id)
+            if rival is not None:
+                rival.rival_task_id = None
+
+    @staticmethod
+    def _repend_uncovered(stage: ExecutionStage, partitions: list[int]) -> None:
+        """Re-queue only the partitions no completed output or other running
+        attempt covers (a speculation rival may still be computing them)."""
+        covered = set(stage.completed)
+        for rt in stage.running.values():
+            covered.update(rt.partitions)
+        covered.update(stage.pending)
+        fresh = [p for p in partitions if p not in covered]
+        for p in fresh:
+            stage.retry_counts[p] = stage.retry_counts.get(p, 0) + 1
+        stage.pending.extend(fresh)
+
+    # -- straggler defense (speculation + deadline sweep) ------------------
+
+    def speculation_candidates(self, now: float) -> list[tuple[int, int, str]]:
+        """Running tasks eligible for a speculative duplicate:
+        [(stage_id, task_id, executor_id)]. A stage qualifies once ≥ the
+        configured quantile of its partitions completed and it has no
+        pending work; a task qualifies once it ran past
+        max(min_runtime, multiplier × median completed duration) and has
+        no duplicate in flight yet."""
+        from ballista_tpu.config import (
+            SPECULATION_ENABLED,
+            SPECULATION_MIN_RUNTIME_S,
+            SPECULATION_MULTIPLIER,
+            SPECULATION_QUANTILE,
+        )
+
+        with self._lock:
+            if self.status is not JobState.RUNNING:
+                return []
+            if not bool(self.config.get(SPECULATION_ENABLED)):
+                return []
+            quantile = float(self.config.get(SPECULATION_QUANTILE))
+            mult = float(self.config.get(SPECULATION_MULTIPLIER))
+            min_runtime = float(self.config.get(SPECULATION_MIN_RUNTIME_S))
+            out: list[tuple[int, int, str]] = []
+            for stage in self.stages.values():
+                if stage.state is not StageState.RUNNING or not stage.running:
+                    continue
+                if stage.pending:
+                    continue  # schedule fresh work before duplicating old
+                done_frac = len(stage.completed) / max(1, stage.effective_partitions)
+                if done_frac < quantile:
+                    continue
+                median = self._median_duration(stage)
+                if median <= 0.0:
+                    continue
+                threshold = max(min_runtime, mult * median)
+                for t in stage.running.values():
+                    if t.rival_task_id is not None:
+                        continue
+                    if now - t.launched_at >= threshold:
+                        out.append((stage.stage_id, t.task_id, t.executor_id))
+            return out
+
+    def register_speculative(self, stage_id: int, task_id: int,
+                             executor_id: str) -> Optional[TaskDescription]:
+        """Create the duplicate attempt of a running task on `executor_id`.
+        Returns None if the original settled (or already has a rival) in
+        the window since speculation_candidates picked it."""
+        with self._lock:
+            stage = self.stages.get(stage_id)
+            if stage is None or self.status is not JobState.RUNNING:
+                return None
+            if stage.state is not StageState.RUNNING:
+                return None
+            orig = stage.running.get(task_id)
+            if orig is None or orig.rival_task_id is not None:
+                return None
+            self.next_task_id += 1
+            deadline = self._deadline_seconds(stage)
+            task = TaskDescription(
+                job_id=self.job_id,
+                stage_id=stage_id,
+                stage_attempt=stage.attempt,
+                task_id=self.next_task_id,
+                partitions=list(orig.partitions),
+                plan=stage.resolved_plan,
+                session_id=self.session_id,
+                task_attempt=orig.task_attempt + 1,
+                deadline_seconds=deadline,
+            )
+            dup = RunningTask(task.task_id, list(orig.partitions), executor_id,
+                              task_attempt=orig.task_attempt + 1,
+                              deadline_seconds=deadline,
+                              rival_task_id=orig.task_id)
+            orig.rival_task_id = task.task_id
+            stage.running[task.task_id] = dup
+            return task
+
+    def expire_overdue_tasks(self, now: float, grace_s: float = 2.0) -> tuple[list[tuple[str, int, int]], bool]:
+        """Scheduler-side deadline backstop: tasks past deadline + grace
+        (executor unresponsive or ignoring its own enforcement) are dropped
+        from running, queued for CancelTasks, and their uncovered partitions
+        re-queued. Returns ([(executor_id, task_id, stage_id)], job_failed)."""
+        expired: list[tuple[str, int, int]] = []
+        job_failed = False
+        with self._lock:
+            if self.status is not JobState.RUNNING:
+                return expired, job_failed
+            for stage in self.stages.values():
+                if stage.state is not StageState.RUNNING:
+                    continue
+                overdue = [
+                    t for t in stage.running.values()
+                    if t.deadline_seconds > 0
+                    and now - t.launched_at > t.deadline_seconds + max(grace_s, 0.5 * t.deadline_seconds)
+                ]
+                for t in overdue:
+                    stage.running.pop(t.task_id, None)
+                    self._unlink_rival(stage, t)
+                    self._repend_uncovered(stage, t.partitions)
+                    stage.failure_reasons.add(
+                        f"task {t.task_id} missed its {t.deadline_seconds:.1f}s deadline (swept)")
+                    stage.task_failures += 1
+                    self.cancelled_tasks.append((t.executor_id, t.task_id, stage.stage_id))
+                    expired.append((t.executor_id, t.task_id, stage.stage_id))
+                    if stage.task_failures > MAX_TASK_FAILURES:
+                        self._fail_job(
+                            f"stage {stage.stage_id} exceeded {MAX_TASK_FAILURES} task "
+                            f"failures (deadline sweep)")
+                        job_failed = True
+                        return expired, job_failed
+        return expired, job_failed
 
     def _on_stage_success(self, stage: ExecutionStage, events: list[str]) -> None:
         if stage.stage_id == self.final_stage_id:
@@ -413,7 +620,8 @@ class ExecutionGraph:
                 dead = [t for t in stage.running.values() if t.executor_id == executor_id]
                 for t in dead:
                     stage.running.pop(t.task_id, None)
-                    stage.pending.extend(t.partitions)
+                    self._unlink_rival(stage, t)
+                    self._repend_uncovered(stage, t.partitions)
                     affected += 1
                 # successful outputs on the lost executor → stage rerun
                 if stage.state is StageState.SUCCESSFUL and any(

@@ -28,13 +28,41 @@ class ExecutorSlot:
     free_slots: int
     last_seen: float = field(default_factory=time.time)
     terminating: bool = False
+    # -- health scoring / quarantine (decayed fail/success counters) -------
+    health_state: str = "healthy"  # healthy | quarantined | probation
+    health_fail: float = 0.0
+    health_succ: float = 0.0
+    health_updated: float = field(default_factory=time.time)
+    quarantined_at: float = 0.0
+    probe_inflight: bool = False
+
+    @property
+    def failure_rate(self) -> float:
+        total = self.health_fail + self.health_succ
+        return self.health_fail / total if total > 0 else 0.0
+
+    @property
+    def schedulable(self) -> bool:
+        """Eligible for regular offers: quarantined/probation executors only
+        receive work through the probe gate."""
+        return not self.terminating and self.health_state == "healthy"
 
 
 class ExecutorManager:
-    def __init__(self, task_distribution: str = "bias", timeout_s: float = DEFAULT_EXECUTOR_TIMEOUT_S):
+    def __init__(self, task_distribution: str = "bias", timeout_s: float = DEFAULT_EXECUTOR_TIMEOUT_S,
+                 quarantine_threshold: float = 0.5, quarantine_min_events: float = 4.0,
+                 health_half_life_s: float = 60.0, probe_backoff_s: float = 10.0):
         self.executors: dict[str, ExecutorSlot] = {}
         self.task_distribution = task_distribution
         self.timeout_s = timeout_s
+        # flaky-executor quarantine knobs (cluster-scoped, not per-session):
+        # an executor whose decayed failure rate crosses the threshold (with
+        # at least min_events of decayed evidence) stops receiving offers
+        # until a probe task succeeds. threshold <= 0 disables quarantine.
+        self.quarantine_threshold = quarantine_threshold
+        self.quarantine_min_events = quarantine_min_events
+        self.health_half_life_s = max(1e-3, health_half_life_s)
+        self.probe_backoff_s = probe_backoff_s
         self._lock = threading.RLock()
         self._rr = 0
 
@@ -82,7 +110,7 @@ class ExecutorManager:
     def reserve_slots(self, n: int) -> list[tuple[str, int]]:
         """Reserve up to n slots; returns [(executor_id, count)]."""
         with self._lock:
-            avail = [e for e in self.executors.values() if e.free_slots > 0 and not e.terminating]
+            avail = [e for e in self.executors.values() if e.free_slots > 0 and e.schedulable]
             if not avail:
                 return []
             out: list[tuple[str, int]] = []
@@ -124,6 +152,17 @@ class ExecutorManager:
         with self._lock:
             e = self.executors.get(executor_id)
             if e is None or e.terminating:
+                return 0
+            if e.health_state != "healthy":
+                # pull-mode probe gate: a quarantined poller past its backoff
+                # gets EXACTLY ONE task to prove itself with
+                if (e.health_state == "quarantined" and not e.probe_inflight
+                        and e.free_slots > 0
+                        and time.time() - e.quarantined_at >= self.probe_backoff_s):
+                    e.health_state = "probation"
+                    e.probe_inflight = True
+                    e.free_slots -= 1
+                    return 1
                 return 0
             take = max(0, min(e.free_slots, n))
             e.free_slots -= take
@@ -172,7 +211,124 @@ class ExecutorManager:
             for off in range(len(points)):
                 eid = owners[(i + off) % len(points)]
                 e = self.executors.get(eid)
-                if e is not None and not e.terminating and e.free_slots > 0:
+                if e is not None and e.schedulable and e.free_slots > 0:
                     e.free_slots -= 1
                     return eid
             return None
+
+    def reserve_one_avoiding(self, avoid: set[str]) -> str | None:
+        """Reserve a single slot on any healthy executor NOT in `avoid` —
+        speculative duplicates must land away from the straggling one."""
+        with self._lock:
+            cands = [e for e in self.executors.values()
+                     if e.free_slots > 0 and e.schedulable and e.metadata.id not in avoid]
+            if not cands:
+                return None
+            cands.sort(key=lambda e: -e.free_slots)
+            cands[0].free_slots -= 1
+            return cands[0].metadata.id
+
+    # -- health scoring / quarantine ----------------------------------------
+
+    def _decay_locked(self, e: ExecutorSlot, now: float) -> None:
+        dt = now - e.health_updated
+        if dt > 0:
+            f = 0.5 ** (dt / self.health_half_life_s)
+            e.health_fail *= f
+            e.health_succ *= f
+            e.health_updated = now
+
+    def record_task_result(self, executor_id: str, ok: bool,
+                           timed_out: bool = False) -> str | None:
+        """Fold one task outcome into the executor's decayed health score.
+        Returns a state transition ('quarantined' | 'readmitted' |
+        'requarantined') when one happened, else None. Cancelled tasks
+        should NOT be reported here (they say nothing about health)."""
+        now = time.time()
+        with self._lock:
+            e = self.executors.get(executor_id)
+            if e is None:
+                return None
+            self._decay_locked(e, now)
+            if ok:
+                e.health_succ += 1.0
+            else:
+                # timeouts weigh like failures: a straggling executor that
+                # never fails outright is exactly what quarantine is for
+                e.health_fail += 1.0
+            if e.health_state == "probation":
+                e.probe_inflight = False
+                if ok:
+                    e.health_state = "healthy"
+                    # the probe clears the slate: old decayed failures must
+                    # not instantly re-trip the threshold on the next miss
+                    e.health_fail = 0.0
+                    e.health_succ = 1.0
+                    return "readmitted"
+                e.health_state = "quarantined"
+                e.quarantined_at = now
+                return "requarantined"
+            if e.health_state == "healthy" and not ok and self.quarantine_threshold > 0:
+                total = e.health_fail + e.health_succ
+                # epsilon: decay over the microseconds between back-to-back
+                # events leaves N outcomes summing to N - ~1e-7, which must
+                # still count as N against the min-events floor
+                if total + 1e-6 >= self.quarantine_min_events and e.failure_rate >= self.quarantine_threshold:
+                    e.health_state = "quarantined"
+                    e.quarantined_at = now
+                    return "quarantined"
+            return None
+
+    def probe_reservations(self, now: float | None = None) -> list[tuple[str, int]]:
+        """Quarantined executors past their backoff get one probation slot
+        each; the caller must bind a real task to it (or cancel_probe)."""
+        now = time.time() if now is None else now
+        out: list[tuple[str, int]] = []
+        with self._lock:
+            for e in self.executors.values():
+                if (e.health_state == "quarantined" and not e.terminating
+                        and not e.probe_inflight and e.free_slots > 0
+                        and now - e.quarantined_at >= self.probe_backoff_s):
+                    e.health_state = "probation"
+                    e.probe_inflight = True
+                    e.free_slots -= 1
+                    out.append((e.metadata.id, 1))
+        return out
+
+    def cancel_probe(self, executor_id: str) -> None:
+        """No task could be bound to the probe slot: put the executor back
+        in quarantine (same quarantined_at, so the next offer retries)."""
+        with self._lock:
+            e = self.executors.get(executor_id)
+            if e is not None and e.health_state == "probation" and e.probe_inflight:
+                e.health_state = "quarantined"
+                e.probe_inflight = False
+                e.free_slots = min(e.total_slots, e.free_slots + 1)
+
+    def probes_due(self, now: float | None = None) -> bool:
+        now = time.time() if now is None else now
+        with self._lock:
+            return any(
+                e.health_state == "quarantined" and not e.probe_inflight
+                and now - e.quarantined_at >= self.probe_backoff_s
+                for e in self.executors.values()
+            )
+
+    def quarantined_count(self) -> int:
+        with self._lock:
+            return sum(1 for e in self.executors.values()
+                       if e.health_state in ("quarantined", "probation"))
+
+    def health_snapshot(self) -> dict[str, dict]:
+        now = time.time()
+        with self._lock:
+            out = {}
+            for eid, e in self.executors.items():
+                self._decay_locked(e, now)
+                out[eid] = {
+                    "state": e.health_state,
+                    "failure_rate": round(e.failure_rate, 4),
+                    "decayed_failures": round(e.health_fail, 3),
+                    "decayed_successes": round(e.health_succ, 3),
+                }
+            return out

@@ -77,6 +77,7 @@ def encode_task_definition(t: TaskDescription, config=None) -> pb.TaskDefinition
     out = pb.TaskDefinitionProto(
         task_id=t.task_id, job_id=t.job_id, stage_id=t.stage_id,
         stage_attempt=t.stage_attempt, session_id=t.session_id,
+        deadline_seconds=t.deadline_seconds, task_attempt=t.task_attempt,
     )
     out.partitions.extend(t.partitions)
     out.plan.ParseFromString(_encoded_plan_bytes(t, config))
@@ -88,6 +89,7 @@ def decode_task_definition(p: pb.TaskDefinitionProto) -> TaskDescription:
         job_id=p.job_id, stage_id=p.stage_id, stage_attempt=p.stage_attempt,
         task_id=p.task_id, partitions=list(p.partitions),
         plan=decode_plan(p.plan), session_id=p.session_id,
+        deadline_seconds=p.deadline_seconds, task_attempt=p.task_attempt,
     )
 
 
@@ -98,6 +100,7 @@ def encode_task_status(r: TaskResult, executor_id: str) -> pb.TaskStatusProto:
         state=r.state, error=r.error, error_kind=r.error_kind, retryable=r.retryable,
         fetch_failed_executor_id=r.fetch_failed_executor_id,
         fetch_failed_stage_id=r.fetch_failed_stage_id,
+        timed_out=r.timed_out,
     )
     out.partitions.extend(r.partitions)
     for l in r.locations:
@@ -160,6 +163,7 @@ def decode_task_status(p: pb.TaskStatusProto, executor_meta: ExecutorMetadata | 
         ],
         fetch_failed_executor_id=p.fetch_failed_executor_id,
         fetch_failed_stage_id=p.fetch_failed_stage_id,
+        timed_out=p.timed_out,
     )
 
 

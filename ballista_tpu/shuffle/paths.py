@@ -5,10 +5,12 @@ hash layout (reference: execution_plans/mod.rs:78):
     one complete Arrow IPC stream per (map task, output partition)
 
 sort layout (reference: sort_shuffle/index.rs — 2×M files instead of N×M):
-    {work_dir}/{job_id}/{stage_id}/data-{map_partition}.arrow   (K buckets,
-        each byte range a complete IPC stream, sorted by partition id)
-    {work_dir}/{job_id}/{stage_id}/data-{map_partition}.idx     (json index:
-        output_partition → [offset, length, rows, bytes])
+    {work_dir}/{job_id}/{stage_id}/data-{map_partition}-{task_id}.arrow
+        (K buckets, each byte range a complete IPC stream, sorted by
+        partition id; task_id makes the name attempt-unique so speculative
+        duplicates never clobber each other)
+    {work_dir}/{job_id}/{stage_id}/data-{map_partition}-{task_id}.idx
+        (json index: output_partition → [offset, length, rows, bytes])
 """
 
 from __future__ import annotations
@@ -24,8 +26,14 @@ def hash_data_path(work_dir: str, job_id: str, stage_id: int, output_partition: 
     return os.path.join(hash_partition_dir(work_dir, job_id, stage_id, output_partition), f"data-{task_id}.arrow")
 
 
-def sort_data_path(work_dir: str, job_id: str, stage_id: int, map_partition: int) -> str:
-    return os.path.join(work_dir, job_id, str(stage_id), f"data-{map_partition}.arrow")
+def sort_data_path(work_dir: str, job_id: str, stage_id: int, map_partition: int,
+                   task_id=None) -> str:
+    """With task_id, the name is ATTEMPT-unique: concurrent attempts of the
+    same map partition (speculation, deadline retries) write disjoint files
+    and the winner's paths are the only ones the scheduler commits. The
+    reader derives the index name from whatever path it is handed."""
+    name = f"data-{map_partition}.arrow" if task_id is None else f"data-{map_partition}-{task_id}.arrow"
+    return os.path.join(work_dir, job_id, str(stage_id), name)
 
 
 def index_path(data_path: str) -> str:

@@ -60,6 +60,14 @@ METADATA_SCHEMA = DFSchema(
 )
 
 
+def _unlink_quiet(*ps: str) -> None:
+    for p in ps:
+        try:
+            os.remove(p)
+        except OSError:
+            pass
+
+
 def _codec(ctx: TaskContext) -> Optional[str]:
     c = str(ctx.config.get(SHUFFLE_COMPRESSION_CODEC))
     return None if c == "none" else c
@@ -127,19 +135,28 @@ class ShuffleWriterExec(ExecutionPlan):
         schema = self.input.schema()
 
         if self.output_partitions <= 0:
-            # passthrough: stage collapse / preserved partitioning
+            # passthrough: stage collapse / preserved partitioning.
+            # tmp + atomic rename: a task killed mid-write (deadline, cancel,
+            # crash) must never leave a truncated file under the final name
             path = paths.hash_data_path(ctx.work_dir, self.job_id, self.stage_id, map_partition, task_id)
             os.makedirs(os.path.dirname(path), exist_ok=True)
-            with open(path, "wb") as f:
-                rows = 0
-                batches = 0
-                with ipc.new_stream(f, schema, options=_ipc_options(ctx)) as w:
-                    for b in self.input.execute(map_partition, ctx):
-                        if b.num_rows:
-                            w.write_batch(b)
-                            rows += b.num_rows
-                            batches += 1
-                nbytes = f.tell()
+            try:
+                with open(path + ".tmp", "wb") as f:
+                    rows = 0
+                    batches = 0
+                    with ipc.new_stream(f, schema, options=_ipc_options(ctx)) as w:
+                        for b in self.input.execute(map_partition, ctx):
+                            if b.num_rows:
+                                w.write_batch(b)
+                                rows += b.num_rows
+                                batches += 1
+                    nbytes = f.tell()
+            except BaseException:
+                # an attempt killed mid-write (cancel, deadline, crash) must
+                # not leave its .tmp around — it will never be renamed
+                _unlink_quiet(path + ".tmp")
+                raise
+            os.replace(path + ".tmp", path)
             return self._meta([(map_partition, path, rows, batches, nbytes, "hash")])
 
         bound = [bind_expr(k, self.input.df_schema) for k in self.keys]
@@ -163,7 +180,7 @@ class ShuffleWriterExec(ExecutionPlan):
             k = max(range(K), key=lambda i: sum(b.nbytes for b in buckets[i]))
             if not buckets[k]:
                 return False
-            sp = paths.sort_data_path(ctx.work_dir, self.job_id, self.stage_id, map_partition) + f".spill{len(spills[k])}.{k}"
+            sp = paths.sort_data_path(ctx.work_dir, self.job_id, self.stage_id, map_partition, task_id) + f".spill{len(spills[k])}.{k}"
             os.makedirs(os.path.dirname(sp), exist_ok=True)
             with open(sp, "wb") as f:
                 _, sp_bytes = write_ipc_stream(buckets[k], schema, f, ctx)
@@ -233,8 +250,13 @@ class ShuffleWriterExec(ExecutionPlan):
                         break
 
             if self.sort_shuffle:
-                return self._finish_sort(map_partition, schema, buckets, spills, bucket_rows, bucket_batches, ctx)
+                return self._finish_sort(map_partition, task_id, schema, buckets, spills, bucket_rows, bucket_batches, ctx)
             return self._finish_hash(map_partition, task_id, schema, buckets, bucket_rows, bucket_batches, ctx)
+        except BaseException:
+            # consolidation removes spills as it streams them; an aborted
+            # attempt has to sweep up whatever it spilled itself
+            _unlink_quiet(*(sp for ks in spills for sp in ks))
+            raise
         finally:
             if pool is not None and pool_held:
                 pool.shrink(pool_held)
@@ -253,8 +275,13 @@ class ShuffleWriterExec(ExecutionPlan):
         def drain(k: int):
             path = paths.hash_data_path(ctx.work_dir, self.job_id, self.stage_id, k, task_id)
             os.makedirs(os.path.dirname(path), exist_ok=True)
-            with open(path, "wb") as f:
-                _, nbytes = write_ipc_stream(buckets[k], schema, f, ctx)
+            try:
+                with open(path + ".tmp", "wb") as f:
+                    _, nbytes = write_ipc_stream(buckets[k], schema, f, ctx)
+            except BaseException:
+                _unlink_quiet(path + ".tmp")
+                raise
+            os.replace(path + ".tmp", path)
             return (k, path, rows[k], batches[k], nbytes, "hash")
 
         if len(live) == 1:
@@ -277,28 +304,41 @@ class ShuffleWriterExec(ExecutionPlan):
                 yield from ipc.open_stream(sf)
             os.remove(sp)
 
-    def _finish_sort(self, map_partition, schema, buckets, spills, rows, batches, ctx):
-        """Consolidate buckets (memory + spills) into one data file + index."""
-        data_path = paths.sort_data_path(ctx.work_dir, self.job_id, self.stage_id, map_partition)
+    def _finish_sort(self, map_partition, task_id, schema, buckets, spills, rows, batches, ctx):
+        """Consolidate buckets (memory + spills) into one data file + index.
+
+        The data file name is attempt-unique (task_id baked in) and both
+        files commit via tmp + atomic rename, data BEFORE index: duplicate
+        attempts of the same map partition (speculation) each produce a
+        complete private file set, and whichever status reaches the
+        scheduler first decides which set readers ever see."""
+        data_path = paths.sort_data_path(ctx.work_dir, self.job_id, self.stage_id, map_partition, task_id)
         os.makedirs(os.path.dirname(data_path), exist_ok=True)
         index: dict[str, list[int]] = {}
         out = []
-        with open(data_path, "wb") as f:
-            for k in range(len(buckets)):
-                if not rows[k]:
-                    continue
-                start = f.tell()
-                nrows = 0
-                with ipc.new_stream(f, schema, options=_ipc_options(ctx)) as w:
-                    for b in self._iter_bucket_batches(buckets[k], spills[k]):
-                        if b.num_rows:
-                            w.write_batch(b)
-                            nrows += b.num_rows
-                length = f.tell() - start
-                index[str(k)] = [start, length, nrows, length]
-                out.append((k, data_path, nrows, batches[k], length, "sort"))
-        with open(paths.index_path(data_path), "w") as f:
-            json.dump(index, f)
+        idx_path = paths.index_path(data_path)
+        try:
+            with open(data_path + ".tmp", "wb") as f:
+                for k in range(len(buckets)):
+                    if not rows[k]:
+                        continue
+                    start = f.tell()
+                    nrows = 0
+                    with ipc.new_stream(f, schema, options=_ipc_options(ctx)) as w:
+                        for b in self._iter_bucket_batches(buckets[k], spills[k]):
+                            if b.num_rows:
+                                w.write_batch(b)
+                                nrows += b.num_rows
+                    length = f.tell() - start
+                    index[str(k)] = [start, length, nrows, length]
+                    out.append((k, data_path, nrows, batches[k], length, "sort"))
+            os.replace(data_path + ".tmp", data_path)
+            with open(idx_path + ".tmp", "w") as f:
+                json.dump(index, f)
+        except BaseException:
+            _unlink_quiet(data_path + ".tmp", idx_path + ".tmp")
+            raise
+        os.replace(idx_path + ".tmp", idx_path)
         return self._meta(out)
 
     def _meta(self, rows: list[tuple]) -> pa.RecordBatch:

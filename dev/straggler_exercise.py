@@ -1,0 +1,141 @@
+"""Exercise the straggler defenses end-to-end on a tiny TPC-H dataset.
+
+    JAX_PLATFORMS=cpu python dev/straggler_exercise.py
+
+Two legs, both on a 2-executor StandaloneCluster running TPC-H q6 with
+chaos straggler mode pinning an 8 s nap on one scan partition:
+
+1. speculation — the scheduler duplicates the straggling task on the
+   other executor; the run must finish well under the nap and commit
+   exactly one attempt's shuffle files.
+2. deadline — speculation off, a 1 s per-task deadline instead; the
+   straggling attempt times out, retries as attempt 1 (which escapes the
+   chaos roll), and the run still converges.
+
+Exits non-zero if either leg fails its wall-clock or bookkeeping check.
+"""
+
+import os
+import sys
+import tempfile
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+NAP_S = 8.0
+Q6 = """
+select sum(l_extendedprice * l_discount) as revenue
+from lineitem
+where l_shipdate >= date '1994-01-01'
+  and l_shipdate < date '1995-01-01'
+  and l_discount between 0.05 and 0.07
+  and l_quantity < 24
+"""
+
+
+def base_config():
+    from ballista_tpu.config import (
+        CHAOS_ENABLED,
+        CHAOS_MODE,
+        CHAOS_PROBABILITY,
+        CHAOS_SEED,
+        CHAOS_STRAGGLER_DELAY_S,
+        CHAOS_STRAGGLER_PARTITION,
+        CHAOS_STRAGGLER_STAGE,
+        DEFAULT_SHUFFLE_PARTITIONS,
+        MAX_PARTITIONS_PER_TASK,
+    )
+
+    return {
+        DEFAULT_SHUFFLE_PARTITIONS: 4,
+        MAX_PARTITIONS_PER_TASK: 1,
+        CHAOS_ENABLED: True,
+        CHAOS_MODE: "straggler",
+        CHAOS_SEED: 42,
+        CHAOS_PROBABILITY: 1.0,
+        CHAOS_STRAGGLER_DELAY_S: NAP_S,
+        CHAOS_STRAGGLER_PARTITION: 1,
+        # partition indices repeat across stages; pin the nap to the scan
+        # stage so the single-task final stage can't re-hit it
+        CHAOS_STRAGGLER_STAGE: 1,
+    }
+
+
+def run_leg(name: str, data_dir: str, extra_cfg: dict, budget_s: float) -> None:
+    from ballista_tpu.client.context import SessionContext
+    from ballista_tpu.config import BallistaConfig
+    from ballista_tpu.executor.standalone import StandaloneCluster
+    from ballista_tpu.scheduler.metrics import InMemoryMetricsCollector
+    from ballista_tpu.testing.tpchgen import register_tpch
+
+    cfg = BallistaConfig({**base_config(), **extra_cfg})
+    ctx = SessionContext(cfg)
+    register_tpch(ctx, data_dir)
+    cluster = StandaloneCluster(num_executors=2, vcores=2, config=cfg)
+    cluster.scheduler.metrics = InMemoryMetricsCollector()
+    try:
+        scheduler = cluster.scheduler
+        session_id = scheduler.sessions.create_or_update(
+            cfg.to_key_value_pairs(), f"straggler-{name}")
+        t0 = time.time()
+        job_id = scheduler.submit_sql(Q6, session_id)
+        status = scheduler.wait_for_job(job_id, timeout=60)
+        elapsed = time.time() - t0
+        if status["state"] != "successful":
+            raise SystemExit(f"[{name}] job failed: {status.get('error')}")
+        if elapsed >= budget_s:
+            raise SystemExit(
+                f"[{name}] took {elapsed:.1f}s — defense did not beat the "
+                f"{NAP_S:.0f}s straggler (budget {budget_s:.1f}s)")
+        m = cluster.scheduler.metrics
+        print(f"[{name}] ok: {elapsed:.2f}s  "
+              f"speculative_launched={m.speculative_launched}  "
+              f"task_timeouts={m.task_timeouts}")
+        if name == "speculation" and m.speculative_launched < 1:
+            raise SystemExit("[speculation] no speculative attempt was launched")
+        if name == "deadline" and m.task_timeouts < 1:
+            raise SystemExit("[deadline] no task timed out — deadline never fired")
+        leftovers = [f for r, _, fs in os.walk(cluster.work_dir)
+                     for f in fs if f.endswith(".tmp")]
+        if leftovers:
+            # aborted attempts sweep their own tmp files; give them a beat
+            time.sleep(1.0)
+            leftovers = [f for r, _, fs in os.walk(cluster.work_dir)
+                         for f in fs if f.endswith(".tmp")]
+        if leftovers:
+            raise SystemExit(f"[{name}] torn shuffle tmp files left behind: {leftovers}")
+    finally:
+        cluster.shutdown()
+
+
+def main() -> None:
+    from ballista_tpu.config import (
+        SPECULATION_ENABLED,
+        SPECULATION_MIN_RUNTIME_S,
+        SPECULATION_MULTIPLIER,
+        SPECULATION_QUANTILE,
+        TASK_DEADLINE_S,
+    )
+    from ballista_tpu.testing.tpchgen import generate_tpch
+
+    with tempfile.TemporaryDirectory(prefix="straggler-tpch-") as d:
+        print(f"generating TPC-H sf0.01 under {d} ...")
+        generate_tpch(d, scale=0.01, seed=42, files_per_table=2)
+
+        run_leg("speculation", d, {
+            SPECULATION_QUANTILE: 0.5,
+            SPECULATION_MIN_RUNTIME_S: 0.2,
+            SPECULATION_MULTIPLIER: 1.5,
+        }, budget_s=NAP_S - 1.5)
+
+        run_leg("deadline", d, {
+            SPECULATION_ENABLED: False,
+            TASK_DEADLINE_S: 1.0,
+        }, budget_s=NAP_S - 1.5)
+
+    print("straggler exercise passed")
+
+
+if __name__ == "__main__":
+    main()

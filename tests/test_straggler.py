@@ -1,0 +1,585 @@
+"""Straggler-defense machinery: chaos straggler mode, speculative
+execution (first attempt wins, loser cancelled), idempotent shuffle
+commits across duplicate attempts, per-task deadlines, and the
+flaky-executor quarantine → probe → re-admit lifecycle.
+"""
+
+import os
+import threading
+import time
+from types import SimpleNamespace
+
+import pyarrow as pa
+import pytest
+
+from ballista_tpu.config import (
+    CHAOS_ENABLED,
+    CHAOS_MODE,
+    CHAOS_PROBABILITY,
+    CHAOS_SEED,
+    CHAOS_STRAGGLER_DELAY_S,
+    CHAOS_STRAGGLER_PARTITION,
+    CHAOS_STRAGGLER_STAGE,
+    DEFAULT_SHUFFLE_PARTITIONS,
+    MAX_PARTITIONS_PER_TASK,
+    SPECULATION_MIN_RUNTIME_S,
+    SPECULATION_MULTIPLIER,
+    SPECULATION_QUANTILE,
+    TASK_DEADLINE_MULTIPLIER,
+    TASK_DEADLINE_S,
+    BallistaConfig,
+)
+from ballista_tpu.errors import Cancelled, ExecutionError
+from ballista_tpu.executor.chaos import ChaosExec
+from ballista_tpu.executor.executor import Executor, ExecutorMetadata
+from ballista_tpu.executor.standalone import InProcessTaskLauncher, StandaloneCluster
+from ballista_tpu.ids import new_executor_id
+from ballista_tpu.plan.physical import ExecutionPlan, TaskContext
+from ballista_tpu.plan.schema import DFField, DFSchema
+from ballista_tpu.scheduler.metrics import InMemoryMetricsCollector
+from ballista_tpu.scheduler.server import SchedulerServer
+from ballista_tpu.scheduler.state.execution_graph import (
+    ExecutionGraph,
+    JobState,
+    TaskDescription,
+)
+from ballista_tpu.scheduler.state.executor_manager import ExecutorManager
+from ballista_tpu.shuffle.types import PartitionLocation, PartitionStats
+
+from .conftest import tpch_query
+
+SCHEMA = DFSchema([DFField("x", pa.int64(), False)])
+
+
+class OneBatchSource(ExecutionPlan):
+    """N-partition source: each partition yields one small batch."""
+
+    def __init__(self, partitions: int = 2):
+        super().__init__(SCHEMA)
+        self.partitions = partitions
+
+    def output_partition_count(self):
+        return self.partitions
+
+    def execute(self, partition, ctx):
+        yield pa.RecordBatch.from_pydict({"x": [partition * 10 + i for i in range(5)]},
+                                         schema=SCHEMA.to_arrow())
+
+
+class SlowSource(OneBatchSource):
+    def __init__(self, partitions: int = 2, delay_s: float = 0.2):
+        super().__init__(partitions)
+        self.delay_s = delay_s
+
+    def execute(self, partition, ctx):
+        time.sleep(self.delay_s)
+        yield from super().execute(partition, ctx)
+
+
+# ---------------------------------------------------------------------------
+# chaos straggler mode
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _warm_arrow():
+    # pyarrow's first from_pydict costs ~0.5s of lazy init — pay it here so
+    # the wall-clock assertions below measure the chaos delay, not warmup
+    list(OneBatchSource(1).execute(0, TaskContext()))
+
+
+class TestChaosStraggler:
+    def _exec(self, chaos: ChaosExec, partition: int, ctx=None) -> float:
+        ctx = ctx or TaskContext()
+        t0 = time.time()
+        list(chaos.execute(partition, ctx))
+        return time.time() - t0
+
+    def test_explicit_partition_delays_only_that_partition(self):
+        chaos = ChaosExec(OneBatchSource(4), seed=1, probability=1.0, mode="straggler",
+                          straggler_delay_s=0.3, straggler_partition=2)
+        assert self._exec(chaos, 0) < 0.2
+        assert self._exec(chaos, 2) >= 0.3
+
+    def test_speculative_attempt_escapes_the_delay(self):
+        chaos = ChaosExec(OneBatchSource(4), seed=1, probability=1.0, mode="straggler",
+                          straggler_delay_s=0.3, straggler_partition=1)
+        ctx = TaskContext()
+        ctx.task_attempt = 1
+        assert self._exec(chaos, 1, ctx) < 0.2
+
+    def test_seeded_roll_is_deterministic_per_partition(self):
+        def hit_set(seed: int) -> set:
+            chaos = ChaosExec(OneBatchSource(8), seed=seed, probability=0.5,
+                              mode="straggler", straggler_delay_s=0.15)
+            return {p for p in range(8) if self._exec(chaos, p) >= 0.14}
+
+        first = hit_set(7)
+        assert first == hit_set(7)  # same seed → same stragglers
+        assert 0 < len(first) < 8, "p=0.5 over 8 partitions should hit some, not all"
+
+    def test_cancel_check_preempts_the_nap(self):
+        chaos = ChaosExec(OneBatchSource(1), seed=1, probability=1.0, mode="straggler",
+                          straggler_delay_s=30.0, straggler_partition=0)
+        ctx = TaskContext()
+        ctx.cancel_check = lambda: True
+        t0 = time.time()
+        with pytest.raises(Cancelled):
+            list(chaos.execute(0, ctx))
+        assert time.time() - t0 < 2.0
+
+    def test_deadline_preempts_the_nap_with_timed_out_error(self):
+        chaos = ChaosExec(OneBatchSource(1), seed=1, probability=1.0, mode="straggler",
+                          straggler_delay_s=30.0, straggler_partition=0)
+        ctx = TaskContext()
+        ctx.deadline_at = time.time() + 0.1
+        t0 = time.time()
+        with pytest.raises(ExecutionError) as ei:
+            list(chaos.execute(0, ctx))
+        assert time.time() - t0 < 2.0
+        assert getattr(ei.value, "timed_out", False)
+        assert getattr(ei.value, "retryable", False)
+
+
+# ---------------------------------------------------------------------------
+# ExecutionGraph speculation bookkeeping
+
+
+def _graph(cfg: dict | None = None, partitions: int = 4) -> ExecutionGraph:
+    stage = SimpleNamespace(stage_id=1, plan=SimpleNamespace(input=None),
+                            partitions=partitions, input_stage_ids=[])
+    config = BallistaConfig({MAX_PARTITIONS_PER_TASK: 1, **(cfg or {})})
+    return ExecutionGraph("job-1", "", "session-1", [stage], config)
+
+
+SPEC_CFG = {SPECULATION_QUANTILE: 0.5, SPECULATION_MIN_RUNTIME_S: 0.05,
+            SPECULATION_MULTIPLIER: 1.5}
+
+
+def _locs(partition: int) -> list[PartitionLocation]:
+    return [PartitionLocation(map_partition=partition, job_id="job-1", stage_id=1,
+                              output_partition=0, executor_id="X",
+                              path=f"/tmp/data-{partition}.arrow",
+                              stats=PartitionStats(num_rows=1, num_bytes=10))]
+
+
+class TestSpeculation:
+    def _run_to_last_task(self, g: ExecutionGraph):
+        """Pop 4 single-partition tasks; complete all but the last."""
+        tasks = [g.pop_next_task("A") for _ in range(4)]
+        for t in tasks[:3]:
+            g.update_task_status(t.task_id, 1, 0, "success", t.partitions,
+                                 _locs(t.partitions[0]))
+        # unit test completes instantly; give the trigger a real median
+        g.stages[1].task_durations = [0.2, 0.2, 0.2]
+        return tasks[3]
+
+    def test_candidates_and_register(self):
+        g = _graph(SPEC_CFG)
+        last = self._run_to_last_task(g)
+        cands = g.speculation_candidates(now=time.time() + 10)
+        assert cands == [(1, last.task_id, "A")]
+        dup = g.register_speculative(1, last.task_id, "B")
+        assert dup is not None
+        assert dup.task_attempt == 1
+        assert dup.partitions == last.partitions
+        # no double-speculation of the same slice
+        assert g.speculation_candidates(now=time.time() + 10) == []
+        assert g.register_speculative(1, last.task_id, "C") is None
+
+    def test_speculative_attempt_wins_and_loser_is_cancelled(self):
+        g = _graph(SPEC_CFG)
+        last = self._run_to_last_task(g)
+        dup = g.register_speculative(1, last.task_id, "B")
+        events = g.update_task_status(dup.task_id, 1, 0, "success", dup.partitions,
+                                      _locs(dup.partitions[0]))
+        assert "job_finished" in events
+        assert g.status is JobState.SUCCESSFUL
+        assert g.drain_cancelled_tasks() == [("A", last.task_id, 1)]
+        # the loser's late failure report must not disturb the finished job
+        events = g.update_task_status(last.task_id, 1, 0, "failed", last.partitions,
+                                      [], error="cancelled late")
+        assert events == []
+        assert g.status is JobState.SUCCESSFUL
+
+    def test_original_wins_and_speculative_loser_is_cancelled(self):
+        g = _graph(SPEC_CFG)
+        last = self._run_to_last_task(g)
+        dup = g.register_speculative(1, last.task_id, "B")
+        events = g.update_task_status(last.task_id, 1, 0, "success", last.partitions,
+                                      _locs(last.partitions[0]))
+        assert "job_finished" in events
+        assert g.drain_cancelled_tasks() == [("B", dup.task_id, 1)]
+        # first-wins: the loser's locations must not replace the winner's
+        committed = g.stages[1].completed[last.partitions[0]]
+        late = g.update_task_status(dup.task_id, 1, 0, "success", dup.partitions,
+                                    _locs(dup.partitions[0]))
+        assert late == []
+        assert g.stages[1].completed[last.partitions[0]] is committed
+
+    def test_failed_original_leaves_speculative_rival_sole_owner(self):
+        g = _graph(SPEC_CFG)
+        last = self._run_to_last_task(g)
+        dup = g.register_speculative(1, last.task_id, "B")
+        g.update_task_status(last.task_id, 1, 0, "failed", last.partitions, [],
+                             error="boom", retryable=True)
+        stage = g.stages[1]
+        # the slice is still covered by the rival: nothing re-pended
+        assert stage.pending == []
+        assert stage.running[dup.task_id].rival_task_id is None
+        events = g.update_task_status(dup.task_id, 1, 0, "success", dup.partitions,
+                                      _locs(dup.partitions[0]))
+        assert "job_finished" in events
+
+
+class TestDeadlines:
+    def test_adaptive_deadline_from_observed_durations(self):
+        g = _graph({TASK_DEADLINE_S: 0.0, TASK_DEADLINE_MULTIPLIER: 3.0})
+        t1 = g.pop_next_task("A")
+        assert t1.deadline_seconds == 0.0  # < 3 samples: no deadline yet
+        g.stages[1].task_durations = [1.0, 2.0, 3.0]
+        t2 = g.pop_next_task("A")
+        assert t2.deadline_seconds == pytest.approx(6.0)  # 3.0 × median 2.0
+
+    def test_deadline_floor_applies_without_samples(self):
+        g = _graph({TASK_DEADLINE_S: 7.5})
+        assert g.pop_next_task("A").deadline_seconds == pytest.approx(7.5)
+
+    def test_expire_overdue_tasks_repends_and_queues_cancel(self):
+        g = _graph({TASK_DEADLINE_S: 0.1})
+        t = g.pop_next_task("A")
+        stage = g.stages[1]
+        stage.running[t.task_id].launched_at -= 60  # far past deadline+grace
+        expired, job_failed = g.expire_overdue_tasks(time.time())
+        assert expired == [("A", t.task_id, 1)]
+        assert not job_failed
+        assert t.partitions[0] in stage.pending
+        assert ("A", t.task_id, 1) in g.drain_cancelled_tasks()
+
+    def test_executor_enforces_deadline_between_partitions(self, tmp_path):
+        from ballista_tpu.shuffle.writer import ShuffleWriterExec
+
+        plan = ShuffleWriterExec(SlowSource(partitions=3, delay_s=0.2),
+                                 "job-d", 1, 0, None)
+        ex = Executor(str(tmp_path), ExecutorMetadata(id="ex-dl"))
+        task = TaskDescription(job_id="job-d", stage_id=1, stage_attempt=0, task_id=9,
+                               partitions=[0, 1, 2], plan=plan, session_id="s",
+                               deadline_seconds=0.1)
+        result = ex.execute_task(task, BallistaConfig())
+        assert result.state == "failed"
+        assert result.retryable
+        assert result.timed_out
+        assert "deadline" in result.error
+
+
+# ---------------------------------------------------------------------------
+# idempotent shuffle commit
+
+
+class TestShuffleCommitIdempotence:
+    def _write(self, tmp_path, task_id: str, sort: bool):
+        from ballista_tpu.plan.expressions import Column
+        from ballista_tpu.shuffle.writer import ShuffleWriterExec
+
+        plan = ShuffleWriterExec(OneBatchSource(1), "job-s", 2, 4, [Column("x")],
+                                 sort_shuffle=sort)
+        ctx = TaskContext(task_id=task_id, work_dir=str(tmp_path))
+        return list(plan.execute(0, ctx))
+
+    @pytest.mark.parametrize("sort", [True, False], ids=["sort", "hash"])
+    def test_duplicate_attempts_commit_disjoint_complete_sets(self, tmp_path, sort):
+        meta_a = self._write(tmp_path, "11", sort)[0]
+        meta_b = self._write(tmp_path, "12", sort)[0]
+        paths_a = set(meta_a.column(1).to_pylist())
+        paths_b = set(meta_b.column(1).to_pylist())
+        assert paths_a and paths_b
+        assert paths_a.isdisjoint(paths_b), "attempts must never share files"
+        for p in paths_a | paths_b:
+            assert os.path.exists(p)
+        # the commit is atomic: no temp files survive
+        leftovers = [os.path.join(r, f) for r, _, fs in os.walk(tmp_path)
+                     for f in fs if f.endswith(".tmp")]
+        assert leftovers == []
+        # both attempts produced identical row counts (idempotence)
+        assert meta_a.column(2).to_pylist() == meta_b.column(2).to_pylist()
+
+    def test_sort_layout_index_committed_per_attempt(self, tmp_path):
+        from ballista_tpu.shuffle import paths as shuffle_paths
+
+        meta = self._write(tmp_path, "21", sort=True)[0]
+        data_path = meta.column(1).to_pylist()[0]
+        assert "-21.arrow" in data_path, "sort data file must be attempt-unique"
+        assert os.path.exists(shuffle_paths.index_path(data_path))
+
+
+# ---------------------------------------------------------------------------
+# executor health scoring + quarantine
+
+
+def _manager(**kw) -> ExecutorManager:
+    defaults = dict(quarantine_threshold=0.5, quarantine_min_events=2.0,
+                    health_half_life_s=60.0, probe_backoff_s=0.05)
+    defaults.update(kw)
+    em = ExecutorManager(**defaults)
+    for eid in ("A", "B"):
+        em.register(ExecutorMetadata(id=eid, vcores=2))
+    return em
+
+
+class TestQuarantine:
+    def test_failures_quarantine_and_offers_stop(self):
+        em = _manager()
+        assert em.record_task_result("A", ok=False) is None  # below min_events
+        assert em.record_task_result("A", ok=False) == "quarantined"
+        assert em.get("A").health_state == "quarantined"
+        assert em.quarantined_count() == 1
+        # regular binding paths all exclude A
+        assert all(eid == "B" for eid, _ in em.reserve_slots(8))
+        assert em.reserve_one_avoiding({"B"}) is None
+        assert em.health_snapshot()["A"]["state"] == "quarantined"
+
+    def test_probe_then_readmit(self):
+        em = _manager()
+        em.record_task_result("A", ok=False)
+        em.record_task_result("A", ok=False)
+        assert em.probe_reservations(now=time.time()) == []  # backoff not elapsed
+        time.sleep(0.06)
+        probes = em.probe_reservations()
+        assert probes == [("A", 1)]
+        assert em.get("A").health_state == "probation"
+        assert em.probe_reservations() == []  # one probe in flight, not two
+        assert em.record_task_result("A", ok=True) == "readmitted"
+        assert em.get("A").health_state == "healthy"
+        assert any(eid == "A" for eid, _ in em.reserve_slots(8))
+
+    def test_failed_probe_requarantines(self):
+        em = _manager()
+        em.record_task_result("A", ok=False)
+        em.record_task_result("A", ok=False)
+        time.sleep(0.06)
+        assert em.probe_reservations() == [("A", 1)]
+        assert em.record_task_result("A", ok=False, timed_out=True) == "requarantined"
+        assert em.get("A").health_state == "quarantined"
+
+    def test_pull_mode_probe_gate(self):
+        em = _manager()
+        em.record_task_result("A", ok=False)
+        em.record_task_result("A", ok=False)
+        assert em.take_slots("A", 4) == 0  # quarantined, backoff pending
+        time.sleep(0.06)
+        assert em.take_slots("A", 4) == 1  # exactly one probe task
+        assert em.get("A").health_state == "probation"
+        assert em.take_slots("A", 4) == 0
+
+    def test_cancel_probe_returns_slot_and_state(self):
+        em = _manager()
+        em.record_task_result("A", ok=False)
+        em.record_task_result("A", ok=False)
+        time.sleep(0.06)
+        em.probe_reservations()
+        free_before = em.get("A").free_slots
+        em.cancel_probe("A")
+        assert em.get("A").health_state == "quarantined"
+        assert em.get("A").free_slots == free_before + 1
+
+    def test_threshold_zero_disables_quarantine(self):
+        em = _manager(quarantine_threshold=0.0)
+        for _ in range(10):
+            assert em.record_task_result("A", ok=False) is None
+        assert em.get("A").health_state == "healthy"
+
+    def test_successes_decay_the_failure_rate(self):
+        em = _manager(quarantine_min_events=4.0)
+        for _ in range(6):
+            em.record_task_result("A", ok=True)
+        assert em.record_task_result("A", ok=False) is None  # 1/7 failure rate
+        assert em.get("A").health_state == "healthy"
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: chaos straggler beaten by a speculative attempt
+
+
+class RecordingLauncher(InProcessTaskLauncher):
+    def __init__(self, executors):
+        super().__init__(executors)
+        self.launches = []  # (executor_id, task_id, stage_id, task_attempt, partitions)
+        self._rec_lock = threading.Lock()
+
+    def launch(self, executor_id, tasks, server):
+        with self._rec_lock:
+            for t in tasks:
+                self.launches.append(
+                    (executor_id, t.task_id, t.stage_id, t.task_attempt, list(t.partitions)))
+        super().launch(executor_id, tasks, server)
+
+
+def test_speculation_beats_chaos_straggler_e2e(tpch_dir):
+    """One partition of the first stage sleeps 8s under chaos straggler
+    mode; a speculative duplicate on the OTHER executor must win long
+    before that, and exactly one attempt's shuffle files are committed."""
+    from ballista_tpu.client.context import SessionContext
+    from ballista_tpu.testing.tpchgen import register_tpch
+
+    # partition 1 exists only in multi-partition stages (the scan has 2
+    # files); the 1-partition final stage can never reach the completion
+    # quantile, so a straggler there would be unrescuable by design
+    straggler_partition = 1
+    cfg = BallistaConfig({
+        DEFAULT_SHUFFLE_PARTITIONS: 4,
+        MAX_PARTITIONS_PER_TASK: 1,  # one task per partition, else nothing to duplicate
+        CHAOS_ENABLED: True,
+        CHAOS_MODE: "straggler",
+        CHAOS_SEED: 42,
+        CHAOS_PROBABILITY: 1.0,
+        CHAOS_STRAGGLER_DELAY_S: 8.0,
+        CHAOS_STRAGGLER_PARTITION: straggler_partition,
+        CHAOS_STRAGGLER_STAGE: 1,  # the final stage's reader re-drives the same
+        # partition indices in a single unspeculatable task — pin to the scan stage
+        SPECULATION_QUANTILE: 0.5,
+        SPECULATION_MIN_RUNTIME_S: 0.2,
+        SPECULATION_MULTIPLIER: 1.5,
+    })
+    ctx = SessionContext(cfg)
+    register_tpch(ctx, tpch_dir)
+    cluster = StandaloneCluster(num_executors=2, vcores=2, config=cfg)
+    old_launcher = cluster.launcher
+    launcher = RecordingLauncher(cluster.executors)
+    cluster.scheduler.launcher = launcher
+    cluster.launcher = launcher
+    old_launcher.pool.shutdown(wait=False)
+    try:
+        scheduler = cluster.scheduler
+        session_id = scheduler.sessions.create_or_update(cfg.to_key_value_pairs(), "s-spec")
+        t0 = time.time()
+        job_id = scheduler.submit_sql(tpch_query(6), session_id)
+        status = scheduler.wait_for_job(job_id, timeout=60)
+        elapsed = time.time() - t0
+        assert status["state"] == "successful", status.get("error")
+        assert elapsed < 6.5, f"took {elapsed:.1f}s — speculation did not beat the 8s straggler"
+
+        with scheduler._jobs_lock:
+            g = scheduler.jobs[job_id]
+        # the straggling slice was duplicated: find the stage that actually
+        # got a speculative attempt and check the winner differs
+        spec = [l for l in launcher.launches if l[3] > 0]
+        assert spec, "no speculative attempt was ever launched"
+        ex_spec, spec_task, spec_stage, _, spec_parts = spec[0]
+        orig = [l for l in launcher.launches
+                if l[2] == spec_stage and l[3] == 0 and straggler_partition in l[4]]
+        assert orig, "no original attempt recorded for the straggler slice"
+        ex_orig, orig_task = orig[0][0], orig[0][1]
+        assert ex_spec != ex_orig, "speculative attempt must land on a DIFFERENT executor"
+
+        committed = g.stages[spec_stage].completed[straggler_partition]
+        assert committed, "straggler partition has no committed locations"
+        winner_ids = {t for t in (spec_task, orig_task)
+                      if any(f"-{t}." in os.path.basename(l.path)
+                             or f"data-{t}." in os.path.basename(l.path)
+                             for l in committed)}
+        assert winner_ids == {spec_task}, (
+            f"committed files {[l.path for l in committed]} should belong to the "
+            f"speculative winner {spec_task}, not the straggler {orig_task}")
+        # exactly ONE attempt's files committed for the slice
+        for p in spec_parts:
+            locs = g.stages[spec_stage].completed.get(p, [])
+            tids = {os.path.basename(l.path) for l in locs}
+            assert len({t.rsplit("-", 1)[-1] for t in tids}) <= 1
+        # the loser aborts asynchronously (its cancel lands mid-straggle and
+        # the writer then unlinks its own .tmp) — give it a moment to sweep up
+        deadline = time.time() + 5.0
+        while True:
+            leftovers = [os.path.join(r, f) for r, _, fs in os.walk(cluster.work_dir)
+                         for f in fs if f.endswith(".tmp")]
+            if not leftovers or time.time() > deadline:
+                break
+            time.sleep(0.1)
+        assert leftovers == []
+    finally:
+        cluster.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: flaky executor quarantined, probed, re-admitted
+
+
+class FlakyLauncher(InProcessTaskLauncher):
+    """Synthesizes retryable failures for the victim until the scheduler
+    quarantines it; from then on (probe included) its tasks run for real —
+    modelling a flaky executor that recovered while benched."""
+
+    def __init__(self, executors, victim_id):
+        super().__init__(executors)
+        self.victim_id = victim_id
+        self.synthetic_failures = 0
+        self.injecting = True
+
+    def launch(self, executor_id, tasks, server):
+        from ballista_tpu.executor.executor import TaskResult
+
+        if executor_id == self.victim_id and self.injecting:
+            slot = server.executors.get(executor_id)
+            if slot is not None and slot.health_state != "healthy":
+                self.injecting = False  # benched: recover for the probe
+            else:
+                for t in tasks:
+                    self.synthetic_failures += 1
+                    server.update_task_status(executor_id, [TaskResult(
+                        task_id=t.task_id, job_id=t.job_id, stage_id=t.stage_id,
+                        stage_attempt=t.stage_attempt, partitions=list(t.partitions),
+                        state="failed", error="flaky: injected fault", retryable=True,
+                    )])
+                return
+        super().launch(executor_id, tasks, server)
+
+
+def test_quarantine_probe_readmit_e2e(tpch_dir):
+    from ballista_tpu.client.context import SessionContext
+    from ballista_tpu.testing.tpchgen import register_tpch
+
+    cfg = BallistaConfig({DEFAULT_SHUFFLE_PARTITIONS: 4, MAX_PARTITIONS_PER_TASK: 1})
+    ctx = SessionContext(cfg)
+    register_tpch(ctx, tpch_dir)
+    import tempfile
+
+    wd = tempfile.mkdtemp(prefix="bt-quarantine-")
+    # bias distribution fills the executor with the most free slots first:
+    # the extra vcores steer the first tasks onto the victim deterministically
+    victim = Executor(wd, ExecutorMetadata(id=str(new_executor_id()), vcores=4), config=cfg)
+    healthy = Executor(wd, ExecutorMetadata(id=str(new_executor_id()), vcores=2), config=cfg)
+    launcher = FlakyLauncher({victim.metadata.id: victim, healthy.metadata.id: healthy},
+                             victim.metadata.id)
+    metrics = InMemoryMetricsCollector()
+    scheduler = SchedulerServer(launcher, metrics,
+                                quarantine_threshold=0.5, quarantine_min_events=1.0,
+                                probe_backoff_s=0.5, sweep_interval_s=0.2)
+    scheduler.start()
+    scheduler.register_executor(victim.metadata)
+    scheduler.register_executor(healthy.metadata)
+    try:
+        session_id = scheduler.sessions.create_or_update(cfg.to_key_value_pairs(), "s-flaky")
+        job_id = scheduler.submit_sql(tpch_query(6), session_id)
+        status = scheduler.wait_for_job(job_id, timeout=60)
+        assert status["state"] == "successful", status.get("error")
+        assert launcher.synthetic_failures >= 1, "victim never exercised — test vacuous"
+        assert scheduler.executors.get(victim.metadata.id).health_state == "quarantined"
+        assert scheduler.executors.quarantined_count() == 1
+
+        # wait out the probe backoff, then give the scheduler work again:
+        # the probe task runs for real (probation) and re-admits the victim
+        time.sleep(0.6)
+        job2 = scheduler.submit_sql(tpch_query(6), session_id)
+        status2 = scheduler.wait_for_job(job2, timeout=60)
+        assert status2["state"] == "successful", status2.get("error")
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if scheduler.executors.get(victim.metadata.id).health_state == "healthy":
+                break
+            time.sleep(0.1)
+        assert scheduler.executors.get(victim.metadata.id).health_state == "healthy", (
+            scheduler.executors.health_snapshot())
+        assert scheduler.executors.quarantined_count() == 0
+        # the gauge saw the quarantine while it lasted
+        assert metrics.quarantined_executors == 0
+    finally:
+        scheduler.stop()
+        launcher.pool.shutdown(wait=False)
