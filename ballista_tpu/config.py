@@ -29,6 +29,8 @@ SHUFFLE_READER_MAX_PER_ADDR = "ballista.shuffle.reader.max.requests.per.address"
 SHUFFLE_READER_MAX_BYTES = "ballista.shuffle.reader.max.inflight.bytes"
 SHUFFLE_READER_FORCE_REMOTE = "ballista.shuffle.reader.force_remote_read"
 SHUFFLE_BLOCK_TRANSPORT = "ballista.shuffle.block.transport"
+SHUFFLE_FETCH_COALESCE = "ballista.shuffle.fetch.coalesce"
+SHUFFLE_MMAP = "ballista.shuffle.mmap.enabled"
 SORT_SHUFFLE_ENABLED = "ballista.shuffle.sort.enabled"
 SORT_SHUFFLE_MEMORY_LIMIT = "ballista.shuffle.sort.memory.limit"
 SORT_SHUFFLE_POOL_WAIT_S = "ballista.shuffle.sort.memory.wait.seconds"
@@ -123,6 +125,19 @@ class ConfigEntry:
         return v
 
 
+def _env_bool(name: str, default: bool) -> bool:
+    """Escape-hatch defaults: data-plane optimizations (mmap serving, fetch
+    coalescing) default ON but can be killed fleet-wide with an env var on
+    the affected host — no session-config change required. The Flight
+    server, which never sees a session config, consults the same vars."""
+    import os
+
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    return raw.strip().lower() not in ("0", "false", "no", "off")
+
+
 def _pos(v: Any) -> bool:
     return v > 0
 
@@ -144,6 +159,8 @@ _ENTRIES: list[ConfigEntry] = [
     ConfigEntry(SHUFFLE_READER_MAX_BYTES, "Reduce-side fetch governor: in-flight byte budget.", int, 256 * 1024 * 1024, _pos),
     ConfigEntry(SHUFFLE_READER_FORCE_REMOTE, "Testing: fetch shuffle partitions over Flight even when local.", bool, False),
     ConfigEntry(SHUFFLE_BLOCK_TRANSPORT, "Fetch remote shuffle partitions as raw 8 MiB IPC blocks (no decode/re-encode).", bool, True),
+    ConfigEntry(SHUFFLE_FETCH_COALESCE, "Coalesce a reduce task's fetches: all map outputs owned by one executor stream back in a single RPC (M small RPCs become one per executor). Env escape hatch: BALLISTA_SHUFFLE_COALESCE=0.", bool, _env_bool("BALLISTA_SHUFFLE_COALESCE", True)),
+    ConfigEntry(SHUFFLE_MMAP, "Serve and read shuffle files through memory maps (zero-copy buffer slices instead of seek+read copies). Env escape hatch: BALLISTA_SHUFFLE_MMAP=0 (also honored by the Flight server, which has no session config).", bool, _env_bool("BALLISTA_SHUFFLE_MMAP", True)),
     ConfigEntry(SORT_SHUFFLE_ENABLED, "Use sort-based shuffle (M consolidated bucket files + index) for hash repartitions.", bool, True),
     ConfigEntry(SORT_SHUFFLE_MEMORY_LIMIT, "Bytes of buffered batches before sort-shuffle spills (0 = unlimited).", int, 256 * 1024 * 1024, _nonneg),
     ConfigEntry(SORT_SHUFFLE_POOL_WAIT_S, "How long a writer with nothing left to spill blocks for session-pool headroom before overcommitting (liveness backstop).", float, 10.0, _nonneg),

@@ -5,13 +5,28 @@ client_pool.rs:34): fetch_partition in decoded-stream mode (do_get) or
 raw-block mode (do_action("io_block_transport"), client.rs:321 — ships the
 stored IPC bytes and decodes once on the reduce side). Pooled clients are
 discarded on error (PooledClient discard-on-error).
+
+Two data-movement optimizations live here:
+
+- Block streams decode through ChainedBufferReader — a file-like view over
+  the received block list — instead of re-assembling them with
+  b"".join(blocks), which doubled the partition's footprint on the reduce
+  side for one decode pass.
+- fetch_partitions_flight ships a reduce task's WHOLE want-list for one
+  executor in a single io_coalesced_transport RPC. The server frames each
+  map output with a JSON header Result, so this client yields per-location
+  results as they complete and, when the stream dies, reports exactly which
+  location was mid-flight (FetchStreamError.loc_index) — the reader turns
+  that into a FetchFailed with the right map identity. Servers that predate
+  the action (the native C++ data plane) reject it; that address is cached
+  in _NO_COALESCE and the caller falls back to per-location fetches.
 """
 
 from __future__ import annotations
 
 import json
 import threading
-from typing import Iterator
+from typing import Iterator, Sequence
 
 import pyarrow as pa
 import pyarrow.flight as flight
@@ -20,6 +35,8 @@ import pyarrow.ipc as ipc
 from ballista_tpu.config import SHUFFLE_BLOCK_TRANSPORT
 from ballista_tpu.plan.physical import TaskContext
 from ballista_tpu.shuffle.types import PartitionLocation
+
+COALESCED_ACTION = "io_coalesced_transport"
 
 
 class ClientPool:
@@ -37,14 +54,14 @@ class ClientPool:
             c = self._clients.get(key)
             if c is None:
                 if tls:
-                    ca, cert, key = tls
+                    ca, cert, key_path = tls
                     kwargs = {}
                     with open(ca, "rb") as f:
                         kwargs["tls_root_certs"] = f.read()
-                    if cert and key:
+                    if cert and key_path:
                         with open(cert, "rb") as f:
                             kwargs["cert_chain"] = f.read()
-                        with open(key, "rb") as f:
+                        with open(key_path, "rb") as f:
                             kwargs["private_key"] = f.read()
                     c = flight.FlightClient(f"grpc+tls://{addr}", **kwargs)
                 else:
@@ -64,6 +81,92 @@ class ClientPool:
 
 
 POOL = ClientPool()
+
+# addresses whose server rejected io_coalesced_transport (native data
+# plane): don't re-probe them on every reduce task
+_NO_COALESCE: set[str] = set()
+_NO_COALESCE_LOCK = threading.Lock()
+
+
+class CoalesceUnsupported(Exception):
+    """The server at this address has no io_coalesced_transport action —
+    caller should fall back to per-location fetches."""
+
+
+class FetchStreamError(Exception):
+    """A coalesced stream died while (or before) serving location
+    `loc_index` (index into the request's location list). Locations before
+    it completed and were already yielded — only the tail needs refetching,
+    and the failure is attributed to exactly this map output."""
+
+    def __init__(self, loc_index: int, cause: BaseException):
+        super().__init__(f"coalesced fetch failed at location {loc_index}: {cause}")
+        self.loc_index = loc_index
+        self.cause = cause
+
+
+class ChainedBufferReader:
+    """File-like view over a list of received blocks for ipc.open_stream —
+    decodes a block stream without re-assembling it into one contiguous
+    bytes object. pyarrow's PythonFile wrapper requires `closed` to be an
+    attribute (a method object is truthy = treated as closed) and never
+    retries short reads, so read(n) must return exactly n bytes until EOF;
+    a span inside one block returns a zero-copy memoryview."""
+
+    closed = False
+
+    def __init__(self, blocks: Sequence) -> None:
+        self._blocks = [memoryview(b) for b in blocks if len(memoryview(b))]
+        self._bi = 0
+        self._off = 0
+        self._pos = 0
+
+    def readable(self) -> bool:
+        return True
+
+    def writable(self) -> bool:
+        return False
+
+    def seekable(self) -> bool:
+        return False
+
+    def tell(self) -> int:
+        return self._pos
+
+    def flush(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+    def size(self) -> int:
+        return sum(len(b) for b in self._blocks)
+
+    def read(self, n: int = -1):
+        blocks, bi, off = self._blocks, self._bi, self._off
+        if n is None or n < 0:
+            n = sum(len(b) for b in blocks[bi:]) - (off if bi < len(blocks) else 0)
+        if bi < len(blocks) and len(blocks[bi]) - off >= n:
+            out = blocks[bi][off:off + n]
+            off += n
+            if off == len(blocks[bi]):
+                bi, off = bi + 1, 0
+            self._bi, self._off = bi, off
+            self._pos += n
+            return out
+        parts = []
+        need = n
+        while need and bi < len(blocks):
+            take = min(need, len(blocks[bi]) - off)
+            parts.append(blocks[bi][off:off + take])
+            need -= take
+            off += take
+            if off == len(blocks[bi]):
+                bi, off = bi + 1, 0
+        self._bi, self._off = bi, off
+        out = b"".join(parts)
+        self._pos += len(out)
+        return out
 
 
 def _ticket(loc: PartitionLocation) -> dict:
@@ -86,27 +189,27 @@ def _session_tls(config) -> tuple[str, str | None, str | None] | None:
             str(config.get(GRPC_TLS_KEY) or "") or None)
 
 
-def fetch_partition_flight(loc: PartitionLocation, ctx: TaskContext) -> Iterator[pa.RecordBatch]:
+def _route(ctx: TaskContext, loc: PartitionLocation, body: dict) -> tuple[str, dict]:
+    """(dial address, wire body) — external mode relays through the
+    scheduler's Flight proxy with the owning executor named in the body."""
     from ballista_tpu.config import FLIGHT_PROXY
 
     proxy = str(ctx.config.get(FLIGHT_PROXY) or "")
     if proxy:
-        # external mode (distributed_query.rs:754-783): relay through the
-        # scheduler's Flight proxy; the ticket carries the owning executor
-        addr = proxy
-        ticket = {**_ticket(loc), "host": loc.host, "flight_port": loc.flight_port}
-    else:
-        addr = f"{loc.host}:{loc.flight_port}"
-        ticket = _ticket(loc)
+        return proxy, {**body, "host": loc.host, "flight_port": loc.flight_port}
+    return f"{loc.host}:{loc.flight_port}", body
+
+
+def fetch_partition_flight(loc: PartitionLocation, ctx: TaskContext) -> Iterator[pa.RecordBatch]:
+    addr, ticket = _route(ctx, loc, _ticket(loc))
     client = POOL.get(addr, tls=_session_tls(ctx.config))
     try:
         if bool(ctx.config.get(SHUFFLE_BLOCK_TRANSPORT)):
             action = flight.Action("io_block_transport", json.dumps(ticket).encode())
-            blocks = [r.body.to_pybytes() for r in client.do_action(action)]
+            blocks = [r.body for r in client.do_action(action)]
             if not blocks:
                 return
-            buf = b"".join(blocks)
-            reader = ipc.open_stream(pa.BufferReader(buf))
+            reader = ipc.open_stream(ChainedBufferReader(blocks))
             yield from reader
         else:
             t = flight.Ticket(json.dumps(ticket).encode())
@@ -115,6 +218,77 @@ def fetch_partition_flight(loc: PartitionLocation, ctx: TaskContext) -> Iterator
     except Exception:
         POOL.discard(addr)
         raise
+
+
+def _is_unknown_action(e: BaseException) -> bool:
+    return "unknown action" in str(e).lower()
+
+
+def fetch_partitions_flight(locs: Sequence[PartitionLocation], ctx: TaskContext
+                            ) -> Iterator[tuple[int, list[pa.RecordBatch], int]]:
+    """Coalesced fetch: every location (all owned by ONE executor) streams
+    back in a single RPC. Yields (index, batches, nbytes) per location, in
+    request order, as each completes. Raises CoalesceUnsupported when the
+    server lacks the action (native data plane) and FetchStreamError with
+    the first incomplete location's index when the stream dies mid-flight.
+    """
+    addr, body = _route(ctx, locs[0], {"locations": [_ticket(l) for l in locs]})
+    with _NO_COALESCE_LOCK:
+        if addr in _NO_COALESCE:
+            raise CoalesceUnsupported(addr)
+    client = POOL.get(addr, tls=_session_tls(ctx.config))
+    action = flight.Action(COALESCED_ACTION, json.dumps(body).encode())
+
+    completed = 0          # locations fully received = first incomplete idx
+    cur_need = 0           # bytes still owed for the current location
+    cur_blocks: list = []
+
+    def fail(e: BaseException):
+        if _is_unknown_action(e):
+            with _NO_COALESCE_LOCK:
+                _NO_COALESCE.add(addr)
+            return CoalesceUnsupported(addr)
+        POOL.discard(addr)
+        return FetchStreamError(completed, e)
+
+    try:
+        results = iter(client.do_action(action))
+    except Exception as e:
+        raise fail(e) from e
+    while True:
+        try:
+            r = next(results)
+        except StopIteration:
+            break
+        except Exception as e:
+            raise fail(e) from e
+        if cur_need == 0:
+            # header Result: {"i": index, "nbytes": n}
+            h = json.loads(r.body.to_pybytes().decode())
+            cur_need = int(h["nbytes"])
+            cur_blocks = []
+            if cur_need == 0:
+                yield completed, [], 0
+                completed += 1
+            continue
+        cur_blocks.append(r.body)
+        cur_need -= r.body.size
+        if cur_need == 0:
+            nbytes = sum(b.size for b in cur_blocks)
+            try:
+                batches = list(ipc.open_stream(ChainedBufferReader(cur_blocks)))
+            except Exception as e:
+                raise FetchStreamError(completed, e) from e
+            cur_blocks = []
+            yield completed, batches, nbytes
+            completed += 1
+    if cur_need:
+        # server hung up inside the current location's data
+        raise FetchStreamError(completed, EOFError(
+            f"stream ended {cur_need} bytes short of location {completed}"))
+    if completed < len(locs):
+        raise FetchStreamError(completed, EOFError(
+            f"stream served {completed}/{len(locs)} locations"))
 
 
 def remove_job_data(host: str, flight_port: int, job_id: str) -> None:

@@ -7,7 +7,11 @@ distributed_query.rs:754-783): clients that cannot reach executors directly
 which relays from the owning executor over the raw-block path.
 
 Tickets are the normal fetch tickets plus the executor's {host, flight_port}
-so the proxy knows where to relay from.
+so the proxy knows where to relay from. The relay is a streaming
+pass-through: each upstream Result body is forwarded verbatim (zero
+re-chunking, nothing buffered), which also preserves the
+io_coalesced_transport header framing byte-for-byte — the proxy needs no
+knowledge of the coalesced wire format to relay it.
 """
 
 from __future__ import annotations
@@ -15,28 +19,9 @@ from __future__ import annotations
 import json
 import threading
 
-import pyarrow as pa
 import pyarrow.flight as flight
-import pyarrow.ipc as ipc
 
-BLOCK_SIZE = 8 * 1024 * 1024
-
-
-def _relay_bytes(ticket: dict, relay_tls: tuple[str, str | None, str | None] | None) -> bytes:
-    """Pull the stored IPC bytes from the owning executor (raw-block mode —
-    no decode on the proxy hop). In a TLS cluster the proxy dials executors
-    with the scheduler's own credentials (the executors' data plane requires
-    client certs)."""
-    from ballista_tpu.flight.client import POOL
-
-    addr = f"{ticket['host']}:{ticket['flight_port']}"
-    client = POOL.get(addr, tls=relay_tls)
-    try:
-        action = flight.Action("io_block_transport", json.dumps(ticket).encode())
-        return b"".join(r.body.to_pybytes() for r in client.do_action(action))
-    except Exception:
-        POOL.discard(addr)
-        raise
+RELAY_ACTIONS = ("io_block_transport", "io_coalesced_transport")
 
 
 class FlightResultProxy(flight.FlightServerBase):
@@ -59,26 +44,62 @@ class FlightResultProxy(flight.FlightServerBase):
         super().__init__(f"{scheme}://{host}:{port}", **kwargs)
         # executor-side dial credentials: (ca, cert, key)
         self.relay_tls = (tls_client_ca, tls_cert, tls_key) if (tls_client_ca and tls_cert) else None
+        self.stats = {"relayed_actions": 0, "relayed_gets": 0}
+
+    def _upstream(self, ticket: dict) -> tuple[str, flight.FlightClient]:
+        """Dial the owning executor. In a TLS cluster the proxy presents the
+        scheduler's own credentials (the executors' data plane requires
+        client certs)."""
+        from ballista_tpu.flight.client import POOL
+
+        addr = f"{ticket['host']}:{ticket['flight_port']}"
+        return addr, POOL.get(addr, tls=self.relay_tls)
 
     def do_get(self, context, ticket):
+        from ballista_tpu.flight.client import POOL
+
         t = json.loads(ticket.ticket.decode())
-        buf = _relay_bytes(t, self.relay_tls)
-        if not buf:
-            return flight.RecordBatchStream(pa.table({}))
-        reader = ipc.open_stream(pa.BufferReader(buf))
-        return flight.RecordBatchStream(reader.read_all())
+        addr, client = self._upstream(t)
+        try:
+            reader = client.do_get(flight.Ticket(json.dumps(t).encode()))
+            schema = reader.schema
+        except Exception:
+            POOL.discard(addr)
+            raise
+        self.stats["relayed_gets"] += 1
+
+        def gen():
+            try:
+                for chunk in reader:
+                    yield chunk.data
+            except Exception:
+                POOL.discard(addr)
+                raise
+
+        return flight.GeneratorStream(schema, gen())
 
     def do_action(self, context, action):
-        if action.type == "io_block_transport":
+        from ballista_tpu.flight.client import POOL
+
+        if action.type in RELAY_ACTIONS:
             t = json.loads(action.body.to_pybytes().decode())
-            buf = _relay_bytes(t, self.relay_tls)
-            for off in range(0, len(buf), BLOCK_SIZE):
-                yield flight.Result(pa.py_buffer(buf[off : off + BLOCK_SIZE]))
+            addr, client = self._upstream(t)
+            self.stats["relayed_actions"] += 1
+            try:
+                # forward the body unchanged — the executor ignores the
+                # routing keys — and pass every Result through verbatim
+                up = flight.Action(action.type, json.dumps(t).encode())
+                for r in client.do_action(up):
+                    yield flight.Result(r.body)
+            except Exception:
+                POOL.discard(addr)
+                raise
             return
         raise flight.FlightServerError(f"unknown action {action.type}")
 
     def list_actions(self, context):
-        return [("io_block_transport", "relay raw IPC blocks from an executor")]
+        return [("io_block_transport", "relay raw IPC blocks from an executor"),
+                ("io_coalesced_transport", "relay a framed multi-location block stream")]
 
 
 def start_flight_proxy(host: str = "0.0.0.0", port: int = 0,

@@ -4,17 +4,33 @@ Rebuild of ballista/executor/src/flight_service.rs:
 
 - do_get(FetchPartition ticket): streams one shuffle output partition as
   decoded record batches (hash layout: whole file; sort layout: byte range
-  through the index).
+  through the index). The stream is generator-based — batches leave as they
+  decode off the memory map, the partition is never materialized with
+  read_all(). A coalesced ticket ({"locations": [...]}) streams several map
+  outputs of the same stage back-to-back in one call.
 - do_action("io_block_transport"): raw 8 MiB block streaming of the stored
   IPC bytes with NO decode/re-encode — the preferred fast path
-  (flight_service.rs:243; 8 MiB buffer :77). The client reassembles and
-  decodes the stream once.
+  (flight_service.rs:243; 8 MiB buffer :77). Blocks are zero-copy slices
+  of a memory map of the shuffle file. The client reassembles and decodes
+  the stream once.
+- do_action("io_coalesced_transport"): the coalesced raw path — body
+  carries {"locations": [ticket, ...]} for one (executor, reduce
+  partition) pair and the server streams every location back-to-back in
+  ONE RPC. Each location is framed by a small JSON header Result
+  ({"i": index, "nbytes": n}) followed by its data blocks, so the client
+  keeps per-location accounting: a mid-stream failure is attributed to the
+  exact map output being served, and FetchFailed carries the right map
+  identity for stage recomputation.
 
 Tickets are JSON: {path, layout, output_partition} — the location fields a
 PartitionLocation already carries. The server does NOT trust the ticket
 path: it is resolved and required to live under this executor's work dir
 (the reference rebuilds paths server-side from structured fields for the
 same reason), and job ids in GC actions are validated against traversal.
+
+mmap serving defaults on; BALLISTA_SHUFFLE_MMAP=0 in the executor's
+environment falls back to plain reads (the data plane has no session
+config, so the escape hatch is environmental).
 
 TLS: when the executor's control plane is configured with mTLS, the same
 certificates secure the Flight listener (tls_certificates + client CA with
@@ -31,26 +47,29 @@ import pyarrow as pa
 import pyarrow.flight as flight
 import pyarrow.ipc as ipc
 
+from ballista_tpu.config import _env_bool
 from ballista_tpu.shuffle import paths
-from ballista_tpu.shuffle.types import PartitionLocation
 
 BLOCK_SIZE = 8 * 1024 * 1024
 
+COALESCED_ACTION = "io_coalesced_transport"
 
-def _read_range(ticket: dict, work_dir: str) -> bytes:
+_EMPTY = pa.py_buffer(b"")
+
+
+def _open_buffer(ticket: dict, work_dir: str) -> pa.Buffer:
+    """One location's stored IPC bytes as a (zero-copy, mmap-backed)
+    buffer; empty buffer for a partition absent from a sort index."""
     path = paths.contained_path(work_dir, ticket["path"])
-    if paths.is_sort_layout(ticket.get("layout", "hash")):
-        with open(paths.index_path(path)) as f:
-            index = json.load(f)
-        entry = index.get(str(ticket["output_partition"]))
-        if entry is None:
-            return b""
-        offset, length = entry[0], entry[1]
-        with open(path, "rb") as f:
-            f.seek(offset)
-            return f.read(length)
-    with open(path, "rb") as f:
-        return f.read()
+    buf = paths.open_range_buffer(
+        path, ticket.get("layout", "hash"), ticket.get("output_partition", 0),
+        use_mmap=_env_bool("BALLISTA_SHUFFLE_MMAP", True),
+    )
+    return _EMPTY if buf is None else buf
+
+
+def _ticket_list(t: dict) -> list[dict]:
+    return t["locations"] if "locations" in t else [t]
 
 
 class BallistaFlightServer(flight.FlightServerBase):
@@ -73,28 +92,75 @@ class BallistaFlightServer(flight.FlightServerBase):
         super().__init__(f"{scheme}://{host}:{port}", **kwargs)
         self.work_dir = work_dir
         self.host = host
+        # data-plane counters (benchmarks / smoke tests read these):
+        # RPCs by kind, locations served, and payload bytes out
+        self.stats = {"do_get": 0, "block_rpc": 0, "coalesced_rpc": 0,
+                      "locations_served": 0, "bytes_served": 0}
+        self._stats_lock = threading.Lock()
+
+    def _bump(self, key: str, n: int = 1) -> None:
+        with self._stats_lock:
+            self.stats[key] += n
 
     def do_get(self, context, ticket):
         t = json.loads(ticket.ticket.decode())
+        tickets = _ticket_list(t)
         try:
-            buf = _read_range(t, self.work_dir)
+            bufs = [_open_buffer(x, self.work_dir) for x in tickets]
         except PermissionError as e:
             raise flight.FlightUnauthorizedError(str(e))
-        if not buf:
+        self._bump("do_get")
+        self._bump("locations_served", len(tickets))
+        readers = [ipc.open_stream(pa.BufferReader(b)) for b in bufs if b.size]
+        if not readers:
             return flight.RecordBatchStream(pa.table({}))
-        reader = ipc.open_stream(pa.BufferReader(buf))
-        table = reader.read_all()
-        return flight.RecordBatchStream(table)
+
+        def gen():
+            served = 0
+            for r in readers:
+                for batch in r:
+                    served += batch.nbytes
+                    yield batch
+            self._bump("bytes_served", served)
+
+        # generator-based: first batch leaves before the last is decoded;
+        # nothing is materialized server-side (no read_all)
+        return flight.GeneratorStream(readers[0].schema, gen())
+
+    def _yield_blocks(self, buf: pa.Buffer):
+        for off in range(0, buf.size, BLOCK_SIZE):
+            # zero-copy: each Result body is a slice of the mmap buffer
+            yield flight.Result(buf.slice(off, min(BLOCK_SIZE, buf.size - off)))
 
     def do_action(self, context, action):
         if action.type == "io_block_transport":
             t = json.loads(action.body.to_pybytes().decode())
             try:
-                buf = _read_range(t, self.work_dir)
+                buf = _open_buffer(t, self.work_dir)
             except PermissionError as e:
                 raise flight.FlightUnauthorizedError(str(e))
-            for off in range(0, len(buf), BLOCK_SIZE):
-                yield flight.Result(pa.py_buffer(buf[off : off + BLOCK_SIZE]))
+            self._bump("block_rpc")
+            self._bump("locations_served")
+            self._bump("bytes_served", buf.size)
+            yield from self._yield_blocks(buf)
+            return
+        if action.type == COALESCED_ACTION:
+            t = json.loads(action.body.to_pybytes().decode())
+            tickets = _ticket_list(t)
+            self._bump("coalesced_rpc")
+            for i, tk in enumerate(tickets):
+                # open INSIDE the stream: a failure on location i surfaces
+                # after location i-1 completed, so the client's per-location
+                # accounting attributes it to the right map output
+                try:
+                    buf = _open_buffer(tk, self.work_dir)
+                except PermissionError as e:
+                    raise flight.FlightUnauthorizedError(str(e))
+                header = json.dumps({"i": i, "nbytes": buf.size}).encode()
+                yield flight.Result(pa.py_buffer(header))
+                yield from self._yield_blocks(buf)
+                self._bump("locations_served")
+                self._bump("bytes_served", buf.size)
             return
         if action.type == "remove_job_data":
             t = json.loads(action.body.to_pybytes().decode())
@@ -112,7 +178,9 @@ class BallistaFlightServer(flight.FlightServerBase):
         raise flight.FlightServerError(f"unknown action {action.type}")
 
     def list_actions(self, context):
-        return [("io_block_transport", "raw IPC block stream"), ("remove_job_data", "GC a job's shuffle files")]
+        return [("io_block_transport", "raw IPC block stream"),
+                (COALESCED_ACTION, "framed multi-location raw IPC block stream"),
+                ("remove_job_data", "GC a job's shuffle files")]
 
 
 def start_flight_server(work_dir: str, host: str = "0.0.0.0", port: int = 0,

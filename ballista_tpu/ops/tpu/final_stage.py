@@ -220,6 +220,14 @@ class TpuFinalStageExec(ExecutionPlan):
         # THESE instead of re-executing the whole child subtree
         self._mat_input: tuple | None = None
         self._mat_node = None
+        # fallback partitions already served off the materialized copy; once
+        # every expected partition has been read the copy is dropped (it can
+        # pin the stage's whole input on the host otherwise)
+        self._mat_served: set[int] = set()
+        self._mat_released_merged = False
+        # partitions served since the last (re-)dispatch — see
+        # _note_served_locked for the re-run retention bound
+        self._served_since_dispatch: set[int] = set()
         parts = [op.node_str() for op in ([sort] if sort else []) + post_ops]
         self.fingerprint = "|".join(
             parts + [agg.node_str(), repr(agg.input.df_schema), f"coalesce={coalesce}"]
@@ -280,18 +288,31 @@ class TpuFinalStageExec(ExecutionPlan):
                         self._results.update(self._tpu_run_all(ctx))
                     self.tpu_count += 1
                     self._mat_input = None
+                    self._served_since_dispatch = set()
                     # serve WITHOUT popping: one re-dispatch covers all K
                     # re-reads of an already-consumed result
                     if partition in self._results:
-                        return list(self._results[partition])
+                        out = list(self._results[partition])
+                        self._note_served_locked(partition)
+                        return out
                 except Exception:  # noqa: BLE001
                     logging.getLogger(__name__).warning(
                         "tpu final-stage re-run failed; cpu fallback for %s",
                         self.agg.node_str(), exc_info=True)
                     self._device_ok = False
             if partition in self._results:
-                return self._results.pop(partition)
+                out = self._results.pop(partition)
+                self._note_served_locked(partition)
+                return out
         return self._fallback(partition, ctx)
+
+    def _note_served_locked(self, partition: int) -> None:
+        """Bound re-run retention (call under _results_lock): when every
+        still-resident result has been served at least once since the last
+        dispatch, drop them all — they only exist for re-read convenience."""
+        self._served_since_dispatch.add(partition)
+        if self._results and set(self._results) <= self._served_since_dispatch:
+            self._results = {}
 
     def _materialized_scan(self):
         """Build (once) a MemoryScanExec over the child output a declined
@@ -311,11 +332,28 @@ class TpuFinalStageExec(ExecutionPlan):
                 self._mat_input = None  # don't retain a second full copy
             return self._mat_node
 
+    def _note_mat_served(self, partition: int, merged: bool) -> None:
+        """Drop the materialized child copy once the LAST expected fallback
+        partition has been served: merged/coalesced stages only ever serve
+        partition 0; hash-placed stages serve every output partition."""
+        with self._results_lock:
+            if self._mat_node is None:
+                return
+            self._mat_served.add(partition)
+            expected = ({0} if (merged or self.coalesce)
+                        else set(range(self.output_partition_count())))
+            if self._mat_served >= expected:
+                self._mat_node = None
+                self._mat_served.clear()
+                self._mat_released_merged = merged
+
     def _fallback(self, partition: int, ctx: TaskContext) -> list[pa.RecordBatch]:
         self.fallback_count += 1
         mat = self._materialized_scan()
+        merged_mat = False
         if mat is not None:
             node, merged = mat
+            merged_mat = merged
             if merged:
                 # bypass-read input is NOT hash-placed: merge globally and
                 # emit on partition 0 (the device bypass contract)
@@ -327,14 +365,25 @@ class TpuFinalStageExec(ExecutionPlan):
                 node = CoalescePartitionsExec(node)
         else:
             node = self.child
-            if self.coalesce:
+            if self._mat_released_merged:
+                # the merged host copy was served and released; bypass-read
+                # input is not hash-placed, so a late re-read must re-merge
+                # the child globally and still emit only on partition 0
+                if partition != 0 and not self.coalesce:
+                    return []
+                node = CoalescePartitionsExec(node)
+                partition = 0
+            elif self.coalesce:
                 node = CoalescePartitionsExec(node)
         node = self.agg.with_children([node])
         for op in reversed(self.post_ops):
             node = op.with_children([node])
         if self.sort is not None:
             node = self.sort.with_children([node])
-        return [b for b in node.execute(partition, ctx)]
+        out = [b for b in node.execute(partition, ctx)]
+        if mat is not None:
+            self._note_mat_served(partition, merged_mat)
+        return out
 
     # ------------------------------------------------------------------
 

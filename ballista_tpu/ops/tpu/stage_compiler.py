@@ -357,6 +357,11 @@ class TpuStageExec(ExecutionPlan):
         self.pid_emitted = 0
         self._results: dict[int, list[pa.RecordBatch]] | None = None
         self._results_lock = threading.Lock()
+        # partitions served since the last (re-)dispatch: once every resident
+        # result has been read at least once, the decoded host batches are
+        # evicted instead of staying pinned for the stage's lifetime (a later
+        # re-read just costs one more hot re-dispatch)
+        self._served_since_dispatch: set[int] = set()
         self._device_ok = False
         # structural fingerprint: identical stages across queries share XLA
         # compilations (plan objects are rebuilt per query, ids are not).
@@ -432,18 +437,31 @@ class TpuStageExec(ExecutionPlan):
                     with device_scope(ctx.device_ordinal):
                         self._results.update(self._tpu_run_all(ctx))
                     self.tpu_count += 1
+                    self._served_since_dispatch = set()
                     # serve WITHOUT popping: a consumer that re-reads one
                     # partition tends to re-read them all — one re-dispatch
                     # must cover all K re-reads, not K re-dispatches
                     if partition in self._results:
-                        return list(self._results[partition])
+                        out = list(self._results[partition])
+                        self._note_served_locked(partition)
+                        return out
                 except Exception:  # noqa: BLE001
                     log.warning("tpu stage re-run failed; cpu fallback for %s",
                                 self.partial_agg.node_str(), exc_info=True)
                     self._device_ok = False
             if partition in self._results:
-                return self._results.pop(partition)
+                out = self._results.pop(partition)
+                self._note_served_locked(partition)
+                return out
         return self._fallback(partition, ctx)
+
+    def _note_served_locked(self, partition: int) -> None:
+        """Bound re-run retention (call under _results_lock): when every
+        still-resident result has been served at least once since the last
+        dispatch, drop them all — they only exist for re-read convenience."""
+        self._served_since_dispatch.add(partition)
+        if self._results and set(self._results) <= self._served_since_dispatch:
+            self._results = {}
 
     def _fallback(self, partition: int, ctx: TaskContext) -> list[pa.RecordBatch]:
         """Re-run the original CPU subtree (scan filters applied on host)."""

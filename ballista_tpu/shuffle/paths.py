@@ -44,6 +44,47 @@ def is_sort_layout(layout: str) -> bool:
     return layout == "sort"
 
 
+def range_for(path: str, layout: str, output_partition: int) -> tuple[int, int] | None:
+    """(offset, length) of one output partition's bytes inside `path`, or
+    None when the partition is absent from a sort index (empty = contract).
+    Hash layout is always the whole file."""
+    if not is_sort_layout(layout):
+        return 0, os.path.getsize(path)
+    import json
+
+    with open(index_path(path)) as f:
+        index = json.load(f)
+    entry = index.get(str(output_partition))
+    if entry is None:
+        return None
+    return entry[0], entry[1]
+
+
+def open_range_buffer(path: str, layout: str, output_partition: int,
+                      use_mmap: bool = True):
+    """One partition's stored IPC bytes as a pyarrow Buffer.
+
+    With mmap (the default) the buffer is a zero-copy slice of a memory
+    map — the page cache backs it and the kernel faults pages in as the
+    consumer streams, so neither the Flight server nor a local reader ever
+    materializes the partition in anonymous memory. The buffer holds a
+    reference to the mapping, which stays alive until the last slice drops.
+    Returns None for a partition absent from a sort index."""
+    import pyarrow as pa
+
+    r = range_for(path, layout, output_partition)
+    if r is None:
+        return None
+    offset, length = r
+    if use_mmap:
+        mm = pa.memory_map(path)
+        mm.seek(offset)
+        return mm.read_buffer(length)
+    with open(path, "rb") as f:
+        f.seek(offset)
+        return pa.py_buffer(f.read(length))
+
+
 def job_dir(work_dir: str, job_id: str) -> str:
     return os.path.join(work_dir, job_id)
 

@@ -15,12 +15,10 @@ Rebuilds ShuffleReaderExec (core/src/execution_plans/shuffle_reader.rs:100):
 
 from __future__ import annotations
 
-import io
-import json
 import os
 import threading
 import time
-from typing import Iterator, Optional
+from typing import Iterator
 
 import pyarrow as pa
 import pyarrow.ipc as ipc
@@ -28,6 +26,9 @@ import pyarrow.ipc as ipc
 from ballista_tpu.config import (
     IO_RETRIES,
     IO_RETRY_WAIT_MS,
+    SHUFFLE_BLOCK_TRANSPORT,
+    SHUFFLE_FETCH_COALESCE,
+    SHUFFLE_MMAP,
     SHUFFLE_READER_FORCE_REMOTE,
     SHUFFLE_READER_MAX_PER_ADDR,
     SHUFFLE_READER_MAX_REQUESTS,
@@ -76,17 +77,25 @@ class ShuffleReaderExec(ExecutionPlan):
         force_remote = bool(ctx.config.get(SHUFFLE_READER_FORCE_REMOTE))
         produced = False
         gov = _governor(ctx)
+        ctr = _FetchCounters()
+        t0 = time.perf_counter_ns()
         if len(locs) > 1:
-            for b in _stream_locations(locs, ctx, force_remote, gov):
+            stream = _stream_locations(locs, ctx, force_remote, gov, counters=ctr)
+        else:
+            stream = (b for loc in locs for b in fetch_partition(
+                loc, ctx, force_remote=force_remote, governor=gov, counters=ctr))
+        try:
+            for b in stream:
                 if b.num_rows:
+                    if not produced:
+                        self.metrics.extra["time_to_first_batch_ns"] = (
+                            time.perf_counter_ns() - t0)
                     produced = True
                     yield b
-        else:
-            for loc in locs:
-                for b in fetch_partition(loc, ctx, force_remote=force_remote, governor=gov):
-                    if b.num_rows:
-                        produced = True
-                        yield b
+        finally:
+            # data-plane accounting for EXPLAIN ANALYZE / the scheduler's
+            # task metrics: RPCs issued and bytes moved by provenance
+            self.metrics.extra.update(ctr.snapshot())
         if not produced:
             yield _empty_batch(self.schema())
 
@@ -124,6 +133,22 @@ class UnresolvedShuffleExec(ExecutionPlan):
 
 
 # -- fetch machinery ---------------------------------------------------------
+
+
+class _FetchCounters:
+    """Per-execute data-plane accounting, mutated from fetch threads."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._data = {"fetch_rpcs": 0, "bytes_fetched_remote": 0, "bytes_read_local": 0}
+
+    def add(self, key: str, n: int = 1) -> None:
+        with self._lock:
+            self._data[key] += n
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return dict(self._data)
 
 
 class FetchGovernor:
@@ -190,21 +215,49 @@ def _governor(ctx: TaskContext) -> FetchGovernor:
         return g
 
 
+def _fetch_units(locs: list[PartitionLocation], remote: list[int],
+                 budget: int, coalesce: bool) -> list[list[int]]:
+    """Group remote location indices into fetch units: with coalescing, one
+    unit per executor address (split so a unit's byte estimate stays under
+    the reader budget) — a reduce task then issues ≈one RPC per executor
+    instead of one per map output. Units are ordered by their first location
+    index so the scheduler's prefix matches consumption order."""
+    if not coalesce:
+        return [[i] for i in remote]
+    by_addr: dict[str, list[list[int]]] = {}
+    for i in remote:
+        addr = locs[i].addr
+        chunks = by_addr.setdefault(addr, [[]])
+        est = min(locs[i].stats.num_bytes, budget)
+        cur_est = sum(min(locs[j].stats.num_bytes, budget) for j in chunks[-1])
+        if chunks[-1] and cur_est + est > budget:
+            chunks.append([])
+        chunks[-1].append(i)
+    units = [c for chunks in by_addr.values() for c in chunks]
+    units.sort(key=lambda u: u[0])
+    return units
+
+
 def _stream_locations(locs: list[PartitionLocation], ctx: TaskContext,
-                      force_remote: bool, gov: "FetchGovernor | None"):
+                      force_remote: bool, gov: "FetchGovernor | None",
+                      counters: "_FetchCounters | None" = None):
     """Bounded multi-location streaming merge (the reference's concurrent
     reduce-side reader, sort_shuffle/multi_stream_reader.rs).
 
-    Remote locations prefetch concurrently; LOCAL locations stream lazily
-    inline when their turn comes (no buffering at all). Yield order stays
-    location order, so order-sensitive float merges are deterministic.
-    Unlike the old fetch-everything-then-drain shape, fetched-but-unconsumed
-    bytes are capped by the reader byte budget: a fetch's result counts
-    against the window until the CONSUMER drains it, and new fetches are
-    only admitted under the cap (one is always admitted when the window is
-    empty, so an oversized partition streams alone instead of deadlocking).
-    Per-location buffering is retained — a retry around a half-yielded
-    Flight stream would duplicate rows (shuffle_reader.rs:975)."""
+    Remote locations prefetch concurrently in UNITS — with coalescing on,
+    all of one executor's map outputs fetch in a single coalesced RPC —
+    while LOCAL locations stream lazily inline when their turn comes (no
+    buffering at all). Yield order stays location order, so order-sensitive
+    float merges are deterministic. Fetched-but-unconsumed bytes are capped
+    by the reader byte budget: a unit's result counts against the window
+    until the CONSUMER drains it, and new units are only admitted under the
+    cap — except that the unit holding the location the consumer is about
+    to block on is always admitted (by-address grouping interleaves units
+    with consumption order, so a hard cap could park the needed unit behind
+    buffered bytes that can never drain; the budget is a soft bound there,
+    like the oversized-singleton admission). Per-location buffering is
+    retained — a retry around a half-yielded Flight stream would duplicate
+    rows (shuffle_reader.rs:975)."""
     import concurrent.futures as fut
     from ballista_tpu.config import SHUFFLE_READER_MAX_BYTES
 
@@ -216,40 +269,64 @@ def _stream_locations(locs: list[PartitionLocation], ctx: TaskContext,
     remote_set = set(remote)
     if not remote:
         for loc in locs:
-            yield from fetch_partition(loc, ctx, force_remote=force_remote, governor=gov)
+            yield from fetch_partition(loc, ctx, force_remote=force_remote,
+                                       governor=gov, counters=counters)
         return
+
+    coalesce = (bool(ctx.config.get(SHUFFLE_FETCH_COALESCE))
+                and bool(ctx.config.get(SHUFFLE_BLOCK_TRANSPORT)))
+    units = _fetch_units(locs, remote, budget, coalesce)
+    unit_of = {i: u for u, unit in enumerate(units) for i in unit}
+
+    def est_loc(i: int) -> int:
+        return min(locs[i].stats.num_bytes, budget)
 
     cond = threading.Condition()
     results: dict[int, list | Exception] = {}
     state = {"buffered": 0, "next": 0}
 
-    def fetch(i: int) -> None:
-        try:
-            out: list | Exception = list(
-                fetch_partition(locs[i], ctx, force_remote=force_remote, governor=gov))
-        except Exception as e:  # noqa: BLE001 — surfaced at the consumer in order
-            out = e
+    def publish(i: int, out) -> None:
         with cond:
             results[i] = out
             if not isinstance(out, Exception):
-                got = sum(b.nbytes for b in out)
                 # replace the stats estimate with actual bytes
-                state["buffered"] += got - min(locs[i].stats.num_bytes, budget)
+                state["buffered"] += sum(b.nbytes for b in out) - est_loc(i)
             cond.notify_all()
 
+    def fetch(i: int) -> None:
+        try:
+            out: list | Exception = list(
+                fetch_partition(locs[i], ctx, force_remote=force_remote,
+                                governor=gov, counters=counters))
+        except Exception as e:  # noqa: BLE001 — surfaced at the consumer in order
+            out = e
+        publish(i, out)
+
+    def fetch_unit(unit: list[int]) -> None:
+        if len(unit) == 1:
+            fetch(unit[0])
+            return
+        fallback = _fetch_unit_coalesced(unit, locs, ctx, gov, publish, counters)
+        for i in fallback:
+            fetch(i)
+
     pool = fut.ThreadPoolExecutor(
-        max_workers=min(len(remote), int(ctx.config.get(SHUFFLE_READER_MAX_REQUESTS))),
+        max_workers=min(len(units), int(ctx.config.get(SHUFFLE_READER_MAX_REQUESTS))),
         thread_name_prefix="shuffle-fetch",
     )
 
+    def submit_next_locked() -> None:
+        u = state["next"]
+        state["buffered"] += sum(est_loc(i) for i in units[u])
+        state["next"] += 1
+        pool.submit(fetch_unit, units[u])
+
     def top_up_locked() -> None:
-        while state["next"] < len(remote):
-            est = min(locs[remote[state["next"]]].stats.num_bytes, budget)
+        while state["next"] < len(units):
+            est = sum(est_loc(i) for i in units[state["next"]])
             if state["buffered"] > 0 and state["buffered"] + est > budget:
                 break
-            state["buffered"] += est
-            pool.submit(fetch, remote[state["next"]])
-            state["next"] += 1
+            submit_next_locked()
 
     try:
         with cond:
@@ -257,6 +334,10 @@ def _stream_locations(locs: list[PartitionLocation], ctx: TaskContext,
         for i, loc in enumerate(locs):
             if i in remote_set:
                 with cond:
+                    # progress guarantee: the unit this wait depends on (and
+                    # every unit before it) must be in flight
+                    while state["next"] <= unit_of[i]:
+                        submit_next_locked()
                     while i not in results:
                         cond.wait()
                     batches = results.pop(i)
@@ -268,26 +349,87 @@ def _stream_locations(locs: list[PartitionLocation], ctx: TaskContext,
                     top_up_locked()
             else:
                 # local: stream straight off disk, nothing buffered
-                yield from fetch_partition(loc, ctx, force_remote=False, governor=gov)
+                yield from fetch_partition(loc, ctx, force_remote=False,
+                                           governor=gov, counters=counters)
     finally:
         pool.shutdown(wait=False, cancel_futures=True)
 
 
+def _fetch_unit_coalesced(unit: list[int], locs: list[PartitionLocation],
+                          ctx: TaskContext, gov: "FetchGovernor | None",
+                          publish, counters: "_FetchCounters | None") -> list[int]:
+    """Fetch one executor's map outputs in a single coalesced RPC,
+    publishing each location's batches as it completes. Retries re-request
+    only the incomplete tail (completed locations were already published —
+    exactly-once per location). After retries the FetchFailed carries the
+    identity of the map output the last stream died on. Returns the indices
+    to fall back on per-location (server without the coalesced action)."""
+    from ballista_tpu.flight.client import (
+        CoalesceUnsupported,
+        FetchStreamError,
+        fetch_partitions_flight,
+    )
+
+    retries = int(ctx.config.get(IO_RETRIES))
+    wait_ms = int(ctx.config.get(IO_RETRY_WAIT_MS))
+    addr = locs[unit[0]].addr
+    remaining = list(unit)
+    failed = remaining[0]
+    last: BaseException | None = None
+    for attempt in range(retries + 1):
+        sub = list(remaining)
+        token = gov.acquire(addr, sum(locs[i].stats.num_bytes for i in sub)) if gov else None
+        try:
+            if counters:
+                counters.add("fetch_rpcs")
+            try:
+                for j, batches, nbytes in fetch_partitions_flight(
+                        [locs[i] for i in sub], ctx):
+                    if counters:
+                        counters.add("bytes_fetched_remote", sum(b.nbytes for b in batches))
+                    publish(sub[j], batches)
+                    remaining.remove(sub[j])
+                return []
+            except CoalesceUnsupported:
+                return remaining
+            except FetchStreamError as e:
+                failed = sub[min(e.loc_index, len(sub) - 1)]
+                last = e.cause
+        finally:
+            if gov:
+                gov.release(addr, token)
+        time.sleep(wait_ms * (attempt + 1) / 1000.0)
+    floc = locs[failed]
+    err = FetchFailed(floc.executor_id, floc.job_id, floc.stage_id,
+                      floc.map_partition, str(last))
+    for i in remaining:
+        publish(i, err)
+    return []
+
+
 def fetch_partition(loc: PartitionLocation, ctx: TaskContext, force_remote: bool = False,
-                    governor: FetchGovernor | None = None) -> Iterator[pa.RecordBatch]:
+                    governor: FetchGovernor | None = None,
+                    counters: _FetchCounters | None = None) -> Iterator[pa.RecordBatch]:
     local = not force_remote and loc.path and os.path.exists(loc.path)
     if local:
-        yield from read_local_partition(loc)
+        served = 0
+        for b in read_local_partition(loc, use_mmap=bool(ctx.config.get(SHUFFLE_MMAP))):
+            served += b.nbytes
+            yield b
+        if counters:
+            counters.add("bytes_read_local", served)
         return
     retries = int(ctx.config.get(IO_RETRIES))
     wait_ms = int(ctx.config.get(IO_RETRY_WAIT_MS))
-    addr = f"{loc.host}:{loc.flight_port}"
+    addr = loc.addr
     last: Exception | None = None
     for attempt in range(retries + 1):
         token = governor.acquire(addr, loc.stats.num_bytes) if governor else None
         try:
             from ballista_tpu.flight.client import fetch_partition_flight
 
+            if counters:
+                counters.add("fetch_rpcs")
             # buffer the WHOLE partition before yielding anything: in
             # decoded (do_get) mode the flight client streams batches
             # incrementally, so a retry around a half-yielded stream would
@@ -301,25 +443,23 @@ def fetch_partition(loc: PartitionLocation, ctx: TaskContext, force_remote: bool
         finally:
             if governor:
                 governor.release(addr, token)
+        if counters:
+            counters.add("bytes_fetched_remote", sum(b.nbytes for b in batches))
         yield from batches
         return
     raise FetchFailed(loc.executor_id, loc.job_id, loc.stage_id, loc.map_partition, str(last))
 
 
-def read_local_partition(loc: PartitionLocation) -> Iterator[pa.RecordBatch]:
-    if paths.is_sort_layout(loc.layout):
-        with open(paths.index_path(loc.path)) as f:
-            index = json.load(f)
-        entry = index.get(str(loc.output_partition))
-        if entry is None:
-            return
-        offset, length = entry[0], entry[1]
+def read_local_partition(loc: PartitionLocation, use_mmap: bool = True) -> Iterator[pa.RecordBatch]:
+    if not use_mmap and not paths.is_sort_layout(loc.layout):
+        # hash layout without mmap: stream straight off the open file
         with open(loc.path, "rb") as f:
-            f.seek(offset)
-            buf = f.read(length)
-        reader = ipc.open_stream(pa.BufferReader(buf))
-        yield from reader
-    else:
-        with open(loc.path, "rb") as f:
-            reader = ipc.open_stream(f)
-            yield from reader
+            yield from ipc.open_stream(f)
+        return
+    # zero-copy: batches decode directly out of the page cache; the buffer
+    # keeps the mapping alive for exactly as long as any batch references it
+    buf = paths.open_range_buffer(loc.path, loc.layout, loc.output_partition,
+                                  use_mmap=use_mmap)
+    if buf is None or buf.size == 0:
+        return
+    yield from ipc.open_stream(pa.BufferReader(buf))

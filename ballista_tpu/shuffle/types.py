@@ -39,3 +39,9 @@ class PartitionLocation:
     path: str = ""  # data file path on the executor
     layout: str = "hash"  # hash | sort
     stats: PartitionStats = field(default_factory=PartitionStats)
+
+    @property
+    def addr(self) -> str:
+        """Data-plane dial address of the owning executor — the coalescing
+        key: locations sharing an addr can ship in one fetch RPC."""
+        return f"{self.host}:{self.flight_port}"

@@ -39,21 +39,23 @@ def make_batches(rows: int, batch_size: int = 64 * 1024) -> list[pa.RecordBatch]
     return out
 
 
-def run_write(batches, work_dir: str, partitions: int, sort_shuffle: bool, ctx):
+def run_write(batches, work_dir: str, partitions: int, sort_shuffle: bool, ctx,
+              maps: int = 1):
     from ballista_tpu.plan.expressions import Column
     from ballista_tpu.plan.physical import MemoryScanExec
     from ballista_tpu.plan.schema import DFSchema
     from ballista_tpu.shuffle.writer import ShuffleWriterExec
 
     schema = DFSchema.from_arrow(batches[0].schema)
-    scan = MemoryScanExec(schema, batches, partitions=1)
+    scan = MemoryScanExec(schema, batches, partitions=maps)
     writer = ShuffleWriterExec(
         scan, "bench-job", 1, partitions, [Column("k")], sort_shuffle=sort_shuffle
     )
     t0 = time.time()
     metas = []
-    for b in writer.execute(0, ctx):
-        metas.append(b)
+    for m in range(maps):
+        for b in writer.execute(m, ctx):
+            metas.append(b)
     dt = time.time() - t0
     total_bytes = sum(
         os.path.getsize(os.path.join(root, f))
@@ -119,11 +121,15 @@ def run_read(work_dir: str, partitions: int, layout: str, mode: str, ctx, rows: 
     return dt
 
 
-def run_reader_exec(work_dir: str, partitions: int, layout: str, ctx, rows: int):
+def run_reader_exec(work_dir: str, partitions: int, layout: str, ctx, rows: int,
+                    coalesce: bool = True):
     """The REAL reduce path: ShuffleReaderExec over a Flight server, all of
     a partition's upstream locations fetched concurrently under the
-    governor. Reports seconds; throughput should scale with location count
+    governor. Reports seconds plus data-plane accounting — server-side RPC
+    counts by kind, bytes moved by provenance, and time-to-first-batch — so
+    a coalesce-on vs coalesce-off pair shows the RPC collapse directly
     (shuffle_reader.rs:762-875)."""
+    from ballista_tpu.config import SHUFFLE_FETCH_COALESCE
     from ballista_tpu.flight.server import start_flight_server
     from ballista_tpu.plan.schema import DFSchema
     from ballista_tpu.shuffle.reader import ShuffleReaderExec
@@ -160,24 +166,44 @@ def run_reader_exec(work_dir: str, partitions: int, layout: str, ctx, rows: int)
             for p in range(partitions)
         ]
         rd = ShuffleReaderExec(schema, locs)
+        rctx = _force_remote(ctx, {SHUFFLE_FETCH_COALESCE: coalesce})
+        stats0 = dict(server.stats)
+        acc = {"fetch_rpcs": 0, "bytes_fetched_remote": 0, "bytes_read_local": 0}
+        ttfb_ns = None
         t0 = time.time()
         got = 0
         for p in range(partitions):
-            for b in rd.execute(p, _force_remote(ctx)):
+            for b in rd.execute(p, rctx):
                 got += b.num_rows
+            extra = rd.metrics.extra
+            for k in acc:
+                acc[k] += int(extra.get(k, 0))
+            if ttfb_ns is None and "time_to_first_batch_ns" in extra:
+                ttfb_ns = extra["time_to_first_batch_ns"]
         dt = time.time() - t0
+        rpc_delta = {k: server.stats[k] - stats0[k]
+                     for k in ("do_get", "block_rpc", "coalesced_rpc")}
     finally:
         server.shutdown()
     assert got == rows, f"reader exec read {got} rows, expected {rows}"
-    return dt
+    return {
+        "seconds": dt,
+        "fetch_rpcs": acc["fetch_rpcs"],
+        "server_rpcs": rpc_delta,
+        "bytes_remote": acc["bytes_fetched_remote"],
+        "bytes_local": acc["bytes_read_local"],
+        "time_to_first_batch_ms": round((ttfb_ns or 0) / 1e6, 3),
+    }
 
 
-def _force_remote(ctx):
+def _force_remote(ctx, extra: dict | None = None):
     from ballista_tpu.config import SHUFFLE_READER_FORCE_REMOTE, BallistaConfig
     from ballista_tpu.plan.physical import TaskContext
 
     cfg = BallistaConfig.from_key_value_pairs(ctx.config.to_key_value_pairs())
     cfg.set(SHUFFLE_READER_FORCE_REMOTE, True)
+    for k, v in (extra or {}).items():
+        cfg.set(k, v)
     return TaskContext(cfg)
 
 
@@ -185,6 +211,8 @@ def main() -> None:
     ap = argparse.ArgumentParser(description="shuffle writer/reader micro-benchmark")
     ap.add_argument("--rows", type=int, default=2_000_000)
     ap.add_argument("--partitions", type=int, default=16)
+    ap.add_argument("--maps", type=int, default=4,
+                    help="upstream map tasks; >1 gives coalescing something to merge")
     ap.add_argument("--layout", choices=("sort", "hash", "both"), default="both")
     ap.add_argument("--read", choices=("local", "flight", "reader", "none"), default="local")
     ap.add_argument("--json", action="store_true")
@@ -200,18 +228,30 @@ def main() -> None:
         work = tempfile.mkdtemp(prefix=f"shuffle-bench-{layout}-")
         cfg = BallistaConfig({SORT_SHUFFLE_ENABLED: layout == "sort"})
         ctx = TaskContext(cfg, work_dir=work)
-        wt, nbytes = run_write(batches, work, args.partitions, layout == "sort", ctx)
+        wt, nbytes = run_write(batches, work, args.partitions, layout == "sort",
+                               ctx, maps=args.maps)
         entry = {
             "layout": layout, "rows": args.rows, "partitions": args.partitions,
+            "maps": args.maps,
             "write_s": round(wt, 3),
             "write_rows_per_s": int(args.rows / wt),
             "bytes": nbytes,
             "files": sum(len(fs) for _, _, fs in os.walk(work)),
         }
         if args.read == "reader":
-            rt = run_reader_exec(work, args.partitions, layout, ctx, args.rows)
-            entry["read_reader_s"] = round(rt, 3)
-            entry["read_reader_rows_per_s"] = int(args.rows / rt)
+            # before/after pair: same data, coalescing off vs on — the JSON
+            # line is the BENCH capture for the RPC-collapse win
+            for coalesce in (False, True):
+                r = run_reader_exec(work, args.partitions, layout, ctx,
+                                    args.rows, coalesce=coalesce)
+                tag = "coalesced" if coalesce else "uncoalesced"
+                entry[f"read_reader_{tag}_s"] = round(r["seconds"], 3)
+                entry[f"read_reader_{tag}_rows_per_s"] = int(args.rows / r["seconds"])
+                entry[f"read_reader_{tag}_fetch_rpcs"] = r["fetch_rpcs"]
+                entry[f"read_reader_{tag}_server_rpcs"] = r["server_rpcs"]
+                entry[f"read_reader_{tag}_bytes_remote"] = r["bytes_remote"]
+                entry[f"read_reader_{tag}_bytes_local"] = r["bytes_local"]
+                entry[f"read_reader_{tag}_ttfb_ms"] = r["time_to_first_batch_ms"]
         elif args.read != "none":
             rt = run_read(work, args.partitions, layout, args.read, ctx, args.rows)
             entry[f"read_{args.read}_s"] = round(rt, 3)

@@ -1,0 +1,195 @@
+"""Exercise the coalesced, zero-copy shuffle fetch path end-to-end.
+
+    JAX_PLATFORMS=cpu python dev/shuffle_exercise.py
+
+Two legs:
+
+1. correctness — TPC-H q5 on a 2-executor StandaloneCluster with every
+   shuffle read forced over Arrow Flight, run with fetch coalescing ON
+   and OFF; both runs must agree (the acceptance criterion for the
+   coalesced wire protocol).
+2. rpc-count — a direct writer→server→ShuffleReaderExec harness with
+   M=8 map tasks and R=4 reduce partitions on one server (E=1); the
+   coalesced run must make exactly R fetch RPCs (≤ E·R, i.e. at most
+   one per executor per reduce partition) where the uncoalesced run
+   makes M·R.
+
+Exits non-zero if either leg fails.
+"""
+
+import os
+import sys
+import tempfile
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+M, R = 8, 4
+
+
+def q5_sql() -> str:
+    with open(os.path.join(ROOT, "benchmarks", "tpch", "queries", "q5.sql")) as f:
+        return f.read()
+
+
+def run_q5(data_dir: str, coalesce: bool):
+    from ballista_tpu.client.context import SessionContext
+    from ballista_tpu.config import (
+        DEFAULT_SHUFFLE_PARTITIONS,
+        SHUFFLE_FETCH_COALESCE,
+        SHUFFLE_READER_FORCE_REMOTE,
+        BallistaConfig,
+    )
+    from ballista_tpu.testing.tpchgen import register_tpch
+
+    cfg = BallistaConfig({
+        DEFAULT_SHUFFLE_PARTITIONS: 4,
+        SHUFFLE_READER_FORCE_REMOTE: True,
+        SHUFFLE_FETCH_COALESCE: coalesce,
+    })
+    ctx = SessionContext.standalone(cfg, num_executors=2, vcores=2)
+    register_tpch(ctx, data_dir)
+    try:
+        return ctx.sql(q5_sql()).collect()
+    finally:
+        ctx.shutdown()
+
+
+def leg_correctness(data_dir: str) -> None:
+    on = run_q5(data_dir, coalesce=True)
+    off = run_q5(data_dir, coalesce=False)
+    key = on.column_names[0]
+    on = on.sort_by(key).to_pydict()
+    off = off.sort_by(key).to_pydict()
+    if on[key] != off[key]:
+        raise SystemExit(f"[q5] group keys differ: {on[key]} vs {off[key]}")
+    for col in on:
+        for a, b in zip(on[col], off[col]):
+            if isinstance(a, float):
+                if abs(a - b) > 1e-6 * max(1.0, abs(a)):
+                    raise SystemExit(f"[q5] {col}: {a} != {b}")
+            elif a != b:
+                raise SystemExit(f"[q5] {col}: {a} != {b}")
+    print(f"[q5] ok: coalesced and uncoalesced agree ({on[key]})")
+
+
+def read_all(work_dir: str, port: int, coalesce: bool) -> dict:
+    """Run ShuffleReaderExec forced-remote over the server; return row count."""
+    import pyarrow as pa
+
+    from ballista_tpu.config import (
+        SHUFFLE_FETCH_COALESCE,
+        SHUFFLE_READER_FORCE_REMOTE,
+        BallistaConfig,
+    )
+    from ballista_tpu.plan.physical import TaskContext
+    from ballista_tpu.plan.schema import DFSchema
+    from ballista_tpu.shuffle.reader import ShuffleReaderExec
+    from ballista_tpu.shuffle.types import PartitionLocation, PartitionStats
+
+    stage_dir = os.path.join(work_dir, "ex-job", "1")
+    per_part: dict[int, list] = {p: [] for p in range(R)}
+    for root, _, files in os.walk(stage_dir):
+        for f in sorted(files):
+            if f.endswith(".idx"):
+                continue
+            p = int(os.path.basename(root))
+            per_part[p].append(os.path.join(root, f))
+    locs = [
+        [
+            PartitionLocation(
+                map_partition=m, job_id="ex-job", stage_id=1,
+                output_partition=p, executor_id="e0", host="127.0.0.1",
+                flight_port=port, path=path, layout="hash",
+                stats=PartitionStats(0, 0, 0),
+            )
+            for m, path in enumerate(per_part[p])
+        ]
+        for p in range(R)
+    ]
+    schema = DFSchema.from_arrow(
+        pa.schema([("k", pa.int64()), ("v", pa.int64())]), "t")
+    ctx = TaskContext(BallistaConfig({
+        SHUFFLE_READER_FORCE_REMOTE: True,
+        SHUFFLE_FETCH_COALESCE: coalesce,
+    }))
+    rd = ShuffleReaderExec(schema, locs)
+    rows = 0
+    for p in range(R):
+        for b in rd.execute(p, ctx):
+            rows += b.num_rows
+    return {"rows": rows}
+
+
+def leg_rpc_count() -> None:
+    import numpy as np
+    import pyarrow as pa
+
+    from ballista_tpu.config import SORT_SHUFFLE_ENABLED, BallistaConfig
+    from ballista_tpu.flight.server import start_flight_server
+    from ballista_tpu.plan.expressions import col
+    from ballista_tpu.plan.physical import MemoryScanExec, TaskContext
+    from ballista_tpu.plan.schema import DFSchema
+    from ballista_tpu.shuffle.writer import ShuffleWriterExec
+
+    rng = np.random.default_rng(3)
+    batches = [
+        pa.record_batch({"k": pa.array(rng.integers(0, 1 << 20, 2000)),
+                         "v": pa.array(rng.integers(0, 100, 2000))})
+        for _ in range(M)
+    ]
+    total = sum(b.num_rows for b in batches)
+    with tempfile.TemporaryDirectory(prefix="shuffle-ex-") as work:
+        scan = MemoryScanExec(DFSchema.from_arrow(batches[0].schema), batches,
+                              partitions=M)
+        writer = ShuffleWriterExec(scan, "ex-job", 1, R, [col("k")],
+                                   sort_shuffle=False)
+        wctx = TaskContext(BallistaConfig({SORT_SHUFFLE_ENABLED: False}),
+                           work_dir=work)
+        for m in range(M):
+            for _ in writer.execute(m, wctx):
+                pass
+        server, port = start_flight_server(work, "127.0.0.1", 0)
+        try:
+            base = dict(server.stats)
+            got = read_all(work, port, coalesce=False)
+            uncoalesced = {k: server.stats[k] - base[k] for k in base}
+            if got["rows"] != total:
+                raise SystemExit(f"[rpc] uncoalesced read {got['rows']} rows, "
+                                 f"expected {total}")
+
+            base = dict(server.stats)
+            got = read_all(work, port, coalesce=True)
+            coalesced = {k: server.stats[k] - base[k] for k in base}
+            if got["rows"] != total:
+                raise SystemExit(f"[rpc] coalesced read {got['rows']} rows, "
+                                 f"expected {total}")
+        finally:
+            server.shutdown()
+
+    if uncoalesced["block_rpc"] != M * R:
+        raise SystemExit(f"[rpc] expected {M * R} uncoalesced block RPCs, "
+                         f"saw {uncoalesced['block_rpc']}")
+    # one server == one executor, so the bound "≤ E·R" means exactly R here
+    rpcs = coalesced["coalesced_rpc"]
+    if rpcs != R or coalesced["block_rpc"] != 0:
+        raise SystemExit(f"[rpc] expected {R} coalesced RPCs and 0 block RPCs, "
+                         f"saw {coalesced}")
+    print(f"[rpc] ok: M·R={M * R} RPCs uncoalesced → {rpcs} coalesced "
+          f"(one per executor per reduce partition)")
+
+
+def main() -> None:
+    from ballista_tpu.testing.tpchgen import generate_tpch
+
+    with tempfile.TemporaryDirectory(prefix="shuffle-tpch-") as d:
+        print(f"generating TPC-H sf0.01 under {d} ...")
+        generate_tpch(d, scale=0.01, seed=42, files_per_table=2)
+        leg_correctness(d)
+
+    leg_rpc_count()
+    print("shuffle exercise passed")
+
+
+if __name__ == "__main__":
+    main()

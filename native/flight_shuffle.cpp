@@ -9,7 +9,16 @@
 //     layout: byte range through the JSON index file).
 //   - DoAction("io_block_transport"): raw 8 MiB block streaming of the
 //     stored IPC bytes, no decode/re-encode (flight_service.rs:243).
+//   - DoAction("io_coalesced_transport"): several map outputs of one
+//     (executor, reduce partition) pair stream back-to-back in ONE call;
+//     each location is framed by a JSON header result {"i": idx,
+//     "nbytes": n} followed by its blocks. Locations open LAZILY inside
+//     the stream so a lost file on location i fails after i-1 completed
+//     and the client attributes the FetchFailed to the right map output.
 //   - DoAction("remove_job_data"): GC a job's shuffle directory.
+//
+// Blocks are zero-copy slices of a memory map of the shuffle file
+// (BALLISTA_SHUFFLE_MMAP=0 falls back to plain reads).
 //
 // Links against the Arrow C++ shipped inside the pyarrow wheel (C++20).
 // Build: native/build.sh → native/ballista-flight-server.
@@ -164,6 +173,29 @@ static bool ValidJobId(const std::string& job) {
          job.find('\0') == std::string::npos;
 }
 
+// One byte range of a shuffle file as a buffer — a zero-copy slice of a
+// memory map by default (the OS page cache backs the stream; nothing is
+// materialized in anonymous memory), plain pread when mmap is disabled
+// or fails (exotic filesystems).
+static arrow::Result<std::shared_ptr<arrow::Buffer>> OpenSlice(const std::string& path,
+                                                               int64_t offset, int64_t length) {
+  static const bool use_mmap = [] {
+    const char* v = std::getenv("BALLISTA_SHUFFLE_MMAP");
+    if (!v) return true;
+    std::string s(v);
+    for (auto& c : s) c = (char)std::tolower((unsigned char)c);
+    return !(s == "0" || s == "false" || s == "no" || s == "off");
+  }();
+  if (length == 0) return arrow::Buffer::FromString("");
+  if (use_mmap) {
+    auto mm = arrow::io::MemoryMappedFile::Open(path, arrow::io::FileMode::READ);
+    if (mm.ok()) return (*mm)->ReadAt(offset, length);
+    if (!fs::exists(path)) return mm.status();  // lost output must ERROR
+  }
+  ARROW_ASSIGN_OR_RAISE(auto f, arrow::io::ReadableFile::Open(path));
+  return f->ReadAt(offset, length);
+}
+
 static arrow::Result<std::shared_ptr<arrow::Buffer>> ReadRange(const std::string& ticket_json,
                                                                const std::string& work_dir) {
   std::string path = JsonStr(ticket_json, "path");
@@ -180,13 +212,72 @@ static arrow::Result<std::shared_ptr<arrow::Buffer>> ReadRange(const std::string
     long long offset = 0, length = 0;
     if (!IndexRange(index_json, JsonInt(ticket_json, "output_partition", 0), &offset, &length))
       return arrow::Buffer::FromString("");  // partition absent = empty (contract)
-    ARROW_ASSIGN_OR_RAISE(auto f, arrow::io::ReadableFile::Open(path));
-    return f->ReadAt(offset, length);
+    return OpenSlice(path, offset, length);
   }
-  ARROW_ASSIGN_OR_RAISE(auto f, arrow::io::ReadableFile::Open(path));
-  ARROW_ASSIGN_OR_RAISE(auto size, f->GetSize());
-  return f->Read(size);
+  std::error_code ec;
+  auto size = fs::file_size(path, ec);
+  if (ec) return arrow::Status::IOError("cannot stat shuffle file: ", path);
+  return OpenSlice(path, 0, (int64_t)size);
 }
+
+// "locations": [ {…}, {…} ] → each element's raw JSON. String-aware
+// brace-depth scan — braces inside quoted strings (paths) don't count.
+static bool SplitLocations(const std::string& j, std::vector<std::string>* out) {
+  auto p = j.find("\"locations\"");
+  if (p == std::string::npos) return false;
+  p = j.find('[', p);
+  if (p == std::string::npos) return false;
+  int depth = 0;
+  size_t start = 0;
+  bool in_str = false;
+  for (size_t i = p + 1; i < j.size(); i++) {
+    char c = j[i];
+    if (in_str) {
+      if (c == '\\') i++;
+      else if (c == '"') in_str = false;
+      continue;
+    }
+    if (c == '"') in_str = true;
+    else if (c == '{') { if (depth == 0) start = i; depth++; }
+    else if (c == '}') { if (--depth == 0) out->push_back(j.substr(start, i - start + 1)); }
+    else if (c == ']' && depth == 0) return true;
+  }
+  return false;
+}
+
+// Streams every location of a coalesced ticket: header result, then the
+// location's blocks, then the next location. Each location's buffer is
+// opened on first touch INSIDE the stream, so the failure point in the
+// result sequence identifies the lost map output.
+class CoalescedResultStream : public fl::ResultStream {
+ public:
+  CoalescedResultStream(std::vector<std::string> locs, std::string work_dir)
+      : locs_(std::move(locs)), work_dir_(std::move(work_dir)) {}
+
+  arrow::Result<std::unique_ptr<fl::Result>> Next() override {
+    if (cur_ && off_ < cur_->size()) {
+      auto len = std::min(kBlockSize, cur_->size() - off_);
+      auto slice = arrow::SliceBuffer(cur_, off_, len);
+      off_ += len;
+      return std::make_unique<fl::Result>(fl::Result{std::move(slice)});
+    }
+    if (idx_ >= locs_.size()) return nullptr;
+    ARROW_ASSIGN_OR_RAISE(cur_, ReadRange(locs_[idx_], work_dir_));
+    off_ = 0;
+    char hdr[64];
+    std::snprintf(hdr, sizeof(hdr), "{\"i\": %zu, \"nbytes\": %lld}", idx_,
+                  (long long)cur_->size());
+    idx_++;
+    return std::make_unique<fl::Result>(fl::Result{arrow::Buffer::FromString(hdr)});
+  }
+
+ private:
+  std::vector<std::string> locs_;
+  std::string work_dir_;
+  std::shared_ptr<arrow::Buffer> cur_;
+  int64_t off_ = 0;
+  size_t idx_ = 0;
+};
 
 class ShuffleServer : public fl::FlightServerBase {
  public:
@@ -221,6 +312,13 @@ class ShuffleServer : public fl::FlightServerBase {
       *result = std::make_unique<fl::SimpleResultStream>(std::move(results));
       return arrow::Status::OK();
     }
+    if (action.type == "io_coalesced_transport") {
+      std::vector<std::string> locs;
+      if (!SplitLocations(body, &locs))
+        return arrow::Status::Invalid("malformed coalesced ticket");
+      *result = std::make_unique<CoalescedResultStream>(std::move(locs), work_dir_);
+      return arrow::Status::OK();
+    }
     if (action.type == "remove_job_data") {
       std::string job = JsonStr(body, "job_id");
       if (!ValidJobId(job)) return arrow::Status::Invalid("invalid job id: ", job);
@@ -239,6 +337,7 @@ class ShuffleServer : public fl::FlightServerBase {
   arrow::Status ListActions(const fl::ServerCallContext&,
                             std::vector<fl::ActionType>* actions) override {
     *actions = {{"io_block_transport", "raw IPC block stream"},
+                {"io_coalesced_transport", "framed multi-location raw IPC block stream"},
                 {"remove_job_data", "GC a job's shuffle files"}};
     return arrow::Status::OK();
   }

@@ -68,7 +68,7 @@ def test_streaming_merge_correct_and_window_bounded(tmp_path):
     lock = threading.Lock()
     orig = rd.fetch_partition
 
-    def tracking(loc, ctx, force_remote=False, governor=None):
+    def tracking(loc, ctx, force_remote=False, governor=None, counters=None):
         with lock:
             active[0] += 1
             peak[0] = max(peak[0], active[0])
@@ -82,8 +82,14 @@ def test_streaming_merge_correct_and_window_bounded(tmp_path):
         from ballista_tpu.shuffle.reader import ShuffleReaderExec
         from ballista_tpu.shuffle.types import PartitionLocation
 
+        from ballista_tpu.config import SHUFFLE_FETCH_COALESCE
+
+        # coalescing off: this test exercises the PER-LOCATION prefetch
+        # window (all 6 duplicates share one address, so coalescing would
+        # collapse them into a single RPC and bypass the window entirely)
         cfg = BallistaConfig({SHUFFLE_READER_FORCE_REMOTE: True,
-                              SHUFFLE_READER_MAX_BYTES: max_bytes})
+                              SHUFFLE_READER_MAX_BYTES: max_bytes,
+                              SHUFFLE_FETCH_COALESCE: False})
         ctx = TaskContext(cfg)
         got = 0
         peak[0] = 0
@@ -257,3 +263,243 @@ def test_extra_metrics_survive_control_plane_wire():
     (m,) = back.metrics
     assert m["spilled_bytes"] == 4096 and m["spill_count"] == 2
     assert m["name"] == "ShuffleWriterExec: h" and m["elapsed_ns"] == 123
+
+
+# -- coalesced, zero-copy data plane ------------------------------------------
+
+
+def _write_multi_map(tmp_path, maps=4, partitions=3):
+    """M hash-layout map outputs for one stage; returns (work_dir, locations
+    by output partition, row counts by output partition, df schema)."""
+    import pyarrow.ipc as ipc
+
+    from ballista_tpu.shuffle import paths as sp
+    from ballista_tpu.shuffle.types import PartitionLocation, PartitionStats
+
+    schema = pa.schema([("k", pa.int64()), ("m", pa.int64())])
+    locs: dict[int, list] = {r: [] for r in range(partitions)}
+    rows = {r: 0 for r in range(partitions)}
+    for m in range(maps):
+        for r in range(partitions):
+            os.makedirs(sp.hash_partition_dir(str(tmp_path), "cjob", 1, r), exist_ok=True)
+            p = sp.hash_data_path(str(tmp_path), "cjob", 1, r, f"t{m}")
+            n = 7 * (m + 1) + r
+            batch = pa.record_batch(
+                {"k": pa.array(np.arange(n, dtype="int64")),
+                 "m": pa.array(np.full(n, m, dtype="int64"))})
+            with ipc.new_stream(p, batch.schema) as w:
+                w.write_batch(batch)
+            rows[r] += n
+            locs[r].append(PartitionLocation(
+                map_partition=m, job_id="cjob", stage_id=1, output_partition=r,
+                executor_id="e1", host="127.0.0.1", flight_port=0, path=p,
+                layout="hash", stats=PartitionStats(n, 1, os.path.getsize(p))))
+    return str(tmp_path), locs, rows, DFSchema.from_arrow(schema)
+
+
+def _reader_ctx(extra=None):
+    from ballista_tpu.config import SHUFFLE_READER_FORCE_REMOTE as FR
+
+    cfg = BallistaConfig({FR: True, **(extra or {})})
+    return cfg, TaskContext(cfg, task_id="t", work_dir="")
+
+
+def test_coalesced_fetch_one_rpc_per_executor(tmp_path):
+    """A reduce partition pulling M map outputs from ONE executor must issue
+    exactly one coalesced RPC (M·R block RPCs with coalescing off)."""
+    from ballista_tpu.config import SHUFFLE_FETCH_COALESCE
+    from ballista_tpu.flight.server import start_flight_server
+    from ballista_tpu.shuffle.reader import ShuffleReaderExec
+
+    work, locs, rows, schema = _write_multi_map(tmp_path, maps=4, partitions=3)
+    server, port = start_flight_server(work, "127.0.0.1", 0)
+    try:
+        for r in locs:
+            for l in locs[r]:
+                l.flight_port = port
+        _, ctx = _reader_ctx()
+        reader = ShuffleReaderExec(schema, [locs[r] for r in sorted(locs)])
+        for r in sorted(locs):
+            got = sum(b.num_rows for b in reader.execute(r, ctx))
+            assert got == rows[r]
+        assert server.stats["coalesced_rpc"] == len(locs)
+        assert server.stats["block_rpc"] == 0
+        assert reader.metrics.extra["fetch_rpcs"] == 1  # last partition: 1 RPC
+        assert reader.metrics.extra["bytes_fetched_remote"] > 0
+        assert "time_to_first_batch_ns" in reader.metrics.extra
+
+        before = server.stats["block_rpc"]
+        _, ctx_off = _reader_ctx({SHUFFLE_FETCH_COALESCE: False})
+        reader2 = ShuffleReaderExec(schema, [locs[r] for r in sorted(locs)])
+        for r in sorted(locs):
+            assert sum(b.num_rows for b in reader2.execute(r, ctx_off)) == rows[r]
+        assert server.stats["block_rpc"] - before == 4 * 3  # M·R uncoalesced
+    finally:
+        server.shutdown()
+
+
+def test_coalesced_midstream_failure_maps_to_right_identity(tmp_path):
+    """Losing map j's file mid-stream must surface as FetchFailed carrying
+    map j's identity (locations before j were already served) so the
+    scheduler recomputes the RIGHT upstream partition."""
+    from ballista_tpu.config import IO_RETRIES, IO_RETRY_WAIT_MS
+    from ballista_tpu.errors import FetchFailed
+    from ballista_tpu.flight.server import start_flight_server
+    from ballista_tpu.shuffle.reader import ShuffleReaderExec
+
+    work, locs, rows, schema = _write_multi_map(tmp_path, maps=4, partitions=1)
+    server, port = start_flight_server(work, "127.0.0.1", 0)
+    try:
+        for l in locs[0]:
+            l.flight_port = port
+        os.remove(locs[0][2].path)  # lose map 2, maps 0-1 still stream fine
+        _, ctx = _reader_ctx({IO_RETRIES: 1, IO_RETRY_WAIT_MS: 1})
+        reader = ShuffleReaderExec(schema, [locs[0]])
+        with pytest.raises(FetchFailed) as ei:
+            list(reader.execute(0, ctx))
+        assert ei.value.map_partition == 2
+        assert ei.value.job_id == "cjob" and ei.value.stage_id == 1
+    finally:
+        server.shutdown()
+
+
+def test_do_get_streams_without_read_all(tmp_path, monkeypatch):
+    """The decoded do_get path must be a true stream: neither the server nor
+    the relay may materialize the partition with read_all()."""
+    import pyarrow.ipc as ipc
+
+    from ballista_tpu.config import SHUFFLE_BLOCK_TRANSPORT
+    from ballista_tpu.flight.server import start_flight_server
+    from ballista_tpu.shuffle.reader import ShuffleReaderExec
+
+    def boom(self, *a, **k):
+        raise AssertionError("read_all() materializes the whole partition")
+
+    monkeypatch.setattr(ipc.RecordBatchStreamReader, "read_all", boom)
+    work, locs, rows, schema = _write_multi_map(tmp_path, maps=3, partitions=1)
+    server, port = start_flight_server(work, "127.0.0.1", 0)
+    try:
+        for l in locs[0]:
+            l.flight_port = port
+        _, ctx = _reader_ctx({SHUFFLE_BLOCK_TRANSPORT: False})
+        reader = ShuffleReaderExec(schema, [locs[0]])
+        assert sum(b.num_rows for b in reader.execute(0, ctx)) == rows[0]
+        assert server.stats["do_get"] >= 1
+    finally:
+        server.shutdown()
+
+
+def test_sort_layout_range_serves_identically_with_and_without_mmap(tmp_path, monkeypatch):
+    """Sort-layout byte ranges must decode identically as zero-copy mmap
+    slices and as plain reads (the env escape hatch)."""
+    from ballista_tpu.flight.server import start_flight_server
+    from ballista_tpu.shuffle.reader import ShuffleReaderExec
+
+    work, locs_by_p, total_rows, schema = _write_stage(tmp_path, rows=50_000, partitions=4)
+    server, port = start_flight_server(work, "127.0.0.1", 0)
+    try:
+        from ballista_tpu.shuffle.types import PartitionLocation
+
+        plocs = [[PartitionLocation(**{**l.__dict__, "flight_port": port})
+                  for l in locs_by_p[p]] for p in range(4)]
+
+        def read_all_rows():
+            _, ctx = _reader_ctx()
+            reader = ShuffleReaderExec(schema, plocs)
+            return [sum(b.num_rows for b in reader.execute(p, ctx)) for p in range(4)]
+
+        with_mmap = read_all_rows()
+        monkeypatch.setenv("BALLISTA_SHUFFLE_MMAP", "0")
+        without_mmap = read_all_rows()
+        assert with_mmap == without_mmap
+        assert sum(with_mmap) == total_rows
+    finally:
+        server.shutdown()
+
+
+def test_proxy_relays_coalesced_tickets_verbatim(tmp_path):
+    """External mode: the scheduler proxy must pass a coalesced stream
+    through unchanged — framing intact, ONE upstream RPC."""
+    from ballista_tpu.config import FLIGHT_PROXY
+    from ballista_tpu.flight.proxy import start_flight_proxy
+    from ballista_tpu.flight.server import start_flight_server
+    from ballista_tpu.shuffle.reader import ShuffleReaderExec
+
+    work, locs, rows, schema = _write_multi_map(tmp_path, maps=4, partitions=1)
+    server, port = start_flight_server(work, "127.0.0.1", 0)
+    proxy, proxy_port = start_flight_proxy("127.0.0.1", 0)
+    try:
+        for l in locs[0]:
+            l.flight_port = port
+        _, ctx = _reader_ctx({FLIGHT_PROXY: f"127.0.0.1:{proxy_port}"})
+        reader = ShuffleReaderExec(schema, [locs[0]])
+        assert sum(b.num_rows for b in reader.execute(0, ctx)) == rows[0]
+        assert server.stats["coalesced_rpc"] == 1  # one RPC reached the executor
+        assert proxy.stats["relayed_actions"] == 1
+    finally:
+        proxy.shutdown()
+        server.shutdown()
+
+
+def test_coalesce_falls_back_when_server_lacks_action(tmp_path):
+    """Against a data plane without io_coalesced_transport (e.g. an older
+    native server) the client must cache the capability miss and fall back
+    to per-location fetches — same rows, no error."""
+    import json
+
+    import pyarrow.flight as flight
+
+    from ballista_tpu.flight import client as fc
+    from ballista_tpu.flight.server import BallistaFlightServer
+    from ballista_tpu.shuffle.reader import ShuffleReaderExec
+
+    class LegacyServer(BallistaFlightServer):
+        def do_action(self, context, action):
+            if action.type == "io_coalesced_transport":
+                raise flight.FlightServerError(f"unknown action {action.type}")
+            yield from super().do_action(context, action)
+
+    work, locs, rows, schema = _write_multi_map(tmp_path, maps=3, partitions=1)
+    server = LegacyServer("127.0.0.1", 0, work)
+    port = server.port
+    t = threading.Thread(target=server.serve, daemon=True)
+    t.start()
+    try:
+        for l in locs[0]:
+            l.flight_port = port
+        _, ctx = _reader_ctx()
+        reader = ShuffleReaderExec(schema, [locs[0]])
+        assert sum(b.num_rows for b in reader.execute(0, ctx)) == rows[0]
+        assert f"127.0.0.1:{port}" in fc._NO_COALESCE
+        assert server.stats["block_rpc"] == 3  # per-location fallback
+    finally:
+        with fc._NO_COALESCE_LOCK:
+            fc._NO_COALESCE.discard(f"127.0.0.1:{port}")
+        server.shutdown()
+
+
+def test_chained_buffer_reader_exact_reads():
+    """ipc decode over the chained reader: read(n) must return exactly n
+    bytes across block boundaries, and odd server block sizes must not
+    corrupt the stream (no b''.join reassembly anywhere)."""
+    import pyarrow.ipc as ipc
+
+    from ballista_tpu.flight.client import ChainedBufferReader
+
+    batch = pa.record_batch({"x": pa.array(np.arange(10_000, dtype="int64"))})
+    sink = pa.BufferOutputStream()
+    with ipc.new_stream(sink, batch.schema) as w:
+        for _ in range(5):
+            w.write_batch(batch)
+    blob = sink.getvalue().to_pybytes()
+    for block in (7, 1024, 100_000, len(blob) + 1):
+        blocks = [blob[i:i + block] for i in range(0, len(blob), block)]
+        r = ChainedBufferReader([pa.py_buffer(b) for b in blocks])
+        got = list(ipc.open_stream(r))
+        assert sum(b.num_rows for b in got) == 50_000
+    # raw semantics: exact-n reads spanning blocks, zero-copy within one
+    r = ChainedBufferReader([pa.py_buffer(b"abc"), pa.py_buffer(b"defgh")])
+    assert bytes(r.read(2)) == b"ab"
+    assert bytes(r.read(3)) == b"cde"  # spans the boundary
+    assert bytes(r.read(-1)) == b"fgh"
+    assert r.read(10) == b""

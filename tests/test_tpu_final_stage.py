@@ -430,11 +430,16 @@ def test_declined_final_stage_reuses_materialized_child():
             rows.extend(b.to_pylist())
     # the final stage declined (device roundtrip not worth 3 rows) ...
     assert all(f.tpu_count == 0 and f.fallback_count > 0 for f in finals)
-    # ... and reused the materialized child output instead of re-scanning
-    assert all(f._mat_node is not None for f in finals), \
-        "fallback did not reuse the materialized child tables"
+    # ... reused the materialized child output instead of re-scanning (the
+    # child executed exactly once, on device, with no host fallback) ...
     assert all(s.fallback_count == 0 for s in stages), \
         "child stage re-executed on the host after its results were consumed"
+    assert all(s.tpu_count == 1 for s in stages), \
+        "child stage re-dispatched: fallback did not reuse the materialized tables"
+    # ... and RELEASED the pinned host copy once the last expected fallback
+    # partition was served (it must not stay resident for the plan's lifetime)
+    assert all(f._mat_node is None and f._mat_input is None for f in finals), \
+        "materialized child copy still pinned after serving"
     # correctness against pandas
     import pandas as pd
 
