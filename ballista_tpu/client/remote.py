@@ -6,23 +6,60 @@ config (catalog registrations ride along as KV pairs), ExecuteQuery (SQL
 or physical-plan protobuf), GetJobStatus polling, then fetch result
 partitions from executors over Flight (local fast path applies when
 colocated).
+
+Overload cooperation: submissions shed by the scheduler's admission gate
+come back as RESOURCE_EXHAUSTED with a `retry-after-ms` hint in trailing
+metadata; this client honors the hint with jittered exponential backoff
+instead of hammering an already-overloaded control plane. Idempotent
+RPCs (GetJobStatus, CreateUpdateSession) retry on transient UNAVAILABLE/
+DEADLINE_EXCEEDED, and wait_for_job's poll interval grows exponentially
+so long jobs don't keep a tight 10 Hz poll loop open per client.
 """
 
 from __future__ import annotations
 
+import logging
+import random
 import time
 
 import grpc
 import pyarrow as pa
 
-from ballista_tpu.config import CLIENT_JOB_TIMEOUT_S, BallistaConfig
-from ballista_tpu.errors import ExecutionError, GrpcError
+from ballista_tpu.config import (
+    CLIENT_BACKOFF_BASE_MS,
+    CLIENT_BACKOFF_MAX_MS,
+    CLIENT_JOB_TIMEOUT_S,
+    CLIENT_SUBMIT_RETRIES,
+    BallistaConfig,
+)
+from ballista_tpu.errors import ClusterOverloaded, ExecutionError, GrpcError
 from ballista_tpu.proto import pb
 from ballista_tpu.scheduler.grpc_service import scheduler_stub
 from ballista_tpu.serde import encode_plan
 from ballista_tpu.serde_control import decode_job_status
 
+log = logging.getLogger(__name__)
+
 POLL_INTERVAL_S = 0.1
+POLL_INTERVAL_MAX_S = 2.0
+
+# transient codes worth retrying on idempotent rpcs
+_TRANSIENT = (grpc.StatusCode.UNAVAILABLE, grpc.StatusCode.DEADLINE_EXCEEDED)
+
+
+def _retry_after_ms(e: grpc.RpcError) -> int | None:
+    """Extract the scheduler's backoff hint from a RESOURCE_EXHAUSTED
+    rejection: trailing metadata first, message text as fallback."""
+    try:
+        for k, v in (e.trailing_metadata() or ()):
+            if k == "retry-after-ms":
+                return int(v)
+    except Exception:  # noqa: BLE001 — metadata shape varies by transport
+        pass
+    import re
+
+    m = re.search(r"retry_after_ms=(\d+)", str(e.details() if hasattr(e, "details") else e))
+    return int(m.group(1)) if m else None
 
 
 class RemoteSchedulerClient:
@@ -34,38 +71,95 @@ class RemoteSchedulerClient:
         self.stub = scheduler_stub(self.channel)
         self.config = config
         self.session_id: str = ""
+        self.submit_retries = 0  # observability: backoffs taken on submit
 
     def _settings(self) -> list[pb.KeyValuePair]:
         return [pb.KeyValuePair(key=k, value=v) for k, v in self.config.to_key_value_pairs()]
 
+    def _backoff_s(self, attempt: int, hint_ms: int | None = None) -> float:
+        """Jittered exponential backoff, floored at the server's
+        retry_after_ms hint when one was given: the server knows its own
+        drain rate better than our exponent does."""
+        base = int(self.config.get(CLIENT_BACKOFF_BASE_MS))
+        cap = int(self.config.get(CLIENT_BACKOFF_MAX_MS))
+        ms = min(cap, base * (2 ** attempt))
+        if hint_ms is not None:
+            ms = min(cap, max(ms, hint_ms))
+        # full jitter (0.5x..1.0x) decorrelates a herd of rejected clients
+        return ms * (0.5 + random.random() * 0.5) / 1000.0
+
+    def _call_idempotent(self, fn, req, what: str, timeout: float = 10.0):
+        """Retry an idempotent rpc on transient UNAVAILABLE /
+        DEADLINE_EXCEEDED with jittered backoff (satellite: wait_for_job
+        must not raise through the caller mid-poll on a scheduler blip)."""
+        retries = int(self.config.get(CLIENT_SUBMIT_RETRIES))
+        for attempt in range(retries + 1):
+            try:
+                return fn(req, timeout=timeout)
+            except grpc.RpcError as e:
+                code = e.code() if hasattr(e, "code") else None
+                if code not in _TRANSIENT or attempt >= retries:
+                    raise
+                wait = self._backoff_s(attempt)
+                log.warning("%s transient failure (%s); retry %d/%d in %.2fs",
+                            what, code, attempt + 1, retries, wait)
+                time.sleep(wait)
+
     def ensure_session(self) -> str:
         req = pb.CreateSessionParams(session_id=self.session_id)
         req.settings.extend(self._settings())
-        resp = self.stub.CreateUpdateSession(req, timeout=10)
+        resp = self._call_idempotent(self.stub.CreateUpdateSession, req, "CreateUpdateSession")
         self.session_id = resp.session_id
         return self.session_id
+
+    def _submit(self, req) -> str:
+        """ExecuteQuery with overload cooperation: RESOURCE_EXHAUSTED
+        rejections back off honoring the scheduler's retry_after_ms hint,
+        then resubmit; a still-overloaded cluster after all retries
+        surfaces a typed ClusterOverloaded to the caller."""
+        retries = int(self.config.get(CLIENT_SUBMIT_RETRIES))
+        for attempt in range(retries + 1):
+            try:
+                return self.stub.ExecuteQuery(req, timeout=30).job_id
+            except grpc.RpcError as e:
+                code = e.code() if hasattr(e, "code") else None
+                if code == grpc.StatusCode.RESOURCE_EXHAUSTED:
+                    hint = _retry_after_ms(e)
+                    if attempt >= retries:
+                        raise ClusterOverloaded(
+                            f"submission rejected after {retries} retries: "
+                            f"{e.details() if hasattr(e, 'details') else e}",
+                            retry_after_ms=hint or 1000,
+                        ) from None
+                    wait = self._backoff_s(attempt, hint)
+                    self.submit_retries += 1
+                    log.info("cluster overloaded; resubmitting in %.2fs (hint=%sms, retry %d/%d)",
+                             wait, hint, attempt + 1, retries)
+                    time.sleep(wait)
+                    continue
+                if code in _TRANSIENT and attempt < retries:
+                    time.sleep(self._backoff_s(attempt))
+                    continue
+                raise GrpcError(f"ExecuteQuery failed: {e}") from None
 
     def execute_sql(self, sql: str, job_name: str = "") -> str:
         sid = self.ensure_session()
         req = pb.ExecuteQueryParams(sql=sql, session_id=sid, job_name=job_name)
         req.settings.extend(self._settings())
-        try:
-            resp = self.stub.ExecuteQuery(req, timeout=30)
-        except grpc.RpcError as e:
-            raise GrpcError(f"ExecuteQuery failed: {e}") from None
-        return resp.job_id
+        return self._submit(req)
 
     def execute_physical(self, physical, job_name: str = "") -> str:
         sid = self.ensure_session()
         req = pb.ExecuteQueryParams(session_id=sid, job_name=job_name)
         req.physical_plan.CopyFrom(encode_plan(physical))
         req.settings.extend(self._settings())
-        resp = self.stub.ExecuteQuery(req, timeout=30)
-        return resp.job_id
+        return self._submit(req)
 
     def execute_sql_push(self, sql: str, job_name: str = "", timeout: float = 600.0) -> dict:
         """Submit + watch in ONE server-streaming rpc (execute_query_push):
-        the scheduler pushes each state change; returns the terminal status."""
+        the scheduler pushes each state change; returns the terminal status.
+        An admission rejection terminates the stream with
+        RESOURCE_EXHAUSTED, surfaced as a typed ClusterOverloaded."""
         sid = self.ensure_session()
         req = pb.ExecuteQueryParams(sql=sql, session_id=sid, job_name=job_name)
         req.settings.extend(self._settings())
@@ -77,6 +171,12 @@ class RemoteSchedulerClient:
                     if last["state"] in ("successful", "failed", "cancelled"):
                         return last
         except grpc.RpcError as e:
+            code = e.code() if hasattr(e, "code") else None
+            if code == grpc.StatusCode.RESOURCE_EXHAUSTED:
+                raise ClusterOverloaded(
+                    f"push submission shed: {e.details() if hasattr(e, 'details') else e}",
+                    retry_after_ms=_retry_after_ms(e) or 1000,
+                ) from None
             raise GrpcError(f"ExecuteQueryPush failed: {e}") from None
         if last is None:
             raise ExecutionError("push stream ended without a terminal status")
@@ -84,12 +184,17 @@ class RemoteSchedulerClient:
 
     def wait_for_job(self, job_id: str, timeout: float = 600.0) -> dict:
         deadline = time.time() + timeout
+        poll = POLL_INTERVAL_S
         while time.time() < deadline:
-            resp = self.stub.GetJobStatus(pb.GetJobStatusParams(job_id=job_id), timeout=10)
+            resp = self._call_idempotent(
+                self.stub.GetJobStatus, pb.GetJobStatusParams(job_id=job_id), "GetJobStatus")
             status = decode_job_status(resp.status)
             if status["state"] in ("successful", "failed", "cancelled"):
                 return status
-            time.sleep(POLL_INTERVAL_S)
+            time.sleep(poll)
+            # exponential poll growth: fast feedback on short jobs, gentle
+            # on the scheduler for long ones
+            poll = min(POLL_INTERVAL_MAX_S, poll * 1.5)
         raise ExecutionError(f"job {job_id} timed out")
 
     def cancel_job(self, job_id: str) -> None:

@@ -56,6 +56,25 @@ GRPC_TLS_CERT = "ballista.grpc.tls.cert.path"
 GRPC_TLS_KEY = "ballista.grpc.tls.key.path"
 IO_RETRIES = "ballista.io.retries.times"
 IO_RETRY_WAIT_MS = "ballista.io.retry.wait.time.ms"
+# overload protection: scheduler admission control + load shedding
+ADMISSION_ENABLED = "ballista.admission.enabled"
+ADMISSION_MAX_PENDING_JOBS = "ballista.admission.max.pending.jobs"
+ADMISSION_MAX_INFLIGHT_PER_SESSION = "ballista.admission.max.inflight.per.session"
+ADMISSION_SHED_DEPTH = "ballista.admission.shed.queue.depth"
+ADMISSION_DRAIN_DEPTH = "ballista.admission.drain.queue.depth"
+ADMISSION_SHED_LOOP_LAG_S = "ballista.admission.shed.loop.lag.seconds"
+ADMISSION_SHED_MEMORY_PRESSURE = "ballista.admission.shed.memory.pressure"
+ADMISSION_MIN_RETRY_AFTER_MS = "ballista.admission.min.retry.after.ms"
+# overload protection: Flight data plane
+FLIGHT_MAX_STREAMS = "ballista.flight.max.streams"
+FLIGHT_ACCEPT_QUEUE = "ballista.flight.accept.queue.depth"
+FLIGHT_STALL_TIMEOUT_S = "ballista.flight.stream.stall.timeout.seconds"
+FLIGHT_BREAKER_THRESHOLD = "ballista.flight.breaker.failure.threshold"
+FLIGHT_BREAKER_COOLDOWN_S = "ballista.flight.breaker.cooldown.seconds"
+# overload protection: client backoff
+CLIENT_SUBMIT_RETRIES = "ballista.client.submit.max.retries"
+CLIENT_BACKOFF_BASE_MS = "ballista.client.backoff.base.ms"
+CLIENT_BACKOFF_MAX_MS = "ballista.client.backoff.max.ms"
 CHAOS_ENABLED = "ballista.chaos.enabled"
 CHAOS_SEED = "ballista.chaos.seed"
 CHAOS_PROBABILITY = "ballista.chaos.probability"
@@ -138,6 +157,32 @@ def _env_bool(name: str, default: bool) -> bool:
     return raw.strip().lower() not in ("0", "false", "no", "off")
 
 
+def _env_int(name: str, default: int) -> int:
+    """Integer escape hatch for daemons with no session config (Flight
+    server, admission control on a shared scheduler)."""
+    import os
+
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    try:
+        return int(raw)
+    except ValueError:
+        return default
+
+
+def _env_float(name: str, default: float) -> float:
+    import os
+
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        return default
+
+
 def _pos(v: Any) -> bool:
     return v > 0
 
@@ -211,12 +256,118 @@ _ENTRIES: list[ConfigEntry] = [
     ),
     ConfigEntry(IO_RETRIES, "Shuffle fetch retry attempts.", int, 3, _nonneg),
     ConfigEntry(IO_RETRY_WAIT_MS, "Base backoff between shuffle fetch retries.", int, 100, _nonneg),
+    ConfigEntry(
+        ADMISSION_ENABLED,
+        "Scheduler admission control: bound pending jobs and per-session in-flight "
+        "quotas, shedding excess submissions with a typed ClusterOverloaded "
+        "rejection + retry_after_ms hint instead of queueing without bound. "
+        "Env escape hatch: BALLISTA_ADMISSION=0.",
+        bool, _env_bool("BALLISTA_ADMISSION", True),
+    ),
+    ConfigEntry(
+        ADMISSION_MAX_PENDING_JOBS,
+        "Max jobs queued/planning cluster-wide before new submissions are shed. "
+        "Env: BALLISTA_ADMISSION_MAX_PENDING.",
+        int, _env_int("BALLISTA_ADMISSION_MAX_PENDING", 256), _pos,
+    ),
+    ConfigEntry(
+        ADMISSION_MAX_INFLIGHT_PER_SESSION,
+        "Max non-terminal jobs one session may hold; the quota halves while the "
+        "cluster is shedding. Env: BALLISTA_ADMISSION_SESSION_QUOTA.",
+        int, _env_int("BALLISTA_ADMISSION_SESSION_QUOTA", 64), _pos,
+    ),
+    ConfigEntry(
+        ADMISSION_SHED_DEPTH,
+        "Pending-job depth at which the overload state machine leaves normal for "
+        "shedding (quotas halve; hysteresis exits at half this depth).",
+        int, _env_int("BALLISTA_ADMISSION_SHED_DEPTH", 128), _pos,
+    ),
+    ConfigEntry(
+        ADMISSION_DRAIN_DEPTH,
+        "Pending-job depth at which shedding escalates to draining: ALL new "
+        "submissions are rejected until the backlog drains below the shed depth.",
+        int, _env_int("BALLISTA_ADMISSION_DRAIN_DEPTH", 224), _pos,
+    ),
+    ConfigEntry(
+        ADMISSION_SHED_LOOP_LAG_S,
+        "Scheduler event-loop lag (post→handle latency) that forces shedding even "
+        "with a shallow queue — a wedged loop means depth is lying.",
+        float, 2.0, _pos,
+    ),
+    ConfigEntry(
+        ADMISSION_SHED_MEMORY_PRESSURE,
+        "Aggregate executor memory-pressure score (0-1, from heartbeats) above "
+        "which the scheduler sheds: executors near pool saturation reject tasks "
+        "anyway, so admitting more jobs only grows the retry storm.",
+        float, 0.9, lambda v: 0.0 < v <= 1.0,
+    ),
+    ConfigEntry(
+        ADMISSION_MIN_RETRY_AFTER_MS,
+        "Floor for the retry_after_ms hint carried by ClusterOverloaded "
+        "rejections (the drain-rate estimate can be optimistic right after a "
+        "burst).",
+        int, 100, _nonneg,
+    ),
+    ConfigEntry(
+        FLIGHT_MAX_STREAMS,
+        "Flight data plane: max concurrent do_get/do_action streams per server; "
+        "excess callers wait in a bounded accept queue and are then rejected "
+        "UNAVAILABLE. Env escape hatch (servers have no session config): "
+        "BALLISTA_FLIGHT_MAX_STREAMS.",
+        int, _env_int("BALLISTA_FLIGHT_MAX_STREAMS", 64), _pos,
+    ),
+    ConfigEntry(
+        FLIGHT_ACCEPT_QUEUE,
+        "Flight data plane: how many callers may wait for a stream slot before "
+        "new ones are rejected immediately. Env: BALLISTA_FLIGHT_ACCEPT_QUEUE.",
+        int, _env_int("BALLISTA_FLIGHT_ACCEPT_QUEUE", 128), _nonneg,
+    ),
+    ConfigEntry(
+        FLIGHT_STALL_TIMEOUT_S,
+        "Flight data plane: a do_get consumer that pulls no batch for this long "
+        "is cut off (frees the server-side buffers and the stream slot instead "
+        "of wedging on a dead peer). 0 disables. Env: BALLISTA_FLIGHT_STALL_TIMEOUT_S.",
+        float, _env_float("BALLISTA_FLIGHT_STALL_TIMEOUT_S", 30.0), _nonneg,
+    ),
+    ConfigEntry(
+        FLIGHT_BREAKER_THRESHOLD,
+        "Flight client circuit breaker: consecutive failures to one address that "
+        "trip it open (fail-fast, no dial, until a half-open probe succeeds). "
+        "0 disables.",
+        int, 5, _nonneg,
+    ),
+    ConfigEntry(
+        FLIGHT_BREAKER_COOLDOWN_S,
+        "Flight client circuit breaker: seconds an open breaker waits before "
+        "allowing one half-open probe.",
+        float, 5.0, _pos,
+    ),
+    ConfigEntry(
+        CLIENT_SUBMIT_RETRIES,
+        "Max client retries of a shed submission (ClusterOverloaded / "
+        "RESOURCE_EXHAUSTED), honoring the server's retry_after_ms hint with "
+        "jitter; also bounds retries of idempotent RPCs on UNAVAILABLE.",
+        int, 5, _nonneg,
+    ),
+    ConfigEntry(
+        CLIENT_BACKOFF_BASE_MS,
+        "Client retry backoff base (exponential, full jitter).",
+        int, 100, _pos,
+    ),
+    ConfigEntry(
+        CLIENT_BACKOFF_MAX_MS,
+        "Client retry backoff ceiling.",
+        int, 10_000, _pos,
+    ),
     ConfigEntry(CHAOS_ENABLED, "Fault injection: wrap leaf operators in chaos nodes.", bool, False),
     ConfigEntry(CHAOS_SEED, "Fault injection RNG seed.", int, 0, _nonneg),
     ConfigEntry(CHAOS_PROBABILITY, "Per-task fault probability.", float, 0.05, lambda v: 0.0 <= v <= 1.0),
     ConfigEntry(
-        CHAOS_MODE, "Fault kind to inject.", str, "transient",
-        choices=("transient", "fatal", "panic", "delay", "straggler"),
+        CHAOS_MODE, "Fault kind to inject. 'overload' synthesizes memory "
+        "pressure (the hit task overcommits its session pool for the "
+        "partition's duration) plus a queue delay — deterministic fuel for "
+        "overload-protection tests.", str, "transient",
+        choices=("transient", "fatal", "panic", "delay", "straggler", "overload"),
     ),
     ConfigEntry(
         CHAOS_STRAGGLER_DELAY_S,

@@ -73,6 +73,33 @@ class GrpcError(BallistaError):
     retryable = True
 
 
+class ClusterOverloaded(BallistaError):
+    """The scheduler shed this submission (admission quota exceeded or the
+    cluster is in a shedding/draining overload state). Always retryable;
+    `retry_after_ms` is the server's backoff hint, computed from the
+    admission queue's observed drain rate. Surfaced over gRPC as
+    RESOURCE_EXHAUSTED with a `retry-after-ms` trailing-metadata entry."""
+
+    retryable = True
+
+    def __init__(self, msg: str, retry_after_ms: int = 1000, reason: str = "quota"):
+        super().__init__(msg)
+        self.retry_after_ms = max(0, int(retry_after_ms))
+        self.reason = reason  # quota | depth | shedding | draining
+
+
+class CircuitOpen(IoError):
+    """Client-side circuit breaker for a Flight address is open: recent
+    consecutive failures tripped it and the cooldown has not elapsed.
+    Fails fast (no dial) so a dead/overloaded data-plane peer cannot tie
+    up every reduce task in connect timeouts."""
+
+    def __init__(self, addr: str, retry_after_s: float):
+        super().__init__(f"circuit open for {addr} (retry in {retry_after_s:.1f}s)")
+        self.addr = addr
+        self.retry_after_s = retry_after_s
+
+
 class Cancelled(BallistaError):
     """Task/job cancelled; terminal, not a failure for retry accounting."""
 
@@ -89,6 +116,8 @@ def error_to_proto_kind(err: BaseException) -> str:
     """Stable string tag used in TaskStatus/FailedTask wire messages."""
     if isinstance(err, FetchFailed):
         return "FetchPartitionError"
+    if isinstance(err, ClusterOverloaded):
+        return "ResourceExhausted"
     if isinstance(err, Cancelled):
         return "TaskKilled"
     if isinstance(err, (IoError, GrpcError)):

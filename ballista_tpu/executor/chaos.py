@@ -73,7 +73,30 @@ class ChaosExec(ExecutionPlan):
                 raise RuntimeError("chaos: injected panic")
             if self.mode == "delay":
                 time.sleep(0.2)
+            if self.mode == "overload":
+                return self._overloaded_execute(partition, ctx)
         return self.input.execute(partition, ctx)
+
+    def _overloaded_execute(self, partition: int, ctx: TaskContext) -> Iterator:
+        """Synthetic memory pressure: reserve the session pool's whole
+        capacity for this partition's duration (grow_wait with a zero
+        deadline forces the reservation through, counting it in
+        `overcommitted`) plus a queue delay. Deterministic fuel for
+        overload tests: while the hit partition runs, the pool reads
+        saturated, so the executor's admission gate rejects new tasks and
+        the heartbeat pressure score goes to >= 1."""
+        pool = getattr(ctx, "memory_pool", None)
+        held = 0
+        if pool is not None:
+            # one byte PAST capacity: even an idle pool ends up overcommitted
+            held = max(2, pool.capacity + 1)
+            pool.grow_wait(held, timeout_s=0.0)
+        try:
+            time.sleep(min(self.straggler_delay_s, 0.5))
+            yield from self.input.execute(partition, ctx)
+        finally:
+            if pool is not None:
+                pool.shrink(held)
 
     def _maybe_straggle(self, partition: int, ctx: TaskContext) -> None:
         """Deterministic slow-partition injection: the roll is keyed on the

@@ -99,6 +99,10 @@ class Executor:
         self._lock = threading.Lock()
         self.tasks_run = 0
         self.tasks_failed = 0
+        # tasks turned away at admission because the session pool was
+        # already saturated (reported in heartbeats; scheduler retries
+        # them elsewhere)
+        self.pressure_rejections = 0
         self.memory_limit_per_task = 0  # bytes; set by the executor process
         # "thread" (in-process, shared GIL) or "process" (spawned worker per
         # task: true parallelism, crash isolation, preemptive cancel —
@@ -135,6 +139,9 @@ class Executor:
         process isolation via ballista.executor.task.isolation (strictly
         safer than threads); it cannot opt a daemon out of it."""
         cfg = config or self.default_config
+        rejected = self._reject_if_saturated(task)
+        if rejected is not None:
+            return rejected
         iso = self.isolation
         if iso != "process":
             from ballista_tpu.config import EXECUTOR_TASK_ISOLATION
@@ -167,6 +174,32 @@ class Executor:
 
                 return run_task_in_subprocess(self, task, cfg)
         return self.execute_task(task, config)
+
+    def _reject_if_saturated(self, task: TaskDescription) -> TaskResult | None:
+        """Executor-side admission gate: a task whose session pool is
+        already at/over capacity is rejected retryably INSTEAD of starting
+        life overcommitted (grow_wait's deadline backstop would force the
+        reservation through and deepen the spiral). The failure is
+        retryable, so the scheduler re-pends the partition and the health
+        scoring steers the retry toward a less-pressured executor."""
+        if self.session_pools is None:
+            return None
+        pool = self.session_pools.get(task.session_id)
+        if not pool.saturated:
+            return None
+        self.pressure_rejections += 1
+        log.warning(
+            "rejecting task %s/%s at admission: session %s pool saturated "
+            "(%.0f%% of %d bytes reserved)", task.job_id, task.task_id,
+            task.session_id, pool.pressure() * 100, pool.capacity)
+        return TaskResult(
+            task_id=task.task_id, job_id=task.job_id, stage_id=task.stage_id,
+            stage_attempt=task.stage_attempt, partitions=list(task.partitions),
+            state="failed",
+            error=(f"executor {self.metadata.id} rejected task at admission: "
+                   f"session memory pool saturated ({pool.reserved}/{pool.capacity} bytes)"),
+            error_kind="ResourceExhausted", retryable=True,
+        )
 
     def execute_task(self, task: TaskDescription, config: BallistaConfig | None = None) -> TaskResult:
         cfg = config or self.default_config

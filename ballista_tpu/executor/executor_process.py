@@ -280,17 +280,30 @@ class ExecutorProcess:
             req.task_status.append(encode_task_status(r, self.metadata.id))
         self._scheduler.UpdateTaskStatus(req, timeout=30)
 
+    def _overload_metrics(self) -> list[tuple[str, float]]:
+        """Pressure signals piggybacked on the heartbeat's existing
+        repeated ExecutorMetricProto field (no wire change): pool
+        saturation, lifetime forced-overcommit bytes, admission
+        rejections, and local task-queue depth."""
+        pools = self.executor.session_pools
+        return [
+            ("memory_pressure", pools.aggregate_pressure() if pools else 0.0),
+            ("pool_overcommitted_bytes", float(pools.total_overcommitted()) if pools else 0.0),
+            ("pressure_rejections", float(self.executor.pressure_rejections)),
+            ("queued_tasks", float(self.service._queue.qsize())),
+        ]
+
     def _heartbeat_loop(self) -> None:
         while not self._stopping.wait(HEARTBEAT_INTERVAL_S):
             try:
-                resp = self._scheduler.HeartBeatFromExecutor(
-                    pb.HeartBeatParams(
-                        executor_id=self.metadata.id,
-                        metadata=encode_executor_metadata(self.metadata),
-                        status="active",
-                    ),
-                    timeout=5,
+                req = pb.HeartBeatParams(
+                    executor_id=self.metadata.id,
+                    metadata=encode_executor_metadata(self.metadata),
+                    status="active",
                 )
+                for name, value in self._overload_metrics():
+                    req.metrics.add(name=name, value=value)
+                resp = self._scheduler.HeartBeatFromExecutor(req, timeout=5)
                 if resp.reregister:
                     self._register()
             except grpc.RpcError as e:

@@ -21,12 +21,16 @@ def start_health_server(executor, stopping_event, host: str = "0.0.0.0", port: i
                 self.send_response(404)
                 self.end_headers()
                 return
+            pools = executor.session_pools
             body = json.dumps({
                 "status": "draining" if stopping_event.is_set() else "healthy",
                 "executor_id": executor.metadata.id,
                 "tasks_run": executor.tasks_run,
                 "tasks_failed": executor.tasks_failed,
                 "device_ordinal": executor.metadata.device_ordinal,
+                "pressure_rejections": executor.pressure_rejections,
+                "memory_pressure": round(pools.aggregate_pressure(), 4) if pools else 0.0,
+                "pool_overcommitted_bytes": pools.total_overcommitted() if pools else 0,
             }).encode()
             self.send_response(200)
             self.send_header("Content-Type", "application/json")

@@ -56,6 +56,21 @@ class MemoryPool:
             self.reserved = max(0, self.reserved - nbytes)
             self._freed.notify_all()
 
+    def pressure(self) -> float:
+        """Saturation as a fraction of capacity; > 1.0 means overcommitted
+        reservations are live right now."""
+        with self._lock:
+            if self.capacity <= 0:
+                return 0.0
+            return self.reserved / self.capacity
+
+    @property
+    def saturated(self) -> bool:
+        """A new task landing here would start life overcommitted — the
+        executor's admission gate rejects (retryably) instead."""
+        with self._lock:
+            return self.reserved >= self.capacity
+
 
 class SessionPoolRegistry:
     """session id → shared MemoryPool (created on first use).
@@ -99,3 +114,19 @@ class SessionPoolRegistry:
     def __len__(self) -> int:
         with self._lock:
             return len(self._pools)
+
+    def aggregate_pressure(self) -> float:
+        """Max saturation across live session pools — the executor's
+        heartbeat pressure score. Max, not mean: admission gating cares
+        whether the pool a NEW task would join is already past budget,
+        and a fresh session always starts at zero."""
+        with self._lock:
+            pools = [p for p, _ in self._pools.values()]
+        return max((p.pressure() for p in pools), default=0.0)
+
+    def total_overcommitted(self) -> int:
+        """Lifetime forced-overcommit bytes across live pools (satellite
+        observability for MemoryPool.overcommitted)."""
+        with self._lock:
+            pools = [p for p, _ in self._pools.values()]
+        return sum(p.overcommitted for p in pools)

@@ -33,6 +33,7 @@ import pyarrow.flight as flight
 import pyarrow.ipc as ipc
 
 from ballista_tpu.config import SHUFFLE_BLOCK_TRANSPORT
+from ballista_tpu.errors import CircuitOpen
 from ballista_tpu.plan.physical import TaskContext
 from ballista_tpu.shuffle.types import PartitionLocation
 
@@ -86,6 +87,91 @@ POOL = ClientPool()
 # plane): don't re-probe them on every reduce task
 _NO_COALESCE: set[str] = set()
 _NO_COALESCE_LOCK = threading.Lock()
+
+
+class CircuitBreaker:
+    """Per-address circuit breaker for the Flight data plane.
+
+    Closed → `threshold` CONSECUTIVE failures → open: every fetch to that
+    address fails fast with CircuitOpen (an IoError: the shuffle reader's
+    retry ladder treats it like any transient fetch failure, so it
+    eventually surfaces as FetchFailed and the stage recomputes
+    elsewhere) instead of each reduce task independently burning a
+    connect timeout against a dead or drowning peer. After `cooldown_s`
+    the breaker goes half-open: exactly ONE caller probes the address;
+    its outcome closes or re-opens the circuit.
+
+    Orthogonal to _NO_COALESCE (a capability cache, not a health signal):
+    CoalesceUnsupported never counts as a breaker failure."""
+
+    def __init__(self, threshold: int | None = None, cooldown_s: float | None = None):
+        if threshold is None or cooldown_s is None:
+            from ballista_tpu.config import (
+                FLIGHT_BREAKER_COOLDOWN_S,
+                FLIGHT_BREAKER_THRESHOLD,
+                BallistaConfig,
+            )
+
+            defaults = BallistaConfig()
+            threshold = int(defaults.get(FLIGHT_BREAKER_THRESHOLD)) if threshold is None else threshold
+            cooldown_s = float(defaults.get(FLIGHT_BREAKER_COOLDOWN_S)) if cooldown_s is None else cooldown_s
+        self.threshold = threshold
+        self.cooldown_s = cooldown_s
+        self._lock = threading.Lock()
+        # addr -> [consecutive_failures, opened_at_monotonic, probing]
+        self._state: dict[str, list] = {}
+        self.trips = 0  # observability: times any circuit opened
+
+    def check(self, addr: str) -> None:
+        """Gate a fetch: raises CircuitOpen when the circuit is open and
+        cooling down; lets exactly one probe through once it elapses."""
+        if self.threshold <= 0:
+            return
+        import time
+
+        with self._lock:
+            st = self._state.get(addr)
+            if st is None or st[1] == 0.0:
+                return
+            elapsed = time.monotonic() - st[1]
+            if elapsed >= self.cooldown_s and not st[2]:
+                st[2] = True  # half-open: this caller is the probe
+                return
+            raise CircuitOpen(addr, max(0.0, self.cooldown_s - elapsed))
+
+    def success(self, addr: str) -> None:
+        with self._lock:
+            self._state.pop(addr, None)
+
+    def failure(self, addr: str) -> None:
+        if self.threshold <= 0:
+            return
+        import time
+
+        with self._lock:
+            st = self._state.setdefault(addr, [0, 0.0, False])
+            st[0] += 1
+            if st[1] != 0.0 and st[2]:
+                # failed probe: re-open for another full cooldown
+                st[1] = time.monotonic()
+                st[2] = False
+                self.trips += 1
+            elif st[1] == 0.0 and st[0] >= self.threshold:
+                st[1] = time.monotonic()
+                st[2] = False
+                self.trips += 1
+
+    def is_open(self, addr: str) -> bool:
+        with self._lock:
+            st = self._state.get(addr)
+            return st is not None and st[1] != 0.0
+
+    def reset(self) -> None:
+        with self._lock:
+            self._state.clear()
+
+
+BREAKER = CircuitBreaker()
 
 
 class CoalesceUnsupported(Exception):
@@ -202,12 +288,14 @@ def _route(ctx: TaskContext, loc: PartitionLocation, body: dict) -> tuple[str, d
 
 def fetch_partition_flight(loc: PartitionLocation, ctx: TaskContext) -> Iterator[pa.RecordBatch]:
     addr, ticket = _route(ctx, loc, _ticket(loc))
+    BREAKER.check(addr)  # fail fast while the address's circuit is open
     client = POOL.get(addr, tls=_session_tls(ctx.config))
     try:
         if bool(ctx.config.get(SHUFFLE_BLOCK_TRANSPORT)):
             action = flight.Action("io_block_transport", json.dumps(ticket).encode())
             blocks = [r.body for r in client.do_action(action)]
             if not blocks:
+                BREAKER.success(addr)
                 return
             reader = ipc.open_stream(ChainedBufferReader(blocks))
             yield from reader
@@ -215,7 +303,9 @@ def fetch_partition_flight(loc: PartitionLocation, ctx: TaskContext) -> Iterator
             t = flight.Ticket(json.dumps(ticket).encode())
             for chunk in client.do_get(t):
                 yield chunk.data
+        BREAKER.success(addr)
     except Exception:
+        BREAKER.failure(addr)
         POOL.discard(addr)
         raise
 
@@ -236,6 +326,7 @@ def fetch_partitions_flight(locs: Sequence[PartitionLocation], ctx: TaskContext
     with _NO_COALESCE_LOCK:
         if addr in _NO_COALESCE:
             raise CoalesceUnsupported(addr)
+    BREAKER.check(addr)  # fail fast while the address's circuit is open
     client = POOL.get(addr, tls=_session_tls(ctx.config))
     action = flight.Action(COALESCED_ACTION, json.dumps(body).encode())
 
@@ -245,9 +336,11 @@ def fetch_partitions_flight(locs: Sequence[PartitionLocation], ctx: TaskContext
 
     def fail(e: BaseException):
         if _is_unknown_action(e):
+            # capability miss, not a health signal: never trips the breaker
             with _NO_COALESCE_LOCK:
                 _NO_COALESCE.add(addr)
             return CoalesceUnsupported(addr)
+        BREAKER.failure(addr)
         POOL.discard(addr)
         return FetchStreamError(completed, e)
 
@@ -284,11 +377,14 @@ def fetch_partitions_flight(locs: Sequence[PartitionLocation], ctx: TaskContext
             completed += 1
     if cur_need:
         # server hung up inside the current location's data
+        BREAKER.failure(addr)
         raise FetchStreamError(completed, EOFError(
             f"stream ended {cur_need} bytes short of location {completed}"))
     if completed < len(locs):
+        BREAKER.failure(addr)
         raise FetchStreamError(completed, EOFError(
             f"stream served {completed}/{len(locs)} locations"))
+    BREAKER.success(addr)
 
 
 def remove_job_data(host: str, flight_port: int, job_id: str) -> None:

@@ -21,6 +21,8 @@ import threading
 
 import pyarrow.flight as flight
 
+from ballista_tpu.config import _env_int
+
 RELAY_ACTIONS = ("io_block_transport", "io_coalesced_transport")
 
 
@@ -44,7 +46,23 @@ class FlightResultProxy(flight.FlightServerBase):
         super().__init__(f"{scheme}://{host}:{port}", **kwargs)
         # executor-side dial credentials: (ca, cert, key)
         self.relay_tls = (tls_client_ca, tls_cert, tls_key) if (tls_client_ca and tls_cert) else None
-        self.stats = {"relayed_actions": 0, "relayed_gets": 0}
+        self.stats = {"relayed_actions": 0, "relayed_gets": 0, "relays_rejected": 0}
+        # the proxy multiplexes EVERY external client over one scheduler
+        # host, so it gets the same bounded-stream gate as the executors'
+        # data plane (same env knobs; no session config here either)
+        from ballista_tpu.flight.server import _StreamGate
+
+        self.gate = _StreamGate(
+            _env_int("BALLISTA_FLIGHT_MAX_STREAMS", 64),
+            _env_int("BALLISTA_FLIGHT_ACCEPT_QUEUE", 128),
+        )
+
+    def _gate_acquire(self) -> None:
+        try:
+            self.gate.acquire()
+        except flight.FlightUnavailableError:
+            self.stats["relays_rejected"] += 1
+            raise
 
     def _upstream(self, ticket: dict) -> tuple[str, flight.FlightClient]:
         """Dial the owning executor. In a TLS cluster the proxy presents the
@@ -59,11 +77,13 @@ class FlightResultProxy(flight.FlightServerBase):
         from ballista_tpu.flight.client import POOL
 
         t = json.loads(ticket.ticket.decode())
+        self._gate_acquire()
         addr, client = self._upstream(t)
         try:
             reader = client.do_get(flight.Ticket(json.dumps(t).encode()))
             schema = reader.schema
         except Exception:
+            self.gate.release()
             POOL.discard(addr)
             raise
         self.stats["relayed_gets"] += 1
@@ -75,6 +95,8 @@ class FlightResultProxy(flight.FlightServerBase):
             except Exception:
                 POOL.discard(addr)
                 raise
+            finally:
+                self.gate.release()
 
         return flight.GeneratorStream(schema, gen())
 
@@ -83,17 +105,21 @@ class FlightResultProxy(flight.FlightServerBase):
 
         if action.type in RELAY_ACTIONS:
             t = json.loads(action.body.to_pybytes().decode())
-            addr, client = self._upstream(t)
-            self.stats["relayed_actions"] += 1
+            self._gate_acquire()
             try:
-                # forward the body unchanged — the executor ignores the
-                # routing keys — and pass every Result through verbatim
-                up = flight.Action(action.type, json.dumps(t).encode())
-                for r in client.do_action(up):
-                    yield flight.Result(r.body)
-            except Exception:
-                POOL.discard(addr)
-                raise
+                addr, client = self._upstream(t)
+                self.stats["relayed_actions"] += 1
+                try:
+                    # forward the body unchanged — the executor ignores the
+                    # routing keys — and pass every Result through verbatim
+                    up = flight.Action(action.type, json.dumps(t).encode())
+                    for r in client.do_action(up):
+                        yield flight.Result(r.body)
+                except Exception:
+                    POOL.discard(addr)
+                    raise
+            finally:
+                self.gate.release()
             return
         raise flight.FlightServerError(f"unknown action {action.type}")
 

@@ -47,7 +47,7 @@ import pyarrow as pa
 import pyarrow.flight as flight
 import pyarrow.ipc as ipc
 
-from ballista_tpu.config import _env_bool
+from ballista_tpu.config import _env_bool, _env_float, _env_int
 from ballista_tpu.shuffle import paths
 
 BLOCK_SIZE = 8 * 1024 * 1024
@@ -55,6 +55,53 @@ BLOCK_SIZE = 8 * 1024 * 1024
 COALESCED_ACTION = "io_coalesced_transport"
 
 _EMPTY = pa.py_buffer(b"")
+
+
+class _StreamGate:
+    """Concurrent-stream cap with a bounded accept queue.
+
+    Up to `max_streams` responses stream at once; up to `accept_queue`
+    more callers may WAIT for a slot (bounded, so a flood of fetches
+    holds a bounded amount of server state); anything past that is
+    rejected immediately with FlightUnavailableError — the client's
+    retry ladder treats it like any transient IO failure and backs off.
+    max_streams <= 0 disables the gate."""
+
+    def __init__(self, max_streams: int, accept_queue: int, acquire_timeout_s: float = 10.0):
+        self.max_streams = max_streams
+        self.accept_queue = accept_queue
+        self.acquire_timeout_s = acquire_timeout_s
+        self._sem = threading.Semaphore(max_streams) if max_streams > 0 else None
+        self._waiters = 0
+        self._lock = threading.Lock()
+
+    def acquire(self) -> None:
+        if self._sem is None:
+            return
+        if self._sem.acquire(blocking=False):
+            return
+        with self._lock:
+            if self._waiters >= self.accept_queue:
+                raise flight.FlightUnavailableError(
+                    f"stream cap reached ({self.max_streams} active, "
+                    f"{self._waiters} queued); retry")
+            self._waiters += 1
+        try:
+            if not self._sem.acquire(timeout=self.acquire_timeout_s):
+                raise flight.FlightUnavailableError(
+                    f"no stream slot freed within {self.acquire_timeout_s:.0f}s; retry")
+        finally:
+            with self._lock:
+                self._waiters -= 1
+
+    def release(self) -> None:
+        if self._sem is not None:
+            self._sem.release()
+
+    @property
+    def waiters(self) -> int:
+        with self._lock:
+            return self._waiters
 
 
 def _open_buffer(ticket: dict, work_dir: str) -> pa.Buffer:
@@ -93,35 +140,68 @@ class BallistaFlightServer(flight.FlightServerBase):
         self.work_dir = work_dir
         self.host = host
         # data-plane counters (benchmarks / smoke tests read these):
-        # RPCs by kind, locations served, and payload bytes out
+        # RPCs by kind, locations served, payload bytes out, and overload
+        # protection outcomes (rejected at the gate / stalled consumers)
         self.stats = {"do_get": 0, "block_rpc": 0, "coalesced_rpc": 0,
-                      "locations_served": 0, "bytes_served": 0}
+                      "locations_served": 0, "bytes_served": 0,
+                      "streams_rejected": 0, "streams_stalled": 0}
         self._stats_lock = threading.Lock()
+        # overload knobs are environmental: the data plane has no session
+        # config (same precedent as BALLISTA_SHUFFLE_MMAP)
+        self.gate = _StreamGate(
+            _env_int("BALLISTA_FLIGHT_MAX_STREAMS", 64),
+            _env_int("BALLISTA_FLIGHT_ACCEPT_QUEUE", 128),
+        )
+        self.stall_timeout_s = _env_float("BALLISTA_FLIGHT_STALL_TIMEOUT_S", 30.0)
 
     def _bump(self, key: str, n: int = 1) -> None:
         with self._stats_lock:
             self.stats[key] += n
 
+    def _gate_acquire(self) -> None:
+        try:
+            self.gate.acquire()
+        except flight.FlightUnavailableError:
+            self._bump("streams_rejected")
+            raise
+
     def do_get(self, context, ticket):
         t = json.loads(ticket.ticket.decode())
         tickets = _ticket_list(t)
+        self._gate_acquire()
         try:
             bufs = [_open_buffer(x, self.work_dir) for x in tickets]
         except PermissionError as e:
+            self.gate.release()
             raise flight.FlightUnauthorizedError(str(e))
         self._bump("do_get")
         self._bump("locations_served", len(tickets))
         readers = [ipc.open_stream(pa.BufferReader(b)) for b in bufs if b.size]
         if not readers:
+            self.gate.release()
             return flight.RecordBatchStream(pa.table({}))
 
         def gen():
+            import time
+
             served = 0
-            for r in readers:
-                for batch in r:
-                    served += batch.nbytes
-                    yield batch
-            self._bump("bytes_served", served)
+            try:
+                for r in readers:
+                    for batch in r:
+                        served += batch.nbytes
+                        t0 = time.monotonic()
+                        yield batch
+                        # a yield that took this long was backpressured by
+                        # the consumer; kill the stream and free the mmap
+                        # buffers instead of wedging a slot indefinitely
+                        if self.stall_timeout_s and time.monotonic() - t0 > self.stall_timeout_s:
+                            self._bump("streams_stalled")
+                            raise flight.FlightTimedOutError(
+                                f"consumer stalled > {self.stall_timeout_s:.0f}s; "
+                                "stream dropped")
+                self._bump("bytes_served", served)
+            finally:
+                self.gate.release()
 
         # generator-based: first batch leaves before the last is decoded;
         # nothing is materialized server-side (no read_all)
@@ -135,32 +215,40 @@ class BallistaFlightServer(flight.FlightServerBase):
     def do_action(self, context, action):
         if action.type == "io_block_transport":
             t = json.loads(action.body.to_pybytes().decode())
+            self._gate_acquire()
             try:
-                buf = _open_buffer(t, self.work_dir)
-            except PermissionError as e:
-                raise flight.FlightUnauthorizedError(str(e))
-            self._bump("block_rpc")
-            self._bump("locations_served")
-            self._bump("bytes_served", buf.size)
-            yield from self._yield_blocks(buf)
+                try:
+                    buf = _open_buffer(t, self.work_dir)
+                except PermissionError as e:
+                    raise flight.FlightUnauthorizedError(str(e))
+                self._bump("block_rpc")
+                self._bump("locations_served")
+                self._bump("bytes_served", buf.size)
+                yield from self._yield_blocks(buf)
+            finally:
+                self.gate.release()
             return
         if action.type == COALESCED_ACTION:
             t = json.loads(action.body.to_pybytes().decode())
             tickets = _ticket_list(t)
-            self._bump("coalesced_rpc")
-            for i, tk in enumerate(tickets):
-                # open INSIDE the stream: a failure on location i surfaces
-                # after location i-1 completed, so the client's per-location
-                # accounting attributes it to the right map output
-                try:
-                    buf = _open_buffer(tk, self.work_dir)
-                except PermissionError as e:
-                    raise flight.FlightUnauthorizedError(str(e))
-                header = json.dumps({"i": i, "nbytes": buf.size}).encode()
-                yield flight.Result(pa.py_buffer(header))
-                yield from self._yield_blocks(buf)
-                self._bump("locations_served")
-                self._bump("bytes_served", buf.size)
+            self._gate_acquire()
+            try:
+                self._bump("coalesced_rpc")
+                for i, tk in enumerate(tickets):
+                    # open INSIDE the stream: a failure on location i surfaces
+                    # after location i-1 completed, so the client's per-location
+                    # accounting attributes it to the right map output
+                    try:
+                        buf = _open_buffer(tk, self.work_dir)
+                    except PermissionError as e:
+                        raise flight.FlightUnauthorizedError(str(e))
+                    header = json.dumps({"i": i, "nbytes": buf.size}).encode()
+                    yield flight.Result(pa.py_buffer(header))
+                    yield from self._yield_blocks(buf)
+                    self._bump("locations_served")
+                    self._bump("bytes_served", buf.size)
+            finally:
+                self.gate.release()
             return
         if action.type == "remove_job_data":
             t = json.loads(action.body.to_pybytes().decode())

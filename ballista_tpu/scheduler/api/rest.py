@@ -90,6 +90,10 @@ def start_rest_api(scheduler: SchedulerServer, metrics: InMemoryMetricsCollector
                     "quarantined_executors": scheduler.executors.quarantined_count(),
                     "jobs": jobs,
                     "flight_proxy_port": getattr(scheduler, "flight_proxy_port", 0),
+                    # overload posture: state machine + admission gauges
+                    "overload": scheduler.admission.snapshot(),
+                    "aggregate_memory_pressure": round(
+                        scheduler.executors.aggregate_pressure(), 4),
                 })
             if p == "/api/executors":
                 out = []

@@ -12,7 +12,7 @@ import logging
 
 import grpc
 
-from ballista_tpu.errors import BallistaError
+from ballista_tpu.errors import BallistaError, ClusterOverloaded
 from ballista_tpu.proto import pb
 from ballista_tpu.scheduler.server import SchedulerServer
 from ballista_tpu.serde_control import (
@@ -42,14 +42,29 @@ class SchedulerGrpcService:
                 [(kv.key, kv.value) for kv in request.settings], session_id
             )
         which = request.WhichOneof("query")
-        if which == "sql":
-            job_id = self.scheduler.submit_sql(request.sql, session_id, request.job_name)
-        else:
-            from ballista_tpu.serde import decode_plan
+        try:
+            if which == "sql":
+                job_id = self.scheduler.submit_sql(request.sql, session_id, request.job_name)
+            else:
+                from ballista_tpu.serde import decode_plan
 
-            plan = decode_plan(request.physical_plan)
-            job_id = self.scheduler.submit_physical_plan(plan, session_id, request.job_name)
+                plan = decode_plan(request.physical_plan)
+                job_id = self.scheduler.submit_physical_plan(plan, session_id, request.job_name)
+        except ClusterOverloaded as e:
+            self._abort_overloaded(context, e)
         return pb.ExecuteQueryResult(job_id=job_id, session_id=session_id)
+
+    @staticmethod
+    def _abort_overloaded(context, e: ClusterOverloaded) -> None:
+        """Shed submissions map to RESOURCE_EXHAUSTED with the backoff
+        hint in trailing metadata (clients parse `retry-after-ms`; the
+        message text carries it too for non-ballista clients)."""
+        context.set_trailing_metadata((
+            ("retry-after-ms", str(e.retry_after_ms)),
+            ("overload-reason", e.reason),
+        ))
+        context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED,
+                      f"{e} [retry_after_ms={e.retry_after_ms}]")
 
     def GetJobStatus(self, request: pb.GetJobStatusParams, context) -> pb.GetJobStatusResult:
         status = self.scheduler.job_status(request.job_id)
@@ -124,7 +139,10 @@ class SchedulerGrpcService:
             return pb.RegisterExecutorResult(success=False, error=str(e))
 
     def HeartBeatFromExecutor(self, request: pb.HeartBeatParams, context) -> pb.HeartBeatResult:
-        known = self.scheduler.executor_heartbeat(request.executor_id)
+        # overload signals ride the existing repeated ExecutorMetricProto
+        # field — no wire change needed
+        metrics = {m.name: m.value for m in request.metrics} or None
+        known = self.scheduler.executor_heartbeat(request.executor_id, metrics)
         return pb.HeartBeatResult(reregister=not known)
 
     def UpdateTaskStatus(self, request: pb.UpdateTaskStatusParams, context) -> pb.UpdateTaskStatusResult:

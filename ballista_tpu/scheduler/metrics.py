@@ -34,6 +34,12 @@ class SchedulerMetricsCollector:
 
     def set_quarantined_executors(self, n: int) -> None: ...
 
+    def record_job_rejected(self, reason: str) -> None: ...
+
+    def set_overload_state(self, state: str) -> None: ...
+
+    def record_pressure_rejection(self, executor_id: str) -> None: ...
+
 
 class NoopMetricsCollector(SchedulerMetricsCollector):
     pass
@@ -83,6 +89,10 @@ class InMemoryMetricsCollector(SchedulerMetricsCollector):
         self.speculative_launched = 0
         self.task_timeouts = 0
         self.quarantined_executors = 0
+        # overload protection: rejections by reason + current posture
+        self.jobs_rejected: dict[str, int] = {}
+        self.overload_state = "normal"
+        self.pressure_rejections = 0
         self.exec_hist = _Histogram(_LATENCY_BUCKETS)
         self.plan_hist = _Histogram(_PLANNING_BUCKETS)
 
@@ -127,6 +137,22 @@ class InMemoryMetricsCollector(SchedulerMetricsCollector):
         with self._lock:
             self.quarantined_executors = n
 
+    def record_job_rejected(self, reason: str) -> None:
+        with self._lock:
+            self.jobs_rejected[reason] = self.jobs_rejected.get(reason, 0) + 1
+
+    def set_overload_state(self, state: str) -> None:
+        with self._lock:
+            self.overload_state = state
+
+    def record_pressure_rejection(self, executor_id: str) -> None:
+        with self._lock:
+            self.pressure_rejections += 1
+
+    def jobs_rejected_total(self) -> int:
+        with self._lock:
+            return sum(self.jobs_rejected.values())
+
     def render_prometheus(self) -> str:
         with self._lock:
             lines = []
@@ -140,11 +166,20 @@ class InMemoryMetricsCollector(SchedulerMetricsCollector):
                 ("ballista_scheduler_task_timeouts_total", self.task_timeouts, "Tasks expired past their deadline"),
                 ("ballista_scheduler_pending_tasks", self.pending_tasks, "Pending task gauge"),
                 ("ballista_scheduler_quarantined_executors", self.quarantined_executors, "Executors in quarantine/probation"),
+                ("ballista_scheduler_pressure_rejections_total", self.pressure_rejections, "Tasks rejected by saturated executor memory pools"),
             ]:
                 lines.append(f"# HELP {name} {help_}")
                 kind = "gauge" if name.endswith(("pending_tasks", "quarantined_executors")) else "counter"
                 lines.append(f"# TYPE {name} {kind}")
                 lines.append(f"{name} {v}")
+            lines.append("# HELP ballista_scheduler_jobs_rejected_total Jobs shed by admission control, by reason")
+            lines.append("# TYPE ballista_scheduler_jobs_rejected_total counter")
+            for reason in sorted(self.jobs_rejected):
+                lines.append(f'ballista_scheduler_jobs_rejected_total{{reason="{reason}"}} {self.jobs_rejected[reason]}')
+            lines.append("# HELP ballista_scheduler_overload_state Overload posture (0=normal 1=shedding 2=draining)")
+            lines.append("# TYPE ballista_scheduler_overload_state gauge")
+            state_code = {"normal": 0, "shedding": 1, "draining": 2}.get(self.overload_state, 0)
+            lines.append(f"ballista_scheduler_overload_state {state_code}")
             lines.extend(self.exec_hist.render(
                 "ballista_scheduler_job_exec_time_seconds", "Job execution wall time"))
             lines.extend(self.plan_hist.render(

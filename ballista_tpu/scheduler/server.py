@@ -21,13 +21,14 @@ import logging
 import queue
 import threading
 import time
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Callable, Optional
 
 from ballista_tpu.config import BallistaConfig
-from ballista_tpu.errors import BallistaError
+from ballista_tpu.errors import BallistaError, ClusterOverloaded
 from ballista_tpu.executor.executor import ExecutorMetadata, TaskResult
 from ballista_tpu.ids import JobId, new_job_id
+from ballista_tpu.scheduler.admission import AdmissionController
 from ballista_tpu.scheduler.metrics import NoopMetricsCollector, SchedulerMetricsCollector
 from ballista_tpu.scheduler.planner import DistributedPlanner
 from ballista_tpu.scheduler.state.execution_graph import (
@@ -64,6 +65,9 @@ class TaskLauncher:
 class Event:
     kind: str  # job_queued | revive | task_update | executor_lost | cancel | shutdown
     payload: object = None
+    # stamped at post time; dequeue-time minus this is the event-loop lag
+    # that feeds the overload state machine
+    posted_at: float = field(default_factory=time.monotonic)
 
 
 class SchedulerServer:
@@ -77,7 +81,8 @@ class SchedulerServer:
                  quarantine_min_events: float = 4.0,
                  health_half_life_s: float = 60.0,
                  probe_backoff_s: float = 10.0,
-                 sweep_interval_s: float = 0.5):
+                 sweep_interval_s: float = 0.5,
+                 admission: AdmissionController | None = None):
         from ballista_tpu.scheduler.state.job_state import InMemoryJobState
 
         self.scheduler_id = scheduler_id
@@ -94,7 +99,9 @@ class SchedulerServer:
         self.job_state = job_state or InMemoryJobState()
         self.launcher = launcher
         self.metrics = metrics or NoopMetricsCollector()
+        self.admission = admission or AdmissionController()
         self._events: "queue.Queue[Event]" = queue.Queue(maxsize=10_000)
+        self._loop_lag_s = 0.0  # EWMA of post→dequeue delay
         self._jobs_lock = threading.RLock()
         self._job_rr = 0  # round-robin offer fairness across jobs
         self._running = False
@@ -134,7 +141,11 @@ class SchedulerServer:
             try:
                 ev = self._events.get(timeout=0.2)
             except queue.Empty:
+                # an idle loop has zero lag by definition; decay toward it
+                self._loop_lag_s *= 0.5
                 continue
+            lag = max(0.0, time.monotonic() - ev.posted_at)
+            self._loop_lag_s = 0.8 * self._loop_lag_s + 0.2 * lag
             try:
                 self._handle(ev)
             except Exception:  # noqa: BLE001
@@ -162,8 +173,21 @@ class SchedulerServer:
 
     # -- job submission --------------------------------------------------------
 
+    def _admit_or_shed(self, session_id: str, job_id: str) -> None:
+        """Admission gate in front of every submit path. A rejection
+        happens BEFORE any job state exists, so a shed submission costs
+        one dict lookup — the whole point of admission control."""
+        try:
+            self.admission.admit(session_id, job_id)
+        except ClusterOverloaded as e:
+            self.metrics.record_job_rejected(e.reason)
+            log.warning("shed job %s from session %s (%s, retry_after=%dms)",
+                        job_id, session_id, e.reason, e.retry_after_ms)
+            raise
+
     def submit_sql(self, sql: str, session_id: str, job_name: str = "") -> str:
         job_id = str(new_job_id())
+        self._admit_or_shed(session_id, job_id)
         with self._jobs_lock:
             self.jobs[job_id] = ExecutionGraph(job_id, job_name, session_id, [],
                                                self.sessions.get(session_id))
@@ -174,6 +198,7 @@ class SchedulerServer:
 
     def submit_physical_plan(self, plan, session_id: str, job_name: str = "") -> str:
         job_id = str(new_job_id())
+        self._admit_or_shed(session_id, job_id)
         with self._jobs_lock:
             self.jobs[job_id] = ExecutionGraph(job_id, job_name, session_id, [],
                                                self.sessions.get(session_id))
@@ -449,6 +474,12 @@ class SchedulerServer:
         if self.executors.probes_due():
             self._offer_reservation()
         self.metrics.set_quarantined_executors(self.executors.quarantined_count())
+        pressure = self.executors.aggregate_pressure()
+        transition = self.admission.update(self._loop_lag_s, pressure)
+        if transition is not None:
+            log.warning("overload state -> %s (inflight=%d, loop_lag=%.2fs, memory_pressure=%.2f)",
+                        transition, self.admission.depth(), self._loop_lag_s, pressure)
+            self.metrics.set_overload_state(transition)
 
     # -- executor lifecycle -----------------------------------------------------------
 
@@ -456,8 +487,19 @@ class SchedulerServer:
         self.executors.register(metadata)
         self.post(Event("revive"))
 
-    def executor_heartbeat(self, executor_id: str) -> bool:
-        return self.executors.heartbeat(executor_id)
+    def executor_heartbeat(self, executor_id: str,
+                           metrics: dict[str, float] | None = None) -> bool:
+        """Heartbeat + overload-signal ingestion. `metrics` is the decoded
+        HeartBeatParams.metrics map (memory_pressure et al.); the
+        pressure feeds the admission state machine on the next sweep."""
+        if metrics and metrics.get("pressure_rejections"):
+            # gauge, not delta: only count growth over the last report
+            prev = self.executors.get(executor_id)
+            prev_n = prev.pressure_rejections if prev is not None else 0.0
+            grown = int(metrics["pressure_rejections"] - prev_n)
+            for _ in range(max(0, grown)):
+                self.metrics.record_pressure_rejection(executor_id)
+        return self.executors.heartbeat(executor_id, metrics)
 
     def _on_executor_lost(self, executor_id: str) -> None:
         self.executors.deregister(executor_id)
@@ -482,11 +524,29 @@ class SchedulerServer:
 
         with self._jobs_lock:
             running = [g for g in self.jobs.values() if g.status is JobState.RUNNING]
+        stuck = []
         for g in running:
             interval = int(g.config.get(JOB_RESUBMIT_INTERVAL_MS))
             if interval > 0 and g.available_task_count() > 0:
-                self.post(Event("revive"))
-                return
+                stuck.append(g)
+        if not stuck:
+            return
+        # diagnose WHY work sat unscheduled, so an overload incident is
+        # readable from logs alone: every slot busy (no-capacity) vs slots
+        # exist but their executors are quarantined (quarantine-starved)
+        alive = self.executors.alive_executors()
+        free_any = sum(e.free_slots for e in alive)
+        free_healthy = sum(e.free_slots for e in alive if e.schedulable)
+        if free_any == 0:
+            reason = "no-capacity"
+        elif free_healthy == 0:
+            reason = "quarantine-starved"
+        else:
+            reason = "missed-offer"
+        for g in stuck:
+            log.info("resubmitting stuck job %s (%d runnable tasks, cause: %s)",
+                     g.job_id, g.available_task_count(), reason)
+        self.post(Event("revive"))
 
     # -- job control ---------------------------------------------------------------------
 
@@ -528,6 +588,10 @@ class SchedulerServer:
         return st
 
     def _notify(self, job_id: str) -> None:
+        # _notify fires on every terminal transition (finished / failed /
+        # cancelled / planning error), so it doubles as the single release
+        # point for the job's admission slot; finish() is idempotent.
+        self.admission.finish(job_id)
         with self._jobs_lock:
             for ev in self._watchers.pop(job_id, []):
                 ev.set()
@@ -539,6 +603,7 @@ class SchedulerServer:
         the work-dir TTL sweep)."""
         with self._jobs_lock:
             self.jobs.pop(job_id, None)
+        self.admission.finish(job_id)  # backstop; no-op if already released
         self.job_state.remove_job(job_id)
         if self.launcher is None:
             return

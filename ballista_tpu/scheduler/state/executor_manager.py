@@ -35,6 +35,10 @@ class ExecutorSlot:
     health_updated: float = field(default_factory=time.time)
     quarantined_at: float = 0.0
     probe_inflight: bool = False
+    # -- overload signals piggybacked on heartbeats -------------------------
+    memory_pressure: float = 0.0  # 0..1+ fraction of pool capacity reserved
+    pool_overcommitted_bytes: float = 0.0
+    pressure_rejections: float = 0.0
 
     @property
     def failure_rate(self) -> float:
@@ -75,14 +79,34 @@ class ExecutorManager:
         with self._lock:
             self.executors[metadata.id] = ExecutorSlot(metadata, metadata.vcores, metadata.vcores)
 
-    def heartbeat(self, executor_id: str) -> bool:
-        """Returns False if the executor is unknown (must re-register)."""
+    def heartbeat(self, executor_id: str, metrics: dict[str, float] | None = None) -> bool:
+        """Returns False if the executor is unknown (must re-register).
+        `metrics` carries the overload signals piggybacked on
+        HeartBeatParams.metrics (memory_pressure, pool_overcommitted_bytes,
+        pressure_rejections — see proto/ballista.proto)."""
         with self._lock:
             ex = self.executors.get(executor_id)
             if ex is None:
                 return False
             ex.last_seen = time.time()
+            if metrics:
+                ex.memory_pressure = float(metrics.get("memory_pressure", ex.memory_pressure))
+                ex.pool_overcommitted_bytes = float(
+                    metrics.get("pool_overcommitted_bytes", ex.pool_overcommitted_bytes))
+                ex.pressure_rejections = float(
+                    metrics.get("pressure_rejections", ex.pressure_rejections))
             return True
+
+    def aggregate_pressure(self) -> float:
+        """Cluster-wide memory-pressure signal for the overload state
+        machine: the mean of live executors' pool saturation (mean, not
+        max — one hot executor is the quarantine/retry machinery's
+        problem; admission control reacts to fleet-wide saturation)."""
+        with self._lock:
+            live = [e for e in self.executors.values() if not e.terminating]
+            if not live:
+                return 0.0
+            return sum(e.memory_pressure for e in live) / len(live)
 
     def deregister(self, executor_id: str) -> None:
         with self._lock:
@@ -330,5 +354,8 @@ class ExecutorManager:
                     "failure_rate": round(e.failure_rate, 4),
                     "decayed_failures": round(e.health_fail, 3),
                     "decayed_successes": round(e.health_succ, 3),
+                    "memory_pressure": round(e.memory_pressure, 4),
+                    "pool_overcommitted_bytes": int(e.pool_overcommitted_bytes),
+                    "pressure_rejections": int(e.pressure_rejections),
                 }
             return out

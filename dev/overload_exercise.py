@@ -1,0 +1,206 @@
+"""Exercise the overload defenses end-to-end on a tiny TPC-H dataset.
+
+    JAX_PLATFORMS=cpu python dev/overload_exercise.py
+
+Three legs, all on a 2-executor StandaloneCluster running TPC-H q6:
+
+1. admission — burst-submit far more jobs than a shrunken admission
+   budget allows; the excess must be shed with typed ClusterOverloaded
+   rejections carrying retry_after_ms hints, every ADMITTED job must
+   complete, and the gate must drain back to zero (no leaked slots, no
+   wedged jobs).
+2. pressure — one executor's session pool is saturated before the job
+   starts; its tasks bounce off the executor admission gate retryably
+   and the retries land on the healthy executor.
+3. posture — drive the overload state machine through
+   shedding → draining → normal with synthetic depth and verify the
+   quotas degrade and recover accordingly.
+
+Exits non-zero if any leg fails its bookkeeping check.
+"""
+
+import os
+import sys
+import tempfile
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+Q6 = """
+select sum(l_extendedprice * l_discount) as revenue
+from lineitem
+where l_shipdate >= date '1994-01-01'
+  and l_shipdate < date '1995-01-01'
+  and l_discount between 0.05 and 0.07
+  and l_quantity < 24
+"""
+
+BURST = 12  # submissions thrown at a quota of 3
+
+
+def _cluster(data_dir: str, cfg):
+    from ballista_tpu.client.context import SessionContext
+    from ballista_tpu.executor.standalone import StandaloneCluster
+    from ballista_tpu.scheduler.metrics import InMemoryMetricsCollector
+    from ballista_tpu.testing.tpchgen import register_tpch
+
+    ctx = SessionContext(cfg)
+    register_tpch(ctx, data_dir)
+    cluster = StandaloneCluster(num_executors=2, vcores=2, config=cfg)
+    cluster.scheduler.metrics = InMemoryMetricsCollector()
+    return cluster
+
+
+def admission_leg(data_dir: str) -> None:
+    from ballista_tpu.config import DEFAULT_SHUFFLE_PARTITIONS, BallistaConfig
+    from ballista_tpu.errors import ClusterOverloaded
+    from ballista_tpu.scheduler.admission import AdmissionController
+
+    cfg = BallistaConfig({DEFAULT_SHUFFLE_PARTITIONS: 2})
+    cluster = _cluster(data_dir, cfg)
+    cluster.scheduler.admission = AdmissionController(
+        enabled=True, max_pending=3, per_session_quota=3,
+        shed_depth=3, drain_depth=6, min_retry_after_ms=50)
+    try:
+        scheduler = cluster.scheduler
+        session_id = scheduler.sessions.create_or_update(
+            cfg.to_key_value_pairs(), "overload-admission")
+        admitted, shed = [], []
+        for _ in range(BURST):
+            try:
+                admitted.append(scheduler.submit_sql(Q6, session_id))
+            except ClusterOverloaded as e:
+                if e.retry_after_ms < 50:
+                    raise SystemExit(
+                        f"[admission] hint below the floor: {e.retry_after_ms}ms")
+                shed.append(e)
+        if not shed:
+            raise SystemExit(f"[admission] burst of {BURST} over quota 3 shed nothing")
+        if len(admitted) < 3:
+            raise SystemExit(f"[admission] only {len(admitted)} admitted — gate too eager")
+        for job_id in admitted:
+            status = scheduler.wait_for_job(job_id, timeout=60)
+            if status["state"] != "successful":
+                raise SystemExit(f"[admission] admitted job {job_id} "
+                                 f"{status['state']}: {status.get('error')}")
+        deadline = time.time() + 5
+        while scheduler.admission.depth() > 0 and time.time() < deadline:
+            time.sleep(0.05)
+        if scheduler.admission.depth() != 0:
+            raise SystemExit(f"[admission] {scheduler.admission.depth()} admission "
+                             "slots leaked after all jobs finished")
+        # drained: the gate admits again without any manual reset
+        late = scheduler.submit_sql(Q6, session_id)
+        if scheduler.wait_for_job(late, timeout=60)["state"] != "successful":
+            raise SystemExit("[admission] post-drain submission failed")
+        m = cluster.scheduler.metrics
+        print(f"[admission] ok: admitted={len(admitted)} shed={len(shed)} "
+              f"reasons={m.jobs_rejected} "
+              f"hints={sorted({e.retry_after_ms for e in shed})}ms")
+    finally:
+        cluster.shutdown()
+
+
+def pressure_leg(data_dir: str) -> None:
+    from ballista_tpu.client.context import SessionContext
+    from ballista_tpu.config import (
+        DEFAULT_SHUFFLE_PARTITIONS,
+        MAX_PARTITIONS_PER_TASK,
+        BallistaConfig,
+    )
+    from ballista_tpu.executor.executor import Executor, ExecutorMetadata
+    from ballista_tpu.executor.memory_pool import SessionPoolRegistry
+    from ballista_tpu.executor.standalone import InProcessTaskLauncher
+    from ballista_tpu.ids import new_executor_id
+    from ballista_tpu.scheduler.metrics import InMemoryMetricsCollector
+    from ballista_tpu.scheduler.server import SchedulerServer
+    from ballista_tpu.testing.tpchgen import register_tpch
+
+    cfg = BallistaConfig({DEFAULT_SHUFFLE_PARTITIONS: 2, MAX_PARTITIONS_PER_TASK: 1})
+    ctx = SessionContext(cfg)
+    register_tpch(ctx, data_dir)
+    wd = tempfile.mkdtemp(prefix="overload-pressure-")
+    # extra vcores bias the first offers onto the saturated executor
+    choked = Executor(wd, ExecutorMetadata(id=str(new_executor_id()), vcores=4), config=cfg)
+    healthy = Executor(wd, ExecutorMetadata(id=str(new_executor_id()), vcores=2), config=cfg)
+    launcher = InProcessTaskLauncher({choked.metadata.id: choked,
+                                      healthy.metadata.id: healthy})
+    scheduler = SchedulerServer(launcher, InMemoryMetricsCollector(),
+                                quarantine_threshold=0.5, quarantine_min_events=1.0,
+                                sweep_interval_s=0.2)
+    scheduler.start()
+    scheduler.register_executor(choked.metadata)
+    scheduler.register_executor(healthy.metadata)
+    try:
+        session_id = scheduler.sessions.create_or_update(
+            cfg.to_key_value_pairs(), "overload-pressure")
+        choked.session_pools = SessionPoolRegistry(capacity_per_session=64)
+        choked.session_pools.get(session_id).grow_wait(64, timeout_s=0.0)
+        job_id = scheduler.submit_sql(Q6, session_id)
+        status = scheduler.wait_for_job(job_id, timeout=60)
+        if status["state"] != "successful":
+            raise SystemExit(f"[pressure] job failed: {status.get('error')}")
+        if choked.pressure_rejections < 1:
+            raise SystemExit("[pressure] choked executor never exercised — vacuous")
+        if choked.tasks_run != 0:
+            raise SystemExit(f"[pressure] saturated pool still ran "
+                             f"{choked.tasks_run} tasks")
+        if healthy.tasks_run < 1:
+            raise SystemExit("[pressure] healthy executor ran nothing")
+        print(f"[pressure] ok: rejections={choked.pressure_rejections} "
+              f"retried_onto_healthy={healthy.tasks_run} "
+              f"pool_pressure={choked.session_pools.aggregate_pressure():.2f}")
+    finally:
+        scheduler.stop()
+        launcher.pool.shutdown(wait=False)
+
+
+def posture_leg() -> None:
+    from ballista_tpu.errors import ClusterOverloaded
+    from ballista_tpu.scheduler.admission import (
+        DRAINING,
+        NORMAL,
+        SHEDDING,
+        AdmissionController,
+    )
+
+    ctl = AdmissionController(enabled=True, max_pending=100, per_session_quota=4,
+                              shed_depth=4, drain_depth=8)
+    for i in range(4):
+        ctl.admit(f"s{i}", f"j{i}")
+    if ctl.update(0.0, 0.0) != SHEDDING:
+        raise SystemExit(f"[posture] depth 4 should shed, state={ctl.state}")
+    try:
+        ctl.admit("s0", "halved")   # s0 now at 2 = the halved quota of 4
+        ctl.admit("s0", "halved2")  # must be shed
+        raise SystemExit("[posture] shedding did not halve the session quota")
+    except ClusterOverloaded as e:
+        if e.reason != "shedding":
+            raise SystemExit(f"[posture] wrong reason {e.reason}")
+    for i in range(4, 8):
+        ctl.admit(f"s{i}", f"j{i}")
+    if ctl.update(0.0, 0.0) != DRAINING:
+        raise SystemExit(f"[posture] depth 9 should drain, state={ctl.state}")
+    for j in list(ctl._inflight):
+        ctl.finish(j)
+    ctl.update(0.0, 0.0)
+    if ctl.state != NORMAL:
+        raise SystemExit(f"[posture] empty gate should be normal, state={ctl.state}")
+    print(f"[posture] ok: shed->drain->normal, rejected={ctl.snapshot()['rejected_total']}")
+
+
+def main() -> None:
+    from ballista_tpu.testing.tpchgen import generate_tpch
+
+    posture_leg()
+    with tempfile.TemporaryDirectory(prefix="overload-tpch-") as d:
+        print(f"generating TPC-H sf0.01 under {d} ...")
+        generate_tpch(d, scale=0.01, seed=42, files_per_table=2)
+        admission_leg(d)
+        pressure_leg(d)
+    print("overload exercise passed")
+
+
+if __name__ == "__main__":
+    main()

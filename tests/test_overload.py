@@ -1,0 +1,600 @@
+"""Overload protection: bounded admission with retry_after hints, the
+normal → shedding → draining state machine, executor-side pressure
+rejection (and the scheduler retrying onto a healthy executor), the
+Flight data plane's stream gate + circuit breaker, and the client's
+jittered backoff honoring the scheduler's hint.
+"""
+
+import json
+import threading
+import time
+from types import SimpleNamespace
+
+import grpc
+import pyarrow as pa
+import pyarrow.flight as flight
+import pytest
+
+from ballista_tpu.config import (
+    CLIENT_BACKOFF_BASE_MS,
+    CLIENT_BACKOFF_MAX_MS,
+    CLIENT_SUBMIT_RETRIES,
+    DEFAULT_SHUFFLE_PARTITIONS,
+    MAX_PARTITIONS_PER_TASK,
+    BallistaConfig,
+)
+from ballista_tpu.errors import CircuitOpen, ClusterOverloaded, IoError
+from ballista_tpu.executor.chaos import ChaosExec
+from ballista_tpu.executor.executor import Executor, ExecutorMetadata
+from ballista_tpu.executor.memory_pool import MemoryPool, SessionPoolRegistry
+from ballista_tpu.executor.standalone import InProcessTaskLauncher, StandaloneCluster
+from ballista_tpu.flight.client import CircuitBreaker
+from ballista_tpu.flight.server import BallistaFlightServer, _StreamGate
+from ballista_tpu.ids import new_executor_id
+from ballista_tpu.plan.physical import ExecutionPlan, TaskContext
+from ballista_tpu.plan.schema import DFField, DFSchema
+from ballista_tpu.scheduler.admission import DRAINING, NORMAL, SHEDDING, AdmissionController
+from ballista_tpu.scheduler.metrics import InMemoryMetricsCollector
+from ballista_tpu.scheduler.server import SchedulerServer
+from ballista_tpu.scheduler.state.execution_graph import TaskDescription
+
+from .conftest import tpch_query
+
+SCHEMA = DFSchema([DFField("x", pa.int64(), False)])
+
+
+class OneBatchSource(ExecutionPlan):
+    def __init__(self, partitions: int = 2):
+        super().__init__(SCHEMA)
+        self.partitions = partitions
+
+    def output_partition_count(self):
+        return self.partitions
+
+    def execute(self, partition, ctx):
+        yield pa.RecordBatch.from_pydict({"x": [partition * 10 + i for i in range(5)]},
+                                         schema=SCHEMA.to_arrow())
+
+
+# ---------------------------------------------------------------------------
+# admission controller
+
+
+def _ctl(**kw) -> AdmissionController:
+    defaults = dict(enabled=True, max_pending=8, per_session_quota=4,
+                    shed_depth=4, drain_depth=6, shed_loop_lag_s=2.0,
+                    shed_memory_pressure=0.9, min_retry_after_ms=10)
+    defaults.update(kw)
+    return AdmissionController(**defaults)
+
+
+class TestAdmission:
+    def test_per_session_quota_rejects_with_retry_after(self):
+        ctl = _ctl(per_session_quota=2)
+        ctl.admit("s1", "j1")
+        ctl.admit("s1", "j2")
+        with pytest.raises(ClusterOverloaded) as ei:
+            ctl.admit("s1", "j3")
+        assert ei.value.reason == "quota"
+        assert ei.value.retryable
+        assert ei.value.retry_after_ms >= 10
+        # the quota is per session, not cluster-wide
+        ctl.admit("s2", "j3")
+
+    def test_cluster_depth_cap(self):
+        ctl = _ctl(max_pending=3, per_session_quota=10, shed_depth=10, drain_depth=10)
+        for i in range(3):
+            ctl.admit(f"s{i}", f"j{i}")
+        with pytest.raises(ClusterOverloaded) as ei:
+            ctl.admit("s9", "j9")
+        assert ei.value.reason == "depth"
+        assert ctl.depth() == 3
+
+    def test_finish_releases_slot_and_is_idempotent(self):
+        ctl = _ctl(per_session_quota=1)
+        ctl.admit("s1", "j1")
+        with pytest.raises(ClusterOverloaded):
+            ctl.admit("s1", "j2")
+        ctl.finish("j1")
+        ctl.finish("j1")  # duplicate terminal event — must not underflow
+        ctl.admit("s1", "j2")
+        assert ctl.depth() == 1
+
+    def test_rejection_records_no_state(self):
+        ctl = _ctl(max_pending=1)
+        ctl.admit("s1", "j1")
+        with pytest.raises(ClusterOverloaded):
+            ctl.admit("s2", "j2")
+        assert ctl.depth() == 1
+        assert ctl.snapshot()["rejected_total"] == 1
+
+    def test_disabled_gate_admits_everything_but_still_tracks(self):
+        ctl = _ctl(enabled=False, max_pending=1, per_session_quota=1)
+        for i in range(5):
+            ctl.admit("s1", f"j{i}")
+        assert ctl.depth() == 5
+
+    def test_retry_after_tracks_drain_rate(self):
+        ctl = _ctl(min_retry_after_ms=1)
+        # synthesize a drain history: ~20 finishes over the last 2 seconds
+        now = time.monotonic()
+        for i in range(20):
+            ctl._finishes.append(now - 2.0 + i * 0.1)
+        # ~10 jobs/s → 1 job over budget clears in ~100ms
+        hint = ctl.retry_after_ms(excess=1)
+        assert 30 <= hint <= 300, hint
+        # 10x the excess → 10x the hint (linear in the backlog joined)
+        assert ctl.retry_after_ms(excess=10) >= 5 * hint
+
+    def test_retry_after_fallback_without_history(self):
+        assert _ctl(min_retry_after_ms=100).retry_after_ms() == 1000
+
+
+class TestOverloadStateMachine:
+    def test_depth_drives_shed_then_drain_then_recovery(self):
+        ctl = _ctl(max_pending=100, per_session_quota=100, shed_depth=4, drain_depth=6)
+        for i in range(4):
+            ctl.admit("s1", f"j{i}")
+        assert ctl.update(0.0, 0.0) == SHEDDING
+        for i in range(4, 6):
+            ctl.admit("s2", f"j{i}")
+        assert ctl.update(0.0, 0.0) == DRAINING
+        with pytest.raises(ClusterOverloaded) as ei:
+            ctl.admit("s3", "late")
+        assert ei.value.reason == "draining"
+        # draining steps DOWN through shedding, never jumps to normal
+        ctl.finish("j5")
+        assert ctl.update(0.0, 0.0) == SHEDDING
+        # hysteresis: still shedding until depth <= shed_depth // 2
+        for j in ("j2", "j3", "j4"):
+            ctl.finish(j)
+        assert ctl.state == SHEDDING
+        assert ctl.update(0.0, 0.0) == NORMAL  # depth 2 == 4 // 2
+
+    def test_shedding_halves_the_session_quota(self):
+        ctl = _ctl(per_session_quota=4, shed_depth=2, drain_depth=50, max_pending=50)
+        ctl.admit("s1", "j1")
+        ctl.admit("s1", "j2")
+        assert ctl.update(0.0, 0.0) == SHEDDING
+        with pytest.raises(ClusterOverloaded) as ei:
+            ctl.admit("s1", "j3")  # 2 in flight >= halved quota of 2
+        assert ei.value.reason == "shedding"
+        # a fresh tenant still gets its (halved) share — degradation, not an outage
+        ctl.admit("s2", "j3")
+
+    def test_loop_lag_and_memory_pressure_also_shed(self):
+        ctl = _ctl(shed_loop_lag_s=1.0, shed_memory_pressure=0.8)
+        assert ctl.update(1.5, 0.0) == SHEDDING
+        assert ctl.update(0.0, 0.0) == NORMAL  # depth 0, signals recovered
+        assert ctl.update(0.0, 0.9) == SHEDDING
+        assert ctl.update(0.0, 0.5) == NORMAL
+
+    def test_no_transition_returns_none(self):
+        ctl = _ctl()
+        assert ctl.update(0.0, 0.0) is None
+        assert ctl.state == NORMAL
+
+
+# ---------------------------------------------------------------------------
+# scheduler integration: the gate in front of submit paths
+
+
+class TestSchedulerAdmission:
+    def _scheduler(self, **admission_kw):
+        metrics = InMemoryMetricsCollector()
+        s = SchedulerServer(None, metrics, admission=_ctl(**admission_kw))
+        sid = s.sessions.create_or_update(BallistaConfig().to_key_value_pairs(), "s-adm")
+        return s, metrics, sid
+
+    def test_shed_submission_creates_no_job_state(self):
+        # unstarted scheduler: admitted jobs stay in flight forever, so the
+        # quota math is deterministic
+        s, metrics, sid = self._scheduler(per_session_quota=2, max_pending=10)
+        j1 = s.submit_sql("SELECT 1", sid)
+        j2 = s.submit_sql("SELECT 1", sid)
+        with pytest.raises(ClusterOverloaded) as ei:
+            s.submit_sql("SELECT 1", sid)
+        assert ei.value.reason == "quota"
+        assert set(s.jobs) == {j1, j2}, "shed submission must not create a job"
+        assert metrics.jobs_rejected == {"quota": 1}
+        assert s.admission.snapshot()["inflight_jobs"] == 2
+
+    def test_terminal_notify_releases_the_slot(self):
+        s, _, sid = self._scheduler(per_session_quota=1, max_pending=10)
+        j1 = s.submit_sql("SELECT 1", sid)
+        with pytest.raises(ClusterOverloaded):
+            s.submit_sql("SELECT 1", sid)
+        s._notify(j1)  # fires on every terminal transition
+        s.submit_sql("SELECT 1", sid)
+
+    def test_heartbeat_pressure_feeds_the_state_machine(self):
+        s, metrics, sid = self._scheduler(shed_memory_pressure=0.8)
+        for eid in ("A", "B"):
+            s.executors.register(ExecutorMetadata(id=eid))
+        s.executor_heartbeat("A", {"memory_pressure": 1.0})
+        s.executor_heartbeat("B", {"memory_pressure": 0.9})
+        assert s.executors.aggregate_pressure() == pytest.approx(0.95)
+        assert s.admission.update(0.0, s.executors.aggregate_pressure()) == SHEDDING
+        # pressure_rejections arrives as a GAUGE; the scheduler counts growth
+        s.executor_heartbeat("A", {"pressure_rejections": 3.0})
+        s.executor_heartbeat("A", {"pressure_rejections": 5.0})
+        s.executor_heartbeat("A", {"pressure_rejections": 5.0})
+        assert metrics.pressure_rejections == 5
+        snap = s.executors.health_snapshot()["A"]
+        assert snap["pressure_rejections"] == 5
+
+
+def test_admitted_jobs_complete_under_small_quota_e2e(tpch_dir):
+    """Real cluster, tiny admission budget: everything the gate admits
+    completes, the slots release on completion, and a post-drain
+    submission is admitted again (no leaked slots)."""
+    from ballista_tpu.client.context import SessionContext
+    from ballista_tpu.testing.tpchgen import register_tpch
+
+    cfg = BallistaConfig({DEFAULT_SHUFFLE_PARTITIONS: 2})
+    ctx = SessionContext(cfg)
+    register_tpch(ctx, tpch_dir)
+    cluster = StandaloneCluster(num_executors=2, vcores=2, config=cfg)
+    cluster.scheduler.admission = _ctl(per_session_quota=2, max_pending=2)
+    try:
+        scheduler = cluster.scheduler
+        sid = scheduler.sessions.create_or_update(cfg.to_key_value_pairs(), "s-e2e")
+        jobs = [scheduler.submit_sql(tpch_query(6), sid) for _ in range(2)]
+        for j in jobs:
+            status = scheduler.wait_for_job(j, timeout=60)
+            assert status["state"] == "successful", status.get("error")
+        deadline = time.time() + 5
+        while scheduler.admission.depth() > 0 and time.time() < deadline:
+            time.sleep(0.05)
+        assert scheduler.admission.depth() == 0, "slots must release on completion"
+        # drained: a new submission is admitted without any manual reset
+        j3 = scheduler.submit_sql(tpch_query(6), sid)
+        assert scheduler.wait_for_job(j3, timeout=60)["state"] == "successful"
+    finally:
+        cluster.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# executor-side pressure gate
+
+
+class TestExecutorPressureGate:
+    def _task(self, session_id="sess") -> TaskDescription:
+        return TaskDescription(job_id="job-p", stage_id=1, stage_attempt=0,
+                               task_id=7, partitions=[0], plan=None,
+                               session_id=session_id)
+
+    def test_saturated_pool_rejects_retryably(self, tmp_path):
+        ex = Executor(str(tmp_path), ExecutorMetadata(id="ex-p"))
+        ex.session_pools = SessionPoolRegistry(capacity_per_session=100)
+        ex.session_pools.get("sess").grow_wait(100, timeout_s=0.0)
+        result = ex.run_task(self._task())
+        assert result.state == "failed"
+        assert result.retryable
+        assert result.error_kind == "ResourceExhausted"
+        assert "saturated" in result.error
+        assert ex.pressure_rejections == 1
+
+    def test_headroom_admits(self, tmp_path):
+        ex = Executor(str(tmp_path), ExecutorMetadata(id="ex-h"))
+        ex.session_pools = SessionPoolRegistry(capacity_per_session=100)
+        ex.session_pools.get("sess").grow_wait(50, timeout_s=5.0)
+        assert ex._reject_if_saturated(self._task()) is None
+        assert ex.pressure_rejections == 0
+
+    def test_no_pools_means_no_gate(self, tmp_path):
+        ex = Executor(str(tmp_path), ExecutorMetadata(id="ex-n"))
+        assert ex._reject_if_saturated(self._task()) is None
+
+    def test_pool_pressure_and_overcommit_observability(self):
+        reg = SessionPoolRegistry(capacity_per_session=100)
+        reg.get("a").grow_wait(150, timeout_s=0.0)  # forced through: overcommit
+        reg.get("b").grow_wait(20, timeout_s=1.0)
+        assert reg.aggregate_pressure() == pytest.approx(1.5)  # max, not mean
+        assert reg.total_overcommitted() == 150
+        assert reg.get("a").saturated
+        assert not reg.get("b").saturated
+
+
+def test_chaos_overload_mode_saturates_the_pool():
+    chaos = ChaosExec(OneBatchSource(1), seed=1, probability=1.0, mode="overload",
+                      straggler_delay_s=0.05)
+    pool = MemoryPool(100)
+    ctx = TaskContext()
+    ctx.memory_pool = pool
+    gen = chaos.execute(0, ctx)
+    next(gen)  # first batch out: the chaos reservation is live
+    assert pool.saturated
+    assert pool.pressure() >= 1.0
+    list(gen)  # drain → finally releases
+    assert pool.reserved == 0
+    assert pool.overcommitted >= 100, "forced reservation must be counted"
+
+
+def test_pressure_rejection_retries_to_healthy_executor_e2e(tpch_dir):
+    """One executor's session pool is saturated before the job starts; its
+    tasks bounce off the admission gate retryably and the scheduler lands
+    the retries on the healthy executor — the job still succeeds."""
+    from ballista_tpu.client.context import SessionContext
+    from ballista_tpu.testing.tpchgen import register_tpch
+
+    cfg = BallistaConfig({DEFAULT_SHUFFLE_PARTITIONS: 2, MAX_PARTITIONS_PER_TASK: 1})
+    ctx = SessionContext(cfg)
+    register_tpch(ctx, tpch_dir)
+    import tempfile
+
+    wd = tempfile.mkdtemp(prefix="bt-pressure-")
+    # extra vcores bias the first offers onto the saturated executor
+    choked = Executor(wd, ExecutorMetadata(id=str(new_executor_id()), vcores=4), config=cfg)
+    healthy = Executor(wd, ExecutorMetadata(id=str(new_executor_id()), vcores=2), config=cfg)
+    launcher = InProcessTaskLauncher({choked.metadata.id: choked,
+                                      healthy.metadata.id: healthy})
+    metrics = InMemoryMetricsCollector()
+    scheduler = SchedulerServer(launcher, metrics,
+                                quarantine_threshold=0.5, quarantine_min_events=1.0,
+                                sweep_interval_s=0.2)
+    scheduler.start()
+    scheduler.register_executor(choked.metadata)
+    scheduler.register_executor(healthy.metadata)
+    try:
+        sid = scheduler.sessions.create_or_update(cfg.to_key_value_pairs(), "s-pressure")
+        choked.session_pools = SessionPoolRegistry(capacity_per_session=64)
+        choked.session_pools.get(sid).grow_wait(64, timeout_s=0.0)
+        job_id = scheduler.submit_sql(tpch_query(6), sid)
+        status = scheduler.wait_for_job(job_id, timeout=60)
+        assert status["state"] == "successful", status.get("error")
+        assert choked.pressure_rejections >= 1, "choked executor never exercised — vacuous"
+        assert healthy.tasks_run >= 1
+        assert choked.tasks_run == 0, "saturated pool must admit nothing"
+    finally:
+        scheduler.stop()
+        launcher.pool.shutdown(wait=False)
+
+
+# ---------------------------------------------------------------------------
+# Flight data plane: stream gate + circuit breaker
+
+
+class TestStreamGate:
+    def test_cap_with_empty_queue_rejects_immediately(self):
+        gate = _StreamGate(max_streams=1, accept_queue=0)
+        gate.acquire()
+        t0 = time.time()
+        with pytest.raises(flight.FlightUnavailableError):
+            gate.acquire()
+        assert time.time() - t0 < 1.0, "no queue slot → fail fast, not after timeout"
+        gate.release()
+        gate.acquire()  # slot freed → admitted again
+        gate.release()
+
+    def test_bounded_waiters_time_out_then_overflow_rejected(self):
+        gate = _StreamGate(max_streams=1, accept_queue=1, acquire_timeout_s=0.15)
+        gate.acquire()
+        results = []
+
+        def waiter():
+            try:
+                gate.acquire()
+                results.append("ok")
+            except flight.FlightUnavailableError:
+                results.append("timeout")
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        time.sleep(0.05)
+        assert gate.waiters == 1
+        # the queue is full: an extra caller is turned away immediately
+        with pytest.raises(flight.FlightUnavailableError):
+            gate.acquire()
+        t.join(timeout=2)
+        assert results == ["timeout"], "queued waiter must give up after the timeout"
+        assert gate.waiters == 0
+
+    def test_waiter_admitted_when_slot_frees(self):
+        gate = _StreamGate(max_streams=1, accept_queue=4, acquire_timeout_s=5.0)
+        gate.acquire()
+        got = threading.Event()
+
+        def waiter():
+            gate.acquire()
+            got.set()
+
+        threading.Thread(target=waiter, daemon=True).start()
+        time.sleep(0.05)
+        assert not got.is_set()
+        gate.release()
+        assert got.wait(timeout=2)
+
+    def test_zero_max_streams_disables_the_gate(self):
+        gate = _StreamGate(max_streams=0, accept_queue=0)
+        for _ in range(10):
+            gate.acquire()
+
+    def test_do_get_rejection_counts_in_server_stats(self, tmp_path):
+        server = BallistaFlightServer(host="127.0.0.1", port=0, work_dir=str(tmp_path))
+        try:
+            server.gate = _StreamGate(max_streams=1, accept_queue=0)
+            server.gate.acquire()  # exhaust the only slot
+            ticket = flight.Ticket(json.dumps(
+                {"path": str(tmp_path / "x.arrow"), "layout": "hash"}).encode())
+            with pytest.raises(flight.FlightUnavailableError):
+                server.do_get(None, ticket)
+            assert server.stats["streams_rejected"] == 1
+        finally:
+            server.shutdown()
+
+
+class TestCircuitBreaker:
+    def test_trip_after_consecutive_failures(self):
+        br = CircuitBreaker(threshold=2, cooldown_s=60.0)
+        br.failure("a:1")
+        br.check("a:1")  # one failure: still closed
+        br.failure("a:1")
+        assert br.trips == 1
+        with pytest.raises(CircuitOpen) as ei:
+            br.check("a:1")
+        assert isinstance(ei.value, IoError)  # reader retry ladder handles it
+        assert ei.value.retry_after_s > 0
+        br.check("b:1")  # per-address: other peers unaffected
+
+    def test_success_resets_the_consecutive_count(self):
+        br = CircuitBreaker(threshold=2, cooldown_s=60.0)
+        br.failure("a:1")
+        br.success("a:1")
+        br.failure("a:1")
+        assert br.trips == 0
+        br.check("a:1")
+
+    def test_half_open_single_probe_then_close(self):
+        br = CircuitBreaker(threshold=1, cooldown_s=0.1)
+        br.failure("a:1")
+        with pytest.raises(CircuitOpen):
+            br.check("a:1")
+        time.sleep(0.12)
+        br.check("a:1")  # cooldown elapsed: THIS caller is the probe
+        with pytest.raises(CircuitOpen):
+            br.check("a:1")  # second caller while the probe is in flight
+        br.success("a:1")
+        br.check("a:1")  # probe succeeded: circuit closed
+
+    def test_failed_probe_reopens_for_another_cooldown(self):
+        br = CircuitBreaker(threshold=1, cooldown_s=0.1)
+        br.failure("a:1")
+        time.sleep(0.12)
+        br.check("a:1")  # probe allowed
+        br.failure("a:1")  # probe failed
+        assert br.trips == 2
+        with pytest.raises(CircuitOpen):
+            br.check("a:1")  # re-opened: cooling down again
+        time.sleep(0.12)
+        br.check("a:1")  # next probe window
+
+    def test_threshold_zero_disables(self):
+        br = CircuitBreaker(threshold=0, cooldown_s=0.1)
+        for _ in range(10):
+            br.failure("a:1")
+        br.check("a:1")
+        assert br.trips == 0
+
+
+# ---------------------------------------------------------------------------
+# client backoff honoring the scheduler's hint
+
+
+class FakeRpcError(grpc.RpcError):
+    def __init__(self, code, details="", trailing=()):
+        self._code = code
+        self._details = details
+        self._trailing = trailing
+
+    def code(self):
+        return self._code
+
+    def details(self):
+        return self._details
+
+    def trailing_metadata(self):
+        return self._trailing
+
+
+def _client(cfg: BallistaConfig):
+    from ballista_tpu.client.remote import RemoteSchedulerClient
+
+    # the channel dials lazily — nothing listens on this port and no rpc
+    # in these tests ever reaches the wire (the stub is replaced)
+    return RemoteSchedulerClient("df://127.0.0.1:1", cfg)
+
+
+class TestClientBackoff:
+    def test_hint_extraction_prefers_trailing_metadata(self):
+        from ballista_tpu.client.remote import _retry_after_ms
+
+        e = FakeRpcError(grpc.StatusCode.RESOURCE_EXHAUSTED,
+                         details="overloaded [retry_after_ms=9999]",
+                         trailing=(("retry-after-ms", "250"),))
+        assert _retry_after_ms(e) == 250
+        e2 = FakeRpcError(grpc.StatusCode.RESOURCE_EXHAUSTED,
+                          details="overloaded [retry_after_ms=400]")
+        assert _retry_after_ms(e2) == 400
+        assert _retry_after_ms(FakeRpcError(grpc.StatusCode.UNAVAILABLE, "nope")) is None
+
+    def test_backoff_is_floored_at_the_server_hint(self):
+        c = _client(BallistaConfig({CLIENT_BACKOFF_BASE_MS: 100,
+                                    CLIENT_BACKOFF_MAX_MS: 10_000}))
+        # attempt 0 alone would be 100ms; the 4s hint must dominate
+        for _ in range(20):
+            s = c._backoff_s(0, hint_ms=4000)
+            assert 2.0 <= s <= 4.0  # jitter is 0.5x..1.0x
+        # and the cap still bounds a hostile hint
+        assert c._backoff_s(0, hint_ms=10**9) <= 10.0
+
+    def test_backoff_grows_exponentially_under_the_cap(self):
+        c = _client(BallistaConfig({CLIENT_BACKOFF_BASE_MS: 100,
+                                    CLIENT_BACKOFF_MAX_MS: 1000}))
+        assert c._backoff_s(0) <= 0.1
+        assert c._backoff_s(10) <= 1.0  # capped
+
+    def test_submit_retries_resource_exhausted_then_succeeds(self):
+        c = _client(BallistaConfig({CLIENT_SUBMIT_RETRIES: 5,
+                                    CLIENT_BACKOFF_BASE_MS: 1,
+                                    CLIENT_BACKOFF_MAX_MS: 50}))
+        calls = []
+
+        def fake_execute(req, timeout):
+            calls.append(time.monotonic())
+            if len(calls) <= 2:
+                raise FakeRpcError(grpc.StatusCode.RESOURCE_EXHAUSTED,
+                                   trailing=(("retry-after-ms", "40"),))
+            return SimpleNamespace(job_id="job-ok")
+
+        c.stub = SimpleNamespace(ExecuteQuery=fake_execute)
+        t0 = time.monotonic()
+        assert c._submit(SimpleNamespace()) == "job-ok"
+        assert len(calls) == 3
+        assert c.submit_retries == 2
+        # two backoffs honoring the 40ms hint, each jittered to >= 20ms
+        assert time.monotonic() - t0 >= 0.04
+
+    def test_overload_surfaces_typed_after_retries_exhausted(self):
+        c = _client(BallistaConfig({CLIENT_SUBMIT_RETRIES: 1,
+                                    CLIENT_BACKOFF_BASE_MS: 1,
+                                    CLIENT_BACKOFF_MAX_MS: 5}))
+
+        def always_shed(req, timeout):
+            raise FakeRpcError(grpc.StatusCode.RESOURCE_EXHAUSTED,
+                               details="draining [retry_after_ms=123]")
+
+        c.stub = SimpleNamespace(ExecuteQuery=always_shed)
+        with pytest.raises(ClusterOverloaded) as ei:
+            c._submit(SimpleNamespace())
+        assert ei.value.retry_after_ms == 123
+        assert ei.value.retryable
+
+    def test_idempotent_rpcs_retry_transient_codes(self):
+        c = _client(BallistaConfig({CLIENT_SUBMIT_RETRIES: 3,
+                                    CLIENT_BACKOFF_BASE_MS: 1,
+                                    CLIENT_BACKOFF_MAX_MS: 5}))
+        attempts = []
+
+        def flaky(req, timeout):
+            attempts.append(1)
+            if len(attempts) < 3:
+                raise FakeRpcError(grpc.StatusCode.UNAVAILABLE, "scheduler blip")
+            return "status"
+
+        assert c._call_idempotent(flaky, None, "GetJobStatus") == "status"
+        assert len(attempts) == 3
+
+    def test_idempotent_rpcs_do_not_retry_fatal_codes(self):
+        c = _client(BallistaConfig({CLIENT_SUBMIT_RETRIES: 3,
+                                    CLIENT_BACKOFF_BASE_MS: 1,
+                                    CLIENT_BACKOFF_MAX_MS: 5}))
+        attempts = []
+
+        def broken(req, timeout):
+            attempts.append(1)
+            raise FakeRpcError(grpc.StatusCode.INVALID_ARGUMENT, "bad request")
+
+        with pytest.raises(grpc.RpcError):
+            c._call_idempotent(broken, None, "GetJobStatus")
+        assert len(attempts) == 1, "non-transient codes must not burn retries"
