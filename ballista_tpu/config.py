@@ -31,6 +31,7 @@ SHUFFLE_READER_FORCE_REMOTE = "ballista.shuffle.reader.force_remote_read"
 SHUFFLE_BLOCK_TRANSPORT = "ballista.shuffle.block.transport"
 SHUFFLE_FETCH_COALESCE = "ballista.shuffle.fetch.coalesce"
 SHUFFLE_MMAP = "ballista.shuffle.mmap.enabled"
+SHUFFLE_CHECKSUM_ENABLED = "ballista.shuffle.checksum.enabled"
 SORT_SHUFFLE_ENABLED = "ballista.shuffle.sort.enabled"
 SORT_SHUFFLE_MEMORY_LIMIT = "ballista.shuffle.sort.memory.limit"
 SORT_SHUFFLE_POOL_WAIT_S = "ballista.shuffle.sort.memory.wait.seconds"
@@ -206,6 +207,7 @@ _ENTRIES: list[ConfigEntry] = [
     ConfigEntry(SHUFFLE_BLOCK_TRANSPORT, "Fetch remote shuffle partitions as raw 8 MiB IPC blocks (no decode/re-encode).", bool, True),
     ConfigEntry(SHUFFLE_FETCH_COALESCE, "Coalesce a reduce task's fetches: all map outputs owned by one executor stream back in a single RPC (M small RPCs become one per executor). Env escape hatch: BALLISTA_SHUFFLE_COALESCE=0.", bool, _env_bool("BALLISTA_SHUFFLE_COALESCE", True)),
     ConfigEntry(SHUFFLE_MMAP, "Serve and read shuffle files through memory maps (zero-copy buffer slices instead of seek+read copies). Env escape hatch: BALLISTA_SHUFFLE_MMAP=0 (also honored by the Flight server, which has no session config).", bool, _env_bool("BALLISTA_SHUFFLE_MMAP", True)),
+    ConfigEntry(SHUFFLE_CHECKSUM_ENABLED, "End-to-end shuffle integrity: writers record a checksum per output-partition byte range (hash layout: .crc sidecar; sort layout: 5th index-entry field), Flight servers ship it in per-location headers, and readers verify the received bytes BEFORE decoding. A mismatch retries the fetch once in place, then escalates as FetchFailed(cause=corruption) so the upstream stage recomputes and the serving executor takes a corruption strike. Disabling only stops WRITING checksums — readers always verify when a stored value is present. Env escape hatch: BALLISTA_SHUFFLE_CHECKSUM=0 (also honored by the Flight server, which has no session config).", bool, _env_bool("BALLISTA_SHUFFLE_CHECKSUM", True)),
     ConfigEntry(SORT_SHUFFLE_ENABLED, "Use sort-based shuffle (M consolidated bucket files + index) for hash repartitions.", bool, True),
     ConfigEntry(SORT_SHUFFLE_MEMORY_LIMIT, "Bytes of buffered batches before sort-shuffle spills (0 = unlimited).", int, 256 * 1024 * 1024, _nonneg),
     ConfigEntry(SORT_SHUFFLE_POOL_WAIT_S, "How long a writer with nothing left to spill blocks for session-pool headroom before overcommitting (liveness backstop).", float, 10.0, _nonneg),
@@ -366,8 +368,15 @@ _ENTRIES: list[ConfigEntry] = [
         CHAOS_MODE, "Fault kind to inject. 'overload' synthesizes memory "
         "pressure (the hit task overcommits its session pool for the "
         "partition's duration) plus a queue delay — deterministic fuel for "
-        "overload-protection tests.", str, "transient",
-        choices=("transient", "fatal", "panic", "delay", "straggler", "overload"),
+        "overload-protection tests. 'corrupt' is a SERVE-time fault (seeded "
+        "bit-flip as the Flight server streams shuffle bytes, so stored files "
+        "stay pristine and a refetch can heal): because the data plane has no "
+        "session config, it is armed via env on the executor — "
+        "BALLISTA_CHAOS_CORRUPT_P (probability per served range), "
+        "BALLISTA_CHAOS_CORRUPT_ONCE=1 (corrupt only the first serve of each "
+        "range: deterministic transient corruption), BALLISTA_CHAOS_SEED.",
+        str, "transient",
+        choices=("transient", "fatal", "panic", "delay", "straggler", "overload", "corrupt"),
     ),
     ConfigEntry(
         CHAOS_STRAGGLER_DELAY_S,

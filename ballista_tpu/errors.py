@@ -55,18 +55,58 @@ class FetchFailed(BallistaError):
 
     retryable = True
 
-    def __init__(self, executor_id: str, job_id: str, stage_id: int, map_partition: int, msg: str = ""):
+    def __init__(self, executor_id: str, job_id: str, stage_id: int, map_partition: int,
+                 msg: str = "", cause: str = ""):
+        tag = f" [{cause}]" if cause else ""
         super().__init__(
-            f"fetch failed from executor={executor_id} {job_id}/{stage_id}/{map_partition}: {msg}"
+            f"fetch failed from executor={executor_id} {job_id}/{stage_id}/{map_partition}{tag}: {msg}"
         )
         self.executor_id = executor_id
         self.job_id = job_id
         self.stage_id = stage_id
         self.map_partition = map_partition
+        # "corruption" when checksum verification failed twice for the same
+        # map output: the scheduler additionally strikes the SERVING
+        # executor's health score (its disk, not the network, is suspect)
+        self.cause = cause
 
 
 class IoError(BallistaError):
     retryable = True
+
+
+class DataCorrupted(IoError):
+    """Shuffle bytes failed checksum verification (client-side before
+    decode, or a local read against the stored value). Retryable exactly
+    ONCE in place — a transient in-transit flip heals on refetch — then
+    escalated as FetchFailed(cause="corruption") so the upstream stage
+    recomputes and the serving executor takes a corruption strike."""
+
+    def __init__(self, where: str, expected: str, actual: str, detail: str = ""):
+        extra = f" ({detail})" if detail else ""
+        super().__init__(
+            f"shuffle data corrupted at {where}: checksum {actual} != expected {expected}{extra}"
+        )
+        self.where = where
+        self.expected = expected
+        self.actual = actual
+
+
+class ShortRead(IoError):
+    """A requested shuffle byte range extends past the file's actual size
+    (torn write, truncated disk, stale index). Typed and retryable so the
+    Flight server can refuse to stream a short range instead of silently
+    ending the stream early."""
+
+    def __init__(self, path: str, offset: int, length: int, size: int):
+        super().__init__(
+            f"shuffle file truncated: {path} has {size} bytes, range needs "
+            f"[{offset}, {offset + length})"
+        )
+        self.path = path
+        self.offset = offset
+        self.length = length
+        self.size = size
 
 
 class GrpcError(BallistaError):
@@ -115,11 +155,15 @@ class ConfigurationError(BallistaError):
 def error_to_proto_kind(err: BaseException) -> str:
     """Stable string tag used in TaskStatus/FailedTask wire messages."""
     if isinstance(err, FetchFailed):
-        return "FetchPartitionError"
+        # the cause rides the kind tag ("FetchPartitionError:corruption")
+        # so blame-aware recovery crosses the wire without a proto change
+        return f"FetchPartitionError:{err.cause}" if err.cause else "FetchPartitionError"
     if isinstance(err, ClusterOverloaded):
         return "ResourceExhausted"
     if isinstance(err, Cancelled):
         return "TaskKilled"
+    if isinstance(err, DataCorrupted):
+        return "DataCorrupted"
     if isinstance(err, (IoError, GrpcError)):
         return "IoError"
     if isinstance(err, ExecutionError):

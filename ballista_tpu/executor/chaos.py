@@ -11,6 +11,13 @@ failures and assert the retry machinery converges.
 Determinism: the RNG seed mixes (config seed, job, stage, partition,
 attempt) so a retried task sees DIFFERENT luck — exactly what makes
 transient-fault tests terminate.
+
+Mode 'corrupt' is the exception: it is a SERVE-time fault (the Flight
+server flips a bit in the bytes it streams, keyed by `corrupt_roll`/
+`flip_bit` below), so ChaosExec itself treats it as a no-op at execute
+time. The data plane has no session config, hence the env knobs
+BALLISTA_CHAOS_CORRUPT_P / BALLISTA_CHAOS_CORRUPT_ONCE / BALLISTA_CHAOS_SEED
+documented on `ballista.chaos.mode`.
 """
 
 from __future__ import annotations
@@ -31,6 +38,30 @@ from ballista_tpu.config import (
 )
 from ballista_tpu.errors import Cancelled, ExecutionError
 from ballista_tpu.plan.physical import ExecutionPlan, TaskContext
+
+
+def corrupt_roll(seed: int, key: str, p: float) -> bool:
+    """Seeded decision for chaos mode=corrupt: should THIS serve of the
+    range identified by `key` flip a bit? Pure function of (seed, key) so
+    a test replaying the same serves sees the same corruption."""
+    if p <= 0.0:
+        return False
+    h = hashlib.sha256(f"{seed}|corrupt|{key}".encode()).digest()
+    return int.from_bytes(h[:8], "big") / 2**64 < p
+
+
+def flip_bit(data: bytes, seed: int, key: str) -> bytes:
+    """Deterministically flip one bit of `data` (position and bit index
+    both derived from the seed+key hash). Returns a new bytes object —
+    the stored file is never touched, only the served copy."""
+    if not data:
+        return data
+    h = hashlib.sha256(f"{seed}|corrupt|{key}".encode()).digest()
+    pos = int.from_bytes(h[8:16], "big") % len(data)
+    bit = h[0] % 8
+    out = bytearray(data)
+    out[pos] ^= 1 << bit
+    return bytes(out)
 
 
 class ChaosExec(ExecutionPlan):

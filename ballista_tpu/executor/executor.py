@@ -64,6 +64,10 @@ class TaskResult:
     # ResultLost identity when a shuffle fetch failed
     fetch_failed_executor_id: str = ""
     fetch_failed_stage_id: int = 0
+    # why the fetch failed ("corruption" = checksum mismatch survived the
+    # retry-once refetch; rides error_kind as "FetchPartitionError:<cause>"
+    # on the wire so no proto change is needed)
+    fetch_failed_cause: str = ""
     # the failure was a per-task deadline expiry (feeds quarantine scoring)
     timed_out: bool = False
 
@@ -280,5 +284,6 @@ class Executor:
             if isinstance(e, FetchFailed):
                 base.fetch_failed_executor_id = e.executor_id
                 base.fetch_failed_stage_id = e.stage_id
+                base.fetch_failed_cause = getattr(e, "cause", "")
             log.warning("task %s/%s failed: %s", task.job_id, task.task_id, e)
             return base

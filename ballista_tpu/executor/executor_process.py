@@ -286,11 +286,17 @@ class ExecutorProcess:
         saturation, lifetime forced-overcommit bytes, admission
         rejections, and local task-queue depth."""
         pools = self.executor.session_pools
+        from ballista_tpu.shuffle.integrity import INTEGRITY
+
+        integrity = INTEGRITY.snapshot()
         return [
             ("memory_pressure", pools.aggregate_pressure() if pools else 0.0),
             ("pool_overcommitted_bytes", float(pools.total_overcommitted()) if pools else 0.0),
             ("pressure_rejections", float(self.executor.pressure_rejections)),
             ("queued_tasks", float(self.service._queue.qsize())),
+            # shuffle-integrity counters (reader-side verification outcomes)
+            ("checksum_failures", float(integrity["checksum_failures"])),
+            ("corruption_retries", float(integrity["corruption_retries"])),
         ]
 
     def _heartbeat_loop(self) -> None:

@@ -21,6 +21,8 @@ def start_health_server(executor, stopping_event, host: str = "0.0.0.0", port: i
                 self.send_response(404)
                 self.end_headers()
                 return
+            from ballista_tpu.shuffle.integrity import INTEGRITY
+
             pools = executor.session_pools
             body = json.dumps({
                 "status": "draining" if stopping_event.is_set() else "healthy",
@@ -31,6 +33,8 @@ def start_health_server(executor, stopping_event, host: str = "0.0.0.0", port: i
                 "pressure_rejections": executor.pressure_rejections,
                 "memory_pressure": round(pools.aggregate_pressure(), 4) if pools else 0.0,
                 "pool_overcommitted_bytes": pools.total_overcommitted() if pools else 0,
+                # shuffle-integrity counters (reader-side verification)
+                **INTEGRITY.snapshot(),
             }).encode()
             self.send_response(200)
             self.send_header("Content-Type", "application/json")

@@ -32,8 +32,9 @@ import pyarrow as pa
 import pyarrow.flight as flight
 import pyarrow.ipc as ipc
 
-from ballista_tpu.config import SHUFFLE_BLOCK_TRANSPORT
-from ballista_tpu.errors import CircuitOpen
+from ballista_tpu.config import SHUFFLE_BLOCK_TRANSPORT, SHUFFLE_CHECKSUM_ENABLED
+from ballista_tpu.errors import CircuitOpen, DataCorrupted
+from ballista_tpu.shuffle.integrity import verify_or_raise as _verify_or_raise
 from ballista_tpu.plan.physical import TaskContext
 from ballista_tpu.shuffle.types import PartitionLocation
 
@@ -255,6 +256,27 @@ class ChainedBufferReader:
         return out
 
 
+def _try_parse_header(body) -> dict | None:
+    """Sniff an optional leading JSON header Result on the block path.
+
+    New servers answering a want_crc ticket prepend {"nbytes": n, "crc":
+    "..."} before the raw blocks; old servers ignore the ticket field and
+    send blocks only. Arrow IPC bytes never begin with '{' (the stream
+    opens with a length prefix / 0xFFFFFFFF continuation marker), so a
+    small first body starting with '{' that parses as JSON with an
+    "nbytes" key is unambiguously the header."""
+    if body.size == 0 or body.size > 256:
+        return None
+    raw = body.to_pybytes()
+    if raw[:1] != b"{":
+        return None
+    try:
+        h = json.loads(raw.decode())
+    except (UnicodeDecodeError, ValueError):
+        return None
+    return h if isinstance(h, dict) and "nbytes" in h else None
+
+
 def _ticket(loc: PartitionLocation) -> dict:
     return {
         "path": loc.path,
@@ -287,16 +309,39 @@ def _route(ctx: TaskContext, loc: PartitionLocation, body: dict) -> tuple[str, d
 
 
 def fetch_partition_flight(loc: PartitionLocation, ctx: TaskContext) -> Iterator[pa.RecordBatch]:
-    addr, ticket = _route(ctx, loc, _ticket(loc))
+    body = _ticket(loc)
+    if bool(ctx.config.get(SHUFFLE_CHECKSUM_ENABLED)):
+        # opt-in: ask the server to prepend its stored checksum header on
+        # the block path (old servers ignore the field — no header comes
+        # back and the bytes stay unchecked, exactly the legacy behavior)
+        body["want_crc"] = True
+    addr, ticket = _route(ctx, loc, body)
     BREAKER.check(addr)  # fail fast while the address's circuit is open
     client = POOL.get(addr, tls=_session_tls(ctx.config))
     try:
         if bool(ctx.config.get(SHUFFLE_BLOCK_TRANSPORT)):
             action = flight.Action("io_block_transport", json.dumps(ticket).encode())
-            blocks = [r.body for r in client.do_action(action)]
-            if not blocks:
+            results = list(client.do_action(action))
+            expected = declared = None
+            if results:
+                h = _try_parse_header(results[0].body)
+                if h is not None:
+                    expected = h.get("crc")
+                    declared = int(h["nbytes"])
+                    results = results[1:]
+            blocks = [r.body for r in results]
+            if not blocks and not declared:
                 BREAKER.success(addr)
                 return
+            where = f"{loc.path}#p{loc.output_partition}"
+            total = sum(b.size for b in blocks)
+            if declared is not None and total != declared:
+                raise DataCorrupted(where, f"{declared} bytes", f"{total} bytes",
+                                    detail="stream length != declared")
+            # verify the RAW received bytes before handing them to the
+            # Arrow decoder: a flip surfaces as typed corruption, not an
+            # opaque decode crash (or silent wrong rows)
+            _verify_or_raise(blocks, expected, where)
             reader = ipc.open_stream(ChainedBufferReader(blocks))
             yield from reader
         else:
@@ -304,6 +349,11 @@ def fetch_partition_flight(loc: PartitionLocation, ctx: TaskContext) -> Iterator
             for chunk in client.do_get(t):
                 yield chunk.data
         BREAKER.success(addr)
+    except DataCorrupted:
+        # corruption is a DISK/serve-path signal, not connection health:
+        # it must not open the circuit (the retry-once refetch needs the
+        # address reachable) and the pooled connection is fine
+        raise
     except Exception:
         BREAKER.failure(addr)
         POOL.discard(addr)
@@ -333,6 +383,7 @@ def fetch_partitions_flight(locs: Sequence[PartitionLocation], ctx: TaskContext
     completed = 0          # locations fully received = first incomplete idx
     cur_need = 0           # bytes still owed for the current location
     cur_blocks: list = []
+    cur_crc: str | None = None
 
     def fail(e: BaseException):
         if _is_unknown_action(e):
@@ -356,9 +407,10 @@ def fetch_partitions_flight(locs: Sequence[PartitionLocation], ctx: TaskContext
         except Exception as e:
             raise fail(e) from e
         if cur_need == 0:
-            # header Result: {"i": index, "nbytes": n}
+            # header Result: {"i": index, "nbytes": n, "crc": optional}
             h = json.loads(r.body.to_pybytes().decode())
             cur_need = int(h["nbytes"])
+            cur_crc = h.get("crc")
             cur_blocks = []
             if cur_need == 0:
                 yield completed, [], 0
@@ -368,6 +420,16 @@ def fetch_partitions_flight(locs: Sequence[PartitionLocation], ctx: TaskContext
         cur_need -= r.body.size
         if cur_need == 0:
             nbytes = sum(b.size for b in cur_blocks)
+            if cur_crc:
+                try:
+                    _verify_or_raise(
+                        cur_blocks, cur_crc,
+                        f"{locs[completed].path}#p{locs[completed].output_partition}")
+                except DataCorrupted as e:
+                    # NOT fail(e): corruption must not trip the breaker or
+                    # drop the pooled connection — the reader's retry-once
+                    # refetch targets this same address
+                    raise FetchStreamError(completed, e) from e
             try:
                 batches = list(ipc.open_stream(ChainedBufferReader(cur_blocks)))
             except Exception as e:

@@ -11,7 +11,11 @@ so the proxy knows where to relay from. The relay is a streaming
 pass-through: each upstream Result body is forwarded verbatim (zero
 re-chunking, nothing buffered), which also preserves the
 io_coalesced_transport header framing byte-for-byte — the proxy needs no
-knowledge of the coalesced wire format to relay it.
+knowledge of the coalesced wire format to relay it. The same property
+carries the shuffle-integrity checksum headers ({"nbytes", "crc"} on the
+block path, "crc" in coalesced frames) end to end: external clients verify
+against the EXECUTOR's stored checksum, so a corruption introduced by the
+relay hop itself is also caught.
 """
 
 from __future__ import annotations

@@ -48,7 +48,9 @@ import pyarrow.flight as flight
 import pyarrow.ipc as ipc
 
 from ballista_tpu.config import _env_bool, _env_float, _env_int
+from ballista_tpu.errors import ShortRead
 from ballista_tpu.shuffle import paths
+from ballista_tpu.shuffle.integrity import INTEGRITY, verify_blocks
 
 BLOCK_SIZE = 8 * 1024 * 1024
 
@@ -119,6 +121,14 @@ def _ticket_list(t: dict) -> list[dict]:
     return t["locations"] if "locations" in t else [t]
 
 
+def _chaos_roll(seed: int, key: str, p: float) -> bool:
+    # lazy import: the chaos module pulls in the plan layer, which the
+    # data plane otherwise never needs
+    from ballista_tpu.executor.chaos import corrupt_roll
+
+    return corrupt_roll(seed, key, p)
+
+
 class BallistaFlightServer(flight.FlightServerBase):
     def __init__(self, host: str = "0.0.0.0", port: int = 0, work_dir: str = "",
                  tls_cert: str | None = None, tls_key: str | None = None,
@@ -144,7 +154,9 @@ class BallistaFlightServer(flight.FlightServerBase):
         # protection outcomes (rejected at the gate / stalled consumers)
         self.stats = {"do_get": 0, "block_rpc": 0, "coalesced_rpc": 0,
                       "locations_served": 0, "bytes_served": 0,
-                      "streams_rejected": 0, "streams_stalled": 0}
+                      "streams_rejected": 0, "streams_stalled": 0,
+                      "checksum_failures": 0, "short_reads": 0,
+                      "chaos_corruptions": 0}
         self._stats_lock = threading.Lock()
         # overload knobs are environmental: the data plane has no session
         # config (same precedent as BALLISTA_SHUFFLE_MMAP)
@@ -153,6 +165,15 @@ class BallistaFlightServer(flight.FlightServerBase):
             _env_int("BALLISTA_FLIGHT_ACCEPT_QUEUE", 128),
         )
         self.stall_timeout_s = _env_float("BALLISTA_FLIGHT_STALL_TIMEOUT_S", 30.0)
+        # integrity: ship stored checksums in serve headers (same env
+        # escape hatch the session knob documents)
+        self.checksum_env = _env_bool("BALLISTA_SHUFFLE_CHECKSUM", True)
+        # chaos mode=corrupt — serve-time seeded bit-flips (stored files
+        # stay pristine, so a refetch can heal); see config.CHAOS_MODE
+        self.corrupt_p = _env_float("BALLISTA_CHAOS_CORRUPT_P", 0.0)
+        self.corrupt_once = _env_bool("BALLISTA_CHAOS_CORRUPT_ONCE", True)
+        self.chaos_seed = _env_int("BALLISTA_CHAOS_SEED", 0)
+        self._serve_counts: dict[str, int] = {}
 
     def _bump(self, key: str, n: int = 1) -> None:
         with self._stats_lock:
@@ -165,6 +186,43 @@ class BallistaFlightServer(flight.FlightServerBase):
             self._bump("streams_rejected")
             raise
 
+    def _crc_for(self, tk: dict) -> str | None:
+        """Stored checksum for one location ticket, or None when unchecked
+        (knob off, pre-checksum file, unreadable sidecar/index). Never
+        raises: serving must not fail because a checksum cannot be read."""
+        if not self.checksum_env:
+            return None
+        try:
+            path = paths.contained_path(self.work_dir, tk["path"])
+            return paths.checksum_for(
+                path, tk.get("layout", "hash"), tk.get("output_partition", 0))
+        except Exception:
+            return None
+
+    def _maybe_corrupt(self, buf: pa.Buffer, tk: dict) -> pa.Buffer:
+        """chaos mode=corrupt: seeded bit-flip of the SERVED copy. With
+        BALLISTA_CHAOS_CORRUPT_ONCE (default) only the FIRST serve of each
+        (path, partition) range is eligible — deterministic transient
+        corruption that heals on the client's retry-once refetch. Without
+        it, every serve rolls independently (mixing the serve count into
+        the key), modelling a persistently bad disk/NIC."""
+        if self.corrupt_p <= 0.0 or buf.size == 0:
+            return buf
+        key = f"{tk.get('path', '')}|{tk.get('output_partition', 0)}"
+        with self._stats_lock:
+            serve = self._serve_counts.get(key, 0)
+            self._serve_counts[key] = serve + 1
+        if self.corrupt_once:
+            hit = serve == 0 and _chaos_roll(self.chaos_seed, key, self.corrupt_p)
+        else:
+            hit = _chaos_roll(self.chaos_seed, f"{key}|{serve}", self.corrupt_p)
+        if not hit:
+            return buf
+        from ballista_tpu.executor.chaos import flip_bit
+
+        self._bump("chaos_corruptions")
+        return pa.py_buffer(flip_bit(buf.to_pybytes(), self.chaos_seed, key))
+
     def do_get(self, context, ticket):
         t = json.loads(ticket.ticket.decode())
         tickets = _ticket_list(t)
@@ -174,6 +232,24 @@ class BallistaFlightServer(flight.FlightServerBase):
         except PermissionError as e:
             self.gate.release()
             raise flight.FlightUnauthorizedError(str(e))
+        except ShortRead as e:
+            self.gate.release()
+            self._bump("short_reads")
+            raise flight.FlightUnavailableError(str(e))
+        # do_get DECODES server-side, so the client never sees the stored
+        # bytes to verify — verify here instead, before the first batch
+        # leaves. Raw block/coalesced paths leave verification client-side.
+        for x, b in zip(tickets, bufs):
+            if b.size == 0:
+                continue
+            expected = self._crc_for(x)
+            if expected and not verify_blocks([b], expected):
+                self.gate.release()
+                self._bump("checksum_failures")
+                INTEGRITY.add("checksum_failures")
+                raise flight.FlightInternalError(
+                    f"stored shuffle bytes corrupted: {x.get('path')} "
+                    f"partition={x.get('output_partition', 0)} fails {expected}")
         self._bump("do_get")
         self._bump("locations_served", len(tickets))
         readers = [ipc.open_stream(pa.BufferReader(b)) for b in bufs if b.size]
@@ -221,9 +297,22 @@ class BallistaFlightServer(flight.FlightServerBase):
                     buf = _open_buffer(t, self.work_dir)
                 except PermissionError as e:
                     raise flight.FlightUnauthorizedError(str(e))
+                except ShortRead as e:
+                    self._bump("short_reads")
+                    raise flight.FlightUnavailableError(str(e))
                 self._bump("block_rpc")
                 self._bump("locations_served")
                 self._bump("bytes_served", buf.size)
+                buf = self._maybe_corrupt(buf, t)
+                if t.get("want_crc"):
+                    # opt-in header (new clients ask; old servers that don't
+                    # understand the field just ignore it and the client
+                    # detects the absence): {"nbytes": n, "crc": "..."}
+                    header = {"nbytes": buf.size}
+                    crc = self._crc_for(t)
+                    if crc:
+                        header["crc"] = crc
+                    yield flight.Result(pa.py_buffer(json.dumps(header).encode()))
                 yield from self._yield_blocks(buf)
             finally:
                 self.gate.release()
@@ -242,8 +331,17 @@ class BallistaFlightServer(flight.FlightServerBase):
                         buf = _open_buffer(tk, self.work_dir)
                     except PermissionError as e:
                         raise flight.FlightUnauthorizedError(str(e))
-                    header = json.dumps({"i": i, "nbytes": buf.size}).encode()
-                    yield flight.Result(pa.py_buffer(header))
+                    except ShortRead as e:
+                        self._bump("short_reads")
+                        raise flight.FlightUnavailableError(str(e))
+                    buf = self._maybe_corrupt(buf, tk)
+                    h = {"i": i, "nbytes": buf.size}
+                    crc = self._crc_for(tk)
+                    if crc:
+                        # expected checksum travels WITH the location frame;
+                        # clients that predate it ignore the extra key
+                        h["crc"] = crc
+                    yield flight.Result(pa.py_buffer(json.dumps(h).encode()))
                     yield from self._yield_blocks(buf)
                     self._bump("locations_served")
                     self._bump("bytes_served", buf.size)

@@ -387,6 +387,21 @@ class SchedulerServer:
                                 executor_id, transition,
                                 self.executors.health_snapshot().get(executor_id, {}).get("failure_rate"))
                     self.metrics.set_quarantined_executors(self.executors.quarantined_count())
+            fetch_cause = str(getattr(r, "fetch_failed_cause", "") or "")
+            if fetch_cause == "corruption" and r.fetch_failed_executor_id:
+                # blame the SERVING executor, not the fetcher: its stored
+                # bytes failed verification twice. Repeated strikes push it
+                # through the same quarantine machinery as task failures.
+                transition = self.executors.record_corruption_strike(
+                    r.fetch_failed_executor_id)
+                log.warning(
+                    "corruption strike against executor %s (reported by %s, "
+                    "%s/%s)%s", r.fetch_failed_executor_id, executor_id,
+                    r.job_id, r.fetch_failed_stage_id,
+                    f" — {transition}" if transition else "")
+                if transition is not None:
+                    self.metrics.set_quarantined_executors(
+                        self.executors.quarantined_count())
             with self._jobs_lock:
                 g = self.jobs.get(r.job_id)
             if g is None:
@@ -395,7 +410,7 @@ class SchedulerServer:
                 r.task_id, r.stage_id, r.stage_attempt, r.state, r.partitions,
                 r.locations, r.error, r.retryable, r.metrics,
                 r.fetch_failed_executor_id, r.fetch_failed_stage_id,
-                timed_out=timed_out,
+                timed_out=timed_out, fetch_failed_cause=fetch_cause,
             )
             if events:
                 # checkpoint the graph at every stage/terminal transition:

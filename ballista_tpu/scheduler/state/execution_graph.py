@@ -257,7 +257,8 @@ class ExecutionGraph:
                            metrics: list | None = None,
                            fetch_failed_executor_id: str = "",
                            fetch_failed_stage_id: int = 0,
-                           timed_out: bool = False) -> list[str]:
+                           timed_out: bool = False,
+                           fetch_failed_cause: str = "") -> list[str]:
         """Ingest one task status; returns job-level events
         ('stage_completed', 'job_finished', 'job_failed')."""
         events: list[str] = []
@@ -310,7 +311,7 @@ class ExecutionGraph:
                         p: locs for p, locs in up.completed.items()
                         if not any(l.executor_id == fetch_failed_executor_id for l in locs)
                     }
-                    self._rerun_stage_tree(fetch_failed_stage_id)
+                    self._rerun_stage_tree(fetch_failed_stage_id, cause=fetch_failed_cause)
                     if self.status is JobState.FAILED:
                         events.append("job_failed")
                     return events
@@ -633,12 +634,23 @@ class ExecutionGraph:
                 affected += 1
             return affected
 
-    def _rerun_stage_tree(self, stage_id: int) -> None:
+    def _rerun_stage_tree(self, stage_id: int, cause: str = "") -> None:
         """Rerun a successful stage; downstream stages that already consumed
-        it roll back to unresolved."""
+        it roll back to unresolved. MAX_STAGE_ATTEMPTS bounds the recompute
+        loop; when the budget dies to corruption, the job failure says so —
+        persistent checksum mismatches mean bad hardware (or a bad writer),
+        and an unbounded rerun would never converge."""
         stage = self.stages[stage_id]
         if stage.attempt + 1 > MAX_STAGE_ATTEMPTS:
-            self._fail_job(f"stage {stage_id} exceeded {MAX_STAGE_ATTEMPTS} attempts")
+            if cause == "corruption":
+                self._fail_job(
+                    f"stage {stage_id} exceeded {MAX_STAGE_ATTEMPTS} attempts: "
+                    "repeated shuffle data corruption (checksum mismatches "
+                    "survived refetch and recompute — suspect failing disks "
+                    "on the serving executors; see corruption strikes in "
+                    "/api/executors)")
+            else:
+                self._fail_job(f"stage {stage_id} exceeded {MAX_STAGE_ATTEMPTS} attempts")
             return
         stage.reset_for_retry()
         # try re-resolving immediately (inputs may still be intact)

@@ -39,6 +39,13 @@ class ExecutorSlot:
     memory_pressure: float = 0.0  # 0..1+ fraction of pool capacity reserved
     pool_overcommitted_bytes: float = 0.0
     pressure_rejections: float = 0.0
+    # -- shuffle integrity ---------------------------------------------------
+    # strikes: times a READER escalated persistent corruption of bytes THIS
+    # executor served (its disk is the suspect). Gauges below are the
+    # executor's own heartbeat-reported reader-side counters.
+    corruption_strikes: int = 0
+    checksum_failures: float = 0.0
+    corruption_retries: float = 0.0
 
     @property
     def failure_rate(self) -> float:
@@ -95,6 +102,10 @@ class ExecutorManager:
                     metrics.get("pool_overcommitted_bytes", ex.pool_overcommitted_bytes))
                 ex.pressure_rejections = float(
                     metrics.get("pressure_rejections", ex.pressure_rejections))
+                ex.checksum_failures = float(
+                    metrics.get("checksum_failures", ex.checksum_failures))
+                ex.corruption_retries = float(
+                    metrics.get("corruption_retries", ex.corruption_retries))
             return True
 
     def aggregate_pressure(self) -> float:
@@ -303,6 +314,21 @@ class ExecutorManager:
                     return "quarantined"
             return None
 
+    def record_corruption_strike(self, executor_id: str) -> str | None:
+        """A reader escalated persistent corruption of bytes this executor
+        SERVED: count the strike and fold it into the decayed health score
+        as a failure — enough strikes quarantine the executor exactly like
+        repeated task failures (its disk is suspect, not its compute, but
+        either way its outputs can't be trusted). Returns the health-state
+        transition when one happened."""
+        with self._lock:
+            e = self.executors.get(executor_id)
+            if e is None:
+                return None
+            e.corruption_strikes += 1
+            # RLock: safe to delegate the scoring under the held lock
+            return self.record_task_result(executor_id, ok=False)
+
     def probe_reservations(self, now: float | None = None) -> list[tuple[str, int]]:
         """Quarantined executors past their backoff get one probation slot
         each; the caller must bind a real task to it (or cancel_probe)."""
@@ -357,5 +383,8 @@ class ExecutorManager:
                     "memory_pressure": round(e.memory_pressure, 4),
                     "pool_overcommitted_bytes": int(e.pool_overcommitted_bytes),
                     "pressure_rejections": int(e.pressure_rejections),
+                    "corruption_strikes": e.corruption_strikes,
+                    "checksum_failures": int(e.checksum_failures),
+                    "corruption_retries": int(e.corruption_retries),
                 }
             return out

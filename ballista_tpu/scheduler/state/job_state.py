@@ -27,6 +27,39 @@ from ballista_tpu.scheduler.state.execution_graph import ExecutionGraph
 
 log = logging.getLogger(__name__)
 
+# checkpoint framing: MAGIC + 4-byte little-endian CRC32 of the payload +
+# the serialized graph proto. Files without the magic are legacy raw protos
+# (still loadable); files WITH it get verified on recover, so a torn write
+# or flipped bit is skipped with a WARN instead of adopted as truth.
+GRAPH_MAGIC = b"BGR1"
+
+
+def _frame_graph(payload: bytes) -> bytes:
+    import struct
+    import zlib
+
+    return GRAPH_MAGIC + struct.pack("<I", zlib.crc32(payload) & 0xFFFFFFFF) + payload
+
+
+def _unframe_graph(raw: bytes) -> bytes:
+    """Payload of a framed checkpoint (verifying its CRC), or the input
+    unchanged when it predates framing. Raises ValueError on a checksum
+    mismatch or truncated header."""
+    import struct
+    import zlib
+
+    if not raw.startswith(GRAPH_MAGIC):
+        return raw
+    if len(raw) < len(GRAPH_MAGIC) + 4:
+        raise ValueError("truncated graph checkpoint header")
+    (expected,) = struct.unpack_from("<I", raw, len(GRAPH_MAGIC))
+    payload = raw[len(GRAPH_MAGIC) + 4:]
+    actual = zlib.crc32(payload) & 0xFFFFFFFF
+    if actual != expected:
+        raise ValueError(
+            f"graph checkpoint CRC mismatch: {actual:08x} != {expected:08x}")
+    return payload
+
 
 class JobStateStore:
     """Trait: persist/recover job graphs and arbitrate ownership."""
@@ -75,7 +108,7 @@ class FileJobState(JobStateStore):
     def save_graph(self, graph: ExecutionGraph) -> None:
         import tempfile
 
-        data = graph.to_proto().SerializeToString()
+        data = _frame_graph(graph.to_proto().SerializeToString())
         path = self._graph_path(graph.job_id)
         # refresh the ownership lease alongside the checkpoint
         try:
@@ -112,9 +145,19 @@ class FileJobState(JobStateStore):
         path = self._graph_path(job_id)
         try:
             with open(path, "rb") as f:
-                proto = pb.ExecutionGraphProto.FromString(f.read())
+                raw = f.read()
+            # CRC check first: a torn/corrupt checkpoint that still parses
+            # as SOME proto is the dangerous case — garbage adopted as truth
+            proto = pb.ExecutionGraphProto.FromString(_unframe_graph(raw))
             return ExecutionGraph.from_proto(proto, config)
         except FileNotFoundError:
+            return None
+        except ValueError as e:
+            log.warning("skipping torn/corrupt job checkpoint %s: %s", path, e)
+            try:
+                os.replace(path, path + ".bad")
+            except OSError:
+                pass
             return None
         except Exception as e:  # noqa: BLE001 — corrupt/skewed graph must
             # never make the scheduler unbootable: quarantine and continue

@@ -163,6 +163,11 @@ def decode_task_status(p: pb.TaskStatusProto, executor_meta: ExecutorMetadata | 
         ],
         fetch_failed_executor_id=p.fetch_failed_executor_id,
         fetch_failed_stage_id=p.fetch_failed_stage_id,
+        # the cause rides the kind tag ("FetchPartitionError:corruption") —
+        # blame-aware recovery without a proto change
+        fetch_failed_cause=(
+            p.error_kind.split(":", 1)[1]
+            if p.error_kind.startswith("FetchPartitionError:") else ""),
         timed_out=p.timed_out,
     )
 

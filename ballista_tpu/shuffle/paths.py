@@ -10,7 +10,16 @@ sort layout (reference: sort_shuffle/index.rs — 2×M files instead of N×M):
         partition id; task_id makes the name attempt-unique so speculative
         duplicates never clobber each other)
     {work_dir}/{job_id}/{stage_id}/data-{map_partition}-{task_id}.idx
-        (json index: output_partition → [offset, length, rows, bytes])
+        (json index: output_partition → [offset, length, rows, bytes]
+         + an optional 5th element: the range's checksum string — readers
+         that predate it only index [0] and [1], so old and new binaries
+         interoperate in both directions)
+
+integrity sidecars: a hash-layout data file's whole-file checksum lives in
+`{data}.arrow.crc` (the sort layout stores per-range checksums inside the
+index instead). Both are optional — their absence means "unchecked", never
+an error, so files written with `ballista.shuffle.checksum.enabled=false`
+round-trip unchanged.
 """
 
 from __future__ import annotations
@@ -38,6 +47,33 @@ def sort_data_path(work_dir: str, job_id: str, stage_id: int, map_partition: int
 
 def index_path(data_path: str) -> str:
     return data_path[: -len(".arrow")] + ".idx" if data_path.endswith(".arrow") else data_path + ".idx"
+
+
+def crc_path(data_path: str) -> str:
+    """Sidecar holding a hash-layout file's whole-file checksum string."""
+    return data_path + ".crc"
+
+
+def checksum_for(path: str, layout: str, output_partition: int) -> str | None:
+    """The stored checksum of one output partition's byte range, or None
+    when it was never recorded (pre-checksum writer, knob disabled, or the
+    partition is absent). Sort layout: 5th element of the index entry;
+    hash layout: the `.crc` sidecar. Never raises — a serving path must
+    treat an unreadable checksum as 'unchecked', not as an error."""
+    try:
+        if is_sort_layout(layout):
+            import json
+
+            with open(index_path(path)) as f:
+                index = json.load(f)
+            entry = index.get(str(output_partition))
+            if entry is None or len(entry) < 5 or not isinstance(entry[4], str):
+                return None
+            return entry[4] or None
+        with open(crc_path(path)) as f:
+            return f.read().strip() or None
+    except (OSError, ValueError):
+        return None
 
 
 def is_sort_layout(layout: str) -> bool:
@@ -76,6 +112,15 @@ def open_range_buffer(path: str, layout: str, output_partition: int,
     if r is None:
         return None
     offset, length = r
+    size = os.path.getsize(path)
+    if offset + length > size:
+        # torn write / truncated disk / stale index: a short mmap slice
+        # would silently end the IPC stream early — refuse with a typed,
+        # retryable error instead (the Flight server surfaces it as
+        # unavailable; a local reader's retry ladder escalates it)
+        from ballista_tpu.errors import ShortRead
+
+        raise ShortRead(path, offset, length, size)
     if use_mmap:
         mm = pa.memory_map(path)
         mm.seek(offset)

@@ -27,16 +27,18 @@ from ballista_tpu.config import (
     IO_RETRIES,
     IO_RETRY_WAIT_MS,
     SHUFFLE_BLOCK_TRANSPORT,
+    SHUFFLE_CHECKSUM_ENABLED,
     SHUFFLE_FETCH_COALESCE,
     SHUFFLE_MMAP,
     SHUFFLE_READER_FORCE_REMOTE,
     SHUFFLE_READER_MAX_PER_ADDR,
     SHUFFLE_READER_MAX_REQUESTS,
 )
-from ballista_tpu.errors import FetchFailed
+from ballista_tpu.errors import DataCorrupted, FetchFailed
 from ballista_tpu.plan.physical import ExecutionPlan, TaskContext, _empty_batch
 from ballista_tpu.plan.schema import DFSchema
 from ballista_tpu.shuffle import paths
+from ballista_tpu.shuffle.integrity import INTEGRITY, verify_or_raise
 from ballista_tpu.shuffle.types import PartitionLocation
 
 
@@ -135,12 +137,26 @@ class UnresolvedShuffleExec(ExecutionPlan):
 # -- fetch machinery ---------------------------------------------------------
 
 
+def _note_corruption(counters: "_FetchCounters | None", retried: bool) -> None:
+    """Account one checksum failure (and, when it triggers an in-place
+    refetch, one corruption retry) in both the per-execute counters and
+    the process-wide INTEGRITY gauges the heartbeat ships."""
+    INTEGRITY.add("checksum_failures")
+    if counters:
+        counters.add("checksum_failures")
+    if retried:
+        INTEGRITY.add("corruption_retries")
+        if counters:
+            counters.add("corruption_retries")
+
+
 class _FetchCounters:
     """Per-execute data-plane accounting, mutated from fetch threads."""
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._data = {"fetch_rpcs": 0, "bytes_fetched_remote": 0, "bytes_read_local": 0}
+        self._data = {"fetch_rpcs": 0, "bytes_fetched_remote": 0, "bytes_read_local": 0,
+                      "checksum_failures": 0, "corruption_retries": 0}
 
     def add(self, key: str, n: int = 1) -> None:
         with self._lock:
@@ -376,9 +392,15 @@ def _fetch_unit_coalesced(unit: list[int], locs: list[PartitionLocation],
     remaining = list(unit)
     failed = remaining[0]
     last: BaseException | None = None
-    for attempt in range(retries + 1):
+    # locations that already burned their one free corruption refetch:
+    # a second checksum failure on the same map output is persistent
+    # (bad stored bytes), so escalate instead of spinning
+    corrupted: set[int] = set()
+    attempt = 0
+    while attempt <= retries:
         sub = list(remaining)
         token = gov.acquire(addr, sum(locs[i].stats.num_bytes for i in sub)) if gov else None
+        corrupt_retry = False
         try:
             if counters:
                 counters.add("fetch_rpcs")
@@ -395,13 +417,26 @@ def _fetch_unit_coalesced(unit: list[int], locs: list[PartitionLocation],
             except FetchStreamError as e:
                 failed = sub[min(e.loc_index, len(sub) - 1)]
                 last = e.cause
+                if isinstance(e.cause, DataCorrupted):
+                    first = failed not in corrupted
+                    _note_corruption(counters, retried=first)
+                    if not first:
+                        break  # persistent corruption: escalate now
+                    corrupted.add(failed)
+                    corrupt_retry = True
         finally:
             if gov:
                 gov.release(addr, token)
+        if corrupt_retry:
+            # retry ONCE in place, immediately and without consuming the
+            # generic IO budget — in-transit corruption heals on refetch
+            continue
         time.sleep(wait_ms * (attempt + 1) / 1000.0)
+        attempt += 1
     floc = locs[failed]
+    cause = "corruption" if isinstance(last, DataCorrupted) else ""
     err = FetchFailed(floc.executor_id, floc.job_id, floc.stage_id,
-                      floc.map_partition, str(last))
+                      floc.map_partition, str(last), cause=cause)
     for i in remaining:
         publish(i, err)
     return []
@@ -412,18 +447,37 @@ def fetch_partition(loc: PartitionLocation, ctx: TaskContext, force_remote: bool
                     counters: _FetchCounters | None = None) -> Iterator[pa.RecordBatch]:
     local = not force_remote and loc.path and os.path.exists(loc.path)
     if local:
-        served = 0
-        for b in read_local_partition(loc, use_mmap=bool(ctx.config.get(SHUFFLE_MMAP))):
-            served += b.nbytes
-            yield b
-        if counters:
-            counters.add("bytes_read_local", served)
-        return
+        verify = bool(ctx.config.get(SHUFFLE_CHECKSUM_ENABLED))
+        corrupt_seen = False
+        while True:
+            try:
+                served = 0
+                for b in read_local_partition(
+                        loc, use_mmap=bool(ctx.config.get(SHUFFLE_MMAP)), verify=verify):
+                    served += b.nbytes
+                    yield b
+                if counters:
+                    counters.add("bytes_read_local", served)
+                return
+            except DataCorrupted as e:
+                # verification happens BEFORE the first batch decodes, so a
+                # retry here cannot duplicate rows. One free re-read (a torn
+                # page-cache read can heal); a second failure means the
+                # stored bytes are bad — same escalation as a remote fetch,
+                # blaming this executor's own disk
+                first = not corrupt_seen
+                _note_corruption(counters, retried=first)
+                if not first:
+                    raise FetchFailed(loc.executor_id, loc.job_id, loc.stage_id,
+                                      loc.map_partition, str(e), cause="corruption") from e
+                corrupt_seen = True
     retries = int(ctx.config.get(IO_RETRIES))
     wait_ms = int(ctx.config.get(IO_RETRY_WAIT_MS))
     addr = loc.addr
     last: Exception | None = None
-    for attempt in range(retries + 1):
+    corrupt_seen = False
+    attempt = 0
+    while attempt <= retries:
         token = governor.acquire(addr, loc.stats.num_bytes) if governor else None
         try:
             from ballista_tpu.flight.client import fetch_partition_flight
@@ -436,9 +490,18 @@ def fetch_partition(loc: PartitionLocation, ctx: TaskContext, force_remote: bool
             # duplicate the first attempt's rows downstream (the
             # reference's fetch_partition_buffered, shuffle_reader.rs:975)
             batches = list(fetch_partition_flight(loc, ctx))
+        except DataCorrupted as e:
+            last = e
+            first = not corrupt_seen
+            _note_corruption(counters, retried=first)
+            if not first:
+                break  # persistent corruption: escalate with blame
+            corrupt_seen = True
+            continue  # retry ONCE in place — no IO-budget charge, no sleep
         except Exception as e:  # noqa: BLE001 — retried, then surfaced as FetchFailed
             last = e
             time.sleep(wait_ms * (attempt + 1) / 1000.0)
+            attempt += 1
             continue
         finally:
             if governor:
@@ -447,10 +510,28 @@ def fetch_partition(loc: PartitionLocation, ctx: TaskContext, force_remote: bool
             counters.add("bytes_fetched_remote", sum(b.nbytes for b in batches))
         yield from batches
         return
-    raise FetchFailed(loc.executor_id, loc.job_id, loc.stage_id, loc.map_partition, str(last))
+    cause = "corruption" if isinstance(last, DataCorrupted) else ""
+    raise FetchFailed(loc.executor_id, loc.job_id, loc.stage_id, loc.map_partition,
+                      str(last), cause=cause)
 
 
-def read_local_partition(loc: PartitionLocation, use_mmap: bool = True) -> Iterator[pa.RecordBatch]:
+def read_local_partition(loc: PartitionLocation, use_mmap: bool = True,
+                         verify: bool = False) -> Iterator[pa.RecordBatch]:
+    if verify:
+        expected = paths.checksum_for(loc.path, loc.layout, loc.output_partition)
+        if expected is not None:
+            # buffered (NOT mmap) read: the verified copy is byte-for-byte
+            # the copy the decoder consumes — with a live mapping the kernel
+            # could re-fault a page from a bad disk between verify and
+            # decode. Verification completes BEFORE the first yield, so the
+            # caller's retry-once cannot duplicate rows.
+            buf = paths.open_range_buffer(loc.path, loc.layout, loc.output_partition,
+                                          use_mmap=False)
+            if buf is None or buf.size == 0:
+                return
+            verify_or_raise([buf], expected, f"{loc.path}#p{loc.output_partition}")
+            yield from ipc.open_stream(pa.BufferReader(buf))
+            return
     if not use_mmap and not paths.is_sort_layout(loc.layout):
         # hash layout without mmap: stream straight off the open file
         with open(loc.path, "rb") as f:

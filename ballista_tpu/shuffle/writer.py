@@ -35,10 +35,12 @@ import pyarrow as pa
 import pyarrow.ipc as ipc
 
 from ballista_tpu.config import (
+    SHUFFLE_CHECKSUM_ENABLED,
     SHUFFLE_COMPRESSION_CODEC,
     SORT_SHUFFLE_MEMORY_LIMIT,
 )
 from ballista_tpu.errors import ExecutionError
+from ballista_tpu.shuffle.integrity import ChecksumSink
 from ballista_tpu.ops.hashing import partition_indices
 from ballista_tpu.ops.phys_expr import bind_expr, evaluate_to_array
 from ballista_tpu.plan.expressions import Expr
@@ -66,6 +68,26 @@ def _unlink_quiet(*ps: str) -> None:
             os.remove(p)
         except OSError:
             pass
+
+
+def _checksum_on(ctx: TaskContext) -> bool:
+    return bool(ctx.config.get(SHUFFLE_CHECKSUM_ENABLED))
+
+
+def _write_crc_sidecar(data_path: str, digest: str | None) -> None:
+    """Commit a hash-layout file's checksum sidecar (tmp + atomic rename,
+    same discipline as the data file it describes). A None digest (knob
+    off) writes nothing — absence means 'unchecked' to every reader."""
+    if not digest:
+        return
+    cp = paths.crc_path(data_path)
+    try:
+        with open(cp + ".tmp", "w") as f:
+            f.write(digest)
+    except BaseException:
+        _unlink_quiet(cp + ".tmp")
+        raise
+    os.replace(cp + ".tmp", cp)
 
 
 def _codec(ctx: TaskContext) -> Optional[str]:
@@ -142,9 +164,10 @@ class ShuffleWriterExec(ExecutionPlan):
             os.makedirs(os.path.dirname(path), exist_ok=True)
             try:
                 with open(path + ".tmp", "wb") as f:
+                    sink = ChecksumSink(f, enabled=_checksum_on(ctx))
                     rows = 0
                     batches = 0
-                    with ipc.new_stream(f, schema, options=_ipc_options(ctx)) as w:
+                    with ipc.new_stream(sink, schema, options=_ipc_options(ctx)) as w:
                         for b in self.input.execute(map_partition, ctx):
                             if b.num_rows:
                                 w.write_batch(b)
@@ -156,6 +179,7 @@ class ShuffleWriterExec(ExecutionPlan):
                 # not leave its .tmp around — it will never be renamed
                 _unlink_quiet(path + ".tmp")
                 raise
+            _write_crc_sidecar(path, sink.digest())
             os.replace(path + ".tmp", path)
             return self._meta([(map_partition, path, rows, batches, nbytes, "hash")])
 
@@ -277,10 +301,12 @@ class ShuffleWriterExec(ExecutionPlan):
             os.makedirs(os.path.dirname(path), exist_ok=True)
             try:
                 with open(path + ".tmp", "wb") as f:
-                    _, nbytes = write_ipc_stream(buckets[k], schema, f, ctx)
+                    sink = ChecksumSink(f, enabled=_checksum_on(ctx))
+                    _, nbytes = write_ipc_stream(buckets[k], schema, sink, ctx)
             except BaseException:
                 _unlink_quiet(path + ".tmp")
                 raise
+            _write_crc_sidecar(path, sink.digest())
             os.replace(path + ".tmp", path)
             return (k, path, rows[k], batches[k], nbytes, "hash")
 
@@ -314,23 +340,31 @@ class ShuffleWriterExec(ExecutionPlan):
         scheduler first decides which set readers ever see."""
         data_path = paths.sort_data_path(ctx.work_dir, self.job_id, self.stage_id, map_partition, task_id)
         os.makedirs(os.path.dirname(data_path), exist_ok=True)
-        index: dict[str, list[int]] = {}
+        index: dict[str, list] = {}
         out = []
         idx_path = paths.index_path(data_path)
         try:
             with open(data_path + ".tmp", "wb") as f:
+                sink = ChecksumSink(f, enabled=_checksum_on(ctx))
                 for k in range(len(buckets)):
                     if not rows[k]:
                         continue
                     start = f.tell()
                     nrows = 0
-                    with ipc.new_stream(f, schema, options=_ipc_options(ctx)) as w:
+                    # per-RANGE checksum: each bucket's byte range is the unit
+                    # readers fetch and verify, so the digest resets here
+                    sink.start_range()
+                    with ipc.new_stream(sink, schema, options=_ipc_options(ctx)) as w:
                         for b in self._iter_bucket_batches(buckets[k], spills[k]):
                             if b.num_rows:
                                 w.write_batch(b)
                                 nrows += b.num_rows
                     length = f.tell() - start
-                    index[str(k)] = [start, length, nrows, length]
+                    crc = sink.digest()
+                    entry: list = [start, length, nrows, length]
+                    if crc:
+                        entry.append(crc)
+                    index[str(k)] = entry
                     out.append((k, data_path, nrows, batches[k], length, "sort"))
             os.replace(data_path + ".tmp", data_path)
             with open(idx_path + ".tmp", "w") as f:

@@ -8,11 +8,13 @@
 //     partition as decoded record batches (hash layout: whole file; sort
 //     layout: byte range through the JSON index file).
 //   - DoAction("io_block_transport"): raw 8 MiB block streaming of the
-//     stored IPC bytes, no decode/re-encode (flight_service.rs:243).
+//     stored IPC bytes, no decode/re-encode (flight_service.rs:243). A
+//     ticket with "want_crc": true gets a JSON header result {"nbytes",
+//     "crc"?} prepended so the client can verify end to end.
 //   - DoAction("io_coalesced_transport"): several map outputs of one
 //     (executor, reduce partition) pair stream back-to-back in ONE call;
 //     each location is framed by a JSON header result {"i": idx,
-//     "nbytes": n} followed by its blocks. Locations open LAZILY inside
+//     "nbytes": n, "crc"?: "…"} followed by its blocks. Locations open LAZILY inside
 //     the stream so a lost file on location i fails after i-1 completed
 //     and the client attributes the FetchFailed to the right map output.
 //   - DoAction("remove_job_data"): GC a job's shuffle directory.
@@ -123,6 +125,17 @@ static long long JsonInt(const std::string& j, const std::string& key, long long
   return std::strtoll(j.c_str() + p, nullptr, 10);
 }
 
+static bool JsonBool(const std::string& j, const std::string& key, bool dflt) {
+  auto k = "\"" + key + "\"";
+  auto p = j.find(k);
+  if (p == std::string::npos) return dflt;
+  p = j.find(':', p + k.size());
+  if (p == std::string::npos) return dflt;
+  p++;
+  while (p < j.size() && (j[p] == ' ' || j[p] == '\t')) p++;
+  return j.compare(p, 4, "true") == 0;
+}
+
 // index file: {"<partition>": [offset, length, ...], ...}
 static bool IndexRange(const std::string& index_json, long long part,
                        long long* offset, long long* length) {
@@ -136,6 +149,23 @@ static bool IndexRange(const std::string& index_json, long long part,
   while (*end == ',' || *end == ' ') end++;
   *length = std::strtoll(end, nullptr, 10);
   return true;
+}
+
+// optional 5th index-entry element: the range's checksum string ("c32:…" /
+// "z32:…"); "" when the entry predates checksums or the knob was off
+static std::string IndexCrc(const std::string& index_json, long long part) {
+  auto key = "\"" + std::to_string(part) + "\"";
+  auto p = index_json.find(key);
+  if (p == std::string::npos) return "";
+  p = index_json.find('[', p);
+  if (p == std::string::npos) return "";
+  auto e = index_json.find(']', p);
+  if (e == std::string::npos) return "";
+  auto q = index_json.find('"', p);
+  if (q == std::string::npos || q > e) return "";
+  auto q2 = index_json.find('"', q + 1);
+  if (q2 == std::string::npos || q2 > e) return "";
+  return index_json.substr(q + 1, q2 - q - 1);
 }
 
 // twin of ballista_tpu/shuffle/paths.py::index_path — "x.arrow" → "x.idx"
@@ -165,6 +195,42 @@ static arrow::Status CheckContained(const std::string& work_dir, const std::stri
        res_s[root_s.size()] != fs::path::preferred_separator))
     return arrow::Status::Invalid("path escapes work dir: ", path);
   return arrow::Status::OK();
+}
+
+// twin of the python server's env gate: BALLISTA_SHUFFLE_CHECKSUM=0 stops
+// SHIPPING checksums (clients then skip verification); default on
+static bool ChecksumEnabled() {
+  static const bool on = [] {
+    const char* v = std::getenv("BALLISTA_SHUFFLE_CHECKSUM");
+    if (!v) return true;
+    std::string s(v);
+    for (auto& c : s) c = (char)std::tolower((unsigned char)c);
+    return !(s == "0" || s == "false" || s == "no" || s == "off");
+  }();
+  return on;
+}
+
+// twin of shuffle/paths.py::checksum_for — the stored checksum of the byte
+// range a ticket addresses ("" = unchecked: knob off, pre-checksum writer,
+// or unreadable sidecar/index; absence must never fail a fetch)
+static std::string ChecksumFor(const std::string& ticket_json, const std::string& work_dir) {
+  if (!ChecksumEnabled()) return "";
+  std::string path = JsonStr(ticket_json, "path");
+  if (!CheckContained(work_dir, path).ok()) return "";
+  std::string layout = JsonStr(ticket_json, "layout");
+  if (layout.rfind("sort", 0) == 0) {
+    std::ifstream idx(IndexPath(path));
+    if (!idx) return "";
+    std::string index_json((std::istreambuf_iterator<char>(idx)),
+                           std::istreambuf_iterator<char>());
+    return IndexCrc(index_json, JsonInt(ticket_json, "output_partition", 0));
+  }
+  std::ifstream crc(path + ".crc");
+  if (!crc) return "";
+  std::string v((std::istreambuf_iterator<char>(crc)), std::istreambuf_iterator<char>());
+  while (!v.empty() && (v.back() == '\n' || v.back() == '\r' || v.back() == ' '))
+    v.pop_back();
+  return v;
 }
 
 static bool ValidJobId(const std::string& job) {
@@ -212,6 +278,16 @@ static arrow::Result<std::shared_ptr<arrow::Buffer>> ReadRange(const std::string
     long long offset = 0, length = 0;
     if (!IndexRange(index_json, JsonInt(ticket_json, "output_partition", 0), &offset, &length))
       return arrow::Buffer::FromString("");  // partition absent = empty (contract)
+    // truncation guard: an index pointing past EOF means the data file was
+    // torn/truncated after commit — a read must not silently come up short
+    std::error_code ec;
+    auto size = fs::file_size(path, ec);
+    if (ec) return arrow::Status::IOError("cannot stat shuffle file: ", path);
+    if (offset + length > (long long)size)
+      return arrow::Status::IOError(
+          "shuffle file truncated: ", path, " has ", std::to_string((long long)size),
+          " bytes, index range needs [", std::to_string(offset), ", ",
+          std::to_string(offset + length), ")");
     return OpenSlice(path, offset, length);
   }
   std::error_code ec;
@@ -264,9 +340,11 @@ class CoalescedResultStream : public fl::ResultStream {
     if (idx_ >= locs_.size()) return nullptr;
     ARROW_ASSIGN_OR_RAISE(cur_, ReadRange(locs_[idx_], work_dir_));
     off_ = 0;
-    char hdr[64];
-    std::snprintf(hdr, sizeof(hdr), "{\"i\": %zu, \"nbytes\": %lld}", idx_,
-                  (long long)cur_->size());
+    std::string hdr = "{\"i\": " + std::to_string(idx_) +
+                      ", \"nbytes\": " + std::to_string((long long)cur_->size());
+    std::string crc = ChecksumFor(locs_[idx_], work_dir_);
+    if (!crc.empty()) hdr += ", \"crc\": \"" + crc + "\"";
+    hdr += "}";
     idx_++;
     return std::make_unique<fl::Result>(fl::Result{arrow::Buffer::FromString(hdr)});
   }
@@ -305,6 +383,15 @@ class ShuffleServer : public fl::FlightServerBase {
     if (action.type == "io_block_transport") {
       ARROW_ASSIGN_OR_RAISE(auto buf, ReadRange(body, work_dir_));
       std::vector<fl::Result> results;
+      if (JsonBool(body, "want_crc", false)) {
+        // checksum-aware clients opt in; the header travels as the first
+        // result so old clients (which never set want_crc) see no change
+        std::string hdr = "{\"nbytes\": " + std::to_string((long long)buf->size());
+        std::string crc = ChecksumFor(body, work_dir_);
+        if (!crc.empty()) hdr += ", \"crc\": \"" + crc + "\"";
+        hdr += "}";
+        results.push_back(fl::Result{arrow::Buffer::FromString(hdr)});
+      }
       for (int64_t off = 0; off < buf->size(); off += kBlockSize) {
         auto len = std::min(kBlockSize, buf->size() - off);
         results.push_back(fl::Result{arrow::SliceBuffer(buf, off, len)});

@@ -1,0 +1,497 @@
+"""End-to-end shuffle integrity: checksums, corruption detection, blame.
+
+Covers the integrity round of shuffle hardening:
+ - per-output-partition CRCs travel with both layouts (sort: 5th index
+   field; hash: `.crc` sidecar) and verify end to end over Flight;
+ - in-transit corruption (seeded chaos bit-flip at serve time) is caught
+   by the reader, refetched ONCE in place, and heals transparently;
+ - persistent corruption (bad bytes on disk) escalates as
+   FetchFailed(cause="corruption"), reruns the upstream stage tree, and
+   files a corruption strike against the SERVING executor;
+ - job-state checkpoints are CRC-framed: a torn/corrupt checkpoint is
+   skipped with a WARN on recover instead of adopted as truth;
+ - a truncated shuffle file fails serve-time with a typed error instead
+   of silently streaming short.
+"""
+
+import json
+import os
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from ballista_tpu.config import (
+    IO_RETRIES,
+    IO_RETRY_WAIT_MS,
+    SHUFFLE_CHECKSUM_ENABLED,
+    SHUFFLE_COMPRESSION_CODEC,
+    SHUFFLE_FETCH_COALESCE,
+    SHUFFLE_READER_FORCE_REMOTE,
+    BallistaConfig,
+)
+from ballista_tpu.errors import DataCorrupted, FetchFailed, ShortRead
+from ballista_tpu.plan.expressions import Column
+from ballista_tpu.plan.physical import MemoryScanExec, TaskContext
+from ballista_tpu.plan.schema import DFSchema
+from ballista_tpu.shuffle import paths as sp
+from ballista_tpu.shuffle.integrity import checksum_bytes, verify_blocks
+
+
+def _write_stage(tmp_path, rows=40_000, partitions=4, sort=True, extra_cfg=None):
+    """One map output through the real writer; returns (work_dir, locations
+    by output partition, rows, df schema)."""
+    from ballista_tpu.shuffle.writer import ShuffleWriterExec, metadata_to_locations
+
+    rng = np.random.default_rng(3)
+    batches = [pa.record_batch({
+        "k": pa.array(rng.integers(0, 1 << 20, rows)),
+        "v": pa.array(rng.integers(0, 100, rows)),
+    })]
+    schema = DFSchema.from_arrow(batches[0].schema)
+    writer = ShuffleWriterExec(
+        MemoryScanExec(schema, batches, partitions=1),
+        "ijob", 1, partitions, [Column("k")], sort_shuffle=sort)
+    cfg = BallistaConfig(extra_cfg or {})
+    ctx = TaskContext(cfg, task_id="t0", work_dir=str(tmp_path))
+    locs: dict[int, list] = {p: [] for p in range(partitions)}
+    for meta in writer.execute(0, ctx):
+        for loc in metadata_to_locations(meta, "ijob", 1, 0, "e1", "127.0.0.1", 0):
+            locs[loc.output_partition].append(loc)
+    return str(tmp_path), locs, rows, schema
+
+
+def _reader_ctx(extra=None):
+    cfg = BallistaConfig({SHUFFLE_READER_FORCE_REMOTE: True, **(extra or {})})
+    return cfg, TaskContext(cfg, task_id="t", work_dir="")
+
+
+def _read_remote(schema, locs_by_p, port, extra=None):
+    from ballista_tpu.shuffle.reader import ShuffleReaderExec
+    from ballista_tpu.shuffle.types import PartitionLocation
+
+    _, ctx = _reader_ctx(extra)
+    plocs = [[PartitionLocation(**{**l.__dict__, "flight_port": port})
+              for l in locs_by_p[p]] for p in sorted(locs_by_p)]
+    reader = ShuffleReaderExec(schema, plocs)
+    rows = [sum(b.num_rows for b in reader.execute(p, ctx)) for p in range(len(plocs))]
+    return rows, reader
+
+
+# -- checksum round-trip, both layouts, compressed + uncompressed -------------
+
+
+@pytest.mark.parametrize("codec", ["none", "lz4"])
+def test_sort_layout_index_carries_range_checksums(tmp_path, codec):
+    """Every non-empty sort-layout index entry gains a 5th checksum field
+    matching the exact bytes of its range; remote fetch verifies clean."""
+    from ballista_tpu.flight.server import start_flight_server
+
+    work, locs, rows, schema = _write_stage(
+        tmp_path, sort=True, extra_cfg={SHUFFLE_COMPRESSION_CODEC: codec})
+    path = locs[0][0].path
+    with open(sp.index_path(path)) as f:
+        index = json.load(f)
+    assert index, "expected non-empty sort index"
+    with open(path, "rb") as f:
+        blob = f.read()
+    for entry in index.values():
+        assert len(entry) >= 5 and isinstance(entry[4], str), entry
+        start, length = entry[0], entry[1]
+        assert checksum_bytes(blob[start:start + length]) == entry[4]
+    for p, ls in locs.items():
+        for l in ls:
+            assert sp.checksum_for(l.path, l.layout, p) is not None
+    server, port = start_flight_server(work, "127.0.0.1", 0)
+    try:
+        got, _ = _read_remote(schema, locs, port)
+        assert sum(got) == rows
+    finally:
+        server.shutdown()
+
+
+@pytest.mark.parametrize("codec", ["none", "zstd"])
+def test_hash_layout_writes_crc_sidecar(tmp_path, codec):
+    from ballista_tpu.flight.server import start_flight_server
+
+    work, locs, rows, schema = _write_stage(
+        tmp_path, sort=False, extra_cfg={SHUFFLE_COMPRESSION_CODEC: codec})
+    for p, ls in locs.items():
+        for l in ls:
+            assert os.path.exists(sp.crc_path(l.path)), l.path
+            with open(l.path, "rb") as f:
+                blob = f.read()
+            expected = sp.checksum_for(l.path, l.layout, p)
+            assert expected == checksum_bytes(blob)
+            assert verify_blocks([blob], expected)
+    server, port = start_flight_server(work, "127.0.0.1", 0)
+    try:
+        got, _ = _read_remote(schema, locs, port)
+        assert sum(got) == rows
+    finally:
+        server.shutdown()
+
+
+def test_checksum_disabled_writes_legacy_format(tmp_path):
+    """Knob off: no sidecars, 4-field index entries, reads work unchanged."""
+    from ballista_tpu.flight.server import start_flight_server
+
+    work, locs, rows, schema = _write_stage(
+        tmp_path, sort=True, extra_cfg={SHUFFLE_CHECKSUM_ENABLED: False})
+    path = locs[0][0].path
+    assert not os.path.exists(sp.crc_path(path))
+    with open(sp.index_path(path)) as f:
+        for entry in json.load(f).values():
+            assert len(entry) == 4, entry
+    for p, ls in locs.items():
+        for l in ls:
+            assert sp.checksum_for(l.path, l.layout, p) is None
+    server, port = start_flight_server(work, "127.0.0.1", 0)
+    try:
+        got, _ = _read_remote(
+            schema, locs, port, {SHUFFLE_CHECKSUM_ENABLED: False})
+        assert sum(got) == rows
+    finally:
+        server.shutdown()
+
+
+# -- in-transit corruption: detect, retry once in place, heal -----------------
+
+
+def _chaos_server(monkeypatch, work, p="1.0", once="1", seed="7"):
+    from ballista_tpu.flight.server import start_flight_server
+
+    monkeypatch.setenv("BALLISTA_CHAOS_CORRUPT_P", p)
+    monkeypatch.setenv("BALLISTA_CHAOS_CORRUPT_ONCE", once)
+    monkeypatch.setenv("BALLISTA_CHAOS_SEED", seed)
+    return start_flight_server(work, "127.0.0.1", 0)
+
+
+def test_transient_corruption_block_path_retries_once_and_heals(tmp_path, monkeypatch):
+    """Chaos corrupt-once flips a bit in the FIRST serve of the partition;
+    the client catches the mismatch, refetches once in place (no generic
+    retry budget burned), and the second serve decodes byte-correct."""
+    work, locs, rows, schema = _write_stage(tmp_path, sort=True, partitions=1)
+    server, port = _chaos_server(monkeypatch, work)
+    try:
+        got, reader = _read_remote(
+            schema, locs, port, {SHUFFLE_FETCH_COALESCE: False, IO_RETRIES: 0})
+        assert sum(got) == rows
+        assert reader.metrics.extra["checksum_failures"] == 1
+        assert reader.metrics.extra["corruption_retries"] == 1
+        assert server.stats["chaos_corruptions"] == 1
+        assert server.stats["checksum_failures"] == 0  # client-side catch
+    finally:
+        server.shutdown()
+
+
+def test_transient_corruption_coalesced_path_retries_tail(tmp_path, monkeypatch):
+    work, locs, rows, schema = _write_stage(tmp_path, sort=True, partitions=1)
+    locs = {0: locs[0] * 3}  # several locations on one executor → coalesced
+    server, port = _chaos_server(monkeypatch, work)
+    try:
+        got, reader = _read_remote(schema, locs, port, {IO_RETRIES: 0})
+        assert sum(got) == rows * 3
+        assert reader.metrics.extra["checksum_failures"] >= 1
+        assert reader.metrics.extra["corruption_retries"] >= 1
+        assert server.stats["chaos_corruptions"] >= 1
+    finally:
+        server.shutdown()
+
+
+def test_chaos_corrupt_roll_and_flip_are_deterministic():
+    from ballista_tpu.executor.chaos import corrupt_roll, flip_bit
+
+    assert corrupt_roll(7, "a|0", 1.0) is True
+    assert corrupt_roll(7, "a|0", 0.0) is False
+    assert corrupt_roll(7, "a|0", 0.5) == corrupt_roll(7, "a|0", 0.5)
+    data = bytes(range(64))
+    flipped = flip_bit(data, 7, "a|0")
+    assert flipped == flip_bit(data, 7, "a|0")  # same seed+key → same flip
+    assert flipped != data
+    diff = [i for i in range(64) if flipped[i] != data[i]]
+    assert len(diff) == 1
+    assert bin(flipped[diff[0]] ^ data[diff[0]]).count("1") == 1
+    assert flip_bit(b"", 7, "x") == b""
+    assert flip_bit(data, 8, "a|0") != flipped or True  # different seed allowed to differ
+
+
+def test_header_sniff_never_misfires_on_arrow_bytes(tmp_path):
+    """The block-path JSON header is sniffed from the first Result; Arrow
+    IPC bytes (which never start with '{') must not parse as a header."""
+    import pyarrow.ipc as ipc
+
+    from ballista_tpu.flight.client import _try_parse_header
+
+    batch = pa.record_batch({"x": pa.array([1, 2, 3])})
+    sink = pa.BufferOutputStream()
+    with ipc.new_stream(sink, batch.schema) as w:
+        w.write_batch(batch)
+    assert _try_parse_header(sink.getvalue()) is None
+    assert _try_parse_header(pa.py_buffer(b"")) is None
+    hdr = _try_parse_header(pa.py_buffer(b'{"nbytes": 10, "crc": "c32:aa"}'))
+    assert hdr == {"nbytes": 10, "crc": "c32:aa"}
+
+
+# -- persistent corruption: escalate with blame -------------------------------
+
+
+def _corrupt_on_disk(path: str, offset: int = -1):
+    with open(path, "r+b") as f:
+        f.seek(0, os.SEEK_END)
+        size = f.tell()
+        pos = size // 2 if offset < 0 else offset
+        f.seek(pos)
+        b = f.read(1)
+        f.seek(pos)
+        f.write(bytes([b[0] ^ 0x40]))
+
+
+def test_persistent_corruption_remote_escalates_fetchfailed(tmp_path):
+    from ballista_tpu.flight.server import start_flight_server
+
+    work, locs, rows, schema = _write_stage(tmp_path, sort=False, partitions=1)
+    _corrupt_on_disk(locs[0][0].path)
+    server, port = start_flight_server(work, "127.0.0.1", 0)
+    try:
+        with pytest.raises(FetchFailed) as ei:
+            _read_remote(schema, locs, port,
+                         {SHUFFLE_FETCH_COALESCE: False, IO_RETRIES: 0,
+                          IO_RETRY_WAIT_MS: 1})
+        assert ei.value.cause == "corruption"
+        assert ei.value.executor_id == "e1"
+        assert "[corruption]" in str(ei.value)
+    finally:
+        server.shutdown()
+
+
+def test_persistent_corruption_local_read_escalates(tmp_path):
+    from ballista_tpu.shuffle.reader import ShuffleReaderExec
+
+    _, locs, rows, schema = _write_stage(tmp_path, sort=True, partitions=2)
+    target = locs[0][0]
+    start, length = sp.range_for(target.path, target.layout, 0)
+    _corrupt_on_disk(target.path, offset=start + length // 2)
+    cfg = BallistaConfig({IO_RETRIES: 0})
+    ctx = TaskContext(cfg, task_id="t", work_dir="")
+    reader = ShuffleReaderExec(schema, [locs[0], locs[1]])
+    with pytest.raises(FetchFailed) as ei:
+        list(reader.execute(0, ctx))
+    assert ei.value.cause == "corruption"
+    # the sibling partition's range is untouched and still reads clean
+    assert sum(b.num_rows for b in reader.execute(1, ctx)) > 0
+
+
+def test_corruption_cause_round_trips_control_plane_wire():
+    from ballista_tpu.errors import error_to_proto_kind
+    from ballista_tpu.executor.executor import TaskResult
+    from ballista_tpu.scheduler.state.executor_manager import ExecutorMetadata
+    from ballista_tpu.serde_control import decode_task_status, encode_task_status
+
+    err = FetchFailed("e9", "j", 3, 1, "bad bytes", cause="corruption")
+    kind = error_to_proto_kind(err)
+    assert kind == "FetchPartitionError:corruption"
+    assert error_to_proto_kind(DataCorrupted("x#p0", "c32:aa", "c32:bb")) == "DataCorrupted"
+
+    r = TaskResult(
+        task_id=1, job_id="j", stage_id=4, stage_attempt=0, partitions=[0],
+        state="failed", error="fetch failed", error_kind=kind, retryable=True,
+        fetch_failed_executor_id="e9", fetch_failed_stage_id=3,
+        fetch_failed_cause="corruption")
+    meta = ExecutorMetadata(id="e1", host="h", grpc_port=1, flight_port=2)
+    back = decode_task_status(encode_task_status(r, "e1"), meta)
+    assert back.fetch_failed_cause == "corruption"
+    assert back.fetch_failed_executor_id == "e9"
+
+
+def test_graph_repeated_corruption_fails_job_with_blame(tpch_ctx):
+    """Corruption-caused reruns are bounded by MAX_STAGE_ATTEMPTS; the final
+    job failure names corruption (suspect disks), not a generic retry cap."""
+    from .test_distributed import _fake_success, _tiny_graph
+
+    g = _tiny_graph(tpch_ctx)
+    final = max(g.stages)
+    upstream = g.stages[final].spec.input_stage_ids[0]
+    events = []
+    guard = 0
+    while g.status.value == "running" and guard < 200:
+        guard += 1
+        t = g.pop_next_task("e1")
+        if t is None:
+            break
+        if t.stage_id == final:
+            events = g.update_task_status(
+                t.task_id, t.stage_id, t.stage_attempt, "failed", t.partitions,
+                [], "checksum mismatch", retryable=True,
+                fetch_failed_executor_id="e1", fetch_failed_stage_id=upstream,
+                fetch_failed_cause="corruption")
+            if "job_failed" in events:
+                break
+        else:
+            _fake_success(g, t)
+    assert g.status.value == "failed"
+    assert "corruption" in g.error
+    assert g.stages[upstream].attempt >= 1  # upstream actually reran
+
+
+def test_corruption_strike_feeds_executor_health():
+    from ballista_tpu.scheduler.state.executor_manager import (
+        ExecutorManager,
+        ExecutorMetadata,
+    )
+
+    em = ExecutorManager()
+    em.register(ExecutorMetadata(id="ex1", host="h", grpc_port=1, flight_port=2))
+    em.record_corruption_strike("ex1")
+    slot = em.get("ex1")
+    assert slot.corruption_strikes == 1
+    assert slot.failure_rate > 0  # strike counts as a failed task outcome
+    assert em.record_corruption_strike("missing") is None  # unknown id: no-op
+    # heartbeat-shipped reader gauges surface in the health snapshot
+    em.heartbeat("ex1", {"checksum_failures": 3.0, "corruption_retries": 2.0})
+    snap = em.health_snapshot()["ex1"]
+    assert snap["corruption_strikes"] == 1
+    assert snap["checksum_failures"] == 3
+    assert snap["corruption_retries"] == 2
+
+
+# -- serve-time truncation guard ----------------------------------------------
+
+
+def test_truncated_shuffle_file_raises_typed_short_read(tmp_path):
+    from ballista_tpu.flight.server import start_flight_server
+
+    work, locs, rows, schema = _write_stage(tmp_path, sort=True, partitions=2)
+    path = locs[0][0].path
+    os.truncate(path, os.path.getsize(path) - 16)
+    with open(sp.index_path(path)) as f:
+        index = json.load(f)
+    last_p = int(max(index, key=lambda k: index[k][0]))
+    with pytest.raises(ShortRead) as ei:
+        sp.open_range_buffer(path, "sort", last_p)
+    assert ei.value.size < ei.value.offset + ei.value.length
+    server, port = start_flight_server(work, "127.0.0.1", 0)
+    try:
+        with pytest.raises(FetchFailed):
+            _read_remote(schema, {0: locs[last_p]}, port,
+                         {SHUFFLE_FETCH_COALESCE: False, IO_RETRIES: 0,
+                          IO_RETRY_WAIT_MS: 1})
+        assert server.stats["short_reads"] >= 1
+    finally:
+        server.shutdown()
+
+
+# -- native C++ server parity -------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def native_flight_work(tmp_path_factory):
+    from ballista_tpu.executor.executor_process import start_native_flight_server
+
+    work = str(tmp_path_factory.mktemp("native-integrity"))
+    started = start_native_flight_server(work, "127.0.0.1", 0)
+    if started is None:
+        pytest.skip("native flight server unavailable")
+    proc, port = started
+    yield work, port
+    proc.terminate()
+    proc.wait(timeout=5)
+
+
+def test_native_server_ships_checksums_and_guards_truncation(native_flight_work):
+    """The C++ data plane must ship the same checksum headers as the python
+    server (block want_crc opt-in + coalesced "crc" key), reject truncated
+    ranges, and pass the python reader's verification end to end."""
+    import pyarrow.flight as flight
+
+    work, port = native_flight_work
+    _, locs, rows, schema = _write_stage(work, sort=True, partitions=2)
+    target = locs[0][0]
+    client = flight.FlightClient(f"grpc://127.0.0.1:{port}")
+    expected = sp.checksum_for(target.path, target.layout, 0)
+    assert expected is not None
+
+    # block path: want_crc prepends a {"nbytes", "crc"} header result
+    ticket = {"path": target.path, "layout": target.layout,
+              "output_partition": 0, "want_crc": True}
+    results = list(client.do_action(flight.Action(
+        "io_block_transport", json.dumps(ticket).encode())))
+    hdr = json.loads(results[0].body.to_pybytes())
+    assert hdr["crc"] == expected
+    body = b"".join(r.body.to_pybytes() for r in results[1:])
+    assert hdr["nbytes"] == len(body)
+    assert checksum_bytes(body) == expected
+    # without the opt-in, the stream is bare blocks (legacy clients)
+    del ticket["want_crc"]
+    results = list(client.do_action(flight.Action(
+        "io_block_transport", json.dumps(ticket).encode())))
+    assert not results[0].body.to_pybytes().startswith(b"{")
+
+    # coalesced header carries the crc
+    results = list(client.do_action(flight.Action(
+        "io_coalesced_transport",
+        json.dumps({"locations": [{"path": target.path, "layout": target.layout,
+                                   "output_partition": 0}]}).encode())))
+    hdr = json.loads(results[0].body.to_pybytes())
+    assert hdr["i"] == 0 and hdr["crc"] == expected
+
+    # the python reader verifies against the native server's headers
+    got, reader = _read_remote(schema, locs, port)
+    assert sum(got) == rows
+    assert reader.metrics.extra["checksum_failures"] == 0
+
+    # truncation guard: an index range past EOF is a typed serve error
+    os.truncate(target.path, os.path.getsize(target.path) - 8)
+    with open(sp.index_path(target.path)) as f:
+        index = json.load(f)
+    last_p = int(max(index, key=lambda k: index[k][0]))
+    with pytest.raises(flight.FlightError, match="truncated"):
+        list(client.do_action(flight.Action(
+            "io_block_transport",
+            json.dumps({"path": target.path, "layout": target.layout,
+                        "output_partition": last_p}).encode())))
+
+
+# -- checksummed job-state checkpoints ----------------------------------------
+
+
+def test_graph_checkpoint_framing_roundtrip_and_tamper():
+    from ballista_tpu.scheduler.state.job_state import (
+        GRAPH_MAGIC,
+        _frame_graph,
+        _unframe_graph,
+    )
+
+    payload = b"\x08\x01\x12\x04jobx" * 9
+    framed = _frame_graph(payload)
+    assert framed.startswith(GRAPH_MAGIC)
+    assert _unframe_graph(framed) == payload
+    assert _unframe_graph(payload) == payload  # legacy: no magic → pass-through
+    bad = bytearray(framed)
+    bad[-1] ^= 0x01
+    with pytest.raises(ValueError, match="CRC mismatch"):
+        _unframe_graph(bytes(bad))
+    with pytest.raises(ValueError, match="truncated"):
+        _unframe_graph(GRAPH_MAGIC + b"\x00")
+
+
+def test_corrupt_checkpoint_skipped_on_recover(tmp_path, tpch_ctx, caplog):
+    import logging
+
+    from ballista_tpu.scheduler.state.job_state import FileJobState
+
+    from .test_distributed import _tiny_graph
+
+    g = _tiny_graph(tpch_ctx)
+    store = FileJobState(str(tmp_path))
+    store.save_graph(g)
+    loaded = store.load_graph(g.job_id)
+    assert loaded is not None and loaded.job_id == g.job_id
+    # flip a payload bit: the CRC check must reject the whole checkpoint
+    path = os.path.join(str(tmp_path), f"{g.job_id}.graph")
+    _corrupt_on_disk(path, offset=os.path.getsize(path) - 3)
+    with caplog.at_level(logging.WARNING):
+        assert store.load_graph(g.job_id) is None
+    assert any("torn/corrupt" in r.message for r in caplog.records)
+    assert os.path.exists(path + ".bad")  # quarantined, not re-adopted
+    assert store.load_graph(g.job_id) is None  # gone from the store
