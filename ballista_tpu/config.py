@@ -107,6 +107,11 @@ TPU_MIN_ROWS = "ballista.tpu.min.rows"
 TPU_BROADCAST_JOIN_ROWS = "ballista.tpu.broadcast.join.threshold.rows"
 TPU_COLLECTIVE_EXCHANGE = "ballista.tpu.collective.exchange"
 TPU_PALLAS = "ballista.tpu.pallas.enabled"
+# cold-path pipeline (fill/compile overlap + persistent XLA compile cache)
+TPU_FILL_THREADS = "ballista.tpu.fill.threads"
+TPU_FILL_CHUNK_ROWS = "ballista.tpu.fill.chunk_rows"
+TPU_COMPILE_OVERLAP = "ballista.tpu.compile.overlap"
+TPU_COMPILE_CACHE_DIR = "ballista.tpu.compile.cache_dir"
 
 
 @dataclass(frozen=True)
@@ -182,6 +187,12 @@ def _env_float(name: str, default: float) -> float:
         return float(raw)
     except ValueError:
         return default
+
+
+def _env_str(name: str, default: str) -> str:
+    import os
+
+    return os.environ.get(name, default)
 
 
 def _pos(v: Any) -> bool:
@@ -486,6 +497,46 @@ _ENTRIES: list[ConfigEntry] = [
         "Use ICI collectives (shard_map all_to_all) instead of file shuffle for "
         "co-scheduled intra-slice stages.",
         bool, False,
+    ),
+    ConfigEntry(
+        TPU_FILL_THREADS,
+        "Host threads encoding scan columns during the device fill. 0 = auto "
+        "(pipelined: column k+1 encodes while column k uploads, bounded "
+        "in-flight host stacks); 1 = strict serial encode→upload, one column "
+        "at a time (the pre-pipeline behavior). Env escape hatch: "
+        "BALLISTA_TPU_FILL_THREADS.",
+        int, _env_int("BALLISTA_TPU_FILL_THREADS", 0), _nonneg,
+    ),
+    ConfigEntry(
+        TPU_FILL_CHUNK_ROWS,
+        "Split each column's [P, N] device upload into row chunks of this "
+        "many rows along N (double-buffered device_put: the host releases "
+        "each chunk as soon as it is issued and XLA overlaps the copies). "
+        "0 = one transfer per column. Ignored under a collective-exchange "
+        "mesh (sharded puts stay whole). Env escape hatch: "
+        "BALLISTA_TPU_FILL_CHUNK_ROWS.",
+        int, _env_int("BALLISTA_TPU_FILL_CHUNK_ROWS", 0), _nonneg,
+    ),
+    ConfigEntry(
+        TPU_COMPILE_OVERLAP,
+        "Overlap XLA compilation and join build-side preparation with the "
+        "device table fill: the compile key (shapes, dtypes, dict sizes) is "
+        "known once every column is encoded, so tracing starts on a "
+        "background thread while uploads are still streaming, and build "
+        "sides collect concurrently with the probe-side fill. RUN_STATS "
+        "reports the hidden seconds as compile_overlap_s. Env escape "
+        "hatch: BALLISTA_TPU_COMPILE_OVERLAP=0.",
+        bool, _env_bool("BALLISTA_TPU_COMPILE_OVERLAP", True),
+    ),
+    ConfigEntry(
+        TPU_COMPILE_CACHE_DIR,
+        "Directory for JAX's persistent (on-disk) XLA compilation cache. "
+        "When set, compiled stage programs survive process restarts: a "
+        "re-admitted or redeployed executor fetches its XLA binaries from "
+        "disk instead of recompiling (RUN_STATS xla_compile_s ~ 0 on warm "
+        "starts). Empty = disabled. Env default: BALLISTA_TPU_COMPILE_CACHE "
+        "(also honored by bare runtime users with no session config).",
+        str, _env_str("BALLISTA_TPU_COMPILE_CACHE", ""),
     ),
 ]
 

@@ -289,7 +289,7 @@ class ExecutorProcess:
         from ballista_tpu.shuffle.integrity import INTEGRITY
 
         integrity = INTEGRITY.snapshot()
-        return [
+        metrics = [
             ("memory_pressure", pools.aggregate_pressure() if pools else 0.0),
             ("pool_overcommitted_bytes", float(pools.total_overcommitted()) if pools else 0.0),
             ("pressure_rejections", float(self.executor.pressure_rejections)),
@@ -298,6 +298,32 @@ class ExecutorProcess:
             ("checksum_failures", float(integrity["checksum_failures"])),
             ("corruption_retries", float(integrity["corruption_retries"])),
         ]
+        metrics.extend(self._tpu_metrics())
+        return metrics
+
+    @staticmethod
+    def _tpu_metrics() -> list[tuple[str, float]]:
+        """TPU cold-path gauges from the engine's merged RUN_STATS plus the
+        persistent compile cache's hit counters. Guarded on sys.modules so a
+        CPU-engine executor never pulls in jax just to heartbeat."""
+        import sys
+
+        sc = sys.modules.get("ballista_tpu.ops.tpu.stage_compiler")
+        if sc is None:
+            return []
+        out = []
+        stats = sc.RUN_STATS.snapshot()
+        for key in ("fill_s", "encode_s", "upload_s", "compile_s",
+                    "compile_overlap_s", "exec_s", "device_bytes"):
+            if key in stats:
+                out.append((f"tpu_{key}", float(stats[key])))
+        from ballista_tpu.ops.tpu import runtime
+
+        cc = runtime.compile_cache_stats()
+        if cc["dir"]:
+            out.append(("tpu_persist_cache_requests", float(cc["requests"])))
+            out.append(("tpu_persist_cache_hits", float(cc["hits"])))
+        return out
 
     def _heartbeat_loop(self) -> None:
         while not self._stopping.wait(HEARTBEAT_INTERVAL_S):

@@ -154,6 +154,33 @@ def encode_column(arr: pa.Array) -> Optional[DeviceCol]:
     return None
 
 
+def encode_stacked(arr: pa.Array, part_rows: list[int], n_padded: int) -> Optional[DeviceCol]:
+    """Encode one whole-scan column and lay it out as a [P, N] partition
+    stack (row `off:off+part_rows[p]` of the flat encoding → `stack[p, :r]`,
+    zero-padded). The single code path shared by the serial and pipelined
+    device fills, so both are byte-identical by construction. The flat
+    encoding is dropped before returning: peak host memory per column is
+    one flat copy + one stack, not both for the table's lifetime."""
+    dc = encode_column(arr)
+    if dc is None:
+        return None
+    P = len(part_rows)
+    stack = np.zeros((P, n_padded), dtype=dc.data.dtype)
+    off = 0
+    for p, r in enumerate(part_rows):
+        stack[p, :r] = dc.data[off : off + r]
+        off += r
+    vstack = None
+    if dc.valid is not None:
+        vstack = np.zeros((P, n_padded), dtype=bool)
+        off = 0
+        for p, r in enumerate(part_rows):
+            vstack[p, :r] = dc.valid[off : off + r]
+            off += r
+    return DeviceCol(dc.kind, stack, dictionary=dc.dictionary, scale=dc.scale,
+                     valid=vstack)
+
+
 def encode_table(tbl: pa.Table, buckets: list[int]) -> Optional[DeviceBatch]:
     n = tbl.num_rows
     padded = next_bucket(max(n, 1), buckets)

@@ -38,8 +38,9 @@ from typing import Iterator
 import numpy as np
 import pyarrow as pa
 
-from ballista_tpu.config import TPU_MAX_DEVICE_BYTES, TPU_MIN_ROWS, BallistaConfig
+from ballista_tpu.config import TPU_MAX_DEVICE_BYTES, TPU_MIN_ROWS, BallistaConfig, _env_int
 from ballista_tpu.ops.tpu.columnar import encode_column, next_bucket
+from ballista_tpu.ops.tpu.stage_compiler import LruDict
 from ballista_tpu.ops.tpu.kernels import DevVal, Lowering, Unsupported, lower_expr, true_mask
 from ballista_tpu.ops.tpu.runtime import ensure_jax
 from ballista_tpu.plan.expressions import Alias, Column, SortKey
@@ -58,7 +59,10 @@ from ballista_tpu.plan.physical import (
 
 MAX_CAPACITY = 1 << 22
 
-_FINAL_COMPILE_CACHE: dict = {}
+# bounded: long-lived executors see one entry per (stage fingerprint, shape)
+# and would otherwise grow without limit (stage_compiler's LruDict is
+# import-safe here: stage_compiler only imports this module lazily)
+_FINAL_COMPILE_CACHE = LruDict(_env_int("BALLISTA_TPU_FINAL_CACHE_ENTRIES", 64))
 _FINAL_COMPILE_LOCK = threading.Lock()
 
 

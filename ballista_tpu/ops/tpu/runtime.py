@@ -13,6 +13,17 @@ import threading
 _lock = threading.Lock()
 _ready = False
 
+# -------------------------------------------------- persistent compile cache
+# JAX's on-disk compilation cache: compiled XLA programs keyed by (HLO,
+# compile options, backend) survive process restarts, so a re-admitted or
+# redeployed executor skips recompiles entirely. Hits/misses are observed
+# through jax's monitoring events (the cache itself never surfaces them).
+_cc_lock = threading.Lock()
+_cc_dir: str | None = None
+_cc_listener_on = False
+_cc_env_checked = False
+_cc_counts = {"requests": 0, "hits": 0}
+
 
 def ensure_jax():
     global _ready
@@ -31,7 +42,91 @@ def ensure_jax():
             jax.config.update("jax_platforms", plat)
         jax.config.update("jax_enable_x64", True)
         _ready = True
-        return jax
+    # env-only activation path: daemons (or bare runtime users) that never
+    # consult a session config still get the persistent cache via the env
+    # var; session configs re-call init_compile_cache with their own value.
+    # One-shot (init_compile_cache re-enters ensure_jax).
+    global _cc_env_checked
+    with _cc_lock:
+        check_env = not _cc_env_checked
+        _cc_env_checked = True
+    if check_env:
+        env_dir = os.environ.get("BALLISTA_TPU_COMPILE_CACHE")
+        if env_dir:
+            init_compile_cache(env_dir)
+    import jax
+
+    return jax
+
+
+def _cc_on_event(event: str, **kwargs) -> None:
+    # recorded by jax._src.compiler around every backend_compile: one
+    # *_use_cache request per compilation attempt, one cache_hits when the
+    # persistent entry was found (misses = requests - hits)
+    if event == "/jax/compilation_cache/compile_requests_use_cache":
+        with _cc_lock:
+            _cc_counts["requests"] += 1
+    elif event == "/jax/compilation_cache/cache_hits":
+        with _cc_lock:
+            _cc_counts["hits"] += 1
+
+
+def init_compile_cache(cache_dir: str | None) -> str | None:
+    """Enable the persistent XLA compilation cache under `cache_dir`.
+    Idempotent; returns the active directory (None = disabled). Thresholds
+    are zeroed so even sub-second stage compiles persist — a query engine's
+    compile population is small and every warm-start second counts."""
+    global _cc_dir, _cc_listener_on
+    if not cache_dir:
+        return _cc_dir
+    with _cc_lock:
+        if _cc_dir == cache_dir:
+            return _cc_dir
+    jax = ensure_jax()
+    os.makedirs(cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    try:
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    except Exception:  # noqa: BLE001 — knob name drifts across jax versions
+        pass
+    try:
+        # jax latches cache initialization on the FIRST backend compile: a
+        # compile that ran before the dir was configured leaves the cache
+        # permanently off for the process. Reset so the new dir takes.
+        from jax._src import compilation_cache as _jcc
+
+        _jcc.reset_cache()
+    except Exception:  # noqa: BLE001 — private module; best effort
+        pass
+    with _cc_lock:
+        _cc_dir = cache_dir
+        if not _cc_listener_on:
+            try:
+                from jax._src import monitoring
+
+                monitoring.register_event_listener(_cc_on_event)
+                _cc_listener_on = True
+            except Exception:  # noqa: BLE001 — stats only, cache still works
+                pass
+    return cache_dir
+
+
+def compile_cache_dir() -> str | None:
+    """Active persistent-cache directory, or None when disabled."""
+    with _cc_lock:
+        return _cc_dir
+
+
+def compile_cache_stats() -> dict:
+    """Snapshot of persistent-cache effectiveness for this process."""
+    with _cc_lock:
+        return {
+            "dir": _cc_dir,
+            "requests": _cc_counts["requests"],
+            "hits": _cc_counts["hits"],
+            "misses": _cc_counts["requests"] - _cc_counts["hits"],
+        }
 
 
 def device_kind() -> str:

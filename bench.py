@@ -42,8 +42,11 @@ grants). Design consequences:
     full-scale timed phase, the leg runs SF1 instead, so *some* hot-path
     device datum lands. A device OOM at full scale also retries at SF1.
   * Roofline evidence: each device iteration event nests the engine's
-    RUN_STATS under "stats" (fill_s, device_bytes, compile_s, exec_s) so
-    achieved HBM GB/s is computable from the artifact alone.
+    RUN_STATS under "stats" (fill_s and its encode_s/upload_s split,
+    device_bytes, trace_s/xla_compile_s/compile_s, compile_overlap_s,
+    exec_s, persist_cache_hits/misses) so achieved HBM GB/s — and how much
+    of the cold path was hidden by the fill/compile overlap — is computable
+    from the artifact alone.
 
 Failure policy: a dead accelerator pool must NOT look like parity. If the
 device leg cannot produce a time, the JSON carries value=0,
@@ -92,7 +95,7 @@ def best_time(engine: str, data_dir: str, sql: str, warmups: int, iters: int,
         try:
             from ballista_tpu.ops.tpu import stage_compiler
 
-            return dict(stage_compiler.RUN_STATS)
+            return stage_compiler.RUN_STATS.snapshot()
         except Exception:  # noqa: BLE001 — diagnostics only
             return {}
 
