@@ -107,6 +107,12 @@ TPU_MIN_ROWS = "ballista.tpu.min.rows"
 TPU_BROADCAST_JOIN_ROWS = "ballista.tpu.broadcast.join.threshold.rows"
 TPU_COLLECTIVE_EXCHANGE = "ballista.tpu.collective.exchange"
 TPU_PALLAS = "ballista.tpu.pallas.enabled"
+# whole-stage fusion (stage_compiler fusion planner + cost model)
+TPU_FUSION_ENABLED = "ballista.tpu.fusion.enabled"
+TPU_FUSION_MODE = "ballista.tpu.fusion.mode"
+TPU_FUSION_MIN_ROWS = "ballista.tpu.fusion.min.rows"
+TPU_FUSION_PALLAS_MAX_GROUPS = "ballista.tpu.fusion.pallas.max.groups"
+TPU_FUSION_PALLAS_MAX_PROBE = "ballista.tpu.fusion.pallas.max.probe.rows"
 # cold-path pipeline (fill/compile overlap + persistent XLA compile cache)
 TPU_FILL_THREADS = "ballista.tpu.fill.threads"
 TPU_FILL_CHUNK_ROWS = "ballista.tpu.fill.chunk_rows"
@@ -488,9 +494,54 @@ _ENTRIES: list[ConfigEntry] = [
     ConfigEntry(TPU_BROADCAST_JOIN_ROWS, "With engine=tpu: max build-side rows to collect a join build instead of co-partitioning. Device joins probe an HBM-resident sorted build table, so the collect budget is orders of magnitude past the CPU broadcast threshold; a partitioned join hides the chain from the stage compiler entirely.", int, 16_000_000, _nonneg),
     ConfigEntry(
         TPU_PALLAS,
-        "Use the fused Pallas masked-group-reduction kernel for float "
-        "aggregates (f32 sums / i32 counts; exact int64 money stays on XLA).",
+        "Legacy switch predating ballista.tpu.fusion.mode: when true the "
+        "fusion cost model requests fused_pallas for every eligible stage "
+        "(f32 sums / i32 counts; exact int64 money stays on XLA). Prefer "
+        "ballista.tpu.fusion.mode=fused_pallas.",
         bool, False,
+    ),
+    ConfigEntry(
+        TPU_FUSION_ENABLED,
+        "Whole-stage fusion in the TPU stage compiler. On, the fusion "
+        "planner groups a stage's operator chain into fusible spans "
+        "(predicates, projections, join probe+gather, aggregation) and the "
+        "cost model picks fused-Pallas / fused-XLA / staged per stage "
+        "(RUN_STATS fusion_mode records the choice). Off, every stage "
+        "compiles in staged mode when eligible (per-span sub-kernels with "
+        "HBM intermediates), else fused-XLA.",
+        bool, True,
+    ),
+    ConfigEntry(
+        TPU_FUSION_MODE,
+        "Fusion mode override: auto (cost model decides), staged, "
+        "fused_xla, or fused_pallas. Forced modes are still clamped to "
+        "what the stage supports (the fallback ladder is fused_pallas → "
+        "fused_xla → staged-ineligible → fused_xla; RUN_STATS fusion_mode "
+        "reports the mode that actually ran).",
+        str, "auto", lambda v: v in ("auto", "staged", "fused_xla", "fused_pallas"),
+    ),
+    ConfigEntry(
+        TPU_FUSION_MIN_ROWS,
+        "Cost model: below this many total stage input rows the planner "
+        "prefers the staged path when the stage is staged-eligible "
+        "(per-span dispatch overhead is noise at small sizes and the "
+        "span timings feed the roofline taps).",
+        int, 4096, _nonneg,
+    ),
+    ConfigEntry(
+        TPU_FUSION_PALLAS_MAX_GROUPS,
+        "Cost model / compiler: max group-domain cardinality routed to the "
+        "Pallas hash-aggregate kernel (multi-tile one-hot accumulation). "
+        "Hard kernel ceiling is 4096 lanes; larger domains use the "
+        "fused-XLA sorted segmented reduction.",
+        int, 4096, _pos,
+    ),
+    ConfigEntry(
+        TPU_FUSION_PALLAS_MAX_PROBE,
+        "Cost model / compiler: max direct-mode build table entries routed "
+        "to the Pallas hash-probe kernel (the key→row table must fit "
+        "VMEM-resident per block). Larger tables probe via the XLA gather.",
+        int, 1 << 18, _pos,
     ),
     ConfigEntry(
         TPU_COLLECTIVE_EXCHANGE,

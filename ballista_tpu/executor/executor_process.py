@@ -314,9 +314,15 @@ class ExecutorProcess:
         out = []
         stats = sc.RUN_STATS.snapshot()
         for key in ("fill_s", "encode_s", "upload_s", "compile_s",
-                    "compile_overlap_s", "exec_s", "device_bytes"):
+                    "compile_overlap_s", "exec_s", "device_bytes",
+                    "fused_spans", "fused_kernel_s"):
             if key in stats:
                 out.append((f"tpu_{key}", float(stats[key])))
+        if "fusion_mode" in stats:
+            # gauges are floats: staged=0, fused_xla=1, fused_pallas=2
+            code = {"staged": 0.0, "fused_xla": 1.0, "fused_pallas": 2.0}
+            out.append(("tpu_fusion_mode",
+                        code.get(str(stats["fusion_mode"]), -1.0)))
         from ballista_tpu.ops.tpu import runtime
 
         cc = runtime.compile_cache_stats()

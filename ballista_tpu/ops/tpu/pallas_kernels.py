@@ -1,25 +1,40 @@
-"""Pallas TPU kernels for the hot aggregation op.
+"""Pallas TPU kernel family for the fused stage-execution hot path.
 
-`masked_group_reduce`: the fused form of the unrolled aggregation path in
-stage_compiler.py — one pass over each [P, N] value lane computing ALL G
-per-group masked sums and counts from VMEM tiles, instead of materializing
-G masked copies for XLA to reduce. Grid = (partition, row-block); output
-blocks are revisited across row-blocks and accumulated in place (the
-standard Pallas reduction pattern, pallas_guide.md).
+Two kernels back `fusion_mode=fused_pallas` in stage_compiler.py:
+
+- `masked_group_reduce`: per-(partition, group) masked (sum, count) over
+  [P, N] value lanes. The per-group reduction is VECTORIZED inside the
+  kernel as a one-hot matmul — each row block builds a [block_n, 128]
+  one-hot membership tile (group id == lane, AND the stage mask) and a
+  single `jnp.dot` yields all 128 group sums at once on the MXU, instead
+  of the old O(G) static Python unroll that emitted two VPU reductions
+  per group. Group domains beyond one 128-lane tile run on a multi-tile
+  grid axis (G up to MAX_GROUPS), so compile time and kernel size no
+  longer grow linearly with the group count.
+- `hash_probe`: tiled direct-mode join probe. The build side's dense
+  key→row int32 table stays VMEM-resident per block while probe-key
+  blocks stream through; the gather and the downstream predicate mask
+  (in-range AND probe-valid AND row-present) fuse into one kernel so the
+  match mask never round-trips through HBM.
+
+Grid = (partition, [group tile,] row block); reduction outputs are
+revisited across row blocks and accumulated in place (the standard
+Pallas reduction pattern, pallas_guide.md).
 
 Scope follows TPU arithmetic reality: f32 sums + i32 counts (the VPU's
 native widths). The exact int64-cents money path stays on the XLA
-reduction; this kernel serves float aggregates and the lossy
-`ballista.tpu.allow.f32.money` mode. Gated by
-`ballista.tpu.pallas.enabled`; on CPU backends the kernel runs in
-interpreter mode so tests cover the exact same code path.
+reduction. Mode selection lives in ops/tpu/fusion.py (cost model); on
+CPU backends both kernels run in interpreter mode so tier-1 tests cover
+the exact same code path.
 """
 
 from __future__ import annotations
 
 import functools
 
-GROUP_LANES = 128  # output tile width (one VPU lane row); G must fit
+GROUP_LANES = 128  # output tile width (one VPU lane row)
+MAX_GROUP_TILES = 32
+MAX_GROUPS = GROUP_LANES * MAX_GROUP_TILES  # multi-tile grid ceiling
 
 
 def _on_cpu() -> bool:
@@ -33,50 +48,55 @@ def _on_cpu() -> bool:
 
 
 @functools.lru_cache(maxsize=32)
-def _build(P: int, N: int, block_n: int, G: int, interpret: bool):
+def _build_group_reduce(P: int, N: int, block_n: int, G: int, interpret: bool):
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
 
+    n_tiles = -(-G // GROUP_LANES)
+
     def kernel(vals_ref, gid_ref, mask_ref, sums_ref, cnts_ref):
-        j = pl.program_id(1)
+        gt = pl.program_id(1)
+        j = pl.program_id(2)
 
         @pl.when(j == 0)
         def _init():
             sums_ref[...] = jnp.zeros_like(sums_ref)
             cnts_ref[...] = jnp.zeros_like(cnts_ref)
 
-        v = vals_ref[0, :]
+        v = vals_ref[...]  # [1, block_n]
         g = gid_ref[0, :]
         m = mask_ref[0, :] != 0
-        # static unroll over groups: each iteration is one VPU masked
-        # reduction; XLA-in-pallas fuses the compares with the sums
-        sums = jnp.stack(
-            [jnp.sum(jnp.where(m & (g == gg), v, 0.0)) for gg in range(G)]
+        # one-hot membership tile for this kernel's 128 group lanes:
+        # [block_n, GROUP_LANES], mask folded in — ONE matmul then computes
+        # every lane's masked sum (MXU), no per-group unroll
+        lanes = gt * GROUP_LANES + jax.lax.broadcasted_iota(
+            jnp.int32, (1, GROUP_LANES), 1
         )
-        cnts = jnp.stack(
-            [jnp.sum((m & (g == gg)).astype(jnp.int32)) for gg in range(G)]
-        )
-        pad = GROUP_LANES - G
-        sums_ref[0, :] += jnp.pad(sums, (0, pad))
-        cnts_ref[0, :] += jnp.pad(cnts, (0, pad))
+        oh = ((g[:, None] == lanes) & m[:, None]).astype(jnp.float32)
+        sums_ref[...] += jnp.dot(v, oh, preferred_element_type=jnp.float32)
+        ones = jnp.ones((1, block_n), jnp.float32)
+        # block_n ≤ 2048 < 2^24: per-block f32 counts are exact
+        cnts_ref[...] += jnp.dot(
+            ones, oh, preferred_element_type=jnp.float32
+        ).astype(jnp.int32)
 
-    grid = (P, N // block_n)
+    grid = (P, n_tiles, N // block_n)
     fn = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, block_n), lambda i, j: (i, j)),
-            pl.BlockSpec((1, block_n), lambda i, j: (i, j)),
-            pl.BlockSpec((1, block_n), lambda i, j: (i, j)),
+            pl.BlockSpec((1, block_n), lambda i, gt, j: (i, j)),
+            pl.BlockSpec((1, block_n), lambda i, gt, j: (i, j)),
+            pl.BlockSpec((1, block_n), lambda i, gt, j: (i, j)),
         ],
         out_specs=(
-            pl.BlockSpec((1, GROUP_LANES), lambda i, j: (i, 0)),
-            pl.BlockSpec((1, GROUP_LANES), lambda i, j: (i, 0)),
+            pl.BlockSpec((1, GROUP_LANES), lambda i, gt, j: (i, gt)),
+            pl.BlockSpec((1, GROUP_LANES), lambda i, gt, j: (i, gt)),
         ),
         out_shape=(
-            jax.ShapeDtypeStruct((P, GROUP_LANES), jnp.float32),
-            jax.ShapeDtypeStruct((P, GROUP_LANES), jnp.int32),
+            jax.ShapeDtypeStruct((P, n_tiles * GROUP_LANES), jnp.float32),
+            jax.ShapeDtypeStruct((P, n_tiles * GROUP_LANES), jnp.int32),
         ),
         interpret=interpret,
     )
@@ -91,14 +111,75 @@ def masked_group_reduce(vals, gid, mask, num_groups: int, block_n: int = 2048):
     """
     import jax.numpy as jnp
 
-    if num_groups > GROUP_LANES:
-        raise ValueError(f"num_groups {num_groups} > {GROUP_LANES}")
+    if num_groups > MAX_GROUPS:
+        raise ValueError(f"num_groups {num_groups} > {MAX_GROUPS}")
     P, N = vals.shape
     bn = min(block_n, N)
     while N % bn:
         bn //= 2
-    fn = _build(P, N, bn, num_groups, interpret=_on_cpu())
+    fn = _build_group_reduce(P, N, bn, num_groups, interpret=_on_cpu())
     sums, cnts = fn(
         vals.astype(jnp.float32), gid.astype(jnp.int32), mask.astype(jnp.int32)
     )
     return sums[:, :num_groups], cnts[:, :num_groups]
+
+
+@functools.lru_cache(maxsize=32)
+def _build_hash_probe(P: int, N: int, block_n: int, T: int, interpret: bool):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    def kernel(keys_ref, mask_ref, table_ref, row_ref, match_ref):
+        k = keys_ref[0, :]
+        m = mask_ref[0, :] != 0
+        table = table_ref[...]  # full [T] lookup table, VMEM-resident
+        rows = table[k]
+        matched = m & (rows >= 0)
+        # fused downstream predicate mask: unmatched probes clamp to row 0
+        # (the gather index contract of the XLA finder, bit-for-bit)
+        row_ref[0, :] = jnp.where(matched, rows, 0)
+        match_ref[0, :] = matched.astype(jnp.int8)
+
+    grid = (P, N // block_n)
+    fn = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_n), lambda i, j: (i, j)),
+            pl.BlockSpec((1, block_n), lambda i, j: (i, j)),
+            pl.BlockSpec((T,), lambda i, j: (0,)),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, block_n), lambda i, j: (i, j)),
+            pl.BlockSpec((1, block_n), lambda i, j: (i, j)),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((P, N), jnp.int32),
+            jax.ShapeDtypeStruct((P, N), jnp.int8),
+        ),
+        interpret=interpret,
+    )
+    return jax.jit(fn)
+
+
+def hash_probe(keys, table, mask, block_n: int = 2048):
+    """Direct-mode join probe: rows = table[keys], fused with the probe
+    predicate mask.
+
+    keys: i32 [P, N], pre-clamped into [0, T); table: i32 [T] (key → build
+    row, -1 absent); mask: bool [P, N] (in-range AND probe-key-valid).
+    Returns (rows i32 [P, N] — 0 where unmatched, matching the XLA
+    finder's clamped gather index — and matched bool [P, N]).
+    """
+    import jax.numpy as jnp
+
+    P, N = keys.shape
+    bn = min(block_n, N)
+    while N % bn:
+        bn //= 2
+    fn = _build_hash_probe(P, N, bn, int(table.shape[0]), interpret=_on_cpu())
+    rows, matched = fn(
+        keys.astype(jnp.int32), mask.astype(jnp.int32), table.astype(jnp.int32)
+    )
+    return rows, matched != 0

@@ -44,6 +44,8 @@ from ballista_tpu.config import (
     TPU_COMPILE_OVERLAP,
     TPU_FILL_CHUNK_ROWS,
     TPU_FILL_THREADS,
+    TPU_FUSION_PALLAS_MAX_GROUPS,
+    TPU_FUSION_PALLAS_MAX_PROBE,
     TPU_MAX_DEVICE_BYTES,
     TPU_MIN_ROWS,
     BallistaConfig,
@@ -172,7 +174,12 @@ class RunStats(Mapping):
     trace+lower), xla_compile_s (backend compile / persistent-cache fetch),
     compile_s (trace_s + xla_compile_s, the legacy total), compile_overlap_s
     (compile seconds hidden under the fill), exec_s (dispatch + fetch +
-    decode), persist_cache_hits/misses (per-run deltas)."""
+    decode), persist_cache_hits/misses (per-run deltas), fusion_mode
+    (staged | fused_xla | fused_pallas — the mode that actually ran),
+    fusion_reason (the cost model's stated rationale), fused_spans
+    (operator spans compiled into the single kernel; 0 in staged mode),
+    fused_kernel_s (device seconds of the fused dispatch, or the sum of
+    per-span times in staged mode; span_s carries the per-span split)."""
 
     _MAX_STAGES = 32
 
@@ -922,7 +929,8 @@ class TpuStageExec(ExecutionPlan):
         with RUN_STATS.run(tag) as rec:
             return self._tpu_run_all_inner(ctx, rec)
 
-    def _compile_key(self, dt: DeviceTable, builds: list[BuildTable]) -> tuple:
+    def _compile_key(self, dt: DeviceTable, builds: list[BuildTable],
+                     mode_req: str = "fused_xla") -> tuple:
         """The compile-cache key. Derivable from a spec DeviceTable (the
         encode metadata alone), which is what makes compile/fill overlap
         possible: tracing starts before the uploads finish."""
@@ -933,16 +941,51 @@ class TpuStageExec(ExecutionPlan):
             tuple(str(c.dtype) for c in dt.cols),
             tuple(v is not None for v in dt.valids),
             tuple(_pow2(len(d)) if d else 0 for d in dt.dicts),
-            tuple(b.shape_key() for b in builds), emit_key,
+            tuple(b.shape_key() for b in builds), emit_key, mode_req,
         )
 
+    def _fusion_decision(self, dt: DeviceTable, builds: list[BuildTable]):
+        """Run the fusion cost model over compile-time stage facts. Pure
+        host logic over encode metadata, so the overlap worker and the main
+        thread compute the SAME decision from a spec table and the real
+        table respectively (same kinds/dicts/part_rows/builds/config)."""
+        from ballista_tpu.ops.tpu import fusion
+
+        est = fusion.estimate_stage(self.scan, self.ops, self.partial_agg, dt, builds)
+        cm = fusion.CostModel.from_config(self.config)
+        try:
+            cm.platform = ensure_jax().devices()[0].platform
+        except Exception:  # noqa: BLE001
+            cm.platform = "cpu"
+        dec = cm.choose(est)
+        if dec.mode == "fused_pallas" and _stage_mesh(self.config) is not None:
+            # pallas kernels are single-device (no shard_map wrapping yet)
+            dec = fusion.FusionDecision(
+                "fused_xla", dec.reason + "; clamped: collective-exchange mesh")
+        return dec, est
+
+    def _compile_with_fallback(self, dt: DeviceTable, builds: list[BuildTable],
+                               rec: dict | None, mode_req: str):
+        """The fallback ladder's top rung: a fused_pallas request whose
+        stage turns out kernel-ineligible at trace time (f64-only sums over
+        money columns, validity planes, G past the lane budget) raises
+        Unsupported — retry once as fused_xla instead of knocking the whole
+        stage off the device."""
+        try:
+            return self._compile_locked(dt, builds, rec, mode_req)
+        except Unsupported:
+            if mode_req != "fused_pallas":
+                raise
+            log.info("fused_pallas ineligible at trace time; retrying fused_xla")
+            return self._compile_locked(dt, builds, rec, "fused_xla")
+
     def _compile_locked(self, dt: DeviceTable, builds: list[BuildTable],
-                        rec: dict | None):
+                        rec: dict | None, mode_req: str = "fused_xla"):
         """Look up or create the compiled entry. `dt` may be a spec table
         (ShapeDtypeStruct columns): _compile only consults shapes, dtypes,
         kinds and dictionaries. Returns (entry, fresh, lowered) — `lowered`
         (the jax Lowered, pre-backend-compile) only for fresh entries."""
-        key = self._compile_key(dt, builds)
+        key = self._compile_key(dt, builds, mode_req)
         P, N = dt.shape
         kinds = list(zip(dt.kinds, dt.scales))
         with _COMPILE_LOCK:
@@ -950,7 +993,8 @@ class TpuStageExec(ExecutionPlan):
             if cached is not None:
                 return cached, False, None
             t0 = time.time()
-            fn, lowering, meta, lowered = self._compile(dt, kinds, dt.dicts, P, N, builds)
+            fn, lowering, meta, lowered = self._compile(
+                dt, kinds, dt.dicts, P, N, builds, mode_req=mode_req)
             RUN_STATS.set("trace_s", round(time.time() - t0, 3), rec=rec)
             # the dispatched flag lives with the entry: the FIRST call of a
             # jitted fn runs the backend compile, so the first dispatcher
@@ -1017,7 +1061,9 @@ class TpuStageExec(ExecutionPlan):
                     bts = [f.result() for f in build_futs]
                     t0 = time.time()
                     with device_scope(ctx.device_ordinal):
-                        entry, fresh, lowered = self._compile_locked(sdt, bts, rec)
+                        dec, _ = self._fusion_decision(sdt, bts)
+                        entry, fresh, lowered = self._compile_with_fallback(
+                            sdt, bts, rec, dec.mode)
                         if fresh and lowered is not None and mesh is None \
                                 and runtime.compile_cache_dir():
                             # AOT-compile here: backend_compile writes the
@@ -1067,9 +1113,13 @@ class TpuStageExec(ExecutionPlan):
             builds = [self._prepare_build(op, jidx, ctx, table_key, mesh)
                       for jidx, op in enumerate(join_ops)]
 
+        dec, _est = self._fusion_decision(dt, builds)
+        rec["fusion_reason"] = dec.reason
         if cached is None:
-            cached, _, _ = self._compile_locked(dt, builds, rec)
+            cached, _, _ = self._compile_with_fallback(dt, builds, rec, dec.mode)
         fn, lowering, meta, state = cached
+        rec["fusion_mode"] = meta.get("fusion_mode", "fused_xla")
+        rec["fused_spans"] = meta.get("fused_spans", 0)
         dicts = dt.dicts
         P, N = dt.shape
 
@@ -1087,9 +1137,20 @@ class TpuStageExec(ExecutionPlan):
         build_args = [b.flat_arrays() for b in builds]
         first_dispatch = not state["dispatched"]
         state["dispatched"] = True
+        span_s: dict[str, float] = {}
         t0 = time.time()
-        outs = fn(dt.flat_cols(), luts, dt.mask, build_args)
+        if meta.get("exec") == "staged":
+            outs = fn(dt.flat_cols(), luts, dt.mask, build_args, span_s)
+        else:
+            outs = fn(dt.flat_cols(), luts, dt.mask, build_args)
+            jax.block_until_ready(list(outs))
         t_call = time.time() - t0
+        # device seconds of the stage kernel(s): the fused dispatch (synced)
+        # or the per-span sum. The cold call folds the backend compile in;
+        # xla_compile_s below carries the honest attribution
+        rec["fused_kernel_s"] = round(sum(span_s.values()) or t_call, 6)
+        if span_s:
+            rec["span_s"] = {k: round(v, 6) for k, v in span_s.items()}
         if first_dispatch:
             # jit compiles (or fetches from the persistent cache) inside the
             # first call; when the overlap worker already AOT-compiled, the
@@ -1117,14 +1178,26 @@ class TpuStageExec(ExecutionPlan):
     # ------------------------------------------------------------------
 
     def _compile(self, dt: DeviceTable, kinds, dicts, P: int, N: int,
-                 builds: list[BuildTable] | None = None):
+                 builds: list[BuildTable] | None = None,
+                 mode_req: str = "fused_xla"):
         from ballista_tpu.plan.physical import HashJoinExec
+        from ballista_tpu.ops.tpu import fusion as _fusion
+        from ballista_tpu.ops.tpu.pallas_kernels import MAX_GROUPS as _PALLAS_MAX_G
 
         jax = ensure_jax()
         jnp = jax.numpy
         agg = self.partial_agg
         scan_schema = self.scan.df_schema
         builds = builds or []
+        spans = _fusion.plan_spans(
+            len(getattr(self.scan, "filters", []) or []), self.ops, agg)
+        span_meta = [(s.kind, s.ops) for s in spans]
+        # the pallas kernels are single-device (no shard_map wrapping yet):
+        # under a collective-exchange mesh the XLA path handles sharding
+        use_pallas = mode_req == "fused_pallas" and _stage_mesh(self.config) is None
+        pallas_g_cap = min(int(self.config.get(TPU_FUSION_PALLAS_MAX_GROUPS)),
+                           _PALLAS_MAX_G)
+        pallas_probe_max = int(self.config.get(TPU_FUSION_PALLAS_MAX_PROBE))
 
         ctx = Lowering(scan_schema, kinds, dicts)
         valid_idx = dt.valid_flat_idx()
@@ -1183,7 +1256,13 @@ class TpuStageExec(ExecutionPlan):
                 off = n_flat_cols + sum(len(builds[i].flat_arrays()) for i in range(jidx))
                 pay_off = off + (2 if bt.cnt is not None else 1)
                 probe_fns = [lower_expr(r, ctx) for (_, r) in op.on]
-                finder = _mk_join_finder(off, probe_fns, bt, lane_cells[jidx])
+                probe_pallas = (
+                    use_pallas and bt.mode == "direct" and bt.cnt is None
+                    and bt.dup == 1
+                    and int(bt.keys.shape[0]) <= pallas_probe_max
+                )
+                finder = _mk_join_finder(off, probe_fns, bt, lane_cells[jidx],
+                                         pallas=probe_pallas)
                 pv_idx = bt.pay_valid_flat_idx()
                 if op.join_type in ("right_semi", "right_anti"):
                     neg = op.join_type == "right_anti"
@@ -1357,9 +1436,23 @@ class TpuStageExec(ExecutionPlan):
             G * n_lanes > 64 or G * n_lanes * P > MAX_SEGMENTS * 16
         ):
             # the unrolled form materializes G masked reductions PER
-            # expansion lane; beyond this budget the sorted form wins
-            # (and scatter-free unrolling stops scaling)
-            unrolled = False
+            # expansion lane; beyond this budget the sorted form wins (and
+            # scatter-free unrolling stops scaling) — UNLESS the Pallas
+            # hash-aggregate was requested and the stage fits the kernel
+            # family: its one-hot matmul accumulation carries all G lanes
+            # without per-group unrolling, so the 64-group budget lifts to
+            # the kernel ceiling. If the value lanes turn out ineligible at
+            # trace time (money int64 sums, validity planes), raw() raises
+            # Unsupported and the fallback ladder retries as fused_xla,
+            # landing here again with use_pallas off → sorted path.
+            pallas_agg_ok = (
+                use_pallas and n_lanes == 1 and mult_weight_fn is None
+                and G <= pallas_g_cap and G * P <= 1 << 22
+                and all(d.func in ("sum", "count", "count_all")
+                        for d in agg.aggs)
+            )
+            if not pallas_agg_ok:
+                unrolled = False
 
         agg_fns = []
         agg_modes = []  # "row" | "build_cnt" (count of a mult-join build col)
@@ -1398,22 +1491,130 @@ class TpuStageExec(ExecutionPlan):
                         slot = gmeta[3]
                 key_slots.append(slot)
                 key_premeta.append(gmeta)
-            return self._compile_sorted(
+            fn_s, ctx_s, meta_s, lowered_s = self._compile_sorted(
                 dt, ctx, P, N, builds, group_fns, agg_fns, key_slots, key_premeta,
                 agg_modes=agg_modes, mult=mult,
             )
+            meta_s["fusion_mode"] = "fused_xla"
+            meta_s["fused_spans"] = len(spans)
+            meta_s["spans"] = span_meta
+            return fn_s, ctx_s, meta_s, lowered_s
 
         meta_holder: dict = {}
         aggs = agg.aggs
 
         lane_sets = ctx.lane_sets
         lane_cells = ctx.lane_cells
-        from ballista_tpu.config import TPU_PALLAS
-        from ballista_tpu.ops.tpu.pallas_kernels import GROUP_LANES
 
-        # the pallas kernel is single-device (no shard_map wrapping yet):
-        # under a collective-exchange mesh the XLA path handles sharding
-        use_pallas = bool(self.config.get(TPU_PALLAS)) and _stage_mesh(self.config) is None
+        # --- span closures, shared by the fused and staged executions -----
+        # Fused mode composes these into ONE traced function; staged mode
+        # jits each span separately with HBM intermediates between them.
+        # Either way the SAME jnp expressions run over the same inputs,
+        # which is what makes fused-vs-staged outputs byte-identical.
+
+        def eval_pred(cols, luts, mask):
+            """predicate span: scan filters, FilterExec predicates, semi/
+            anti membership masks, join match masks — one fused [P, N]
+            boolean."""
+            m = mask
+            for ff in filter_fns:
+                m = m & true_mask(ff(cols, luts))
+            return m
+
+        def eval_proj(cols, luts):
+            """project/probe span: group-id composition and agg value lanes
+            (join-probe gathers ride inside the lowered column closures)."""
+            if group_fns:
+                gid = None
+                for gf, psz in zip(group_fns, pad_sizes):
+                    codes = gf(cols, luts).arr.astype(jnp.int32)
+                    gid = codes if gid is None else gid * psz + codes
+            else:
+                gid = None
+            vs = [af(cols, luts) if af is not None else None for af in agg_fns]
+            return gid, vs
+
+        def aggregate_lane(m, gid, vs, w, m_eff):
+            """aggregate span, one expansion lane: per-group masked
+            reductions (the XLA form — pure VPU, no scatter)."""
+            gmasks = [m & (gid == g) for g in range(G)] if gid is not None else [m]
+            outs_lane = []
+            out_meta = []
+            nullcnt_lane = []
+            nullcnt_map: dict[int, int] = {}
+            for ai, (d, v) in enumerate(zip(aggs, vs)):
+                if v is None:
+                    out_meta.append(("i64", 0))
+                else:
+                    out_meta.append(("i64", 0) if d.func == "count" else (v.kind, v.scale))
+                cols_out = []
+                for gm in gmasks:
+                    if agg_modes[ai] == "build_cnt":
+                        cols_out.append(
+                            jnp.where(gm, w, 0).astype(jnp.int64).sum(axis=1))
+                    elif m_eff is None:
+                        cols_out.append(_masked_reduce(jnp, v, gm, d.func))
+                    else:
+                        cols_out.append(_masked_reduce_w(jnp, v, gm, d.func, m_eff))
+                outs_lane.append(jnp.stack(cols_out, axis=1))  # [P, G]
+                if (v is not None and v.valid is not None
+                        and d.func in ("sum", "min", "max",
+                                       "welford_mean", "welford_m2")):
+                    # valid-count companion: a group whose inputs are all
+                    # NULL must decode to NULL, not 0 / ±inf
+                    nullcnt_map[ai] = len(nullcnt_lane)
+                    nullcnt_lane.append(jnp.stack(
+                        [(gm & v.valid).sum(axis=1) for gm in gmasks], axis=1
+                    ))
+            presence_lane = jnp.stack([gm.sum(axis=1) for gm in gmasks], axis=1)
+            meta_holder["out"] = out_meta
+            meta_holder["nullcnt_map"] = nullcnt_map
+            return outs_lane, nullcnt_lane, presence_lane
+
+        def pallas_lane(m, gid, vs):
+            """aggregate span, Pallas form: the multi-tile one-hot hash
+            aggregate computes ALL G masked sums + counts in one VMEM pass
+            per float value lane (exact int64 money stays on the XLA
+            reductions in aggregate_lane)."""
+            from ballista_tpu.ops.tpu.pallas_kernels import masked_group_reduce
+
+            # sums first: every sum's kernel call also yields the counts,
+            # so count aggs never need a dedicated pass
+            sum_results: dict[int, object] = {}
+            counts = None
+            for i_, (d, v) in enumerate(zip(aggs, vs)):
+                if d.func == "sum":
+                    arr = jnp.broadcast_to(v.arr, m.shape)
+                    s, c = masked_group_reduce(arr, gid, m, G)
+                    sum_results[i_] = s
+                    counts = c if counts is None else counts
+            if counts is None:  # count-only aggregation
+                _, counts = masked_group_reduce(
+                    jnp.zeros(m.shape, jnp.float32), gid, m, G
+                )
+            outs_lane = []
+            out_meta = []
+            for i_, d in enumerate(aggs):
+                if d.func in ("count", "count_all"):
+                    outs_lane.append(counts.astype(jnp.int64))
+                    out_meta.append(("i64", 0))
+                else:
+                    outs_lane.append(sum_results[i_].astype(jnp.float64))
+                    out_meta.append(("f64", 0))
+            meta_holder["out"] = out_meta
+            meta_holder["nullcnt_map"] = {}
+            meta_holder["pallas_used"] = True
+            return outs_lane, counts
+
+        staged_ok = (
+            mode_req == "staged" and len(lane_sets) == 1
+            and mult_weight_fn is None
+        )
+        if staged_ok:
+            return self._compile_staged(
+                dt, ctx, dicts, builds, eval_pred, eval_proj, aggregate_lane,
+                meta_holder, span_meta, group_src_slots, pad_sizes, G,
+            )
 
         def raw(cols, luts, mask, build_args):
             # keep [P, N]: partitions are the leading axis, reductions run
@@ -1430,27 +1631,15 @@ class TpuStageExec(ExecutionPlan):
             for lane in lane_sets:
                 for cell, d_ in zip(lane_cells, lane):
                     cell["d"] = d_
-                m = mask
-                for ff in filter_fns:
-                    m = m & true_mask(ff(cols, luts))
-                if group_fns:
-                    gid = None
-                    for gf, psz in zip(group_fns, pad_sizes):
-                        codes = gf(cols, luts).arr.astype(jnp.int32)
-                        gid = codes if gid is None else gid * psz + codes
-                else:
-                    gid = None
-                vs = [af(cols, luts) if af is not None else None for af in agg_fns]
+                m = eval_pred(cols, luts, mask)
+                gid, vs = eval_proj(cols, luts)
                 w = m_eff = None
                 if mult_weight_fn is not None:
                     w = jnp.broadcast_to(mult_weight_fn(cols, luts), mask.shape)
                     m_eff = jnp.maximum(w, 1) if mult_outer else w
-                # fused Pallas path: one VMEM pass per float value lane
-                # computing ALL G masked sums + counts (exact int64 money
-                # stays on the XLA reductions below)
                 pallas_ok = (
-                    use_pallas and gid is not None and aggs and G <= GROUP_LANES
-                    and mult_weight_fn is None
+                    use_pallas and gid is not None and aggs
+                    and G <= pallas_g_cap and mult_weight_fn is None
                     and all(v is None or v.valid is None for v in vs)
                     and all(
                         d.func in ("count", "count_all")
@@ -1459,71 +1648,22 @@ class TpuStageExec(ExecutionPlan):
                     )
                 )
                 if pallas_ok:
-                    from ballista_tpu.ops.tpu.pallas_kernels import masked_group_reduce
-
-                    # sums first: every sum's kernel call also yields the
-                    # counts, so count aggs never need a dedicated pass
-                    sum_results: dict[int, object] = {}
-                    counts = None
-                    for i_, (d, v) in enumerate(zip(aggs, vs)):
-                        if d.func == "sum":
-                            arr = jnp.broadcast_to(v.arr, mask.shape)
-                            s, c = masked_group_reduce(arr, gid, m, G)
-                            sum_results[i_] = s
-                            counts = c if counts is None else counts
-                    if counts is None:  # count-only aggregation
-                        _, counts = masked_group_reduce(
-                            jnp.zeros(mask.shape, jnp.float32), gid, m, G
-                        )
-                    outs_lane = []
-                    out_meta = []
-                    for i_, d in enumerate(aggs):
-                        if d.func in ("count", "count_all"):
-                            outs_lane.append(counts.astype(jnp.int64))
-                            out_meta.append(("i64", 0))
-                        else:
-                            outs_lane.append(sum_results[i_].astype(jnp.float64))
-                            out_meta.append(("f64", 0))
-                    presence_lane = counts
-                    meta_holder["out"] = out_meta
-                    if outs is None:
-                        outs, presence = outs_lane, presence_lane
-                    else:
-                        outs = [p_ + c_ for p_, c_ in zip(outs, outs_lane)]
-                        presence = presence + presence_lane
-                    continue
-                gmasks = [m & (gid == g) for g in range(G)] if gid is not None else [m]
-                outs_lane = []
-                out_meta = []
-                nullcnt_lane = []
-                nullcnt_map: dict[int, int] = {}
-                for ai, (d, v) in enumerate(zip(aggs, vs)):
-                    if v is None:
-                        out_meta.append(("i64", 0))
-                    else:
-                        out_meta.append(("i64", 0) if d.func == "count" else (v.kind, v.scale))
-                    cols_out = []
-                    for gm in gmasks:
-                        if agg_modes[ai] == "build_cnt":
-                            cols_out.append(
-                                jnp.where(gm, w, 0).astype(jnp.int64).sum(axis=1))
-                        elif m_eff is None:
-                            cols_out.append(_masked_reduce(jnp, v, gm, d.func))
-                        else:
-                            cols_out.append(_masked_reduce_w(jnp, v, gm, d.func, m_eff))
-                    outs_lane.append(jnp.stack(cols_out, axis=1))  # [P, G]
-                    if (v is not None and v.valid is not None
-                            and d.func in ("sum", "min", "max",
-                                           "welford_mean", "welford_m2")):
-                        # valid-count companion: a group whose inputs are all
-                        # NULL must decode to NULL, not 0 / ±inf
-                        nullcnt_map[ai] = len(nullcnt_lane)
-                        nullcnt_lane.append(jnp.stack(
-                            [(gm & v.valid).sum(axis=1) for gm in gmasks], axis=1
-                        ))
-                presence_lane = jnp.stack([gm.sum(axis=1) for gm in gmasks], axis=1)
-                meta_holder["out"] = out_meta
-                meta_holder["nullcnt_map"] = nullcnt_map
+                    outs_lane, presence_lane = pallas_lane(m, gid, vs)
+                    nullcnt_lane = []
+                else:
+                    if use_pallas and (
+                        G * len(lane_sets) > 64
+                        or G * len(lane_sets) * m.shape[0] > MAX_SEGMENTS * 16
+                    ):
+                        # this stage only kept the unrolled form because the
+                        # relaxed Pallas budget admitted it; its value lanes
+                        # turned out kernel-ineligible (money int64 sums,
+                        # validity planes) — refuse the G-wide XLA unroll
+                        # and let the fallback ladder retry as fused_xla
+                        raise Unsupported(
+                            f"pallas-ineligible aggregation at G={G}")
+                    outs_lane, nullcnt_lane, presence_lane = aggregate_lane(
+                        m, gid, vs, w, m_eff)
                 if outs is None:
                     outs, presence, nullcnts = outs_lane, presence_lane, nullcnt_lane
                 else:
@@ -1554,6 +1694,11 @@ class TpuStageExec(ExecutionPlan):
         lowered = jitted.lower(cols_spec, luts_spec, mask_spec, builds_spec)
         meta = {
             "mode": "unrolled",
+            "fusion_mode": (
+                "fused_pallas" if meta_holder.get("pallas_used") else "fused_xla"
+            ),
+            "fused_spans": len(spans),
+            "spans": span_meta,
             "out": meta_holder["out"],
             "nullcnt_map": meta_holder.get("nullcnt_map", {}),
             "group_src_slots": group_src_slots,
@@ -1561,6 +1706,112 @@ class TpuStageExec(ExecutionPlan):
             "G": G,
         }
         return jitted, ctx, meta, lowered
+
+    def _compile_staged(self, dt: DeviceTable, ctx: Lowering, dicts, builds,
+                        eval_pred, eval_proj, aggregate_lane, meta_holder,
+                        span_meta, group_src_slots, pad_sizes, G: int):
+        """Per-span sub-kernels with HBM intermediates — the always-available
+        fallback mode and the roofline instrument.
+
+        Each span (predicate → project → aggregate) is its own jitted
+        function, dispatched with a device sync in between, so `span_s`
+        in RunStats shows where a stage's time actually goes. The spans
+        trace the SAME closures the fused path composes (eval_pred /
+        eval_proj / aggregate_lane), so staged and fused_xla results are
+        byte-identical; the price is materializing the predicate mask and
+        every projected value lane in HBM between dispatches."""
+        jax = ensure_jax()
+        jnp = jax.numpy
+        proj_info: dict = {}
+
+        def pred_raw(cols, luts, mask, build_args):
+            cols = list(cols) + [a for b in build_args for a in b]
+            return eval_pred(cols, luts, mask)
+
+        def proj_raw(cols, luts, mask, build_args):
+            cols = list(cols) + [a for b in build_args for a in b]
+            gid, vs = eval_proj(cols, luts)
+            out = {}
+            if gid is not None:
+                out["gid"] = jnp.broadcast_to(gid, mask.shape)
+            vmeta = []
+            for ai, v in enumerate(vs):
+                if v is None:
+                    vmeta.append(None)
+                    continue
+                out[f"a{ai}"] = jnp.broadcast_to(v.arr, mask.shape)
+                if v.valid is not None:
+                    out[f"v{ai}"] = jnp.broadcast_to(v.valid, mask.shape)
+                vmeta.append((v.kind, v.scale))
+            proj_info["vmeta"] = vmeta
+            return out
+
+        def agg_raw(m, pv):
+            vs = []
+            for ai, vm in enumerate(proj_info["vmeta"]):
+                if vm is None:
+                    vs.append(None)
+                else:
+                    kind, scale = vm
+                    vs.append(DevVal(kind, pv[f"a{ai}"], scale,
+                                     valid=pv.get(f"v{ai}")))
+            outs_lane, nullcnt_lane, presence_lane = aggregate_lane(
+                m, pv.get("gid"), vs, None, None)
+            return tuple(outs_lane) + tuple(nullcnt_lane) + (presence_lane,)
+
+        # single expansion lane (the staged gate): pin the lane cells once
+        for cell, d_ in zip(ctx.lane_cells, ctx.lane_sets[0]):
+            cell["d"] = d_
+        jp = jax.jit(pred_raw)
+        jproj = jax.jit(proj_raw)
+        jagg = jax.jit(agg_raw)
+
+        cols_spec = [jax.ShapeDtypeStruct(c.shape, c.dtype) for c in dt.flat_cols()]
+        luts0 = ctx.build_luts(dicts, [b.dicts for b in builds])
+        luts_spec = [jax.ShapeDtypeStruct(l.shape, l.dtype) for l in luts0]
+        mask_spec = jax.ShapeDtypeStruct(dt.mask.shape, np.bool_)
+        builds_spec = [
+            [jax.ShapeDtypeStruct(a.shape, a.dtype) for a in b.flat_arrays()]
+            for b in builds
+        ]
+        # trace now (Unsupported must surface at compile time, where the
+        # fallback ladder lives): proj fills vmeta, agg fills meta_holder
+        # (out / nullcnt_map) — the same metadata the fused trace produces
+        jax.eval_shape(pred_raw, cols_spec, luts_spec, mask_spec, builds_spec)
+        pv_spec = jax.eval_shape(proj_raw, cols_spec, luts_spec, mask_spec,
+                                 builds_spec)
+        jax.eval_shape(agg_raw, mask_spec, pv_spec)
+
+        def staged_fn(cols, luts, mask, build_args, span_s=None):
+            t0 = time.time()
+            m = jp(cols, luts, mask, build_args)
+            jax.block_until_ready(m)
+            t1 = time.time()
+            pv = jproj(cols, luts, mask, build_args)
+            jax.block_until_ready(pv)
+            t2 = time.time()
+            outs = jagg(m, pv)
+            jax.block_until_ready(list(outs))
+            t3 = time.time()
+            if span_s is not None:
+                span_s["predicate"] = t1 - t0
+                span_s["project"] = t2 - t1
+                span_s["aggregate"] = t3 - t2
+            return outs
+
+        meta = {
+            "mode": "unrolled",
+            "exec": "staged",
+            "fusion_mode": "staged",
+            "fused_spans": 0,
+            "spans": span_meta,
+            "out": meta_holder["out"],
+            "nullcnt_map": meta_holder.get("nullcnt_map", {}),
+            "group_src_slots": group_src_slots,
+            "pad_sizes": pad_sizes,
+            "G": G,
+        }
+        return staged_fn, ctx, meta, None
 
     def _compile_sorted(self, dt: DeviceTable, ctx: Lowering, P: int, N: int,
                         builds: list[BuildTable], group_fns, agg_fns, key_slots,
@@ -2172,7 +2423,8 @@ def _mk_col_reader(i: int, kind: str, scale: int, dictionary, valid_idx=None):
     return run
 
 
-def _mk_join_finder(off: int, probe_fns, bt: BuildTable, cell: dict):
+def _mk_join_finder(off: int, probe_fns, bt: BuildTable, cell: dict,
+                    pallas: bool = False):
     """Closure computing (clamped build index, matched mask) for one join.
 
     'direct' unique mode: the build shipped a dense key→row int32 table —
@@ -2185,10 +2437,19 @@ def _mk_join_finder(off: int, probe_fns, bt: BuildTable, cell: dict):
     device range guards mirroring the host-side guards, so out-of-range
     keys can never alias a real build key. XLA CSEs the duplicate lookups
     issued by the per-column gathers.
+
+    `pallas=True` (direct unique mode only) routes the lookup through the
+    tiled `hash_probe` kernel: table VMEM-resident, gather + match mask
+    fused. Every build-column gather closure re-invokes the finder, and
+    XLA does not CSE custom calls the way it CSEs gathers — so the kernel
+    result is memoized per trace, keyed by the identity of the traced
+    `cols` list (a strong ref pins the list so its id cannot be recycled;
+    the identity check makes a stale hit impossible).
     """
     mode, shifts, dup = bt.mode, bt.shifts, bt.dup
     has_cnt = bt.cnt is not None
     b_static = bt.padded_rows()  # in shape_key, so cache hits can't go stale
+    _probe_memo: dict = {}
 
     def run(cols, luts):
         import jax.numpy as jnp
@@ -2214,6 +2475,18 @@ def _mk_join_finder(off: int, probe_fns, bt: BuildTable, cell: dict):
         if mode == "direct" and not has_cnt:
             T = keys_arr.shape[0]
             in_range = valid & (k >= 0) & (k < T)
+            if pallas:
+                from ballista_tpu.ops.tpu.pallas_kernels import hash_probe
+
+                hit = _probe_memo.get(id(cols))
+                if hit is None or hit[0] is not cols:
+                    kq = jnp.where(in_range, k, 0).astype(jnp.int32)
+                    rows, matched = hash_probe(kq, keys_arr, in_range)
+                    if len(_probe_memo) > 4:
+                        _probe_memo.clear()
+                    hit = (cols, rows, matched)
+                    _probe_memo[id(cols)] = hit
+                return hit[1], DevVal("bool", hit[2])
             row = keys_arr[jnp.where(in_range, k, 0)]
             matched = in_range & (row >= 0)
             idxc = jnp.clip(row, 0, None).astype(jnp.int32)

@@ -52,7 +52,11 @@ Failure policy: a dead accelerator pool must NOT look like parity. If the
 device leg cannot produce a time, the JSON carries value=0,
 vs_baseline=0.0, "device_error", the FULL init-event trail (iteration
 events truncated, init events never — ADVICE r4), per-leg /proc autopsies
-and stderr tails.
+and stderr tails. "device_leg" states the leg's fate explicitly: "ok",
+"error", or "skipped_init_timeout" — the last when no leg reported
+devices_ok within INIT_PROBE_TIMEOUT (a hung backend init / pool claim),
+in which case the round degrades to a recorded CPU-only datum instead of
+burning the whole budget on a claim that will never land.
 """
 
 import json
@@ -68,6 +72,13 @@ import time
 DEVICE_LEG_TIMEOUT = int(os.environ.get("BENCH_DEVICE_TIMEOUT", "1500"))
 HEDGE_AFTER = int(os.environ.get("BENCH_HEDGE_AFTER", "300"))
 MAX_LEGS = int(os.environ.get("BENCH_INIT_ATTEMPTS", "3"))
+# bounded init probe: if NO leg has reported devices_ok by this point the
+# accelerator claim itself is hung (jax init / pool grant — the failure
+# mode where backend init blocks forever inside a C extension and the
+# subprocess can't even time itself out). Stop waiting, record the round
+# as CPU-only with device_leg="skipped_init_timeout", keep the autopsies.
+INIT_PROBE_TIMEOUT = min(int(os.environ.get("BENCH_INIT_PROBE_TIMEOUT", "600")),
+                         DEVICE_LEG_TIMEOUT)
 # estimated seconds the full-scale device phase needs after data-ready
 # (cache fill over the tunnel + 1 warmup + 3 iters); beyond this the leg
 # drops to SF1 which needs ~1/10th of it
@@ -451,6 +462,7 @@ def main() -> None:
     threading.Thread(target=watcher, daemon=True).start()
 
     device_error = None
+    device_leg_state = None
     try:
         from ballista_tpu.testing.tpchgen import generate_tpch
 
@@ -521,6 +533,19 @@ def main() -> None:
             if not devices_ok and not mid_autopsy_done and now - T0 > 2 * HEDGE_AFTER:
                 mid_autopsy_done = True
                 pool.autopsy_all("mid")
+            if not devices_ok and now - T0 > INIT_PROBE_TIMEOUT:
+                # no leg ever got past backend init: don't burn the rest of
+                # the budget waiting on a hung claim — degrade to a recorded
+                # CPU-only round
+                pool.autopsy_all("init_timeout")
+                stage = events[-1]["event"] if events else "no progress at all"
+                device_error = (
+                    f"no devices_ok within init probe window "
+                    f"({INIT_PROBE_TIMEOUT}s); last progress: {stage}; "
+                    f"crashes: {pool.errors[-2:]}")
+                device_leg_state = "skipped_init_timeout"
+                log(device_error)
+                break
             if now > deadline:
                 pool.autopsy_all("deadline")
                 stage = events[-1]["event"] if events else "no progress at all"
@@ -566,12 +591,14 @@ def main() -> None:
     }
     if device_error is None and tpu_t > 0:
         log(f"tpu q1 {base_tag}: {tpu_t:.3f}s ({base_t / tpu_t:.1f}x)")
+        result["device_leg"] = "ok"
         result["value"] = round(base_rows / tpu_t)
         result["vs_baseline"] = round((base_rows / tpu_t) / (base_rows / base_t), 2)
         if leg_scale != scale:
             result["note"] = f"reduced-scale fallback: device ran sf{leg_scale:g}"
     else:
         # LOUD failure: never report the CPU number as the TPU number
+        result["device_leg"] = device_leg_state or "error"
         result["value"] = 0
         result["vs_baseline"] = 0.0
         result["device_error"] = device_error

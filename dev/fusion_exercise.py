@@ -1,0 +1,208 @@
+"""Exercise whole-stage fusion end-to-end in all three modes (CPU jax,
+Pallas in interpreter mode).
+
+    JAX_PLATFORMS=cpu python dev/fusion_exercise.py
+
+Two TPC-H-shaped stages, each run under `ballista.tpu.fusion.mode` =
+staged, fused_xla, fused_pallas — every mode in a fresh subprocess so
+compile caches can't bleed between modes:
+
+- **q1** (scan filter → projection arithmetic → partial aggregate over a
+  2-key dictionary domain, money measures). Asserts staged and fused_xla
+  are BYTE-IDENTICAL, fused reports `fused_spans >= 2`, staged reports
+  its per-span split — and that the fused_pallas request LADDERS DOWN to
+  fused_xla (exact int64 money sums are outside the kernel family; the
+  fallback must land on-device, not on the CPU engine).
+- **syn** (lineitem-shaped: dictionary category keys, f64 measures, a
+  selective filter). fused_pallas genuinely runs the Pallas hash-
+  aggregate here; counts must be exact and f32 sums within kernel
+  tolerance of the staged oracle.
+
+Prints per-mode RunStats deltas (fusion_mode, fused_spans,
+fused_kernel_s, trace/compile/exec, staged's span_s) and exits non-zero
+on any divergence. The CPU-interpreter run is the correctness rig for
+the same code path a real TPU executes; expect fused_pallas to be slow
+here, not fast.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+STATS_MARK = "FUSION_EXERCISE_STATS "
+MODES = ("staged", "fused_xla", "fused_pallas")
+SYN_SQL = ("select cat, sum(w * (1 - disc)) rev, sum(w) s, count(*) c "
+           "from syn where qty < 24 group by cat order by cat")
+
+
+def q1_sql() -> str:
+    with open(os.path.join(ROOT, "benchmarks", "tpch", "queries", "q1.sql")) as f:
+        return f.read()
+
+
+def _save(data_dir: str, tag: str, mode: str, table) -> None:
+    import pyarrow.ipc as ipc
+
+    path = os.path.join(data_dir, f"result_{tag}_{mode}.arrow")
+    with ipc.new_file(path, table.schema) as sink:
+        sink.write_table(table.combine_chunks())
+
+
+def child(data_dir: str, mode: str) -> None:
+    from ballista_tpu.client.context import SessionContext
+    from ballista_tpu.config import (
+        BallistaConfig,
+        EXECUTOR_ENGINE,
+        TPU_FUSION_MODE,
+        TPU_MIN_ROWS,
+    )
+    from ballista_tpu.ops.tpu import stage_compiler
+    from ballista_tpu.testing.tpchgen import register_tpch
+
+    cfg = BallistaConfig({EXECUTOR_ENGINE: "tpu", TPU_MIN_ROWS: 0,
+                          TPU_FUSION_MODE: mode})
+    ctx = SessionContext(cfg)
+    register_tpch(ctx, data_dir)
+    ctx.register_parquet("syn", os.path.join(data_dir, "syn.parquet"))
+
+    stats = {}
+    for tag, sql in (("q1", q1_sql()), ("syn", SYN_SQL)):
+        stage_compiler.RUN_STATS.clear()
+        out = ctx.sql(sql).collect()
+        if out.num_rows == 0:
+            raise SystemExit(f"[{mode}/{tag}] produced no rows")
+        _save(data_dir, tag, mode, out)
+        stats[tag] = stage_compiler.RUN_STATS.snapshot()
+    print(STATS_MARK + json.dumps(stats))
+
+
+def spawn(data_dir: str, mode: str) -> dict:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--child", data_dir, mode],
+        env=env, capture_output=True, text=True, timeout=600)
+    if proc.returncode != 0:
+        raise SystemExit(f"[{mode}] child failed:\n{proc.stdout}\n{proc.stderr}")
+    for line in proc.stdout.splitlines():
+        if line.startswith(STATS_MARK):
+            return json.loads(line[len(STATS_MARK):])
+    raise SystemExit(f"[{mode}] child printed no stats:\n{proc.stdout}")
+
+
+def load(data_dir: str, tag: str, mode: str):
+    import pyarrow.ipc as ipc
+
+    with ipc.open_file(os.path.join(data_dir, f"result_{tag}_{mode}.arrow")) as f:
+        return f.read_all()
+
+
+def report(tag: str, mode: str, stats: dict) -> None:
+    print(f"[{tag}/{mode:12s}] fusion_mode={stats.get('fusion_mode')} "
+          f"fused_spans={stats.get('fused_spans')} "
+          f"fused_kernel_s={stats.get('fused_kernel_s', 0.0):.4f} "
+          f"trace_s={stats.get('trace_s', 0.0):.3f} "
+          f"compile_s={stats.get('compile_s', 0.0):.3f} "
+          f"exec_s={stats.get('exec_s', 0.0):.3f}")
+    if stats.get("span_s"):
+        spans = "  ".join(f"{k}={v:.4f}s" for k, v in stats["span_s"].items())
+        print(f"[{tag}/{mode:12s}]   span_s: {spans}")
+    print(f"[{tag}/{mode:12s}]   reason: {stats.get('fusion_reason')}")
+
+
+def gen_synthetic(data_dir: str) -> None:
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    rng = np.random.default_rng(42)
+    n = 60_000
+    pq.write_table(pa.table({
+        # 50 categories → G = 64: inside the staged/unrolled budget, so all
+        # three modes run their native form (multi-tile G > 128 is covered
+        # by tests/test_tpu_fusion.py)
+        "cat": rng.choice([f"c{i:03d}" for i in range(50)], n),
+        "w": rng.uniform(0.0, 10.0, n),
+        "disc": rng.uniform(0.0, 0.1, n),
+        "qty": rng.integers(1, 50, n),
+    }), os.path.join(data_dir, "syn.parquet"))
+
+
+def main() -> None:
+    if len(sys.argv) > 1 and sys.argv[1] == "--child":
+        child(sys.argv[2], sys.argv[3])
+        return
+    import numpy as np
+
+    from ballista_tpu.testing.tpchgen import generate_tpch
+
+    with tempfile.TemporaryDirectory(prefix="fusion-tpch-") as d:
+        print(f"generating TPC-H sf0.01 + synthetic under {d} ...")
+        generate_tpch(d, scale=0.01, seed=42, files_per_table=2)
+        gen_synthetic(d)
+        stats = {m: spawn(d, m) for m in MODES}
+        results = {(t, m): load(d, t, m) for m in MODES for t in ("q1", "syn")}
+
+    for tag in ("q1", "syn"):
+        for m in MODES:
+            report(tag, m, stats[m][tag])
+
+    # -- mode routing ------------------------------------------------------
+    for tag in ("q1", "syn"):
+        for m in ("staged", "fused_xla"):
+            got = stats[m][tag].get("fusion_mode")
+            if got != m:
+                raise SystemExit(f"[{tag}/{m}] ran as {got!r}, not as requested")
+    got = stats["fused_pallas"]["q1"].get("fusion_mode")
+    if got != "fused_xla":
+        raise SystemExit(
+            f"[q1/fused_pallas] expected the ladder to land on fused_xla "
+            f"(money sums are kernel-ineligible), got {got!r}")
+    print("[ladder] q1 fused_pallas request correctly laddered to fused_xla")
+    got = stats["fused_pallas"]["syn"].get("fusion_mode")
+    if got != "fused_pallas":
+        raise SystemExit(f"[syn/fused_pallas] ran as {got!r}, kernel never used")
+
+    # -- span accounting ---------------------------------------------------
+    if stats["fused_xla"]["q1"].get("fused_spans", 0) < 2:
+        raise SystemExit(
+            f"[q1/fused_xla] filter→project→agg stage reported fused_spans="
+            f"{stats['fused_xla']['q1'].get('fused_spans')} (< 2)")
+    for tag in ("q1", "syn"):
+        if not stats["staged"][tag].get("span_s"):
+            raise SystemExit(f"[{tag}/staged] no per-span timings recorded")
+
+    # -- parity ------------------------------------------------------------
+    for tag in ("q1", "syn"):
+        if not results[(tag, "staged")].equals(results[(tag, "fused_xla")]):
+            raise SystemExit(
+                f"DIVERGENCE: {tag} staged vs fused_xla not byte-identical")
+    print("[parity] staged == fused_xla (byte-identical, q1 and syn)")
+
+    ref, pal = results[("syn", "staged")], results[("syn", "fused_pallas")]
+    if ref.column_names != pal.column_names or ref.num_rows != pal.num_rows:
+        raise SystemExit("DIVERGENCE: syn fused_pallas result shape differs")
+    for name in ref.column_names:
+        a, b = ref.column(name).to_pandas(), pal.column(name).to_pandas()
+        try:
+            af, bf = a.astype(float), b.astype(float)
+        except (ValueError, TypeError):
+            if not a.equals(b):
+                raise SystemExit(f"DIVERGENCE: syn column {name} differs")
+            continue
+        if not np.allclose(af, bf, rtol=2e-5, equal_nan=True):
+            raise SystemExit(
+                f"DIVERGENCE: syn fused_pallas column {name} beyond kernel "
+                f"tolerance (max rel "
+                f"{np.nanmax(np.abs(af - bf) / np.maximum(np.abs(bf), 1e-12)):.2e})")
+    print("[parity] syn fused_pallas within kernel tolerance (f32 sums)")
+    print("fusion exercise passed")
+
+
+if __name__ == "__main__":
+    main()
