@@ -118,6 +118,12 @@ TPU_FILL_THREADS = "ballista.tpu.fill.threads"
 TPU_FILL_CHUNK_ROWS = "ballista.tpu.fill.chunk_rows"
 TPU_COMPILE_OVERLAP = "ballista.tpu.compile.overlap"
 TPU_COMPILE_CACHE_DIR = "ballista.tpu.compile.cache_dir"
+# mesh-wide stage execution (planner mesh merge + on-device all_to_all exchange)
+TPU_MESH_ENABLED = "ballista.tpu.mesh.enabled"
+TPU_MESH_DEVICES = "ballista.tpu.mesh.devices"
+TPU_MESH_EXCHANGE_CAPACITY = "ballista.tpu.mesh.exchange.capacity.rows"
+TPU_MESH_MIN_ROWS = "ballista.tpu.mesh.min.rows"
+TPU_MESH_MAX_INPUT_BYTES = "ballista.tpu.mesh.max.input.bytes"
 
 
 @dataclass(frozen=True)
@@ -548,6 +554,50 @@ _ENTRIES: list[ConfigEntry] = [
         "Use ICI collectives (shard_map all_to_all) instead of file shuffle for "
         "co-scheduled intra-slice stages.",
         bool, False,
+    ),
+    ConfigEntry(
+        TPU_MESH_ENABLED,
+        "Mesh-wide stage execution: the distributed planner merges an "
+        "intra-host hash-shuffle producer stage into its single consumer "
+        "and ships the merged stage as ONE task spanning the device mesh; "
+        "the repartition runs as an on-device all_to_all (MeshExchangeExec) "
+        "instead of shuffle files + Flight fetches. Requires "
+        "ballista.executor.engine = tpu; stages that don't fit (multiple "
+        "consumers, broadcast edges, unsupported dtypes, capacity overflow) "
+        "keep or demote to the per-partition path.",
+        bool, False,
+    ),
+    ConfigEntry(
+        TPU_MESH_DEVICES,
+        "Device-mesh width for mesh-wide stages. 0 = every visible device "
+        "(make_mesh falls back to CPU virtual devices when the default "
+        "platform has fewer). A mesh below 2 devices demotes the exchange "
+        "to the host split.",
+        int, 0, _nonneg,
+    ),
+    ConfigEntry(
+        TPU_MESH_EXCHANGE_CAPACITY,
+        "Fixed per-(sender, destination) slot capacity of the on-device "
+        "all_to_all exchange, in rows. The host-side gate "
+        "(require_exchange_capacity) raises ExchangeCapacityExceeded and "
+        "demotes the stage when routed rows exceed it — no row is ever "
+        "silently truncated.",
+        int, 1 << 20, _pos,
+    ),
+    ConfigEntry(
+        TPU_MESH_MIN_ROWS,
+        "Below this many producer rows a mesh exchange is not worth the "
+        "collective dispatch; the stage demotes to the host split "
+        "(mesh_mode_reason = demoted:small-input).",
+        int, 0, _nonneg,
+    ),
+    ConfigEntry(
+        TPU_MESH_MAX_INPUT_BYTES,
+        "AQE guard: at stage resolution, a mesh exchange whose observed "
+        "input stages exceed this many bytes is demoted to the "
+        "per-partition path before execution (the fixed-capacity collective "
+        "would overflow anyway; skip the wasted dispatch). 0 = no limit.",
+        int, 0, _nonneg,
     ),
     ConfigEntry(
         TPU_FILL_THREADS,

@@ -315,7 +315,8 @@ class ExecutorProcess:
         stats = sc.RUN_STATS.snapshot()
         for key in ("fill_s", "encode_s", "upload_s", "compile_s",
                     "compile_overlap_s", "exec_s", "device_bytes",
-                    "fused_spans", "fused_kernel_s"):
+                    "fused_spans", "fused_kernel_s",
+                    "mesh_devices", "exchange_bytes_on_device", "exchange_s"):
             if key in stats:
                 out.append((f"tpu_{key}", float(stats[key])))
         if "fusion_mode" in stats:
@@ -323,6 +324,12 @@ class ExecutorProcess:
             code = {"staged": 0.0, "fused_xla": 1.0, "fused_pallas": 2.0}
             out.append(("tpu_fusion_mode",
                         code.get(str(stats["fusion_mode"]), -1.0)))
+        if "mesh_mode_reason" in stats:
+            # gauges are floats: 1 = the collective exchange ran on-device,
+            # 0 = demoted to the host split (the string reason stays in
+            # RUN_STATS for bench/exercise output)
+            mesh = 1.0 if str(stats["mesh_mode_reason"]) == "mesh" else 0.0
+            out.append(("tpu_mesh_mode", mesh))
         from ballista_tpu.ops.tpu import runtime
 
         cc = runtime.compile_cache_stats()

@@ -988,11 +988,19 @@ def _sort_table(tbl: pa.Table, df_schema: DFSchema, keys: list[SortKey]) -> pa.T
     for i, k in enumerate(keys):
         pe = bind_expr(k.expr, df_schema)
         arr = evaluate_to_array(pe, batch)
+        if arr.null_count:
+            # null placement without the SortOptions kwarg: pyarrow ≥25
+            # deprecates the global null_placement (the FutureWarning that
+            # flooded the multichip dryrun tail) and older releases have no
+            # per-key form — a leading is-null flag column expresses the
+            # same order on every version, and honors nulls_first PER KEY
+            # instead of only key 0's setting
+            aux[f"__n{i}"] = pc.is_null(arr)
+            sort_cols.append((f"__n{i}", "descending" if k.nulls_first else "ascending"))
         aux[f"__s{i}"] = arr
         sort_cols.append((f"__s{i}", "ascending" if k.ascending else "descending"))
     aux_tbl = pa.table(aux)
-    null_placement = "at_start" if keys[0].nulls_first else "at_end"
-    idx = pc.sort_indices(aux_tbl, sort_keys=sort_cols, null_placement=null_placement)
+    idx = pc.sort_indices(aux_tbl, sort_keys=sort_cols)
     return tbl.take(idx)
 
 

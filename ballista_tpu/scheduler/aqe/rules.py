@@ -99,6 +99,19 @@ def apply_aqe(plan: ExecutionPlan, input_stats: dict[int, InputStageStats],
     if bool(config.get(AQE_DYNAMIC_JOIN_SELECTION)):
         plan = _select_joins(plan, input_stats, config)
 
+    # mesh-wide stages: the fused exchange's bucket count is a fixed K baked
+    # into MeshExchangeExec — coalescing this stage's partitions below K
+    # would orphan every bucket >= the coalesced count (silent data loss),
+    # so the coalescing rule never applies here. AQE's contribution instead
+    # is the input-bytes demotion guard: a mesh exchange whose observed
+    # input stages exceed `ballista.tpu.mesh.max.input.bytes` would blow the
+    # fixed-capacity collective anyway — demote it before the wasted
+    # dispatch, with the reason on record.
+    mesh_nodes = _mesh_nodes(plan)
+    if mesh_nodes:
+        _demote_oversized_mesh(mesh_nodes, input_stats, config)
+        return plan, None
+
     new_parts = None
     target = int(config.get(AQE_TARGET_PARTITION_BYTES))
     min_b = int(config.get(AQE_MIN_PARTITION_BYTES))
@@ -144,6 +157,37 @@ def apply_aqe(plan: ExecutionPlan, input_stats: dict[int, InputStageStats],
             plan = retarget_routers(plan, new_parts)
             log.info("AQE coalesced %d reduce partitions into %d groups", k, len(groups))
     return plan, new_parts
+
+
+def _mesh_nodes(plan: ExecutionPlan) -> list:
+    from ballista_tpu.ops.tpu.mesh_stage import MeshExchangeExec
+
+    out = []
+
+    def walk(n):
+        if isinstance(n, MeshExchangeExec):
+            out.append(n)
+        for c in n.children():
+            walk(c)
+
+    walk(plan)
+    return out
+
+
+def _demote_oversized_mesh(nodes: list, input_stats: dict[int, InputStageStats],
+                           config: BallistaConfig) -> None:
+    from ballista_tpu.config import TPU_MESH_MAX_INPUT_BYTES
+
+    limit = int(config.get(TPU_MESH_MAX_INPUT_BYTES))
+    if limit <= 0:
+        return
+    total = sum(s.total_bytes for s in input_stats.values() if not s.broadcast)
+    if total <= limit:
+        return
+    reason = f"aqe:input-bytes({total}>{limit})"
+    for n in nodes:
+        n.demote_reason = reason
+    log.info("AQE demoted mesh exchange to the per-partition path: %s", reason)
 
 
 def _replace_readers(plan: ExecutionPlan, replacements: dict[int, ShuffleReaderExec]) -> ExecutionPlan:

@@ -219,6 +219,9 @@ class SchedulerServer:
                 physical = body
             stages = DistributedPlanner(job_id).plan_query_stages(physical)
             cfg = self.sessions.get(session_id) or BallistaConfig()
+            from ballista_tpu.scheduler.planner import merge_mesh_stages
+
+            stages = merge_mesh_stages(stages, cfg)
             old = self.jobs.get(job_id)
             graph = ExecutionGraph(job_id, old.job_name if old else "", session_id, stages, cfg)
             with self._jobs_lock:

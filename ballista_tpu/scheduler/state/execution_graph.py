@@ -188,8 +188,12 @@ class ExecutionGraph:
             for stage in sorted(self.stages.values(), key=lambda s: s.stage_id):
                 if not stage.is_runnable:
                     continue
-                parts = stage.pending[:slice_size]
-                stage.pending = stage.pending[slice_size:]
+                # a mesh stage's exchange runs ONCE and serves every reduce
+                # bucket from one device dispatch — it must ship as a single
+                # mesh-wide task, never be sliced across executors
+                n = len(stage.pending) if stage.spec.mesh else slice_size
+                parts = stage.pending[:n]
+                stage.pending = stage.pending[n:]
                 self.next_task_id += 1
                 deadline = self._deadline_seconds(stage)
                 attempt = max((stage.retry_counts.get(p, 0) for p in parts), default=0)
@@ -738,6 +742,7 @@ class ExecutionGraph:
         links: dict[int, list[int]] = {}
         for sp in proto.stages:
             plan = decode_plan(sp.plan)
+            from ballista_tpu.ops.tpu.mesh_stage import contains_mesh_exchange
             from ballista_tpu.scheduler.planner import _find_input_stages
 
             stages.append(
@@ -746,6 +751,10 @@ class ExecutionGraph:
                     partitions=sp.partitions,
                     output_partitions=plan.output_partitions or sp.partitions,
                     input_stage_ids=_find_input_stages(plan),
+                    # the proto has no mesh flag; the plan itself is the
+                    # durable record — a recovered mesh stage must keep its
+                    # single-task shape
+                    mesh=contains_mesh_exchange(plan),
                 )
             )
             links[sp.stage_id] = list(sp.output_links)

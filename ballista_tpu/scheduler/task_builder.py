@@ -43,6 +43,7 @@ from ballista_tpu.plan.physical import (
     UnionExec,
 )
 from ballista_tpu.ops.cpu.dynamic_join import DynamicJoinSelectionExec
+from ballista_tpu.ops.tpu.mesh_stage import MeshExchangeExec
 from ballista_tpu.shuffle.reader import ShuffleReaderExec
 
 _COLLAPSE_ALL_CHILDREN = (
@@ -87,6 +88,11 @@ def restrict_plan_to_partitions(plan: ExecutionPlan, partitions: list[int],
         for idx, c in enumerate(kids):
             child_scoped = scoped
             if isinstance(node, _COLLAPSE_ALL_CHILDREN):
+                child_scoped = False
+            elif isinstance(node, MeshExchangeExec):
+                # the fused exchange consumes EVERY producer partition in its
+                # one device dispatch; scoping its input would starve the
+                # all_to_all of rows
                 child_scoped = False
             elif isinstance(node, HashJoinExec) and node.mode == "collect_left" and idx == 0:
                 child_scoped = False

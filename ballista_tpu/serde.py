@@ -61,6 +61,7 @@ from ballista_tpu.plan.physical import (
     SortPreservingMergeExec,
     UnionExec,
 )
+from ballista_tpu.ops.tpu.mesh_stage import MeshExchangeExec
 from ballista_tpu.plan.schema import DFField, DFSchema
 from ballista_tpu.proto import pb
 from ballista_tpu.shuffle.reader import ShuffleReaderExec, UnresolvedShuffleExec
@@ -452,6 +453,18 @@ def encode_plan(plan: ExecutionPlan) -> pb.PhysicalPlanNode:
         n.n = plan.n
         for k in plan.keys:
             n.keys.append(encode_expr(k))
+    elif isinstance(plan, MeshExchangeExec):
+        # wire form: a repartition node with scheme "mesh_exchange" — the
+        # checked-in generated proto predates the mesh node (and the image
+        # carries no protoc to extend it); the scheme string disambiguates
+        # losslessly since planner-made RepartitionExec schemes are a closed
+        # set ("hash"/"round_robin")
+        n = out.repartition
+        n.input.CopyFrom(encode_plan(plan.producer))
+        n.scheme = "mesh_exchange"
+        n.n = plan.file_partitions
+        for k in plan.keys:
+            n.keys.append(encode_expr(k))
     elif isinstance(plan, UnionExec):
         for c in plan.inputs:
             out.union.inputs.append(encode_plan(c))
@@ -571,6 +584,8 @@ def decode_plan(p: pb.PhysicalPlanNode) -> ExecutionPlan:
         return GlobalLimitExec(decode_plan(n.input), None if n.fetch < 0 else n.fetch, n.skip)
     if which == "repartition":
         n = p.repartition
+        if n.scheme == "mesh_exchange":
+            return MeshExchangeExec(decode_plan(n.input), [decode_expr(k) for k in n.keys], n.n)
         return RepartitionExec(decode_plan(n.input), n.scheme, n.n, [decode_expr(k) for k in n.keys])
     if which == "union":
         return UnionExec([decode_plan(c) for c in p.union.inputs], decode_schema(p.union.schema))

@@ -110,12 +110,19 @@ def best_time(engine: str, data_dir: str, sql: str, warmups: int, iters: int,
         except Exception:  # noqa: BLE001 — diagnostics only
             return {}
 
+    def mesh_fields(stats: dict) -> dict:
+        # surfaced as first-class event fields (not only nested under
+        # "stats") so log scrapers can grep mesh adoption per iteration
+        return {"mesh_mode": stats.get("mesh_mode_reason"),
+                "exchange_bytes_on_device": stats.get("exchange_bytes_on_device")}
+
     for w in range(warmups):
         t0 = time.time()
         ctx.sql(sql).collect()
         if progress:
+            st = run_stats()
             progress("warmup", i=w, s=round(time.time() - t0, 3),
-                     stats=run_stats())
+                     stats=st, **mesh_fields(st))
     best = float("inf")
     for i in range(iters):
         t0 = time.time()
@@ -123,7 +130,8 @@ def best_time(engine: str, data_dir: str, sql: str, warmups: int, iters: int,
         dt = time.time() - t0
         best = min(best, dt)
         if progress:
-            progress("iter", i=i, s=round(dt, 3), stats=run_stats())
+            st = run_stats()
+            progress("iter", i=i, s=round(dt, 3), stats=st, **mesh_fields(st))
         assert out.num_rows > 0
     return best, rows
 

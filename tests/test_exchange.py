@@ -10,10 +10,14 @@ import numpy as np
 import pytest
 
 from ballista_tpu.parallel.exchange import (
+    ExchangeCapacityExceeded,
     exchange_capacity_fits,
     hash_exchange_all_to_all,
+    hash_exchange_table,
     make_mesh,
     partial_then_psum,
+    require_exchange_capacity,
+    required_exchange_capacity,
 )
 
 
@@ -30,6 +34,7 @@ def _expected_routing(keys_np, n):
     return splitmix64(keys_np.astype(np.uint64)) % np.uint64(n)
 
 
+@pytest.mark.multichip
 def test_hash_exchange_routes_every_row_once():
     import jax.numpy as jnp
 
@@ -54,6 +59,7 @@ def test_hash_exchange_routes_every_row_once():
         assert sorted(per_dev[d][per_ok[d]].tolist()) == want
 
 
+@pytest.mark.multichip
 def test_hash_exchange_overflow_never_clobbers_valid_rows():
     """Force overflow: surviving rows must be an intact SUBSET of the
     input — an overflow write must never zero a valid slot (the round-2
@@ -102,6 +108,89 @@ def test_exchange_capacity_fits_gate():
     assert not exchange_capacity_fits(keys, n, 8)
 
 
+@pytest.mark.multichip
+def test_make_mesh_clamps_device_count():
+    mesh = _mesh8()
+    n = mesh.devices.size
+    # asking for fewer devices than exist clamps the mesh to that many
+    assert make_mesh(4).devices.size == 4
+    assert make_mesh(1).devices.size == 1
+    # asking for more than any backend has is a hard error, not truncation
+    with pytest.raises(RuntimeError, match="devices"):
+        make_mesh(n * 1000)
+
+
+def test_require_exchange_capacity_raises_typed():
+    # every row routes to ONE destination → required == row count
+    keys = [np.zeros(100, dtype=np.int64)]
+    assert require_exchange_capacity(keys, 8, 100) == 100
+    with pytest.raises(ExchangeCapacityExceeded) as ei:
+        require_exchange_capacity(keys, 8, 10)
+    assert ei.value.required == 100
+    assert ei.value.capacity == 10
+    assert ei.value.n_devices == 8
+    assert "demote" in str(ei.value)
+
+
+def test_required_capacity_prehashed_routes_on_raw_hash():
+    # prehashed: the values ARE the combined row hashes — no splitmix64 pass
+    h = np.full(64, 5, dtype=np.uint64)  # all route to 5 % n
+    assert required_exchange_capacity([h], 8, prehashed=True) == 64
+    spread = np.arange(64, dtype=np.uint64)  # 8 rows per destination
+    assert required_exchange_capacity([spread], 8, prehashed=True) == 8
+    assert exchange_capacity_fits([spread], 8, 8, prehashed=True)
+    assert not exchange_capacity_fits([spread], 8, 7, prehashed=True)
+
+
+@pytest.mark.multichip
+def test_hash_exchange_table_skewed_round_trip():
+    """Multi-lane table exchange under heavy key skew: every live row
+    arrives exactly once on the device its PRE-combined hash routes to,
+    all lanes travel together, and dead (padding) rows never arrive."""
+    mesh = _mesh8()
+    n = mesh.devices.size
+    rows = 64 * n
+    rng = np.random.default_rng(9)
+    hot = rng.random(rows) < 0.8  # 80% of rows on one hot key
+    hashes = np.where(
+        hot, np.uint64(0xDEADBEEF),
+        rng.integers(1, 1 << 62, rows).astype(np.uint64),
+    )
+    lane_a = np.arange(rows, dtype=np.int64)  # row id
+    lane_b = rng.integers(-1000, 1000, rows).astype(np.int64)
+    live = np.ones(rows, dtype=bool)
+    live[-7:] = False  # a padding tail that must never arrive
+
+    shards = [
+        hashes[d * 64:(d + 1) * 64][live[d * 64:(d + 1) * 64]] for d in range(n)
+    ]
+    cap = required_exchange_capacity(shards, n, prehashed=True)
+    h_out, (a_out, b_out), ok = hash_exchange_table(
+        hashes.view(np.int64), [lane_a, lane_b], live, mesh, capacity=cap)
+    h_out = np.asarray(h_out)
+    a_out, b_out = np.asarray(a_out), np.asarray(b_out)
+    ok = np.asarray(ok)
+
+    # exactly the live rows arrive, each once
+    assert sorted(a_out[ok].tolist()) == lane_a[live].tolist()
+    # lanes travel together with their hash
+    b_of = dict(zip(lane_a.tolist(), lane_b.tolist()))
+    h_of = dict(zip(lane_a.tolist(), hashes.tolist()))
+    for rid, b, h in zip(a_out[ok].tolist(), b_out[ok].tolist(),
+                         h_out[ok].view(np.uint64).tolist()):
+        assert b_of[rid] == b
+        assert h_of[rid] == h
+    # and each lands on the device its hash routes to
+    per_rid = a_out.reshape(n, -1)
+    per_ok = ok.reshape(n, -1)
+    dest = (hashes % np.uint64(n)).astype(np.int64)
+    for d in range(n):
+        got = sorted(per_rid[d][per_ok[d]].tolist())
+        want = sorted(lane_a[live & (dest == d)].tolist())
+        assert got == want
+
+
+@pytest.mark.multichip
 def test_partial_then_psum_merges_globally():
     import jax.numpy as jnp
 
