@@ -168,6 +168,14 @@ class SessionContext:
             return DataFrame(self, plan, sql_text=query)
         raise PlanningError(f"unsupported statement {type(stmt).__name__}")
 
+    def prepare(self, query: str) -> "ClientPreparedStatement":
+        """Prepare a parameterized SELECT once; `execute(params)` then
+        binds fresh literal values into the cached plan template without
+        re-parsing or re-planning (the serving tier's prepared-statement
+        surface). Parameter slots are the statement's literals in plan
+        walk order."""
+        return ClientPreparedStatement(self, query)
+
     def table(self, name: str) -> "DataFrame":
         from ballista_tpu.plan.logical import TableScan
 
@@ -208,6 +216,86 @@ class SessionContext:
         if not batches:
             return pa.table({f.name: pa.array([], f.type) for f in schema}, schema=schema)
         return pa.Table.from_batches(batches, schema=schema)
+
+
+class ClientPreparedStatement:
+    """Client handle for a prepared statement. Prepare parses and plans
+    the statement once (server-side for standalone/remote, in-process for
+    local mode); execute() binds parameter values and collects. The slot
+    order is the statement's literal order in plan walk order — the handle
+    exposes `num_params` and `type_tags` so callers can check it."""
+
+    def __init__(self, ctx: SessionContext, query: str):
+        self.ctx = ctx
+        self.sql = query
+        self.statement_id = ""
+        self._local_lift = None
+        if ctx.mode == "standalone" and not ctx._has_memory_tables():
+            # memory tables never ship to the scheduler (same rule as
+            # _collect_standalone) — those statements prepare in-process
+            scheduler = ctx._ensure_cluster().scheduler
+            sid = scheduler.sessions.create_or_update(
+                ctx.config.to_key_value_pairs(), str(ctx.session_id))
+            handle = scheduler.prepare_statement(query, sid)
+        elif ctx.mode == "remote":
+            handle = ctx._ensure_remote().prepare_statement(query)
+        else:
+            from ballista_tpu.serving.normalize import lift_parameters
+            from ballista_tpu.sql.ast import SelectStmt as _Sel
+
+            stmt = parse_sql(query)
+            if not isinstance(stmt, _Sel):
+                raise PlanningError("only SELECT statements can be prepared")
+            lift = lift_parameters(optimize(SqlPlanner(ctx.catalog).plan_query(stmt)))
+            if not lift.cacheable:
+                raise PlanningError(f"statement cannot be parameterized: {lift.reason}")
+            self._local_lift = lift
+            handle = {"statement_id": "local", "num_params": len(lift.values),
+                      "type_tags": list(lift.type_tags)}
+        self.statement_id = handle["statement_id"]
+        self.num_params = int(handle["num_params"])
+        self.type_tags = list(handle["type_tags"])
+
+    def execute(self, params=None) -> pa.Table:
+        from ballista_tpu.config import CLIENT_JOB_TIMEOUT_S
+        from ballista_tpu.errors import ExecutionError
+
+        if self.ctx.mode == "standalone" and self._local_lift is None:
+            scheduler = self.ctx._ensure_cluster().scheduler
+            sid = scheduler.sessions.create_or_update(
+                self.ctx.config.to_key_value_pairs(), str(self.ctx.session_id))
+            job_id = scheduler.execute_prepared(
+                self.statement_id, params, sid, inline_results=True)
+            status = scheduler.wait_for_job(
+                job_id, timeout=float(self.ctx.config.get(CLIENT_JOB_TIMEOUT_S)))
+            if status["state"] != "successful":
+                raise ExecutionError(
+                    f"job {job_id} {status['state']}: {status.get('error', '')}")
+            return fetch_job_results(status, self.ctx.config)
+        if self.ctx.mode == "remote" and self._local_lift is None:
+            client = self.ctx._ensure_remote()
+            job_id = client.execute_prepared(self.statement_id, params)
+            status = client.wait_for_job(
+                job_id, timeout=float(self.ctx.config.get(CLIENT_JOB_TIMEOUT_S)))
+            if status["state"] != "successful":
+                raise ExecutionError(
+                    f"job {job_id} {status['state']}: {status.get('error', '')}")
+            return fetch_job_results(status, self.ctx.config)
+        # local mode: bind into the retained tagged plan and execute here
+        from ballista_tpu.serving.normalize import bind_logical
+
+        values = tuple(params) if params is not None else self._local_lift.values
+        if len(values) != self.num_params:
+            raise PlanningError(
+                f"statement takes {self.num_params} parameters, got {len(values)}")
+        bound = bind_logical(self._local_lift.tagged, values)
+        physical = self.ctx.create_physical_plan(bound)
+        return self.ctx.execute_collect(physical)
+
+    def close(self) -> None:
+        if (self.ctx.mode == "standalone" and self._local_lift is None
+                and self.ctx._cluster is not None):
+            self.ctx._cluster.scheduler.close_prepared(self.statement_id)
 
 
 class DataFrame:
@@ -304,7 +392,9 @@ class DataFrame:
             self.ctx.config.to_key_value_pairs(), str(self.ctx.session_id)
         )
         if self.sql_text is not None and not self.ctx._has_memory_tables():
-            job_id = scheduler.submit_sql(self.sql_text, session_id)
+            # inline_results: this process can accept a result table right
+            # in the status dict (serving-tier result-cache hits)
+            job_id = scheduler.submit_sql(self.sql_text, session_id, inline_results=True)
         else:
             # in-memory tables can't be re-resolved from SQL on the
             # scheduler: plan CLIENT-side and submit the physical plan
@@ -405,6 +495,11 @@ def fetch_job_results(status: dict, config: BallistaConfig) -> pa.Table:
 
     from ballista_tpu.config import FLIGHT_PROXY, SHUFFLE_READER_FORCE_REMOTE
 
+    # serving-tier result-cache hit: the table rode back in the status
+    # dict; nothing to fetch
+    inline = status.get("inline_result")
+    if inline is not None:
+        return inline
     schema = status["schema"].to_arrow() if status.get("schema") is not None else None
     locs = sorted(status.get("partitions", []), key=lambda l: (l.output_partition, l.map_partition))
     ctx = TaskContext(config)

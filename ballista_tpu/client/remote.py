@@ -40,7 +40,11 @@ from ballista_tpu.serde_control import decode_job_status
 
 log = logging.getLogger(__name__)
 
-POLL_INTERVAL_S = 0.1
+# the floor sets the best-case tail latency a polling client can observe:
+# at the old 100ms floor a 5ms fast-lane query always took >=100ms
+# end-to-end, wiping out the serving tier's win. 10ms keeps short-query
+# p99 honest; the exponential growth still backs long jobs off to the cap.
+POLL_INTERVAL_S = 0.01
 POLL_INTERVAL_MAX_S = 2.0
 
 # transient codes worth retrying on idempotent rpcs
@@ -196,6 +200,57 @@ class RemoteSchedulerClient:
             # on the scheduler for long ones
             poll = min(POLL_INTERVAL_MAX_S, poll * 1.5)
         raise ExecutionError(f"job {job_id} timed out")
+
+    # -- prepared statements -------------------------------------------------
+
+    def prepare_statement(self, sql: str) -> dict:
+        """PrepareStatement rpc: plan once server-side, get back a handle
+        {statement_id, num_params, type_tags} (JSON in the job_id field —
+        the rpc reuses the ExecuteQuery message pair)."""
+        import json
+
+        sid = self.ensure_session()
+        req = pb.ExecuteQueryParams(sql=sql, session_id=sid)
+        req.settings.extend(self._settings())
+        try:
+            resp = self.stub.PrepareStatement(req, timeout=30)
+        except grpc.RpcError as e:
+            raise GrpcError(f"PrepareStatement failed: {e}") from None
+        return json.loads(resp.job_id)
+
+    def execute_prepared(self, statement_id: str, params=None, job_name: str = "") -> str:
+        """ExecutePrepared rpc with overload cooperation (same backoff
+        contract as _submit); params travel JSON-encoded with type tags."""
+        import json
+
+        from ballista_tpu.serving.normalize import encode_params
+
+        sid = self.ensure_session()
+        body = {"statement_id": statement_id}
+        if params is not None:
+            body["params"] = encode_params(params)
+        req = pb.ExecuteQueryParams(sql=json.dumps(body), session_id=sid, job_name=job_name)
+        retries = int(self.config.get(CLIENT_SUBMIT_RETRIES))
+        for attempt in range(retries + 1):
+            try:
+                return self.stub.ExecutePrepared(req, timeout=30).job_id
+            except grpc.RpcError as e:
+                code = e.code() if hasattr(e, "code") else None
+                if code == grpc.StatusCode.RESOURCE_EXHAUSTED:
+                    hint = _retry_after_ms(e)
+                    if attempt >= retries:
+                        raise ClusterOverloaded(
+                            f"prepared execution rejected after {retries} retries: "
+                            f"{e.details() if hasattr(e, 'details') else e}",
+                            retry_after_ms=hint or 1000,
+                        ) from None
+                    self.submit_retries += 1
+                    time.sleep(self._backoff_s(attempt, hint))
+                    continue
+                if code in _TRANSIENT and attempt < retries:
+                    time.sleep(self._backoff_s(attempt))
+                    continue
+                raise GrpcError(f"ExecutePrepared failed: {e}") from None
 
     def cancel_job(self, job_id: str) -> None:
         self.stub.CancelJob(pb.CancelJobParams(job_id=job_id), timeout=10)

@@ -66,6 +66,17 @@ ADMISSION_DRAIN_DEPTH = "ballista.admission.drain.queue.depth"
 ADMISSION_SHED_LOOP_LAG_S = "ballista.admission.shed.loop.lag.seconds"
 ADMISSION_SHED_MEMORY_PRESSURE = "ballista.admission.shed.memory.pressure"
 ADMISSION_MIN_RETRY_AFTER_MS = "ballista.admission.min.retry.after.ms"
+ADMISSION_INTERACTIVE_MAX_PENDING = "ballista.admission.interactive.max.pending.jobs"
+# high-QPS serving tier: plan cache / prepared statements / result cache /
+# short-query fast lane
+SERVING_PLAN_CACHE = "ballista.serving.plan.cache.enabled"
+SERVING_PLAN_CACHE_ENTRIES = "ballista.serving.plan.cache.max.entries"
+SERVING_RESULT_CACHE = "ballista.serving.result.cache.enabled"
+SERVING_RESULT_CACHE_ENTRIES = "ballista.serving.result.cache.max.entries"
+SERVING_RESULT_CACHE_BYTES = "ballista.serving.result.cache.max.bytes"
+SERVING_RESULT_MAX_BYTES = "ballista.serving.result.cache.max.result.bytes"
+SERVING_FAST_LANE = "ballista.serving.fast.lane.enabled"
+SERVING_FAST_LANE_TIMEOUT_S = "ballista.serving.fast.lane.timeout.seconds"
 # overload protection: Flight data plane
 FLIGHT_MAX_STREAMS = "ballista.flight.max.streams"
 FLIGHT_ACCEPT_QUEUE = "ballista.flight.accept.queue.depth"
@@ -332,6 +343,71 @@ _ENTRIES: list[ConfigEntry] = [
         "rejections (the drain-rate estimate can be optimistic right after a "
         "burst).",
         int, 100, _nonneg,
+    ),
+    ConfigEntry(
+        ADMISSION_INTERACTIVE_MAX_PENDING,
+        "Per-lane admission: max in-flight jobs in the interactive lane (plan-"
+        "cache hits known to be single-stage, prepared executions). The batch "
+        "lane keeps the global max-pending cap; shedding/draining degrade the "
+        "batch lane first so short repeat queries survive a batch overload. "
+        "Env: BALLISTA_ADMISSION_INTERACTIVE_MAX_PENDING.",
+        int, _env_int("BALLISTA_ADMISSION_INTERACTIVE_MAX_PENDING", 512), _pos,
+    ),
+    ConfigEntry(
+        SERVING_PLAN_CACHE,
+        "Serving tier: cache physical-plan templates keyed on the normalized "
+        "optimized logical plan (literals lifted to parameters) plus the session "
+        "config fingerprint. Repeats of a query shape skip physical planning; "
+        "exact-text repeats also skip parsing and optimization. "
+        "Env escape hatch: BALLISTA_SERVING_PLAN_CACHE=0.",
+        bool, _env_bool("BALLISTA_SERVING_PLAN_CACHE", True),
+    ),
+    ConfigEntry(
+        SERVING_PLAN_CACHE_ENTRIES,
+        "Plan-template cache entry cap (LRU). The exact-text L1 cache holds 4x "
+        "this many entries. Env: BALLISTA_SERVING_PLAN_ENTRIES.",
+        int, _env_int("BALLISTA_SERVING_PLAN_ENTRIES", 256), _pos,
+    ),
+    ConfigEntry(
+        SERVING_RESULT_CACHE,
+        "Serving tier: cache final result tables keyed on (normalized plan, "
+        "bound parameters, table versions); any re-registration of a referenced "
+        "table invalidates by version bump. Results are served inline to "
+        "in-process clients only. Off by default: it changes freshness "
+        "semantics. Env escape hatch: BALLISTA_SERVING_RESULT_CACHE=1.",
+        bool, _env_bool("BALLISTA_SERVING_RESULT_CACHE", False),
+    ),
+    ConfigEntry(
+        SERVING_RESULT_CACHE_ENTRIES,
+        "Result cache entry cap (LRU). Env: BALLISTA_SERVING_RESULT_ENTRIES.",
+        int, _env_int("BALLISTA_SERVING_RESULT_ENTRIES", 512), _pos,
+    ),
+    ConfigEntry(
+        SERVING_RESULT_CACHE_BYTES,
+        "Result cache byte budget across all cached tables (LRU evicts past "
+        "it). Env: BALLISTA_SERVING_RESULT_BYTES.",
+        int, _env_int("BALLISTA_SERVING_RESULT_BYTES", 64 * 1024 * 1024), _pos,
+    ),
+    ConfigEntry(
+        SERVING_RESULT_MAX_BYTES,
+        "Largest single result the cache will hold; bigger results are never "
+        "cached (they would evict many small interactive results).",
+        int, _env_int("BALLISTA_SERVING_RESULT_MAX_RESULT_BYTES", 4 * 1024 * 1024), _pos,
+    ),
+    ConfigEntry(
+        SERVING_FAST_LANE,
+        "Serving tier: dispatch single-stage plans straight to warm executors "
+        "from the submit path, bypassing the execution-graph/event-loop "
+        "machinery; failures and timeouts fall back to the full DAG path. "
+        "Env escape hatch: BALLISTA_SERVING_FAST_LANE=0.",
+        bool, _env_bool("BALLISTA_SERVING_FAST_LANE", True),
+    ),
+    ConfigEntry(
+        SERVING_FAST_LANE_TIMEOUT_S,
+        "Seconds a fast-lane job may run before the straggler sweep demotes it "
+        "to the full DAG path (covers executors lost mid-flight, which fast "
+        "jobs otherwise would not notice).",
+        float, 30.0, _pos,
     ),
     ConfigEntry(
         FLIGHT_MAX_STREAMS,

@@ -103,6 +103,9 @@ class Executor:
         self._lock = threading.Lock()
         self.tasks_run = 0
         self.tasks_failed = 0
+        # serving tier: tasks dispatched on the short-query fast lane
+        # (single-stage, no execution graph); reported in heartbeats
+        self.fast_lane_tasks = 0
         # tasks turned away at admission because the session pool was
         # already saturated (reported in heartbeats; scheduler retries
         # them elsewhere)
@@ -143,6 +146,8 @@ class Executor:
         process isolation via ballista.executor.task.isolation (strictly
         safer than threads); it cannot opt a daemon out of it."""
         cfg = config or self.default_config
+        if getattr(task, "fast_lane", False):
+            self.fast_lane_tasks += 1
         rejected = self._reject_if_saturated(task)
         if rejected is not None:
             return rejected

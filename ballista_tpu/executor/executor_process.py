@@ -294,6 +294,8 @@ class ExecutorProcess:
             ("pool_overcommitted_bytes", float(pools.total_overcommitted()) if pools else 0.0),
             ("pressure_rejections", float(self.executor.pressure_rejections)),
             ("queued_tasks", float(self.service._queue.qsize())),
+            # serving tier: fast-lane dispatches seen by this executor
+            ("fast_lane_tasks", float(self.executor.fast_lane_tasks)),
             # shuffle-integrity counters (reader-side verification outcomes)
             ("checksum_failures", float(integrity["checksum_failures"])),
             ("corruption_retries", float(integrity["corruption_retries"])),

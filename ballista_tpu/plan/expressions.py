@@ -115,6 +115,10 @@ def col(name: str) -> Column:
 @dataclass(frozen=True)
 class Literal(Expr):
     value: Any
+    # parameter slot assigned by the serving tier's plan normalizer
+    # (serving/normalize.py). Excluded from equality/repr so tagged plans
+    # stay indistinguishable from untagged ones everywhere else.
+    param: int | None = field(default=None, compare=False, repr=False)
 
     def data_type(self, schema: DFSchema) -> pa.DataType:
         return literal_type(self.value)
@@ -744,7 +748,9 @@ def transform_expr(e: Expr, fn) -> Expr:
     kids = e.children()
     if kids:
         new_kids = [transform_expr(k, fn) for k in kids]
-        if new_kids != kids:
+        # identity, not equality: rewrites may swap in nodes that compare
+        # equal to the originals (e.g. Literal carries non-compared metadata)
+        if any(a is not b for a, b in zip(new_kids, kids)):
             e = e.with_children(new_kids)
     return fn(e)
 

@@ -38,6 +38,7 @@ from collections import deque
 from ballista_tpu.config import (
     ADMISSION_DRAIN_DEPTH,
     ADMISSION_ENABLED,
+    ADMISSION_INTERACTIVE_MAX_PENDING,
     ADMISSION_MAX_INFLIGHT_PER_SESSION,
     ADMISSION_MAX_PENDING_JOBS,
     ADMISSION_MIN_RETRY_AFTER_MS,
@@ -51,6 +52,12 @@ from ballista_tpu.errors import ClusterOverloaded
 NORMAL = "normal"
 SHEDDING = "shedding"
 DRAINING = "draining"
+
+# admission lanes (serving tier): interactive = known-short repeat work
+# (single-stage plan-cache hits, prepared executions); batch = everything
+# else. Overload postures degrade the batch lane first.
+LANE_BATCH = "batch"
+LANE_INTERACTIVE = "interactive"
 
 # drain-rate estimation window: recent finishes only, so the hint tracks
 # the cluster's *current* throughput, not its lifetime average
@@ -73,7 +80,8 @@ class AdmissionController:
                  drain_depth: int | None = None,
                  shed_loop_lag_s: float | None = None,
                  shed_memory_pressure: float | None = None,
-                 min_retry_after_ms: int | None = None):
+                 min_retry_after_ms: int | None = None,
+                 interactive_max_pending: int | None = None):
         defaults = BallistaConfig()
         self.enabled = bool(defaults.get(ADMISSION_ENABLED)) if enabled is None else enabled
         self.max_pending = int(defaults.get(ADMISSION_MAX_PENDING_JOBS)) if max_pending is None else max_pending
@@ -87,9 +95,18 @@ class AdmissionController:
                                      if shed_memory_pressure is None else shed_memory_pressure)
         self.min_retry_after_ms = (int(defaults.get(ADMISSION_MIN_RETRY_AFTER_MS))
                                    if min_retry_after_ms is None else min_retry_after_ms)
+        self.interactive_max_pending = (int(defaults.get(ADMISSION_INTERACTIVE_MAX_PENDING))
+                                        if interactive_max_pending is None
+                                        else interactive_max_pending)
         self._lock = threading.Lock()
         self._inflight: dict[str, str] = {}  # job_id -> session_id
         self._per_session: dict[str, int] = {}
+        # per-lane bookkeeping (serving tier): shedding and draining are
+        # evaluated per lane so interactive traffic survives batch overload
+        self._job_lane: dict[str, str] = {}  # job_id -> lane
+        self._lane_inflight: dict[str, int] = {}
+        self._lane_admitted: dict[str, int] = {}
+        self._lane_shed: dict[str, int] = {}
         self._finishes: deque[float] = deque(maxlen=_DRAIN_SAMPLES)
         self._state = NORMAL
         self._rejected = 0
@@ -99,50 +116,85 @@ class AdmissionController:
 
     # -- admission ---------------------------------------------------------
 
-    def admit(self, session_id: str, job_id: str) -> None:
+    def admit(self, session_id: str, job_id: str, lane: str = LANE_BATCH) -> None:
         """Claim an admission slot for `job_id` or raise ClusterOverloaded.
 
         Raising means NO state was recorded: the caller must not create
-        the job."""
+        the job. Shedding is per lane: the batch lane carries the original
+        posture semantics untouched, while the interactive lane (serving
+        tier: known-short repeat queries) only sheds against its own depth
+        cap — halved while draining — so short queries keep flowing when a
+        batch backlog trips the state machine."""
         if not self.enabled:
             with self._lock:
-                self._inflight[job_id] = session_id
-                self._per_session[session_id] = self._per_session.get(session_id, 0) + 1
+                self._record_admit_locked(session_id, job_id, lane)
             return
         with self._lock:
             depth = len(self._inflight)
             state = self._state
+            used = self._per_session.get(session_id, 0)
+            if lane == LANE_INTERACTIVE:
+                cap = self.interactive_max_pending
+                if state == DRAINING:
+                    cap = max(1, cap // 2)
+                lane_depth = self._lane_inflight.get(lane, 0)
+                if lane_depth >= cap:
+                    self._shed_locked(
+                        lane, "draining" if state == DRAINING else "depth",
+                        f"interactive lane has {lane_depth} jobs in flight "
+                        f"(cap {cap}{' while draining' if state == DRAINING else ''})",
+                        lane_depth - cap + 1)
+                if used >= self.per_session_quota:
+                    self._shed_locked(
+                        lane, "quota",
+                        f"session {session_id} has {used} jobs in flight "
+                        f"(quota {self.per_session_quota})",
+                        used - self.per_session_quota + 1)
+                self._record_admit_locked(session_id, job_id, lane)
+                return
             if state == DRAINING:
-                self._rejected += 1
-                raise ClusterOverloaded(
+                self._shed_locked(
+                    lane, "draining",
                     f"cluster is draining (depth={depth} >= {self.drain_depth}); "
                     "rejecting all new work until the backlog clears",
-                    retry_after_ms=self._retry_after_ms_locked(max(1, depth - self.shed_depth)),
-                    reason="draining",
-                )
+                    max(1, depth - self.shed_depth))
             quota = self.per_session_quota
             if state == SHEDDING:
                 # graceful degradation: shedding halves every tenant's quota
                 # instead of rejecting everyone outright
                 quota = max(1, quota // 2)
-            used = self._per_session.get(session_id, 0)
             if used >= quota:
-                self._rejected += 1
-                raise ClusterOverloaded(
+                self._shed_locked(
+                    lane, "shedding" if state == SHEDDING else "quota",
                     f"session {session_id} has {used} jobs in flight "
                     f"(quota {quota}{' while shedding' if state == SHEDDING else ''})",
-                    retry_after_ms=self._retry_after_ms_locked(used - quota + 1),
-                    reason="shedding" if state == SHEDDING else "quota",
-                )
+                    used - quota + 1)
             if depth >= self.max_pending:
-                self._rejected += 1
-                raise ClusterOverloaded(
+                self._shed_locked(
+                    lane, "depth",
                     f"cluster has {depth} jobs in flight (max pending {self.max_pending})",
-                    retry_after_ms=self._retry_after_ms_locked(depth - self.max_pending + 1),
-                    reason="depth",
-                )
-            self._inflight[job_id] = session_id
-            self._per_session[session_id] = used + 1
+                    depth - self.max_pending + 1)
+            self._record_admit_locked(session_id, job_id, lane)
+
+    def _record_admit_locked(self, session_id: str, job_id: str, lane: str) -> None:
+        self._inflight[job_id] = session_id
+        self._per_session[session_id] = self._per_session.get(session_id, 0) + 1
+        self._job_lane[job_id] = lane
+        self._lane_inflight[lane] = self._lane_inflight.get(lane, 0) + 1
+        self._lane_admitted[lane] = self._lane_admitted.get(lane, 0) + 1
+
+    def _shed_locked(self, lane: str, reason: str, msg: str, excess: int) -> None:
+        self._rejected += 1
+        self._lane_shed[lane] = self._lane_shed.get(lane, 0) + 1
+        raise ClusterOverloaded(
+            msg,
+            retry_after_ms=self._retry_after_ms_locked(max(1, excess)),
+            reason=reason,
+        )
+
+    def lane_of(self, job_id: str) -> str | None:
+        with self._lock:
+            return self._job_lane.get(job_id)
 
     def finish(self, job_id: str) -> None:
         """Release `job_id`'s admission slot (idempotent — terminal events
@@ -156,6 +208,12 @@ class AdmissionController:
                 self._per_session.pop(session_id, None)
             else:
                 self._per_session[session_id] = n
+            lane = self._job_lane.pop(job_id, LANE_BATCH)
+            ln = self._lane_inflight.get(lane, 0) - 1
+            if ln <= 0:
+                self._lane_inflight.pop(lane, None)
+            else:
+                self._lane_inflight[lane] = ln
             self._finishes.append(time.monotonic())
 
     # -- overload state machine --------------------------------------------
@@ -234,4 +292,14 @@ class AdmissionController:
                 "loop_lag_s": round(self._last_loop_lag_s, 3),
                 "memory_pressure": round(self._last_memory_pressure, 3),
                 "retry_after_ms": self._retry_after_ms_locked(1),
+                "lanes": {
+                    lane: {
+                        "inflight": self._lane_inflight.get(lane, 0),
+                        "admitted_total": self._lane_admitted.get(lane, 0),
+                        "shed_total": self._lane_shed.get(lane, 0),
+                        "cap": (self.interactive_max_pending
+                                if lane == LANE_INTERACTIVE else self.max_pending),
+                    }
+                    for lane in (LANE_BATCH, LANE_INTERACTIVE)
+                },
             }

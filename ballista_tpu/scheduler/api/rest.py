@@ -91,9 +91,12 @@ def start_rest_api(scheduler: SchedulerServer, metrics: InMemoryMetricsCollector
                     "jobs": jobs,
                     "flight_proxy_port": getattr(scheduler, "flight_proxy_port", 0),
                     # overload posture: state machine + admission gauges
+                    # (per-lane inflight/shed counts live under "lanes")
                     "overload": scheduler.admission.snapshot(),
                     "aggregate_memory_pressure": round(
                         scheduler.executors.aggregate_pressure(), 4),
+                    # serving tier: plan/result cache hit rates + fast lane
+                    "serving": scheduler.serving.snapshot(),
                 })
             if p == "/api/executors":
                 out = []
@@ -114,6 +117,7 @@ def start_rest_api(scheduler: SchedulerServer, metrics: InMemoryMetricsCollector
                 for o in out:
                     o.pop("partitions", None)
                     o.pop("schema", None)
+                    o.pop("inline_result", None)  # pa.Table; not JSON
                 return self._json(out)
             m = re.match(r"^/api/job/([^/]+)$", p)
             if m:
@@ -122,6 +126,7 @@ def start_rest_api(scheduler: SchedulerServer, metrics: InMemoryMetricsCollector
                     return self._json({"error": "not found"}, 404)
                 st.pop("partitions", None)
                 st.pop("schema", None)
+                st.pop("inline_result", None)
                 return self._json(st)
             m = re.match(r"^/api/job/([^/]+)/stages$", p)
             if m:

@@ -66,6 +66,46 @@ class SchedulerGrpcService:
         context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED,
                       f"{e} [retry_after_ms={e.retry_after_ms}]")
 
+    def PrepareStatement(self, request: pb.ExecuteQueryParams, context) -> pb.ExecuteQueryResult:
+        """Server-side prepare: parse/optimize/plan once, return the
+        statement handle. Reuses the ExecuteQuery message pair (no protoc
+        in this environment): sql carries the statement text, and the
+        response's job_id field carries a JSON handle
+        {statement_id, num_params, type_tags}."""
+        import json
+
+        session_id = request.session_id or self.scheduler.sessions.create_or_update(
+            [(kv.key, kv.value) for kv in request.settings]
+        )
+        if request.settings and request.session_id:
+            self.scheduler.sessions.create_or_update(
+                [(kv.key, kv.value) for kv in request.settings], session_id
+            )
+        try:
+            handle = self.scheduler.prepare_statement(request.sql, session_id)
+        except BallistaError as e:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+        return pb.ExecuteQueryResult(job_id=json.dumps(handle), session_id=session_id)
+
+    def ExecutePrepared(self, request: pb.ExecuteQueryParams, context) -> pb.ExecuteQueryResult:
+        """Execute a prepared statement with bound parameters. The sql
+        field carries JSON {statement_id, params} with params encoded by
+        serving.encode_params (dates/decimals ride with type tags)."""
+        import json
+
+        from ballista_tpu.serving.normalize import decode_params
+
+        body = json.loads(request.sql)
+        params = decode_params(body["params"]) if body.get("params") else None
+        try:
+            job_id = self.scheduler.execute_prepared(
+                body["statement_id"], params, request.session_id, request.job_name)
+        except ClusterOverloaded as e:
+            self._abort_overloaded(context, e)
+        except BallistaError as e:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+        return pb.ExecuteQueryResult(job_id=job_id, session_id=request.session_id)
+
     def GetJobStatus(self, request: pb.GetJobStatusParams, context) -> pb.GetJobStatusResult:
         status = self.scheduler.job_status(request.job_id)
         out = pb.GetJobStatusResult()
@@ -172,6 +212,10 @@ class SchedulerGrpcService:
 
 _RPCS = {
     "ExecuteQuery": (pb.ExecuteQueryParams, pb.ExecuteQueryResult),
+    # prepared statements reuse the ExecuteQuery message pair (no protoc
+    # here): handles/params travel as JSON in the sql/job_id string fields
+    "PrepareStatement": (pb.ExecuteQueryParams, pb.ExecuteQueryResult),
+    "ExecutePrepared": (pb.ExecuteQueryParams, pb.ExecuteQueryResult),
     "GetJobStatus": (pb.GetJobStatusParams, pb.GetJobStatusResult),
     "CreateUpdateSession": (pb.CreateSessionParams, pb.CreateSessionResult),
     "RemoveSession": (pb.RemoveSessionParams, pb.RemoveSessionResult),

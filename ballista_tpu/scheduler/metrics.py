@@ -34,11 +34,21 @@ class SchedulerMetricsCollector:
 
     def set_quarantined_executors(self, n: int) -> None: ...
 
-    def record_job_rejected(self, reason: str) -> None: ...
+    def record_job_rejected(self, reason: str, lane: str = "batch") -> None: ...
 
     def set_overload_state(self, state: str) -> None: ...
 
     def record_pressure_rejection(self, executor_id: str) -> None: ...
+
+    # -- serving tier (plan/result caches, fast lane, lanes) ---------------
+
+    def record_plan_cache(self, hit: bool) -> None: ...
+
+    def record_result_cache(self, hit: bool) -> None: ...
+
+    def record_fast_lane(self, outcome: str) -> None: ...
+
+    def record_lane_admitted(self, lane: str) -> None: ...
 
 
 class NoopMetricsCollector(SchedulerMetricsCollector):
@@ -91,8 +101,16 @@ class InMemoryMetricsCollector(SchedulerMetricsCollector):
         self.quarantined_executors = 0
         # overload protection: rejections by reason + current posture
         self.jobs_rejected: dict[str, int] = {}
+        self.jobs_rejected_by_lane: dict[str, int] = {}
         self.overload_state = "normal"
         self.pressure_rejections = 0
+        # serving tier: cache outcomes, fast-lane outcomes, lane admissions
+        self.plan_cache_hits = 0
+        self.plan_cache_misses = 0
+        self.result_cache_hits = 0
+        self.result_cache_misses = 0
+        self.fast_lane: dict[str, int] = {}  # executed | fallback
+        self.lane_admitted: dict[str, int] = {}
         self.exec_hist = _Histogram(_LATENCY_BUCKETS)
         self.plan_hist = _Histogram(_PLANNING_BUCKETS)
 
@@ -137,9 +155,32 @@ class InMemoryMetricsCollector(SchedulerMetricsCollector):
         with self._lock:
             self.quarantined_executors = n
 
-    def record_job_rejected(self, reason: str) -> None:
+    def record_job_rejected(self, reason: str, lane: str = "batch") -> None:
         with self._lock:
             self.jobs_rejected[reason] = self.jobs_rejected.get(reason, 0) + 1
+            self.jobs_rejected_by_lane[lane] = self.jobs_rejected_by_lane.get(lane, 0) + 1
+
+    def record_plan_cache(self, hit: bool) -> None:
+        with self._lock:
+            if hit:
+                self.plan_cache_hits += 1
+            else:
+                self.plan_cache_misses += 1
+
+    def record_result_cache(self, hit: bool) -> None:
+        with self._lock:
+            if hit:
+                self.result_cache_hits += 1
+            else:
+                self.result_cache_misses += 1
+
+    def record_fast_lane(self, outcome: str) -> None:
+        with self._lock:
+            self.fast_lane[outcome] = self.fast_lane.get(outcome, 0) + 1
+
+    def record_lane_admitted(self, lane: str) -> None:
+        with self._lock:
+            self.lane_admitted[lane] = self.lane_admitted.get(lane, 0) + 1
 
     def set_overload_state(self, state: str) -> None:
         with self._lock:
@@ -176,6 +217,26 @@ class InMemoryMetricsCollector(SchedulerMetricsCollector):
             lines.append("# TYPE ballista_scheduler_jobs_rejected_total counter")
             for reason in sorted(self.jobs_rejected):
                 lines.append(f'ballista_scheduler_jobs_rejected_total{{reason="{reason}"}} {self.jobs_rejected[reason]}')
+            lines.append("# HELP ballista_scheduler_jobs_rejected_by_lane_total Jobs shed by admission control, by lane")
+            lines.append("# TYPE ballista_scheduler_jobs_rejected_by_lane_total counter")
+            for lane in sorted(self.jobs_rejected_by_lane):
+                lines.append(f'ballista_scheduler_jobs_rejected_by_lane_total{{lane="{lane}"}} {self.jobs_rejected_by_lane[lane]}')
+            lines.append("# HELP ballista_scheduler_jobs_admitted_by_lane_total Jobs admitted, by lane")
+            lines.append("# TYPE ballista_scheduler_jobs_admitted_by_lane_total counter")
+            for lane in sorted(self.lane_admitted):
+                lines.append(f'ballista_scheduler_jobs_admitted_by_lane_total{{lane="{lane}"}} {self.lane_admitted[lane]}')
+            lines.append("# HELP ballista_scheduler_plan_cache_total Serving plan-cache lookups, by outcome")
+            lines.append("# TYPE ballista_scheduler_plan_cache_total counter")
+            lines.append(f'ballista_scheduler_plan_cache_total{{outcome="hit"}} {self.plan_cache_hits}')
+            lines.append(f'ballista_scheduler_plan_cache_total{{outcome="miss"}} {self.plan_cache_misses}')
+            lines.append("# HELP ballista_scheduler_result_cache_total Serving result-cache lookups, by outcome")
+            lines.append("# TYPE ballista_scheduler_result_cache_total counter")
+            lines.append(f'ballista_scheduler_result_cache_total{{outcome="hit"}} {self.result_cache_hits}')
+            lines.append(f'ballista_scheduler_result_cache_total{{outcome="miss"}} {self.result_cache_misses}')
+            lines.append("# HELP ballista_scheduler_fast_lane_total Fast-lane dispatches, by outcome")
+            lines.append("# TYPE ballista_scheduler_fast_lane_total counter")
+            for outcome in sorted(self.fast_lane):
+                lines.append(f'ballista_scheduler_fast_lane_total{{outcome="{outcome}"}} {self.fast_lane[outcome]}')
             lines.append("# HELP ballista_scheduler_overload_state Overload posture (0=normal 1=shedding 2=draining)")
             lines.append("# TYPE ballista_scheduler_overload_state gauge")
             state_code = {"normal": 0, "shedding": 1, "draining": 2}.get(self.overload_state, 0)

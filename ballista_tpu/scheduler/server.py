@@ -24,11 +24,17 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
-from ballista_tpu.config import BallistaConfig
-from ballista_tpu.errors import BallistaError, ClusterOverloaded
+from ballista_tpu.config import (
+    SERVING_FAST_LANE,
+    SERVING_FAST_LANE_TIMEOUT_S,
+    SERVING_PLAN_CACHE,
+    SERVING_RESULT_CACHE,
+    BallistaConfig,
+)
+from ballista_tpu.errors import BallistaError, ClusterOverloaded, PlanningError
 from ballista_tpu.executor.executor import ExecutorMetadata, TaskResult
 from ballista_tpu.ids import JobId, new_job_id
-from ballista_tpu.scheduler.admission import AdmissionController
+from ballista_tpu.scheduler.admission import LANE_BATCH, LANE_INTERACTIVE, AdmissionController
 from ballista_tpu.scheduler.metrics import NoopMetricsCollector, SchedulerMetricsCollector
 from ballista_tpu.scheduler.planner import DistributedPlanner
 from ballista_tpu.scheduler.state.execution_graph import (
@@ -38,6 +44,15 @@ from ballista_tpu.scheduler.state.execution_graph import (
 )
 from ballista_tpu.scheduler.state.executor_manager import ExecutorManager
 from ballista_tpu.scheduler.state.session_manager import SessionManager
+from ballista_tpu.serving.fast_lane import FAST_TASK_ID_BASE, FastJob
+from ballista_tpu.serving.normalize import (
+    bind_logical,
+    bind_physical,
+    collect_physical_params,
+    config_fingerprint,
+    lift_parameters,
+)
+from ballista_tpu.serving.tier import PlanTemplate, PreparedStatement, ServingTier
 
 log = logging.getLogger(__name__)
 
@@ -107,6 +122,14 @@ class SchedulerServer:
         self._running = False
         self._loop_thread: threading.Thread | None = None
         self._watchers: dict[str, list[threading.Event]] = {}
+        # serving tier: plan/result caches + fast-lane jobs executing
+        # outside the execution-graph machinery (keyed by job_id)
+        self.serving = ServingTier()
+        self._fast_jobs: dict[str, FastJob] = {}
+        # graph jobs whose results should fill a result-cache slot on finish
+        self._rc_pending: dict[str, tuple] = {}
+        # catalog changes orphan the table's cached results
+        self.sessions.on_catalog_change = self.serving.table_versions.bump
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -173,28 +196,307 @@ class SchedulerServer:
 
     # -- job submission --------------------------------------------------------
 
-    def _admit_or_shed(self, session_id: str, job_id: str) -> None:
+    def _admit_or_shed(self, session_id: str, job_id: str, lane: str = LANE_BATCH) -> None:
         """Admission gate in front of every submit path. A rejection
         happens BEFORE any job state exists, so a shed submission costs
-        one dict lookup — the whole point of admission control."""
+        one dict lookup — the whole point of admission control. Lanes shed
+        independently: interactive (fast-lane) traffic has its own cap and
+        keeps flowing while batch drains, and vice versa."""
         try:
-            self.admission.admit(session_id, job_id)
+            self.admission.admit(session_id, job_id, lane=lane)
         except ClusterOverloaded as e:
-            self.metrics.record_job_rejected(e.reason)
-            log.warning("shed job %s from session %s (%s, retry_after=%dms)",
-                        job_id, session_id, e.reason, e.retry_after_ms)
+            self.metrics.record_job_rejected(e.reason, lane=lane)
+            log.warning("shed %s-lane job %s from session %s (%s, retry_after=%dms)",
+                        lane, job_id, session_id, e.reason, e.retry_after_ms)
             raise
+        self.metrics.record_lane_admitted(lane)
 
-    def submit_sql(self, sql: str, session_id: str, job_name: str = "") -> str:
-        job_id = str(new_job_id())
-        self._admit_or_shed(session_id, job_id)
+    def submit_sql(self, sql: str, session_id: str, job_name: str = "",
+                   inline_results: bool = False) -> str:
+        """SQL entry point. With the serving tier enabled, planning runs
+        synchronously on the submit thread through the plan cache (a hit
+        skips parse+optimize+physical planning entirely); single-stage
+        plans then dispatch on the fast lane without ever touching the
+        event loop. `inline_results` marks an in-process caller that can
+        accept a result table in the status dict (result-cache hits)."""
+        cfg = self.sessions.get(session_id) or BallistaConfig()
+        if not bool(cfg.get(SERVING_PLAN_CACHE)):
+            job_id = str(new_job_id())
+            self._admit_or_shed(session_id, job_id)
+            with self._jobs_lock:
+                self.jobs[job_id] = ExecutionGraph(job_id, job_name, session_id, [],
+                                                   self.sessions.get(session_id))
+                self.jobs[job_id].status = JobState.QUEUED
+            self.metrics.record_submitted(job_id)
+            self.post(Event("job_queued", (job_id, "sql", sql, session_id)))
+            return job_id
+        return self._submit_serving(sql, session_id, job_name, cfg, inline_results)
+
+    def _enqueue_legacy_sql(self, job_id: str, sql: str, session_id: str,
+                            job_name: str) -> str:
         with self._jobs_lock:
             self.jobs[job_id] = ExecutionGraph(job_id, job_name, session_id, [],
                                                self.sessions.get(session_id))
             self.jobs[job_id].status = JobState.QUEUED
-        self.metrics.record_submitted(job_id)
         self.post(Event("job_queued", (job_id, "sql", sql, session_id)))
         return job_id
+
+    def _submit_serving(self, sql: str, session_id: str, job_name: str,
+                        cfg: BallistaConfig, inline_results: bool) -> str:
+        from ballista_tpu.engine.physical_planner import PhysicalPlanner
+        from ballista_tpu.sql.ast import CreateExternalTable, DropTable, SelectStmt
+        from ballista_tpu.sql.optimizer import optimize
+        from ballista_tpu.sql.parser import parse_sql
+        from ballista_tpu.sql.planner import SqlPlanner
+
+        cfg_fp = config_fingerprint(cfg)
+        hit = self.serving.lookup_text(sql, cfg_fp)
+        job_id = str(new_job_id())
+        # lane choice must precede admission; only a cache hit knows the
+        # stage count up front, so first-time shapes ride the batch lane
+        lane = LANE_BATCH
+        if hit is not None and hit[2].single_stage:
+            lane = LANE_INTERACTIVE
+        self._admit_or_shed(session_id, job_id, lane=lane)
+        self.metrics.record_submitted(job_id)
+        t0 = time.time()
+        try:
+            if hit is not None:
+                key, values, template = hit
+                self.metrics.record_plan_cache(True)
+                template.hits += 1
+            else:
+                stmt = parse_sql(sql)
+                if not isinstance(stmt, SelectStmt):
+                    # DDL / utility statements take the legacy queued path
+                    # (the planning context handles them); catalog-visible
+                    # DDL orphans the table's cached results
+                    if isinstance(stmt, (CreateExternalTable, DropTable)):
+                        self.serving.table_versions.bump(stmt.name.lower())
+                    return self._enqueue_legacy_sql(job_id, sql, session_id, job_name)
+                ctx = self.sessions.create_planning_context(session_id)
+                optimized = optimize(SqlPlanner(ctx.catalog).plan_query(stmt))
+                lift = lift_parameters(optimized)
+                if not lift.cacheable:
+                    self.serving.note_uncacheable()
+                    log.debug("job %s uncacheable (%s); planning directly", job_id, lift.reason)
+                    physical = PhysicalPlanner(cfg).plan(optimized)
+                    self.metrics.record_planning_ms(job_id, (time.time() - t0) * 1000)
+                    return self._dispatch_serving(job_id, job_name, session_id, cfg,
+                                                  physical, None, (), inline_results)
+                key = f"{lift.key}:{cfg_fp}"
+                values = lift.values
+                template = self.serving.lookup_template(key, values)
+                self.metrics.record_plan_cache(template is not None)
+                if template is None:
+                    tagged_physical = PhysicalPlanner(cfg).plan(lift.tagged)
+                    bindable = set(range(len(values))) <= collect_physical_params(tagged_physical)
+                    template = PlanTemplate(key=key, physical=tagged_physical,
+                                            type_tags=lift.type_tags, values=values,
+                                            tables=lift.tables, bindable=bindable)
+                    self.serving.store_template(template)
+                self.serving.remember_text(sql, cfg_fp, key, values)
+            if (bool(cfg.get(SERVING_RESULT_CACHE)) and inline_results):
+                rkey = self.serving.result_key(template.key, values, template.tables)
+                cached = self.serving.lookup_result(rkey)
+                self.metrics.record_result_cache(cached is not None)
+                if cached is not None:
+                    job = FastJob(job_id, job_name, session_id, cfg, inline_result=cached)
+                    with self._jobs_lock:
+                        self.jobs[job_id] = job
+                    self.metrics.record_completed(job_id, 0.0)
+                    self._notify(job_id)
+                    return job_id
+            else:
+                rkey = None
+            bound = bind_physical(template.physical, values)
+            self.metrics.record_planning_ms(job_id, (time.time() - t0) * 1000)
+            return self._dispatch_serving(job_id, job_name, session_id, cfg,
+                                          bound, template, values, inline_results,
+                                          rkey=rkey)
+        except BaseException as e:  # noqa: BLE001 — same contract as _plan_job
+            log.warning("serving submit failed for %s: %s", job_id, e, exc_info=True)
+            with self._jobs_lock:
+                g = ExecutionGraph(job_id, job_name, session_id, [], cfg)
+                g.status = JobState.FAILED
+                g.error = f"planning failed: {e}"
+                g.ended_at = time.time()
+                self.jobs[job_id] = g
+            self.metrics.record_failed(job_id)
+            self._notify(job_id)
+            return job_id
+
+    def _dispatch_serving(self, job_id: str, job_name: str, session_id: str,
+                          cfg: BallistaConfig, physical, template, values,
+                          inline_results: bool, rkey=None) -> str:
+        """Stage the bound plan and dispatch: fast lane for single-stage
+        plans with slots available, the ordinary execution graph otherwise."""
+        from ballista_tpu.scheduler.planner import merge_mesh_stages
+
+        stages = merge_mesh_stages(DistributedPlanner(job_id).plan_query_stages(physical), cfg)
+        if template is not None and template.single_stage is None:
+            template.single_stage = len(stages) == 1
+        if (len(stages) == 1 and self.launcher is not None
+                and bool(cfg.get(SERVING_FAST_LANE))
+                and self._try_fast_lane(job_id, job_name, session_id, cfg, stages, rkey)):
+            return job_id
+        graph = ExecutionGraph(job_id, job_name, session_id, stages, cfg)
+        with self._jobs_lock:
+            self.jobs[job_id] = graph
+            if rkey is not None:
+                self._rc_pending[job_id] = rkey
+        if self.job_state.acquire(job_id, self.scheduler_id):
+            self.job_state.save_graph(graph)
+        self.post(Event("revive"))
+        return job_id
+
+    def _try_fast_lane(self, job_id: str, job_name: str, session_id: str,
+                       cfg: BallistaConfig, stages, rkey) -> bool:
+        """Dispatch a single-stage job straight to warm executors from the
+        submit thread — no graph, no event-loop round trip. Declines (and
+        the caller falls back to the graph) unless every partition gets a
+        slot NOW: a partially-dispatched fast job would just be a worse
+        execution graph."""
+        stage = stages[0]
+        n = stage.partitions
+        reservations = self.executors.reserve_slots(n)
+        granted = sum(c for _, c in reservations)
+        if granted < n:
+            for executor_id, count in reservations:
+                self.executors.free_slot(executor_id, count)
+            return False
+        job = FastJob(job_id, job_name, session_id, cfg, stages=stages, rc_key=rkey)
+        with self._jobs_lock:
+            self.jobs[job_id] = job
+            self._fast_jobs[job_id] = job
+        parts = list(range(n))
+        i = 0
+        for executor_id, count in reservations:
+            chunk, i = parts[i:i + count], i + count
+            tasks = [TaskDescription(
+                job_id=job_id, stage_id=stage.stage_id, stage_attempt=0,
+                task_id=FAST_TASK_ID_BASE + p, partitions=[p], plan=stage.plan,
+                session_id=session_id, fast_lane=True,
+            ) for p in chunk]
+            if tasks:
+                self._spawn_launch(executor_id, tasks)
+        self.serving.note_fast_lane("executed")
+        self.metrics.record_fast_lane("executed")
+        return True
+
+    # -- prepared statements ---------------------------------------------------
+
+    def prepare_statement(self, sql: str, session_id: str) -> dict:
+        """Parse + optimize + physical-plan ONCE; later execute() calls
+        bind new parameter values into the cached template. Returns the
+        statement id and the slot signature (count + arrow types)."""
+        from ballista_tpu.engine.physical_planner import PhysicalPlanner
+        from ballista_tpu.sql.ast import SelectStmt
+        from ballista_tpu.sql.optimizer import optimize
+        from ballista_tpu.sql.parser import parse_sql
+        from ballista_tpu.sql.planner import SqlPlanner
+
+        cfg = self.sessions.get(session_id) or BallistaConfig()
+        stmt = parse_sql(sql)
+        if not isinstance(stmt, SelectStmt):
+            raise PlanningError("only SELECT statements can be prepared")
+        ctx = self.sessions.create_planning_context(session_id)
+        lift = lift_parameters(optimize(SqlPlanner(ctx.catalog).plan_query(stmt)))
+        if not lift.cacheable:
+            raise PlanningError(f"statement cannot be parameterized: {lift.reason}")
+        key = f"{lift.key}:{config_fingerprint(cfg)}"
+        if self.serving.plan_cache.get(key) is None:
+            physical = PhysicalPlanner(cfg).plan(lift.tagged)
+            bindable = set(range(len(lift.values))) <= collect_physical_params(physical)
+            self.serving.store_template(PlanTemplate(
+                key=key, physical=physical, type_tags=lift.type_tags,
+                values=lift.values, tables=lift.tables, bindable=bindable))
+        statement_id = f"stmt-{new_job_id()}"
+        self.serving.register_prepared(PreparedStatement(
+            statement_id, sql, session_id, key, lift.type_tags, lift.values))
+        return {"statement_id": statement_id,
+                "num_params": len(lift.values),
+                "type_tags": list(lift.type_tags)}
+
+    def execute_prepared(self, statement_id: str, params=None, session_id: str = "",
+                         job_name: str = "", inline_results: bool = False) -> str:
+        """Bind params into a prepared statement's template and dispatch.
+        Survives template eviction (re-plans from the retained SQL) and
+        non-bindable templates (binds at the logical level instead)."""
+        from ballista_tpu.engine.physical_planner import PhysicalPlanner
+        from ballista_tpu.sql.optimizer import optimize
+        from ballista_tpu.sql.parser import parse_sql
+        from ballista_tpu.sql.planner import SqlPlanner
+
+        stmt = self.serving.get_prepared(statement_id)
+        if stmt is None:
+            raise BallistaError(f"unknown prepared statement {statement_id}")
+        sid = session_id or stmt.session_id
+        cfg = self.sessions.get(sid) or BallistaConfig()
+        values = tuple(params) if params is not None else stmt.default_values
+        if len(values) != len(stmt.type_tags):
+            raise PlanningError(
+                f"statement {statement_id} takes {len(stmt.type_tags)} "
+                f"parameters, got {len(values)}")
+        job_id = str(new_job_id())
+        peek = self.serving.plan_cache.get(stmt.key)
+        lane = LANE_INTERACTIVE if (peek is not None and peek.single_stage) else LANE_BATCH
+        self._admit_or_shed(sid, job_id, lane=lane)
+        self.metrics.record_submitted(job_id)
+        t0 = time.time()
+        try:
+            template = self.serving.lookup_template(stmt.key, values)
+            self.metrics.record_plan_cache(template is not None)
+            if (bool(cfg.get(SERVING_RESULT_CACHE)) and inline_results
+                    and template is not None):
+                rkey = self.serving.result_key(stmt.key, values, template.tables)
+                cached = self.serving.lookup_result(rkey)
+                self.metrics.record_result_cache(cached is not None)
+                if cached is not None:
+                    job = FastJob(job_id, job_name, sid, cfg, inline_result=cached)
+                    with self._jobs_lock:
+                        self.jobs[job_id] = job
+                    self.metrics.record_completed(job_id, 0.0)
+                    self._notify(job_id)
+                    return job_id
+            else:
+                rkey = None
+            if template is not None:
+                bound = bind_physical(template.physical, values)
+            else:
+                # evicted, or non-bindable with new values: re-lift from
+                # the retained SQL and bind at the logical level
+                ctx = self.sessions.create_planning_context(sid)
+                lift = lift_parameters(optimize(
+                    SqlPlanner(ctx.catalog).plan_query(parse_sql(stmt.sql))))
+                if not lift.cacheable or len(lift.values) != len(values):
+                    raise PlanningError(
+                        f"statement {statement_id} no longer parameterizes "
+                        f"the same way ({lift.reason or 'slot count changed'})")
+                bound = PhysicalPlanner(cfg).plan(bind_logical(lift.tagged, values))
+                physical = PhysicalPlanner(cfg).plan(lift.tagged)
+                bindable = set(range(len(values))) <= collect_physical_params(physical)
+                template = PlanTemplate(
+                    key=stmt.key, physical=physical, type_tags=lift.type_tags,
+                    values=lift.values, tables=lift.tables, bindable=bindable)
+                self.serving.store_template(template)
+            self.metrics.record_planning_ms(job_id, (time.time() - t0) * 1000)
+            return self._dispatch_serving(job_id, job_name, sid, cfg, bound,
+                                          template, values, inline_results, rkey=rkey)
+        except BaseException as e:  # noqa: BLE001 — same contract as _plan_job
+            log.warning("execute_prepared failed for %s: %s", job_id, e, exc_info=True)
+            with self._jobs_lock:
+                g = ExecutionGraph(job_id, job_name, sid, [], cfg)
+                g.status = JobState.FAILED
+                g.error = f"planning failed: {e}"
+                g.ended_at = time.time()
+                self.jobs[job_id] = g
+            self.metrics.record_failed(job_id)
+            self._notify(job_id)
+            return job_id
+
+    def close_prepared(self, statement_id: str) -> None:
+        self.serving.close_prepared(statement_id)
 
     def submit_physical_plan(self, plan, session_id: str, job_name: str = "") -> str:
         job_id = str(new_job_id())
@@ -347,6 +649,10 @@ class SchedulerServer:
         if not self.executors.heartbeat(metadata.id):
             self.executors.register(metadata)
         if results:
+            fast, results = self._split_fast(results)
+            if fast:
+                self._fast_update(metadata.id, fast)
+        if results:
             # frees the ledger slots taken at handout below
             self._apply_task_updates(metadata.id, results, free_slots_managed=True)
         out: list[TaskDescription] = []
@@ -370,7 +676,93 @@ class SchedulerServer:
     # -- status ingestion ----------------------------------------------------------
 
     def update_task_status(self, executor_id: str, results: list[TaskResult]) -> None:
-        self.post(Event("task_update", (executor_id, results)))
+        fast, rest = self._split_fast(results)
+        if fast:
+            # fast-lane results complete on the reporting thread: the whole
+            # point of the lane is that short queries never wait behind the
+            # event-loop queue
+            self._fast_update(executor_id, fast)
+        if rest:
+            self.post(Event("task_update", (executor_id, rest)))
+
+    def _split_fast(self, results: list[TaskResult]) -> tuple[list, list]:
+        with self._jobs_lock:
+            fast_ids = set(self._fast_jobs)
+        fast = [r for r in results if r.job_id in fast_ids]
+        rest = [r for r in results if r.job_id not in fast_ids]
+        return fast, rest
+
+    def _fast_update(self, executor_id: str, results: list[TaskResult]) -> None:
+        for r in results:
+            self.executors.free_slot(executor_id, 1)
+            if r.state in ("success", "failed"):
+                transition = self.executors.record_task_result(
+                    executor_id, ok=(r.state == "success"),
+                    timed_out=bool(getattr(r, "timed_out", False)))
+                if transition is not None:
+                    self.metrics.set_quarantined_executors(self.executors.quarantined_count())
+            with self._jobs_lock:
+                job = self._fast_jobs.get(r.job_id)
+            if job is None:
+                continue
+            outcome = job.on_result(r)
+            if outcome == "finished":
+                with self._jobs_lock:
+                    self._fast_jobs.pop(r.job_id, None)
+                self.metrics.record_completed(job.job_id, time.time() - job.queued_at)
+                self._maybe_cache_result(job)
+                self._notify(job.job_id)
+            elif outcome == "failed":
+                self._fast_fallback(job, job.error)
+        self.post(Event("revive"))  # freed slots may unblock queued graph work
+
+    def _fast_fallback(self, job: FastJob, reason: str) -> None:
+        """Demote a failed/timed-out fast job to an ordinary execution
+        graph built from the same stages — it gets retries, speculation,
+        and deadline sweeps like any other job. Idempotent per job."""
+        with self._jobs_lock:
+            if self._fast_jobs.pop(job.job_id, None) is None:
+                return  # raced another fallback / completion
+            graph = ExecutionGraph(job.job_id, job.job_name, job.session_id,
+                                   job.demote(), job.config)
+            self.jobs[job.job_id] = graph
+        self.serving.note_fast_lane("fallback")
+        self.metrics.record_fast_lane("fallback")
+        log.warning("fast lane fell back to full DAG for %s: %s",
+                    job.job_id, reason.splitlines()[0][:200] if reason else "timeout")
+        self.post(Event("revive"))
+
+    def _maybe_cache_result(self, job: FastJob) -> None:
+        """Fetch a finished fast job's partitions and fill its result-cache
+        slot, also serving THIS submission inline (the fetch already ran)."""
+        if job.rc_key is None:
+            return
+        try:
+            from ballista_tpu.client.context import fetch_job_results
+
+            tbl = fetch_job_results(job.job_status(), job.config)
+            self.serving.store_result(job.rc_key, tbl)
+            job.inline_result = tbl
+        except Exception as e:  # noqa: BLE001 — cache fill is best-effort
+            log.debug("result-cache fill for %s failed: %s", job.job_id, e)
+
+    def _fill_result_cache_from_graph(self, g) -> None:
+        """Graph-path result-cache fill: on job_finished, fetch the final
+        partitions off the event loop and store under the pending key."""
+        with self._jobs_lock:
+            rkey = self._rc_pending.pop(g.job_id, None)
+        if rkey is None:
+            return
+
+        def run():
+            try:
+                from ballista_tpu.client.context import fetch_job_results
+
+                self.serving.store_result(rkey, fetch_job_results(g.job_status(), g.config))
+            except Exception as e:  # noqa: BLE001
+                log.debug("result-cache fill for %s failed: %s", g.job_id, e)
+
+        threading.Thread(target=run, daemon=True, name="result-cache-fill").start()
 
     def _apply_task_updates(self, executor_id: str, results: list[TaskResult],
                             free_slots_managed: bool = True) -> None:
@@ -423,6 +815,7 @@ class SchedulerServer:
             for ev in events:
                 if ev == "job_finished":
                     self.metrics.record_completed(g.job_id, time.time() - g.queued_at)
+                    self._fill_result_cache_from_graph(g)
                     self._notify(g.job_id)
                 elif ev == "job_failed":
                     self.metrics.record_failed(g.job_id)
@@ -458,7 +851,14 @@ class SchedulerServer:
         DIFFERENT executor, (3) re-offer when quarantine probes come due."""
         now = time.time()
         with self._jobs_lock:
-            running = [g for g in self.jobs.values() if g.status is JobState.RUNNING]
+            fast = list(self._fast_jobs.values())
+            running = [g for g in self.jobs.values()
+                       if g.status is JobState.RUNNING and not isinstance(g, FastJob)]
+        for job in fast:
+            # backstop for fast jobs whose executor died or wedged: demote
+            # to a full graph, which has retries and deadline machinery
+            if job.expired(now, float(job.config.get(SERVING_FAST_LANE_TIMEOUT_S))):
+                self._fast_fallback(job, "fast-lane timeout")
         for g in running:
             expired, job_failed = g.expire_overdue_tasks(now)
             if expired:
@@ -498,6 +898,10 @@ class SchedulerServer:
             log.warning("overload state -> %s (inflight=%d, loop_lag=%.2fs, memory_pressure=%.2f)",
                         transition, self.admission.depth(), self._loop_lag_s, pressure)
             self.metrics.set_overload_state(transition)
+            if transition in ("shedding", "draining"):
+                # give the shed its headroom: drop the serving caches so
+                # memory-pressure recovery isn't fighting cached results
+                self.serving.clear()
 
     # -- executor lifecycle -----------------------------------------------------------
 
@@ -621,6 +1025,8 @@ class SchedulerServer:
         the work-dir TTL sweep)."""
         with self._jobs_lock:
             self.jobs.pop(job_id, None)
+            self._fast_jobs.pop(job_id, None)
+            self._rc_pending.pop(job_id, None)
         self.admission.finish(job_id)  # backstop; no-op if already released
         self.job_state.remove_job(job_id)
         if self.launcher is None:

@@ -67,6 +67,9 @@ class TaskDescription:
     # hard wall-clock budget (seconds, 0 = none); the executor aborts at
     # the deadline and reports a retryable timeout
     deadline_seconds: float = 0.0
+    # serving tier: dispatched straight from the submit path (single-stage
+    # plan, no execution graph); executors count these for heartbeat gauges
+    fast_lane: bool = False
 
 
 @dataclass

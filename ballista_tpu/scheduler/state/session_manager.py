@@ -21,12 +21,21 @@ class SessionManager:
     def __init__(self):
         self.sessions: dict[str, BallistaConfig] = {}
         self._lock = threading.Lock()
+        # serving tier hook: called with the table name whenever a session
+        # update registers a table or points an existing one at a new path
+        # (bumps the table-version vector → cached results stop matching)
+        self.on_catalog_change = None
 
     def create_or_update(self, settings: list[tuple[str, str]], session_id: str = "") -> str:
         cfg = BallistaConfig.from_key_value_pairs(settings, scrub_restricted=True)
         sid = session_id or str(new_session_id())
         with self._lock:
+            old = self.sessions.get(sid)
             self.sessions[sid] = cfg
+        if self.on_catalog_change is not None:
+            for k, v in cfg.to_key_value_pairs():
+                if k.startswith(CATALOG_PREFIX) and (old is None or old.get(k) != v):
+                    self.on_catalog_change(k[len(CATALOG_PREFIX):])
         return sid
 
     def get(self, session_id: str) -> BallistaConfig | None:

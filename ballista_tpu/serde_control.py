@@ -85,11 +85,16 @@ def encode_task_definition(t: TaskDescription, config=None) -> pb.TaskDefinition
 
 
 def decode_task_definition(p: pb.TaskDefinitionProto) -> TaskDescription:
+    # the fast-lane flag has no proto field (no protoc here); the reserved
+    # task-id band IS the wire encoding — graph tasks never reach it
+    from ballista_tpu.serving.fast_lane import FAST_TASK_ID_BASE
+
     return TaskDescription(
         job_id=p.job_id, stage_id=p.stage_id, stage_attempt=p.stage_attempt,
         task_id=p.task_id, partitions=list(p.partitions),
         plan=decode_plan(p.plan), session_id=p.session_id,
         deadline_seconds=p.deadline_seconds, task_attempt=p.task_attempt,
+        fast_lane=p.task_id >= FAST_TASK_ID_BASE,
     )
 
 

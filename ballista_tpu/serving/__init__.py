@@ -1,0 +1,37 @@
+"""High-QPS serving tier: plan cache, prepared statements, result cache.
+
+The scheduler-side machinery that turns repeated small queries from a
+full parse→optimize→plan→DAG round trip into a cache hit:
+
+- `normalize`: lift literals out of an optimized logical plan into
+  parameter slots, fingerprint the shape, and bind values back into a
+  cached physical-plan template.
+- `tier`: the `ServingTier` facade owning the LRU-bounded plan/result
+  caches, table-version invalidation, and prepared-statement registry.
+"""
+
+from ballista_tpu.serving.normalize import (
+    LiftResult,
+    bind_logical,
+    bind_physical,
+    collect_physical_params,
+    config_fingerprint,
+    decode_params,
+    encode_params,
+    lift_parameters,
+)
+from ballista_tpu.serving.tier import PlanTemplate, PreparedStatement, ServingTier
+
+__all__ = [
+    "LiftResult",
+    "PlanTemplate",
+    "PreparedStatement",
+    "ServingTier",
+    "bind_logical",
+    "bind_physical",
+    "collect_physical_params",
+    "config_fingerprint",
+    "decode_params",
+    "encode_params",
+    "lift_parameters",
+]

@@ -620,7 +620,48 @@ def main() -> None:
         init_ev = [e for e in trail if e.get("event") not in ("warmup", "iter")]
         run_ev = [e for e in trail if e.get("event") in ("warmup", "iter")]
         result["device_progress"] = init_ev + run_ev[-40:]
+
+    if os.environ.get("BENCH_SERVING", "1") == "1":
+        result["serving"] = serving_leg()
+
     print(json.dumps(result))
+
+
+def serving_leg() -> dict:
+    """High-QPS serving-tier leg (CPU-only, own sf0.01 dataset): plan
+    cache + fast lane + result cache vs the legacy queued path, concurrent
+    sessions, sustained QPS and p50/p99. Failures are recorded, never
+    fatal — this leg must not sink the device benchmark's result."""
+    log("running serving-tier QPS leg ...")
+    try:
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "dev"))
+        from qps_exercise import run_qps_comparison
+
+        from ballista_tpu.testing.tpchgen import generate_tpch
+
+        with tempfile.TemporaryDirectory(prefix="bench_qps_") as qd:
+            generate_tpch(qd, scale=0.01, seed=42, files_per_table=2)
+            stats = run_qps_comparison(qd)
+        out = {
+            "speedup_qps": stats["speedup_qps"],
+            "speedup_p50": stats["speedup_p50"],
+        }
+        for mode in ("legacy", "serving"):
+            s = stats[mode]
+            out[mode] = {k: s[k] for k in
+                         ("queries", "wall_s", "qps", "p50_ms", "p99_ms",
+                          "warm_p50_ms", "warm_p99_ms")}
+        out["caches"] = {
+            "plan_cache": stats["serving"]["serving"]["plan_cache"],
+            "result_cache": stats["serving"]["serving"]["result_cache"],
+            "fast_lane": stats["serving"]["serving"]["fast_lane"],
+        }
+        log(f"serving leg: {out['speedup_qps']}x QPS, {out['speedup_p50']}x p50")
+        return out
+    except (Exception, SystemExit) as e:  # noqa: BLE001 — recorded, not fatal
+        log(f"serving leg failed: {e}")
+        return {"error": str(e)}
 
 
 if __name__ == "__main__":

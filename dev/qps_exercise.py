@@ -1,0 +1,225 @@
+"""Exercise the high-QPS serving tier end-to-end on a tiny TPC-H dataset.
+
+    JAX_PLATFORMS=cpu python dev/qps_exercise.py
+
+Two identical workloads — N concurrent sessions each firing repeated
+short parameterized queries at a 2-executor StandaloneCluster — run
+twice: once with the serving tier enabled (plan cache + result cache +
+fast lane) and once fully disabled (the legacy queued path). The run
+reports sustained QPS and p50/p99 latency for both, then enforces:
+
+1. correctness — every query's result bytes are identical across modes
+   and across repeats (zero wrong results);
+2. caches engaged — nonzero plan-cache hits and fast-lane executions in
+   serving mode, nothing cached in legacy mode;
+3. speedup — serving-mode sustained QPS >= 2x legacy and a lower p50;
+   warm serving p99 must beat the uncached legacy p50.
+
+Exits non-zero if any check fails. `run_qps_comparison` is importable
+(bench.py's serving leg reuses it).
+"""
+
+import os
+import statistics
+import sys
+import tempfile
+import threading
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+# one query SHAPE, many literals: every distinct literal is a fresh SQL
+# text, so legacy mode re-parses and re-plans each one while serving mode
+# binds into one cached template
+QUERY = ("SELECT l_orderkey, l_partkey, l_quantity FROM lineitem "
+         "WHERE l_quantity < {k}")
+PARAMS = (2, 3, 4, 5)
+
+SESSIONS = int(os.environ.get("QPS_SESSIONS", "4"))
+REPEATS = int(os.environ.get("QPS_REPEATS", "6"))  # per param, per session
+
+
+def _pct(sorted_vals, p):
+    if not sorted_vals:
+        return 0.0
+    i = min(len(sorted_vals) - 1, int(round(p / 100.0 * (len(sorted_vals) - 1))))
+    return sorted_vals[i]
+
+
+def _fingerprint(tbl) -> bytes:
+    """Order-independent byte fingerprint of a result table."""
+    import hashlib
+
+    cols = sorted(tbl.column_names)
+    rows = sorted(zip(*(tbl.column(c).to_pylist() for c in cols)))
+    return hashlib.sha256(repr((cols, rows)).encode()).digest()
+
+
+def qps_leg(data_dir: str, serving: bool) -> dict:
+    """Run the workload against one cluster; returns latencies, QPS, the
+    per-param result fingerprints, and the serving-tier snapshot."""
+    from ballista_tpu.client.context import SessionContext, fetch_job_results
+    from ballista_tpu.config import (
+        DEFAULT_SHUFFLE_PARTITIONS,
+        SERVING_FAST_LANE,
+        SERVING_PLAN_CACHE,
+        SERVING_RESULT_CACHE,
+        BallistaConfig,
+    )
+    from ballista_tpu.executor.standalone import StandaloneCluster
+    from ballista_tpu.testing.tpchgen import register_tpch
+
+    cfg = BallistaConfig({
+        DEFAULT_SHUFFLE_PARTITIONS: 2,
+        SERVING_PLAN_CACHE: serving,
+        SERVING_FAST_LANE: serving,
+        SERVING_RESULT_CACHE: serving,
+    })
+    ctx = SessionContext(cfg)
+    register_tpch(ctx, data_dir)
+    cluster = StandaloneCluster(num_executors=2, vcores=4, config=cfg)
+    scheduler = cluster.scheduler
+    mode = "serving" if serving else "legacy"
+    latencies: list[float] = []
+    warm_latencies: list[float] = []  # repeats after each shape's first run
+    fingerprints: dict[int, set] = {k: set() for k in PARAMS}
+    errors: list[str] = []
+    lock = threading.Lock()
+
+    def session_worker(n: int) -> None:
+        session_id = scheduler.sessions.create_or_update(
+            cfg.to_key_value_pairs(), f"qps-{mode}-{n}")
+        try:
+            for rep in range(REPEATS):
+                for k in PARAMS:
+                    t0 = time.monotonic()
+                    # inline_results: in-process caller, the contract the
+                    # result cache requires (tables can't ride the proto)
+                    job_id = scheduler.submit_sql(QUERY.format(k=k), session_id,
+                                                  inline_results=True)
+                    status = scheduler.wait_for_job(job_id, timeout=120)
+                    if status["state"] != "successful":
+                        raise RuntimeError(
+                            f"job {job_id} {status['state']}: {status.get('error')}")
+                    tbl = fetch_job_results(status, cfg)
+                    dt = time.monotonic() - t0
+                    with lock:
+                        latencies.append(dt)
+                        if rep > 0:
+                            warm_latencies.append(dt)
+                        fingerprints[k].add(_fingerprint(tbl))
+        except Exception as e:  # noqa: BLE001 — collected and reported
+            with lock:
+                errors.append(f"session {n}: {e}")
+
+    try:
+        # warm the cluster once so neither mode pays executor cold-start
+        # inside the timed window
+        warm_sid = scheduler.sessions.create_or_update(
+            cfg.to_key_value_pairs(), f"qps-{mode}-warmup")
+        wj = scheduler.submit_sql(QUERY.format(k=PARAMS[0]), warm_sid)
+        if scheduler.wait_for_job(wj, timeout=120)["state"] != "successful":
+            raise SystemExit(f"[{mode}] warmup query failed")
+
+        threads = [threading.Thread(target=session_worker, args=(i,))
+                   for i in range(SESSIONS)]
+        t_start = time.monotonic()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.monotonic() - t_start
+    finally:
+        cluster.shutdown()
+
+    if errors:
+        raise SystemExit(f"[{mode}] worker failures: {errors[:3]}")
+    lat = sorted(latencies)
+    warm = sorted(warm_latencies)
+    return {
+        "mode": mode,
+        "queries": len(latencies),
+        "wall_s": round(wall, 3),
+        "qps": round(len(latencies) / wall, 2),
+        "p50_ms": round(_pct(lat, 50) * 1000, 1),
+        "p99_ms": round(_pct(lat, 99) * 1000, 1),
+        "warm_p50_ms": round(_pct(warm, 50) * 1000, 1),
+        "warm_p99_ms": round(_pct(warm, 99) * 1000, 1),
+        "mean_ms": round(statistics.mean(lat) * 1000, 1),
+        "fingerprints": fingerprints,
+        "serving": scheduler.serving.snapshot(),
+    }
+
+
+def run_qps_comparison(data_dir: str) -> dict:
+    """Serving vs legacy on the same data; asserts the acceptance bars and
+    returns both legs' stats (without the raw fingerprints)."""
+    legacy = qps_leg(data_dir, serving=False)
+    served = qps_leg(data_dir, serving=True)
+
+    # 1. zero wrong results: one fingerprint per param, identical across modes
+    for k in PARAMS:
+        fps = served["fingerprints"][k] | legacy["fingerprints"][k]
+        if len(served["fingerprints"][k]) != 1 or len(fps) != 1:
+            raise SystemExit(
+                f"[qps] param {k}: results diverged across repeats/modes "
+                f"(serving={len(served['fingerprints'][k])} distinct, "
+                f"combined={len(fps)})")
+
+    # 2. the caches actually engaged
+    snap = served["serving"]
+    if snap["plan_cache"]["hits"] == 0:
+        raise SystemExit("[qps] serving mode recorded zero plan-cache hits — vacuous")
+    if snap["fast_lane"]["executed"] == 0:
+        raise SystemExit("[qps] fast lane never engaged on a single-stage query")
+    if snap["result_cache"]["hits"] == 0:
+        raise SystemExit("[qps] result cache recorded zero hits on repeats")
+    lsnap = legacy["serving"]
+    if lsnap["plan_cache"]["hits"] or lsnap["plan_cache"]["misses"]:
+        raise SystemExit("[qps] disabled serving tier still touched the plan cache")
+
+    # 3. the speedup bars
+    if served["qps"] < 2.0 * legacy["qps"]:
+        raise SystemExit(f"[qps] serving {served['qps']} QPS < 2x legacy "
+                         f"{legacy['qps']} QPS")
+    if served["p50_ms"] >= legacy["p50_ms"]:
+        raise SystemExit(f"[qps] serving p50 {served['p50_ms']}ms not below "
+                         f"legacy p50 {legacy['p50_ms']}ms")
+    if served["warm_p99_ms"] >= legacy["p50_ms"]:
+        raise SystemExit(f"[qps] warm serving p99 {served['warm_p99_ms']}ms not "
+                         f"below uncached legacy p50 {legacy['p50_ms']}ms")
+
+    out = {}
+    for leg in (legacy, served):
+        leg = dict(leg)
+        leg.pop("fingerprints")
+        out[leg["mode"]] = leg
+    out["speedup_qps"] = round(served["qps"] / max(legacy["qps"], 1e-9), 2)
+    out["speedup_p50"] = round(legacy["p50_ms"] / max(served["p50_ms"], 1e-9), 2)
+    return out
+
+
+def main() -> None:
+    from ballista_tpu.testing.tpchgen import generate_tpch
+
+    with tempfile.TemporaryDirectory(prefix="qps-tpch-") as d:
+        print(f"generating TPC-H sf0.01 under {d} ...")
+        generate_tpch(d, scale=0.01, seed=42, files_per_table=2)
+        stats = run_qps_comparison(d)
+        for mode in ("legacy", "serving"):
+            s = stats[mode]
+            print(f"[{mode:8s}] {s['queries']} queries in {s['wall_s']}s "
+                  f"-> {s['qps']} QPS  p50={s['p50_ms']}ms p99={s['p99_ms']}ms "
+                  f"(warm p50={s['warm_p50_ms']}ms p99={s['warm_p99_ms']}ms)")
+        srv = stats["serving"]["serving"]
+        print(f"[caches  ] plan hits={srv['plan_cache']['hits']} "
+              f"misses={srv['plan_cache']['misses']} "
+              f"text_hits={srv['plan_cache']['text_hits']} "
+              f"fast_lane={srv['fast_lane']}")
+        print(f"qps exercise passed: {stats['speedup_qps']}x QPS, "
+              f"{stats['speedup_p50']}x p50")
+
+
+if __name__ == "__main__":
+    main()

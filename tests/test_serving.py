@@ -1,0 +1,476 @@
+"""High-QPS serving tier: parameterized plan cache (literal lifting +
+shape fingerprints), prepared statements, the result cache with
+table-version invalidation, the short-query fast lane (byte parity with
+the full DAG path), and per-lane admission shedding.
+"""
+
+import time
+
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from ballista_tpu.client.context import SessionContext
+from ballista_tpu.config import (
+    DEFAULT_SHUFFLE_PARTITIONS,
+    SERVING_FAST_LANE,
+    SERVING_PLAN_CACHE,
+    SERVING_RESULT_CACHE,
+    BallistaConfig,
+)
+from ballista_tpu.errors import ClusterOverloaded, PlanningError
+from ballista_tpu.scheduler.admission import (
+    DRAINING,
+    LANE_BATCH,
+    LANE_INTERACTIVE,
+    SHEDDING,
+    AdmissionController,
+)
+from ballista_tpu.scheduler.metrics import InMemoryMetricsCollector
+from ballista_tpu.serving.fast_lane import FAST_TASK_ID_BASE
+from ballista_tpu.serving.normalize import (
+    bind_logical,
+    bind_physical,
+    collect_physical_params,
+    config_fingerprint,
+    decode_params,
+    encode_params,
+    lift_parameters,
+)
+from ballista_tpu.serving.tier import PlanTemplate, ServingTier
+from ballista_tpu.sql.optimizer import optimize
+from ballista_tpu.sql.parser import parse_sql
+from ballista_tpu.sql.planner import SqlPlanner
+
+from .conftest import tpch_query
+
+
+def _optimized(ctx: SessionContext, sql: str):
+    return optimize(SqlPlanner(ctx.catalog).plan_query(parse_sql(sql)))
+
+
+def _local_ctx(rows: int = 50) -> SessionContext:
+    ctx = SessionContext()
+    ctx.register_arrow_table(
+        "t", pa.table({"a": list(range(rows)), "b": [float(i) for i in range(rows)]}))
+    return ctx
+
+
+def _sorted(tbl: pa.Table) -> pa.Table:
+    return tbl.sort_by([(n, "ascending") for n in tbl.column_names])
+
+
+# ---------------------------------------------------------------------------
+# plan normalization: literal lifting, shape keys, binding
+
+
+class TestPlanNormalization:
+    def test_same_shape_different_literals_share_one_cache_entry(self):
+        ctx = _local_ctx()
+        l1 = lift_parameters(_optimized(ctx, "SELECT a, b FROM t WHERE a < 10"))
+        l2 = lift_parameters(_optimized(ctx, "SELECT a, b FROM t WHERE a < 20"))
+        assert l1.cacheable and l2.cacheable
+        assert l1.key == l2.key, "shape key must be literal-independent"
+        assert l1.values == (10,) and l2.values == (20,)
+
+        tier = ServingTier()
+        assert tier.lookup_template(l1.key, l1.values) is None  # cold miss
+        phys = ctx.create_physical_plan(l1.tagged)
+        tier.store_template(PlanTemplate(
+            key=l1.key, physical=phys, type_tags=l1.type_tags, values=l1.values,
+            tables=l1.tables, bindable=True))
+        hit = tier.lookup_template(l2.key, l2.values)
+        assert hit is not None, "different literals must hit the same entry"
+        snap = tier.snapshot()["plan_cache"]
+        assert snap == {**snap, "entries": 1, "hits": 1, "misses": 1}
+
+    def test_different_shape_gets_a_different_key(self):
+        ctx = _local_ctx()
+        l1 = lift_parameters(_optimized(ctx, "SELECT a, b FROM t WHERE a < 10"))
+        l2 = lift_parameters(_optimized(ctx, "SELECT a, b FROM t WHERE a > 10"))
+        l3 = lift_parameters(_optimized(ctx, "SELECT a FROM t WHERE a < 10"))
+        assert len({l1.key, l2.key, l3.key}) == 3
+
+    def test_binding_substitutes_without_mutating_the_template(self):
+        ctx = _local_ctx()
+        lift = lift_parameters(_optimized(ctx, "SELECT a, b FROM t WHERE a < 10"))
+        phys = ctx.create_physical_plan(lift.tagged)
+        assert collect_physical_params(phys) == {0}
+
+        assert ctx.execute_collect(bind_physical(phys, (30,))).num_rows == 30
+        # the template still binds its ORIGINAL value afterwards — binding
+        # must never write through into the cached tree
+        assert ctx.execute_collect(bind_physical(phys, (10,))).num_rows == 10
+        assert collect_physical_params(phys) == {0}
+
+        bound = bind_logical(lift.tagged, (25,))
+        assert ctx.execute_collect(ctx.create_physical_plan(bound)).num_rows == 25
+
+    def test_values_rows_are_uncacheable(self):
+        ctx = _local_ctx()
+        lift = lift_parameters(_optimized(ctx, "SELECT * FROM (VALUES (1), (2)) v(a)"))
+        assert not lift.cacheable
+        assert "VALUES" in lift.reason
+
+    def test_text_cache_hit_requires_resident_template(self):
+        ctx = _local_ctx()
+        lift = lift_parameters(_optimized(ctx, "SELECT a FROM t WHERE a < 7"))
+        tier = ServingTier()
+        tier.remember_text("q", "fp", lift.key, lift.values)
+        assert tier.lookup_text("q", "fp") is None, "text entry without template is dead"
+        tier.store_template(PlanTemplate(
+            key=lift.key, physical=ctx.create_physical_plan(lift.tagged),
+            type_tags=lift.type_tags, values=lift.values, tables=lift.tables,
+            bindable=True))
+        assert tier.lookup_text("q", "fp") is not None
+        assert tier.lookup_text("q", "other-fp") is None, "config fp is part of the key"
+
+    def test_config_fingerprint_tracks_catalog_registrations(self):
+        c1, c2 = BallistaConfig(), BallistaConfig()
+        c2.set("ballista.catalog.table.t", "/data/v2/t.parquet")
+        assert config_fingerprint(c1) != config_fingerprint(c2)
+
+    def test_non_bindable_template_serves_exact_values_only(self):
+        t = PlanTemplate(key="k", physical=None, type_tags=("int64",),
+                         values=(5,), tables=("t",), bindable=False)
+        assert t.accepts((5,))
+        assert not t.accepts((6,))
+        assert not t.accepts((5, 5))
+
+    def test_param_wire_codec_round_trips_tagged_types(self):
+        from datetime import date, datetime
+        from decimal import Decimal
+
+        vals = (1, "x", 2.5, date(1998, 12, 1), datetime(2026, 8, 5, 12, 30),
+                Decimal("10.25"), None)
+        assert decode_params(encode_params(vals)) == vals
+
+
+# ---------------------------------------------------------------------------
+# result cache: version-vector invalidation
+
+
+class TestResultCache:
+    def test_table_version_bump_orphans_cached_results(self):
+        tier = ServingTier()
+        tbl = pa.table({"x": [1, 2, 3]})
+        rkey = tier.result_key("k", (5,), ("t",))
+        assert tier.lookup_result(rkey) is None
+        tier.store_result(rkey, tbl)
+        assert tier.lookup_result(tier.result_key("k", (5,), ("t",))) is tbl
+        tier.table_versions.bump("t")
+        assert tier.lookup_result(tier.result_key("k", (5,), ("t",))) is None
+        # the old entry is orphaned, not scanned: still resident until LRU
+        assert tier.snapshot()["result_cache"]["entries"] == 1
+
+    def test_oversized_results_are_not_cached(self):
+        tier = ServingTier()
+        tier.result_max_bytes = 8
+        tier.store_result(("k", (), ()), pa.table({"x": list(range(1000))}))
+        assert tier.snapshot()["result_cache"]["entries"] == 0
+
+    def test_e2e_invalidation_on_table_reregistration(self, tmp_path):
+        p1, p2 = str(tmp_path / "v1.parquet"), str(tmp_path / "v2.parquet")
+        pq.write_table(pa.table({"a": list(range(10))}), p1)
+        pq.write_table(pa.table({"a": list(range(100, 120))}), p2)
+
+        cfg = BallistaConfig({DEFAULT_SHUFFLE_PARTITIONS: 2,
+                              SERVING_RESULT_CACHE: True})
+        ctx = SessionContext.standalone(cfg, num_executors=1)
+        try:
+            ctx.register_parquet("t", p1)
+            q = "SELECT a FROM t WHERE a < 1000"
+            r1 = ctx.sql(q).collect()
+            r2 = ctx.sql(q).collect()
+            serving = ctx._cluster.scheduler.serving
+            assert serving.snapshot()["result_cache"]["hits"] >= 1
+            assert _sorted(r1).equals(_sorted(r2))
+
+            # re-registering the table bumps its version: the next lookup
+            # must MISS and read the new file, never the stale result
+            ctx.register_parquet("t", p2)
+            r3 = ctx.sql(q).collect()
+            assert _sorted(r3).column("a").to_pylist() == list(range(100, 120))
+            assert serving.table_versions.bumps >= 1
+        finally:
+            ctx.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# fast lane vs full DAG: byte parity on TPC-H
+
+
+def _serving_ctx(tpch_dir, **overrides) -> SessionContext:
+    from ballista_tpu.testing.tpchgen import register_tpch
+
+    cfg = BallistaConfig({DEFAULT_SHUFFLE_PARTITIONS: 2, **overrides})
+    ctx = SessionContext.standalone(cfg, num_executors=2)
+    register_tpch(ctx, tpch_dir)
+    return ctx
+
+
+@pytest.mark.parametrize("q", [1, 6])
+def test_serving_path_byte_parity_with_legacy_path(q, tpch_dir):
+    """The serving submit path (plan cache + template binding) must return
+    byte-identical results to the legacy queued path for the same query."""
+    on = _serving_ctx(tpch_dir)
+    off = _serving_ctx(tpch_dir, **{SERVING_PLAN_CACHE: False})
+    try:
+        sql = tpch_query(q)
+        r_on_cold = on.sql(sql).collect()
+        r_on_warm = on.sql(sql).collect()  # second run rides the caches
+        r_off = off.sql(sql).collect()
+        assert _sorted(r_on_cold).equals(_sorted(r_off))
+        assert _sorted(r_on_warm).equals(_sorted(r_off))
+        assert on._cluster.scheduler.serving.snapshot()["plan_cache"]["hits"] >= 1
+        assert off._cluster.scheduler.serving.snapshot()["plan_cache"]["misses"] == 0, \
+            "disabled serving tier must not touch the caches"
+    finally:
+        on.shutdown()
+        off.shutdown()
+
+
+def test_fast_lane_byte_parity_on_single_stage_query(tpch_dir):
+    """A single-stage query executes through the fast lane (no execution
+    graph); its bytes must match the full-DAG path with the lane disabled."""
+    sql = ("SELECT l_orderkey, l_partkey, l_quantity FROM lineitem "
+           "WHERE l_quantity < 3")
+    fast = _serving_ctx(tpch_dir)
+    slow = _serving_ctx(tpch_dir, **{SERVING_FAST_LANE: False})
+    try:
+        r_fast = [fast.sql(sql).collect() for _ in range(2)]
+        r_slow = slow.sql(sql).collect()
+        for r in r_fast:
+            assert _sorted(r).equals(_sorted(r_slow))
+        snap = fast._cluster.scheduler.serving.snapshot()
+        assert snap["fast_lane"]["executed"] >= 1, "fast lane never engaged — vacuous"
+        assert slow._cluster.scheduler.serving.snapshot()["fast_lane"]["executed"] == 0
+    finally:
+        fast.shutdown()
+        slow.shutdown()
+
+
+def test_prepared_statement_binds_fresh_values_e2e(tpch_dir):
+    ctx = _serving_ctx(tpch_dir)
+    try:
+        ps = ctx.prepare("SELECT l_orderkey FROM lineitem WHERE l_quantity < 3")
+        assert ps.num_params == 1
+        r3 = ps.execute()
+        r7 = ps.execute([7])
+        assert r7.num_rows > r3.num_rows > 0
+        # bound executions are plan-cache hits, not re-plans
+        assert ctx._cluster.scheduler.serving.snapshot()["plan_cache"]["hits"] >= 2
+        with pytest.raises(PlanningError):
+            ps.execute([1, 2])
+        ps.close()
+        assert ctx._cluster.scheduler.serving.snapshot()["prepared_statements"] == 0
+    finally:
+        ctx.shutdown()
+
+
+def test_prepare_rejects_non_select():
+    ctx = SessionContext()
+    with pytest.raises(PlanningError):
+        ctx.prepare("CREATE EXTERNAL TABLE x STORED AS PARQUET LOCATION '/tmp/x'")
+
+
+# ---------------------------------------------------------------------------
+# per-lane admission: interactive traffic survives batch overload
+
+
+def _ctl(**kw) -> AdmissionController:
+    defaults = dict(enabled=True, max_pending=64, per_session_quota=4,
+                    shed_depth=32, drain_depth=48, shed_loop_lag_s=10.0,
+                    shed_memory_pressure=0.9, min_retry_after_ms=1,
+                    interactive_max_pending=4)
+    defaults.update(kw)
+    return AdmissionController(**defaults)
+
+
+class TestPerLaneShedding:
+    def test_chaos_overload_pressure_sheds_batch_but_not_interactive(self):
+        """Memory pressure from a chaos-overloaded pool trips SHEDDING;
+        the batch lane's quota halves while the interactive lane keeps its
+        full session quota — short queries keep flowing."""
+        from ballista_tpu.executor.chaos import ChaosExec
+        from ballista_tpu.executor.memory_pool import MemoryPool
+        from ballista_tpu.plan.physical import ExecutionPlan, TaskContext
+        from ballista_tpu.plan.schema import DFField, DFSchema
+
+        schema = DFSchema([DFField("x", pa.int64(), False)])
+
+        class OneBatch(ExecutionPlan):
+            def __init__(self):
+                super().__init__(schema)
+
+            def output_partition_count(self):
+                return 1
+
+            def execute(self, partition, task_ctx):
+                yield pa.RecordBatch.from_pydict({"x": [1]}, schema=schema.to_arrow())
+
+        chaos = ChaosExec(OneBatch(), seed=1, probability=1.0, mode="overload",
+                          straggler_delay_s=0.01)
+        pool = MemoryPool(100)
+        task_ctx = TaskContext()
+        task_ctx.memory_pool = pool
+        gen = chaos.execute(0, task_ctx)
+        next(gen)  # chaos reservation live: the pool reads saturated
+        assert pool.pressure() >= 1.0
+
+        ctl = _ctl(per_session_quota=2)
+        assert ctl.update(0.0, pool.pressure()) == SHEDDING
+        ctl.admit("s1", "b1", lane=LANE_BATCH)
+        with pytest.raises(ClusterOverloaded) as ei:
+            ctl.admit("s1", "b2", lane=LANE_BATCH)  # halved quota of 1
+        assert ei.value.reason == "shedding"
+        # the same session's interactive work still gets its FULL quota
+        ctl.admit("s1", "i1", lane=LANE_INTERACTIVE)
+        lanes = ctl.snapshot()["lanes"]
+        assert lanes[LANE_BATCH]["shed_total"] == 1
+        assert lanes[LANE_INTERACTIVE]["shed_total"] == 0
+        assert lanes[LANE_INTERACTIVE]["inflight"] == 1
+        list(gen)  # drain the chaos generator → reservation released
+
+    def test_interactive_lane_has_its_own_depth_cap(self):
+        ctl = _ctl(interactive_max_pending=2, per_session_quota=10)
+        ctl.admit("s1", "i1", lane=LANE_INTERACTIVE)
+        ctl.admit("s2", "i2", lane=LANE_INTERACTIVE)
+        with pytest.raises(ClusterOverloaded) as ei:
+            ctl.admit("s3", "i3", lane=LANE_INTERACTIVE)
+        assert ei.value.reason == "depth"
+        ctl.finish("i1")
+        ctl.admit("s3", "i3", lane=LANE_INTERACTIVE)
+
+    def test_draining_halves_the_interactive_cap_but_admits(self):
+        ctl = _ctl(interactive_max_pending=4, max_pending=100,
+                   per_session_quota=100, shed_depth=2, drain_depth=4)
+        for i in range(4):
+            ctl.admit(f"s{i}", f"b{i}", lane=LANE_BATCH)
+        assert ctl.update(0.0, 0.0) == DRAINING
+        with pytest.raises(ClusterOverloaded) as ei:
+            ctl.admit("s9", "late-batch", lane=LANE_BATCH)
+        assert ei.value.reason == "draining"
+        # interactive cap halves to 2 while draining — degraded, not dead
+        ctl.admit("sa", "i1", lane=LANE_INTERACTIVE)
+        ctl.admit("sb", "i2", lane=LANE_INTERACTIVE)
+        with pytest.raises(ClusterOverloaded) as ei:
+            ctl.admit("sc", "i3", lane=LANE_INTERACTIVE)
+        assert ei.value.reason == "draining"
+
+    def test_finish_releases_the_lane_slot(self):
+        ctl = _ctl(interactive_max_pending=1)
+        ctl.admit("s1", "i1", lane=LANE_INTERACTIVE)
+        assert ctl.lane_of("i1") == LANE_INTERACTIVE
+        ctl.finish("i1")
+        assert ctl.lane_of("i1") is None
+        assert ctl.snapshot()["lanes"][LANE_INTERACTIVE]["inflight"] == 0
+        ctl.admit("s1", "i2", lane=LANE_INTERACTIVE)
+
+
+# ---------------------------------------------------------------------------
+# observability: /api surfaces, prometheus counters, heartbeat gauge, serde
+
+
+class TestServingObservability:
+    def test_api_state_includes_serving_and_lane_snapshots(self):
+        import json
+        import urllib.request
+
+        from ballista_tpu.scheduler.api.rest import start_rest_api
+        from ballista_tpu.scheduler.server import SchedulerServer
+
+        metrics = InMemoryMetricsCollector()
+        scheduler = SchedulerServer(None, metrics)
+        scheduler.serving.note_fast_lane("executed")
+        server, port = start_rest_api(scheduler, metrics, host="127.0.0.1", port=0)
+        try:
+            state = json.load(urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/api/state"))
+            assert state["serving"]["fast_lane"]["executed"] == 1
+            assert set(state["serving"]) >= {"plan_cache", "result_cache", "fast_lane"}
+            assert set(state["overload"]["lanes"]) == {LANE_BATCH, LANE_INTERACTIVE}
+        finally:
+            server.shutdown()
+
+    def test_prometheus_renders_serving_counters(self):
+        m = InMemoryMetricsCollector()
+        m.record_plan_cache(True)
+        m.record_plan_cache(False)
+        m.record_result_cache(True)
+        m.record_fast_lane("executed")
+        m.record_fast_lane("fallback")
+        m.record_lane_admitted(LANE_INTERACTIVE)
+        m.record_job_rejected("depth", lane=LANE_INTERACTIVE)
+        out = m.render_prometheus()
+        assert 'plan_cache_total{outcome="hit"} 1' in out
+        assert 'plan_cache_total{outcome="miss"} 1' in out
+        assert "result_cache" in out
+        assert "fast_lane" in out
+        assert 'lane="interactive"' in out
+
+    def test_executor_counts_fast_lane_tasks(self, tmp_path):
+        from ballista_tpu.executor.executor import Executor, ExecutorMetadata
+        from ballista_tpu.scheduler.state.execution_graph import TaskDescription
+
+        ex = Executor(str(tmp_path), ExecutorMetadata(id="ex-fl"))
+        # plan=None fails fast in run_task — the gauge must still count the
+        # ATTEMPT, mirroring tasks_run accounting
+        task = TaskDescription(job_id="j", stage_id=1, stage_attempt=0,
+                               task_id=FAST_TASK_ID_BASE + 3, partitions=[0],
+                               plan=None, session_id="s", fast_lane=True)
+        ex.run_task(task)
+        assert ex.fast_lane_tasks == 1
+
+    def test_task_id_band_is_the_wire_encoding_of_fast_lane(self, tmp_path):
+        """No proto field exists for fast_lane; the reserved task-id band
+        must survive an encode/decode round trip."""
+        from ballista_tpu.scheduler.planner import DistributedPlanner
+        from ballista_tpu.scheduler.state.execution_graph import TaskDescription
+        from ballista_tpu.serde_control import (
+            decode_task_definition,
+            encode_task_definition,
+        )
+
+        path = str(tmp_path / "t.parquet")
+        pq.write_table(pa.table({"a": [1, 2, 3]}), path)
+        ctx = SessionContext()
+        ctx.register_parquet("t", path)
+        physical = ctx.create_physical_plan(_optimized(ctx, "SELECT a FROM t"))
+        stages = DistributedPlanner("job-band").plan_query_stages(physical)
+        assert len(stages) == 1
+        for task_id, expect in ((FAST_TASK_ID_BASE, True), (7, False)):
+            t = TaskDescription(job_id="job-band", stage_id=stages[0].stage_id,
+                                stage_attempt=0, task_id=task_id, partitions=[0],
+                                plan=stages[0].plan, session_id="s",
+                                fast_lane=expect)
+            decoded = decode_task_definition(encode_task_definition(t))
+            assert decoded.fast_lane is expect
+            assert decoded.task_id == task_id
+
+
+# ---------------------------------------------------------------------------
+# wait_for_job tail latency: the poll floor must not eat fast-lane wins
+
+
+def test_client_poll_floor_is_sub_hundred_ms():
+    from ballista_tpu.client import remote
+
+    assert remote.POLL_INTERVAL_S <= 0.02, \
+        "a 100ms first poll wipes out single-digit-ms fast-lane latency"
+    assert remote.POLL_INTERVAL_MAX_S <= 2.0
+
+
+def test_scheduler_wait_for_job_returns_promptly(tpch_dir):
+    """End-to-end latency guard: a warm repeated single-stage query through
+    the serving tier completes well under the old polling floor regime."""
+    ctx = _serving_ctx(tpch_dir)
+    try:
+        sql = "SELECT l_orderkey FROM lineitem WHERE l_quantity < 2"
+        ctx.sql(sql).collect()  # warm: compile + plan template
+        t0 = time.monotonic()
+        ctx.sql(sql).collect()
+        warm_s = time.monotonic() - t0
+        assert warm_s < 5.0, f"warm single-stage query took {warm_s:.2f}s"
+    finally:
+        ctx.shutdown()

@@ -146,7 +146,8 @@ class TestChaosStraggler:
 
 def _graph(cfg: dict | None = None, partitions: int = 4) -> ExecutionGraph:
     stage = SimpleNamespace(stage_id=1, plan=SimpleNamespace(input=None),
-                            partitions=partitions, input_stage_ids=[])
+                            partitions=partitions, input_stage_ids=[],
+                            mesh=False)
     config = BallistaConfig({MAX_PARTITIONS_PER_TASK: 1, **(cfg or {})})
     return ExecutionGraph("job-1", "", "session-1", [stage], config)
 
