@@ -1,0 +1,30 @@
+"""Engine invariant analyzer: custom static-analysis passes + plan verifier.
+
+Eight PRs of growth accreted repo-wide invariants that were enforced only
+by reviewer memory: every `ballista.*` knob registered AND documented,
+module caches bounded, CPU-side modules never importing jax at top level,
+every plan node serde-complete, RunStats gauges emitted where consumed,
+no blocking calls on the scheduler event loop. This package makes them
+machine-checked:
+
+- `core`       — the pass framework: shared AST walking, typed `Finding`s,
+                 per-line / per-file suppression comments, a checked-in
+                 baseline for grandfathered violations
+- `passes/`    — the engine-specific passes (see `passes.ALL_PASSES`)
+- `plan_check` — the second front: a static verifier over physical plans /
+                 `ExecutionGraph`s (stage-boundary schema agreement,
+                 partition-count consistency, mesh gating, fast-lane
+                 task-id band disjointness)
+
+CLI: `python -m ballista_tpu.analysis` (see `__main__.py`); the tier-1
+gate is `tests/test_static_analysis.py`. Docs: docs/static_analysis.md.
+"""
+
+from ballista_tpu.analysis.core import (  # noqa: F401
+    Analyzer,
+    AnalysisReport,
+    Finding,
+    SourceFile,
+    load_baseline,
+    repo_root,
+)

@@ -1,0 +1,32 @@
+"""Engine-specific analysis passes.
+
+Each module defines one `AnalysisPass` subclass; `ALL_PASSES` is the
+registry the CLI and the tier-1 gate run. Order is reporting order only —
+passes are independent.
+"""
+
+from ballista_tpu.analysis.passes.bounded_cache import BoundedCachePass
+from ballista_tpu.analysis.passes.event_loop import EventLoopHygienePass
+from ballista_tpu.analysis.passes.jax_guard import JaxGuardPass
+from ballista_tpu.analysis.passes.knob_sync import KnobSyncPass
+from ballista_tpu.analysis.passes.serde_sync import SerdeCompletenessPass
+from ballista_tpu.analysis.passes.stats_sync import StatsRegistrySyncPass
+
+ALL_PASSES = [
+    KnobSyncPass(),
+    BoundedCachePass(),
+    JaxGuardPass(),
+    SerdeCompletenessPass(),
+    StatsRegistrySyncPass(),
+    EventLoopHygienePass(),
+]
+
+__all__ = [
+    "ALL_PASSES",
+    "BoundedCachePass",
+    "EventLoopHygienePass",
+    "JaxGuardPass",
+    "KnobSyncPass",
+    "SerdeCompletenessPass",
+    "StatsRegistrySyncPass",
+]

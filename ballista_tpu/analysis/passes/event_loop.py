@@ -1,0 +1,130 @@
+"""event-loop hygiene: no blocking calls in scheduler event-loop handlers.
+
+Every graph mutation funnels through `SchedulerServer._event_loop` →
+`_handle`; one blocking call there stalls task placement, heartbeat
+application, and AQE resolution cluster-wide (the admission controller
+even sheds on loop lag — a blocked loop triggers exactly the overload
+it's meant to prevent). Planning already runs on a spawned thread for
+this reason.
+
+The pass builds the intra-class call graph from `_handle` over
+`self.method()` edges (nested function defs are excluded — they are
+thread targets, not loop code) and flags the blocking primitives:
+`time.sleep`, subprocess spawns, raw socket dials, `urlopen`,
+`Event.wait`, `Thread.join` without a timeout, and `Future.result()`
+without a timeout.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ballista_tpu.analysis.core import AnalysisPass, Analyzer, Finding
+
+SERVER_REL = "ballista_tpu/scheduler/server.py"
+ROOT_METHODS = ("_handle",)
+
+_BLOCKING_MODULE_CALLS = {
+    ("time", "sleep"),
+    ("subprocess", "run"),
+    ("subprocess", "check_call"),
+    ("subprocess", "check_output"),
+    ("subprocess", "Popen"),
+    ("socket", "create_connection"),
+}
+_TIMEOUT_REQUIRED_METHODS = {"result", "join", "wait"}
+
+
+def _has_timeout(call: ast.Call) -> bool:
+    if any(k.arg == "timeout" for k in call.keywords):
+        return True
+    return bool(call.args)  # positional timeout (Event.wait(5), join(5))
+
+
+def _method_defs(cls: ast.ClassDef) -> dict[str, ast.FunctionDef]:
+    return {n.name: n for n in cls.body if isinstance(n, ast.FunctionDef)}
+
+
+def _own_statements(fn: ast.FunctionDef):
+    """Walk fn's body, NOT descending into nested function defs (those run
+    on other threads)."""
+    stack = list(fn.body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        yield node
+        for child in ast.iter_child_nodes(node):
+            stack.append(child)
+
+
+def _self_calls(fn: ast.FunctionDef) -> set[str]:
+    out: set[str] = set()
+    for node in _own_statements(fn):
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute) \
+                and isinstance(node.func.value, ast.Name) \
+                and node.func.value.id == "self":
+            out.add(node.func.attr)
+    return out
+
+
+class EventLoopHygienePass(AnalysisPass):
+    pass_id = "event-loop"
+    doc = "no blocking sleeps/IO in SchedulerServer event-loop handlers"
+
+    def run(self, analyzer: Analyzer) -> list[Finding]:
+        findings: list[Finding] = []
+        src = analyzer.file(SERVER_REL)
+        if src is None or src.tree is None:
+            return findings
+        cls = next((n for n in src.tree.body
+                    if isinstance(n, ast.ClassDef) and n.name == "SchedulerServer"), None)
+        if cls is None:
+            return findings
+        methods = _method_defs(cls)
+
+        reachable: set[str] = set()
+        stack = [m for m in ROOT_METHODS if m in methods]
+        while stack:
+            name = stack.pop()
+            if name in reachable:
+                continue
+            reachable.add(name)
+            for callee in _self_calls(methods[name]):
+                if callee in methods and callee not in reachable:
+                    stack.append(callee)
+
+        for name in sorted(reachable):
+            for node in _own_statements(methods[name]):
+                if not isinstance(node, ast.Call):
+                    continue
+                f = node.func
+                if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+                    pair = (f.value.id, f.attr)
+                    if pair in _BLOCKING_MODULE_CALLS:
+                        findings.append(Finding(
+                            self.pass_id, src.rel, node.lineno,
+                            f"blocking call {pair[0]}.{pair[1]}() inside event-loop "
+                            f"handler SchedulerServer.{name}; post work to a thread "
+                            f"or use the sweep timer",
+                            symbol=f"{name}:{pair[0]}.{pair[1]}",
+                        ))
+                        continue
+                if isinstance(f, ast.Name) and f.id == "urlopen":
+                    findings.append(Finding(
+                        self.pass_id, src.rel, node.lineno,
+                        f"blocking urlopen() inside event-loop handler "
+                        f"SchedulerServer.{name}",
+                        symbol=f"{name}:urlopen",
+                    ))
+                    continue
+                if isinstance(f, ast.Attribute) and \
+                        f.attr in _TIMEOUT_REQUIRED_METHODS and not _has_timeout(node):
+                    findings.append(Finding(
+                        self.pass_id, src.rel, node.lineno,
+                        f".{f.attr}() without a timeout inside event-loop handler "
+                        f"SchedulerServer.{name}; an unbounded wait wedges the "
+                        f"whole scheduler",
+                        symbol=f"{name}:{f.attr}",
+                    ))
+        return findings

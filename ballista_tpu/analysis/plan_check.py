@@ -1,0 +1,243 @@
+"""Static verifier over staged physical plans and ExecutionGraphs.
+
+The distributed planner, the mesh merge pass, AQE replans, and graph
+recovery all REWRITE stage DAGs; each rewrite preserves a set of
+invariants nothing re-checks afterward. This module checks them:
+
+stage-list invariants (`verify_stages`):
+- stage ids unique; every root is a ShuffleWriterExec tagged with its own
+  stage id; `input_stage_ids` equals the UnresolvedShuffleExec leaves
+  actually present in the plan; references resolve; the DAG is acyclic
+- every shuffle edge agrees with its producer: the leaf's
+  `output_partitions` matches the producer stage's, the `broadcast` flag
+  matches, and the leaf's schema (field names + dtypes) matches what the
+  producer's writer actually emits
+- mesh gating (`merge_mesh_stages` postconditions): `stage.mesh` iff the
+  plan contains a MeshExchangeExec; a mesh stage is never a broadcast
+  producer; the exchange's device bucket count equals the stage's task
+  span
+
+graph invariants (`verify_graph`): all of the above on the stage specs,
+plus `effective_partitions <= spec.partitions` (AQE only shrinks), task
+ids below the fast-lane band (`FAST_TASK_ID_BASE` — graph tasks and fast
+jobs share the executor's task-id namespace), and resolved readers
+tagged with a live `source_stage_id`.
+
+Wiring: `ballista.debug.plan.verify` runs `check_stages` at submit time
+(after `merge_mesh_stages`) and `check_graph` after AQE replans, failing
+the job instead of executing a corrupt DAG. The TPC-H plan-stability
+tests call `check_stages` unconditionally on every golden plan.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ballista_tpu.errors import GeneralError
+from ballista_tpu.ops.tpu.mesh_stage import MeshExchangeExec, contains_mesh_exchange
+from ballista_tpu.shuffle.reader import ShuffleReaderExec, UnresolvedShuffleExec
+from ballista_tpu.shuffle.writer import ShuffleWriterExec
+
+
+@dataclass(frozen=True)
+class PlanViolation:
+    code: str  # stable machine tag, e.g. "edge-schema"
+    stage_id: int
+    message: str
+
+    def render(self) -> str:
+        return f"stage {self.stage_id}: [{self.code}] {self.message}"
+
+
+class PlanVerificationError(GeneralError):
+    """Raised by check_stages/check_graph; carries the full violation list."""
+
+    def __init__(self, violations: list[PlanViolation]):
+        self.violations = violations
+        super().__init__(
+            "plan verification failed:\n  " +
+            "\n  ".join(v.render() for v in violations)
+        )
+
+
+def _schema_fields(schema) -> list[tuple[str, str]]:
+    # compare names + dtypes; qualifiers legitimately differ across a
+    # shuffle edge (the reader drops table qualifiers the writer kept)
+    return [(f.name, str(f.dtype)) for f in schema]
+
+
+def _shuffle_leaves(plan) -> list:
+    out = []
+
+    def walk(n):
+        if isinstance(n, (UnresolvedShuffleExec, ShuffleReaderExec)):
+            out.append(n)
+        for c in n.children():
+            walk(c)
+
+    walk(plan)
+    return out
+
+
+def _mesh_exchanges(plan) -> list[MeshExchangeExec]:
+    out = []
+
+    def walk(n):
+        if isinstance(n, MeshExchangeExec):
+            out.append(n)
+        for c in n.children():
+            walk(c)
+
+    walk(plan)
+    return out
+
+
+def verify_stages(stages) -> list[PlanViolation]:
+    """Invariants over a list of QueryStage (pre-graph, post-merge)."""
+    v: list[PlanViolation] = []
+    by_id = {}
+    for s in stages:
+        if s.stage_id in by_id:
+            v.append(PlanViolation("dup-stage-id", s.stage_id,
+                                   "duplicate stage id in stage list"))
+        by_id[s.stage_id] = s
+
+    for s in stages:
+        plan = s.plan
+        if not isinstance(plan, ShuffleWriterExec):
+            v.append(PlanViolation("root-not-writer", s.stage_id,
+                                   f"stage root is {type(plan).__name__}, "
+                                   f"expected ShuffleWriterExec"))
+            continue
+        if plan.stage_id != s.stage_id:
+            v.append(PlanViolation("writer-stage-id", s.stage_id,
+                                   f"writer is tagged stage {plan.stage_id}"))
+        if plan.output_partitions > 0 and plan.output_partitions != s.output_partitions:
+            v.append(PlanViolation(
+                "writer-partitions", s.stage_id,
+                f"writer produces {plan.output_partitions} output partitions "
+                f"but the stage advertises {s.output_partitions}"))
+
+        leaves = [l for l in _shuffle_leaves(plan) if isinstance(l, UnresolvedShuffleExec)]
+        leaf_ids = sorted({l.stage_id for l in leaves})
+        if leaf_ids != sorted(set(s.input_stage_ids)):
+            v.append(PlanViolation(
+                "input-ids", s.stage_id,
+                f"input_stage_ids={sorted(set(s.input_stage_ids))} but the plan "
+                f"references stages {leaf_ids}"))
+
+        for leaf in leaves:
+            prod = by_id.get(leaf.stage_id)
+            if prod is None:
+                v.append(PlanViolation(
+                    "dangling-input", s.stage_id,
+                    f"reads stage {leaf.stage_id} which is not in the stage list"))
+                continue
+            if leaf.output_partitions != prod.output_partitions:
+                v.append(PlanViolation(
+                    "edge-partitions", s.stage_id,
+                    f"reads stage {prod.stage_id} expecting "
+                    f"{leaf.output_partitions} partitions; the producer makes "
+                    f"{prod.output_partitions}"))
+            if bool(leaf.broadcast) != bool(prod.broadcast):
+                v.append(PlanViolation(
+                    "edge-broadcast", s.stage_id,
+                    f"reads stage {prod.stage_id} with broadcast={leaf.broadcast} "
+                    f"but the producer stage has broadcast={prod.broadcast}"))
+            if isinstance(prod.plan, ShuffleWriterExec):
+                produced = _schema_fields(prod.plan.input.df_schema)
+                expected = _schema_fields(leaf.df_schema)
+                if produced != expected:
+                    v.append(PlanViolation(
+                        "edge-schema", s.stage_id,
+                        f"reads stage {prod.stage_id} expecting fields "
+                        f"{expected} but the producer emits {produced}"))
+
+        # mesh gating postconditions
+        exchanges = _mesh_exchanges(plan)
+        if bool(s.mesh) != bool(exchanges):
+            v.append(PlanViolation(
+                "mesh-flag", s.stage_id,
+                f"mesh={s.mesh} but the plan contains {len(exchanges)} "
+                f"MeshExchangeExec node(s); the flag and the plan must agree "
+                f"(pop_next_task ships mesh stages as ONE unsliced task)"))
+        if s.mesh and s.broadcast:
+            v.append(PlanViolation(
+                "mesh-broadcast", s.stage_id,
+                "a mesh stage cannot be a broadcast producer (the merge gate "
+                "rejects broadcast edges)"))
+        for ex in exchanges:
+            if ex.file_partitions != s.partitions:
+                v.append(PlanViolation(
+                    "mesh-buckets", s.stage_id,
+                    f"mesh exchange routes {ex.file_partitions} device buckets "
+                    f"but the stage spans {s.partitions} task partitions; the "
+                    f"single mesh task must cover exactly the reduce buckets"))
+
+    # acyclicity over the input-stage edges
+    state: dict[int, int] = {}  # 0=visiting, 1=done
+
+    def dfs(sid: int) -> bool:
+        if state.get(sid) == 1:
+            return True
+        if state.get(sid) == 0:
+            return False
+        state[sid] = 0
+        s = by_id.get(sid)
+        ok = all(dfs(i) for i in (s.input_stage_ids if s else []) if i in by_id)
+        state[sid] = 1
+        return ok
+
+    for sid in by_id:
+        if not dfs(sid):
+            v.append(PlanViolation("cycle", sid, "stage dependency cycle"))
+            break
+    return v
+
+
+def verify_graph(graph) -> list[PlanViolation]:
+    """verify_stages over the specs, plus runtime-state invariants."""
+    from ballista_tpu.serving.fast_lane import FAST_TASK_ID_BASE
+
+    stages = [st.spec for st in graph.stages.values()]
+    v = verify_stages(stages)
+    if graph.next_task_id >= FAST_TASK_ID_BASE:
+        v.append(PlanViolation(
+            "task-id-band", 0,
+            f"next_task_id={graph.next_task_id} has crossed the fast-lane "
+            f"band (FAST_TASK_ID_BASE={FAST_TASK_ID_BASE}); graph and fast "
+            f"tasks would collide in the executor task-id namespace"))
+    for st in graph.stages.values():
+        if st.effective_partitions > st.spec.partitions:
+            v.append(PlanViolation(
+                "aqe-grew", st.stage_id,
+                f"effective_partitions={st.effective_partitions} exceeds the "
+                f"planned {st.spec.partitions}; AQE may only shrink a stage"))
+        for task_id in st.running:
+            if task_id >= FAST_TASK_ID_BASE:
+                v.append(PlanViolation(
+                    "task-id-band", st.stage_id,
+                    f"running task {task_id} is inside the fast-lane id band"))
+        if st.resolved_plan is not None and st.resolved_plan is not st.spec.plan:
+            for leaf in _shuffle_leaves(st.resolved_plan):
+                if isinstance(leaf, UnresolvedShuffleExec):
+                    continue  # partially resolved plans are legal mid-flight
+                src = getattr(leaf, "source_stage_id", None)
+                if src is not None and src not in graph.stages:
+                    v.append(PlanViolation(
+                        "reader-source", st.stage_id,
+                        f"resolved reader tagged source_stage_id={src}, which "
+                        f"is not a stage of this graph"))
+    return v
+
+
+def check_stages(stages) -> None:
+    violations = verify_stages(stages)
+    if violations:
+        raise PlanVerificationError(violations)
+
+
+def check_graph(graph) -> None:
+    violations = verify_graph(graph)
+    if violations:
+        raise PlanVerificationError(violations)

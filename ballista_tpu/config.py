@@ -135,6 +135,8 @@ TPU_MESH_DEVICES = "ballista.tpu.mesh.devices"
 TPU_MESH_EXCHANGE_CAPACITY = "ballista.tpu.mesh.exchange.capacity.rows"
 TPU_MESH_MIN_ROWS = "ballista.tpu.mesh.min.rows"
 TPU_MESH_MAX_INPUT_BYTES = "ballista.tpu.mesh.max.input.bytes"
+# debug verifiers
+DEBUG_PLAN_VERIFY = "ballista.debug.plan.verify"
 
 
 @dataclass(frozen=True)
@@ -315,13 +317,15 @@ _ENTRIES: list[ConfigEntry] = [
     ConfigEntry(
         ADMISSION_SHED_DEPTH,
         "Pending-job depth at which the overload state machine leaves normal for "
-        "shedding (quotas halve; hysteresis exits at half this depth).",
+        "shedding (quotas halve; hysteresis exits at half this depth). Env: "
+        "BALLISTA_ADMISSION_SHED_DEPTH.",
         int, _env_int("BALLISTA_ADMISSION_SHED_DEPTH", 128), _pos,
     ),
     ConfigEntry(
         ADMISSION_DRAIN_DEPTH,
         "Pending-job depth at which shedding escalates to draining: ALL new "
-        "submissions are rejected until the backlog drains below the shed depth.",
+        "submissions are rejected until the backlog drains below the shed depth. "
+        "Env: BALLISTA_ADMISSION_DRAIN_DEPTH.",
         int, _env_int("BALLISTA_ADMISSION_DRAIN_DEPTH", 224), _pos,
     ),
     ConfigEntry(
@@ -391,7 +395,8 @@ _ENTRIES: list[ConfigEntry] = [
     ConfigEntry(
         SERVING_RESULT_MAX_BYTES,
         "Largest single result the cache will hold; bigger results are never "
-        "cached (they would evict many small interactive results).",
+        "cached (they would evict many small interactive results). Env: "
+        "BALLISTA_SERVING_RESULT_MAX_RESULT_BYTES.",
         int, _env_int("BALLISTA_SERVING_RESULT_MAX_RESULT_BYTES", 4 * 1024 * 1024), _pos,
     ),
     ConfigEntry(
@@ -715,9 +720,81 @@ _ENTRIES: list[ConfigEntry] = [
         "(also honored by bare runtime users with no session config).",
         str, _env_str("BALLISTA_TPU_COMPILE_CACHE", ""),
     ),
+    ConfigEntry(
+        DEBUG_PLAN_VERIFY,
+        "Run the static plan verifier (analysis/plan_check.py) over every "
+        "staged plan at submit time and after each AQE replan, failing the "
+        "job with PlanVerificationError on an invariant violation (stage-"
+        "boundary schema mismatch, partition-count drift on a shuffle edge, "
+        "mesh gating, task-id band collisions) instead of executing a "
+        "corrupt DAG. Cheap (pure graph walk, no IO) but off by default; "
+        "plan-stability tests run it unconditionally. Env escape hatch: "
+        "BALLISTA_PLAN_VERIFY=1.",
+        bool, _env_bool("BALLISTA_PLAN_VERIFY", False),
+    ),
 ]
 
 VALID_ENTRIES: dict[str, ConfigEntry] = {e.name: e for e in _ENTRIES}
+
+
+@dataclass(frozen=True)
+class EnvKnob:
+    """An environment-only knob: read by a daemon at import/startup time,
+    with no session-config equivalent (session config arrives after the
+    value is needed — e.g. module-cache sizing, native-lib discovery).
+    Registered here so the knob-sync analysis pass can verify every
+    BALLISTA_* env read maps to something documented; entries render into
+    docs/configs.md alongside the session keys."""
+
+    name: str
+    description: str
+    ty: type
+    default: Any
+
+
+_ENV_KNOBS: list[EnvKnob] = [
+    EnvKnob(
+        "BALLISTA_NATIVE_LIB",
+        "Explicit path to the native kernels .so (ops/native.py); unset = "
+        "discover next to the package, missing = numpy fallback.",
+        str, "",
+    ),
+    EnvKnob(
+        "BALLISTA_DEVICE_ORDINAL",
+        "Pin this executor's TPU device ordinal (-1 = auto). Read once at "
+        "executor startup, before any session config exists.",
+        int, -1,
+    ),
+    EnvKnob(
+        "BALLISTA_TPU_COMPILE_CACHE_ENTRIES",
+        "Entry cap of the in-process compiled-stage LruDict in the TPU "
+        "stage compiler (import-time sizing).",
+        int, 64,
+    ),
+    EnvKnob(
+        "BALLISTA_TPU_LUT_CACHE_ENTRIES",
+        "Entry cap of the device lookup-table LruDict (dictionary-encoded "
+        "string columns) in the TPU stage compiler.",
+        int, 256,
+    ),
+    EnvKnob(
+        "BALLISTA_TPU_BUILD_CACHE_ENTRIES",
+        "Entry cap of the join build-table LruDict in the TPU stage compiler.",
+        int, 32,
+    ),
+    EnvKnob(
+        "BALLISTA_TPU_BUILD_CACHE_BYTES",
+        "Byte budget of the join build-table LruDict (HBM-resident arrays).",
+        int, 2 * 1024**3,
+    ),
+    EnvKnob(
+        "BALLISTA_TPU_FINAL_CACHE_ENTRIES",
+        "Entry cap of the final-stage program LruDict (ops/tpu/final_stage.py).",
+        int, 64,
+    ),
+]
+
+ENV_KNOBS: dict[str, EnvKnob] = {k.name: k for k in _ENV_KNOBS}
 
 # Keys a remote client may NOT override on the shared daemons
 # (reference: restricted-config scrubbing, extension.rs:302).
@@ -810,6 +887,10 @@ def generate_config_docs() -> str:
     lines = [
         "# Configuration keys",
         "",
+        "<!-- GENERATED FILE — do not edit by hand. -->",
+        "<!-- Rendered from the config.py registry by dev/gen_configs.py; -->",
+        "<!-- the knob-sync analysis pass fails CI when this file is stale. -->",
+        "",
         "All keys are set per-session and shipped with every job as key/value",
         "pairs; executors apply them when building the task's runtime.",
         "",
@@ -818,5 +899,18 @@ def generate_config_docs() -> str:
     ]
     for e in _ENTRIES:
         lines.append(f"| `{e.name}` | {e.ty.__name__} | `{_fmt(e.default)}` | {e.description} |")
+    lines.extend([
+        "",
+        "## Environment-only knobs",
+        "",
+        "Read by daemons at import/startup time, before any session config",
+        "exists; no `ballista.*` equivalent. (Env *escape hatches* for session",
+        "keys are documented inline in the table above.)",
+        "",
+        "| variable | type | default | description |",
+        "|----------|------|---------|-------------|",
+    ])
+    for k in _ENV_KNOBS:
+        lines.append(f"| `{k.name}` | {k.ty.__name__} | `{_fmt(k.default)}` | {k.description} |")
     lines.append("")
     return "\n".join(lines)

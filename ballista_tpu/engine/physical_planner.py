@@ -648,7 +648,9 @@ def _rebind_schema(s: DFSchema) -> DFSchema:
 
 # -- crude cardinality estimator (join selection / broadcast decisions) -----
 
-_EST_CACHE: dict[int, float] = {}
+from ballista_tpu.utils.lru import LruDict
+
+_EST_CACHE = LruDict(max_entries=4096)
 
 
 def estimate_rows(node: LogicalPlan) -> float:
@@ -667,9 +669,6 @@ def estimate_rows(node: LogicalPlan) -> float:
         ref = weakref.ref(node)
     except TypeError:  # un-weakrefable: skip caching
         return v
-    if len(_EST_CACHE) > 4096:
-        for k in [k for k, (_, r) in _EST_CACHE.items() if r() is None]:
-            _EST_CACHE.pop(k, None)
     _EST_CACHE[key] = (v, ref)
     return v
 

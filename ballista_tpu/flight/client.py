@@ -86,6 +86,7 @@ POOL = ClientPool()
 
 # addresses whose server rejected io_coalesced_transport (native data
 # plane): don't re-probe them on every reduce task
+# analysis: ignore[bounded-cache] one entry per executor address; bounded by fleet size
 _NO_COALESCE: set[str] = set()
 _NO_COALESCE_LOCK = threading.Lock()
 

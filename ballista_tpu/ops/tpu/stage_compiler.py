@@ -78,69 +78,11 @@ log = logging.getLogger(__name__)
 
 MAX_SEGMENTS = 1 << 16
 
-
-class LruDict:
-    """Thread-safe LRU mapping with an entry cap and an optional byte budget
-    (`sizer(value)` → bytes). Long-lived executor sessions touch unbounded
-    stage populations; module caches must evict, not leak."""
-
-    def __init__(self, max_entries: int, max_bytes: int = 0, sizer=None):
-        import collections
-
-        self._od: "collections.OrderedDict" = collections.OrderedDict()
-        self._lock = threading.Lock()
-        self.max_entries = max(1, int(max_entries))
-        self.max_bytes = int(max_bytes)
-        self._sizer = sizer
-        self._bytes = 0
-        self.evictions = 0
-
-    def get(self, key, default=None):
-        with self._lock:
-            try:
-                self._od.move_to_end(key)
-            except KeyError:
-                return default
-            return self._od[key][0]
-
-    def __getitem__(self, key):
-        _MISS = object()
-        got = self.get(key, _MISS)
-        if got is _MISS:
-            raise KeyError(key)
-        return got
-
-    def __setitem__(self, key, value) -> None:
-        size = int(self._sizer(value)) if self._sizer else 0
-        with self._lock:
-            old = self._od.pop(key, None)
-            if old is not None:
-                self._bytes -= old[1]
-            self._od[key] = (value, size)
-            self._bytes += size
-            while len(self._od) > self.max_entries or (
-                self.max_bytes and self._bytes > self.max_bytes and len(self._od) > 1
-            ):
-                _, (_, sz) = self._od.popitem(last=False)
-                self._bytes -= sz
-                self.evictions += 1
-
-    def __contains__(self, key) -> bool:
-        with self._lock:
-            return key in self._od
-
-    def __len__(self) -> int:
-        with self._lock:
-            return len(self._od)
-
-    def nbytes(self) -> int:
-        with self._lock:
-            return self._bytes
-
-    def clear(self) -> None:
-        with self._lock:
-            self._od.clear()
-            self._bytes = 0
+# LruDict moved to utils/lru.py (PR 9) so CPU-side modules can bound their
+# caches without importing this module — the executor heartbeat keys TPU
+# gauges on `sys.modules` containing this module's name. Re-exported here
+# for back-compat.
+from ballista_tpu.utils.lru import LruDict  # noqa: E402
 
 
 # Entry budgets (env-tunable; these are safety rails for long-lived daemons,
@@ -174,12 +116,17 @@ class RunStats(Mapping):
     trace+lower), xla_compile_s (backend compile / persistent-cache fetch),
     compile_s (trace_s + xla_compile_s, the legacy total), compile_overlap_s
     (compile seconds hidden under the fill), exec_s (dispatch + fetch +
-    decode), persist_cache_hits/misses (per-run deltas), fusion_mode
+    decode), persist_cache_hits and persist_cache_misses (per-run deltas),
+    fusion_mode
     (staged | fused_xla | fused_pallas — the mode that actually ran),
     fusion_reason (the cost model's stated rationale), fused_spans
     (operator spans compiled into the single kernel; 0 in staged mode),
     fused_kernel_s (device seconds of the fused dispatch, or the sum of
-    per-span times in staged mode; span_s carries the per-span split)."""
+    per-span times in staged mode; span_s carries the per-span split),
+    mesh_devices (devices participating in a mesh-fused exchange stage),
+    exchange_bytes_on_device (bytes moved by the on-device all_to_all),
+    exchange_s (wall seconds of the exchange collective), mesh_mode_reason
+    (why the mesh merge pass did or did not fuse the exchange)."""
 
     _MAX_STAGES = 32
 

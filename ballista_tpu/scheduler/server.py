@@ -334,6 +334,7 @@ class SchedulerServer:
         from ballista_tpu.scheduler.planner import merge_mesh_stages
 
         stages = merge_mesh_stages(DistributedPlanner(job_id).plan_query_stages(physical), cfg)
+        self._maybe_verify_stages(stages, cfg, job_id)
         if template is not None and template.single_stage is None:
             template.single_stage = len(stages) == 1
         if (len(stages) == 1 and self.launcher is not None
@@ -349,6 +350,21 @@ class SchedulerServer:
             self.job_state.save_graph(graph)
         self.post(Event("revive"))
         return job_id
+
+    @staticmethod
+    def _maybe_verify_stages(stages, cfg: BallistaConfig, job_id: str) -> None:
+        """Static plan verification behind ballista.debug.plan.verify: a
+        violated DAG invariant fails the job at submit time (the raise
+        propagates into the planning-failure path) instead of executing a
+        corrupt plan. Off by default — the golden plan-stability tests run
+        the same checks unconditionally."""
+        from ballista_tpu.config import DEBUG_PLAN_VERIFY
+
+        if cfg is not None and bool(cfg.get(DEBUG_PLAN_VERIFY)):
+            from ballista_tpu.analysis.plan_check import check_stages
+
+            log.debug("plan verify: %d stages of %s", len(stages), job_id)
+            check_stages(stages)
 
     def _try_fast_lane(self, job_id: str, job_name: str, session_id: str,
                        cfg: BallistaConfig, stages, rkey) -> bool:
@@ -524,6 +540,7 @@ class SchedulerServer:
             from ballista_tpu.scheduler.planner import merge_mesh_stages
 
             stages = merge_mesh_stages(stages, cfg)
+            self._maybe_verify_stages(stages, cfg, job_id)
             old = self.jobs.get(job_id)
             graph = ExecutionGraph(job_id, old.job_name if old else "", session_id, stages, cfg)
             with self._jobs_lock:

@@ -487,6 +487,7 @@ class ExecutionGraph:
         # propagation → runtime join selection → obsolete-stage
         # cancellation); no-op unless ballista.planner.adaptive.enabled
         self.replanner.replan_after_finalize(self, stage, events)
+        self._maybe_verify(f"replan after stage {stage.stage_id} finalized")
         if self.status is not JobState.RUNNING:
             return
         for out_id in self.output_links.get(stage.stage_id, []):
@@ -574,6 +575,7 @@ class ExecutionGraph:
             stage.pending = list(range(new_parts))
             stage.effective_partitions = new_parts
         stage.state = StageState.RESOLVED
+        self._maybe_verify(f"stage {stage.stage_id} resolution")
 
     def _build_reader(self, inp: ExecutionStage) -> ShuffleReaderExec:
         # deterministic location order: completed.values() is task-ARRIVAL
@@ -593,6 +595,21 @@ class ExecutionGraph:
         reader = ShuffleReaderExec(schema, by_output, broadcast=inp.spec.broadcast)
         reader.source_stage_id = inp.stage_id  # AQE stats lookup tag
         return reader
+
+    def _maybe_verify(self, context: str) -> None:
+        """Re-check DAG invariants after a rewrite, failing the job rather
+        than executing a corrupt graph. Gated on ballista.debug.plan.verify."""
+        from ballista_tpu.config import DEBUG_PLAN_VERIFY
+
+        if not bool(self.config.get(DEBUG_PLAN_VERIFY)):
+            return
+        from ballista_tpu.analysis.plan_check import verify_graph
+
+        violations = verify_graph(self)
+        if violations:
+            detail = "; ".join(x.render() for x in violations)
+            log.error("plan verification failed after %s: %s", context, detail)
+            self._fail_job(f"plan verification failed after {context}: {detail}")
 
     def _fail_job(self, error: str) -> None:
         self.status = JobState.FAILED
@@ -741,22 +758,35 @@ class ExecutionGraph:
             config = BallistaConfig.from_key_value_pairs(
                 [(kv.key, kv.value) for kv in proto.settings]
             )
+        from ballista_tpu.ops.tpu.mesh_stage import contains_mesh_exchange
+        from ballista_tpu.scheduler.planner import _find_input_stages
+        from ballista_tpu.shuffle.reader import UnresolvedShuffleExec
+
+        plans: dict[int, object] = {sp.stage_id: decode_plan(sp.plan) for sp in proto.stages}
+        # the proto has no per-stage flags; the plans themselves are the
+        # durable record. A stage is a broadcast producer iff some consumer
+        # reads it through a broadcast leaf — without this a recovered
+        # broadcast stage would be read partition-wise and lose rows.
+        broadcast_ids: set[int] = set()
+        for plan in plans.values():
+            def walk(n):
+                if isinstance(n, UnresolvedShuffleExec) and n.broadcast:
+                    broadcast_ids.add(n.stage_id)
+                for c in n.children():
+                    walk(c)
+            walk(plan)
         stages = []
         links: dict[int, list[int]] = {}
         for sp in proto.stages:
-            plan = decode_plan(sp.plan)
-            from ballista_tpu.ops.tpu.mesh_stage import contains_mesh_exchange
-            from ballista_tpu.scheduler.planner import _find_input_stages
-
+            plan = plans[sp.stage_id]
             stages.append(
                 QueryStage(
                     stage_id=sp.stage_id, plan=plan,
                     partitions=sp.partitions,
                     output_partitions=plan.output_partitions or sp.partitions,
                     input_stage_ids=_find_input_stages(plan),
-                    # the proto has no mesh flag; the plan itself is the
-                    # durable record — a recovered mesh stage must keep its
-                    # single-task shape
+                    broadcast=sp.stage_id in broadcast_ids,
+                    # a recovered mesh stage must keep its single-task shape
                     mesh=contains_mesh_exchange(plan),
                 )
             )

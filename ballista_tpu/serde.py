@@ -61,6 +61,11 @@ from ballista_tpu.plan.physical import (
     SortPreservingMergeExec,
     UnionExec,
 )
+from ballista_tpu.ops.cpu.range_repartition import (
+    BufferExec,
+    RuntimeStatsExec,
+    UnorderedRangeRepartitionExec,
+)
 from ballista_tpu.ops.tpu.mesh_stage import MeshExchangeExec
 from ballista_tpu.plan.schema import DFField, DFSchema
 from ballista_tpu.proto import pb
@@ -465,6 +470,28 @@ def encode_plan(plan: ExecutionPlan) -> pb.PhysicalPlanNode:
         n.n = plan.file_partitions
         for k in plan.keys:
             n.keys.append(encode_expr(k))
+    elif isinstance(plan, UnorderedRangeRepartitionExec):
+        # the dynamic range-repartition pipeline rides the repartition
+        # oneof too (same frozen-proto constraint as mesh_exchange); the
+        # SortKey's direction flags travel in the scheme string
+        n = out.repartition
+        n.input.CopyFrom(encode_plan(plan.input))
+        n.scheme = (f"range_unordered:asc={int(plan.key.ascending)},"
+                    f"nulls_first={int(plan.key.nulls_first)}")
+        n.n = plan.n
+        n.keys.append(encode_expr(plan.key.expr))
+    elif isinstance(plan, RuntimeStatsExec):
+        n = out.repartition
+        n.input.CopyFrom(encode_plan(plan.input))
+        n.scheme = "runtime_stats"
+        n.n = 0
+        if plan.sort_expr is not None:
+            n.keys.append(encode_expr(plan.sort_expr))
+    elif isinstance(plan, BufferExec):
+        n = out.repartition
+        n.input.CopyFrom(encode_plan(plan.input))
+        n.scheme = "buffer"
+        n.n = plan.max_bytes
     elif isinstance(plan, UnionExec):
         for c in plan.inputs:
             out.union.inputs.append(encode_plan(c))
@@ -586,6 +613,16 @@ def decode_plan(p: pb.PhysicalPlanNode) -> ExecutionPlan:
         n = p.repartition
         if n.scheme == "mesh_exchange":
             return MeshExchangeExec(decode_plan(n.input), [decode_expr(k) for k in n.keys], n.n)
+        if n.scheme.startswith("range_unordered:"):
+            flags = dict(kv.split("=") for kv in n.scheme.split(":", 1)[1].split(","))
+            key = SortKey(decode_expr(n.keys[0]), ascending=flags["asc"] == "1",
+                          nulls_first=flags["nulls_first"] == "1")
+            return UnorderedRangeRepartitionExec(decode_plan(n.input), key, n.n)
+        if n.scheme == "runtime_stats":
+            expr = decode_expr(n.keys[0]) if n.keys else None
+            return RuntimeStatsExec(decode_plan(n.input), expr)
+        if n.scheme == "buffer":
+            return BufferExec(decode_plan(n.input), n.n)
         return RepartitionExec(decode_plan(n.input), n.scheme, n.n, [decode_expr(k) for k in n.keys])
     if which == "union":
         return UnionExec([decode_plan(c) for c in p.union.inputs], decode_schema(p.union.schema))

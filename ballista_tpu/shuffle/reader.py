@@ -40,6 +40,7 @@ from ballista_tpu.plan.schema import DFSchema
 from ballista_tpu.shuffle import paths
 from ballista_tpu.shuffle.integrity import INTEGRITY, verify_or_raise
 from ballista_tpu.shuffle.types import PartitionLocation
+from ballista_tpu.utils.lru import LruDict
 
 
 class ShuffleReaderExec(ExecutionPlan):
@@ -206,8 +207,7 @@ class FetchGovernor:
         self.total.release()
 
 
-_GOV_CACHE: dict[tuple, FetchGovernor] = {}
-_GOV_LOCK = threading.Lock()
+_GOV_CACHE = LruDict(max_entries=64)
 
 
 def _governor(ctx: TaskContext) -> FetchGovernor:
@@ -219,16 +219,12 @@ def _governor(ctx: TaskContext) -> FetchGovernor:
         int(ctx.config.get(SHUFFLE_READER_MAX_PER_ADDR)),
         int(ctx.config.get(SHUFFLE_READER_MAX_BYTES)),
     )
-    with _GOV_LOCK:
-        g = _GOV_CACHE.get(key)
-        if g is None:
-            g = FetchGovernor(
-                int(ctx.config.get(SHUFFLE_READER_MAX_REQUESTS)),
-                int(ctx.config.get(SHUFFLE_READER_MAX_PER_ADDR)),
-                int(ctx.config.get(SHUFFLE_READER_MAX_BYTES)),
-            )
-            _GOV_CACHE[key] = g
+    g = _GOV_CACHE.get(key)
+    if g is not None:
         return g
+    # setdefault is atomic: concurrent reduce tasks with the same limits
+    # must share one governor or the global budgets mean nothing
+    return _GOV_CACHE.setdefault(key, FetchGovernor(*key))
 
 
 def _fetch_units(locs: list[PartitionLocation], remote: list[int],

@@ -1,0 +1,41 @@
+"""Regenerate docs/configs.md from the config.py registry.
+
+Usage: python dev/gen_configs.py [--check]
+
+--check exits 1 without writing if the committed file is stale (the same
+comparison the knob-sync analysis pass and tests/test_ops.py run in CI).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ballista_tpu.config import generate_config_docs  # noqa: E402
+
+
+def main(argv: list[str]) -> int:
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(root, "docs", "configs.md")
+    expected = generate_config_docs()
+    if "--check" in argv:
+        try:
+            with open(path, encoding="utf-8") as f:
+                actual = f.read()
+        except OSError:
+            actual = None
+        if actual != expected:
+            print(f"{path} is stale; run `python dev/gen_configs.py`", file=sys.stderr)
+            return 1
+        print(f"{path} is up to date")
+        return 0
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(expected)
+    print(f"wrote {path}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

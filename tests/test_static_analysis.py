@@ -1,0 +1,304 @@
+"""The engine invariant analyzer as a tier-1 gate (ISSUE 11).
+
+Two fronts:
+
+1. the AST lint suite (`ballista_tpu.analysis`) must report zero
+   actionable findings over the repo — new violations fail CI here, not
+   in review;
+2. the static plan verifier (`analysis.plan_check`) must accept every
+   real planner output and REJECT deliberately corrupted DAGs (schema
+   mismatch on a shuffle edge, partition-count mismatch, a mesh flag on
+   a stage with no exchange, ...).
+"""
+
+import json
+import os
+
+import pytest
+
+from ballista_tpu.analysis import Analyzer, SourceFile, load_baseline
+from ballista_tpu.analysis.core import repo_root
+from ballista_tpu.analysis.plan_check import (
+    PlanVerificationError,
+    check_stages,
+    verify_graph,
+    verify_stages,
+)
+
+from .tpch_plan_stability.fixtures import query_path, stats_context
+
+pytestmark = pytest.mark.analysis
+
+
+# -- the repo-wide gate -------------------------------------------------------
+
+
+def test_repo_is_clean():
+    """`python -m ballista_tpu.analysis` must exit 0: every pass over every
+    file, after suppressions and the checked-in baseline."""
+    report = Analyzer().run()
+    assert report.files_scanned > 100, "scan set collapsed — collect() is broken"
+    assert report.ok, "\n" + report.render()
+
+
+def test_baseline_entries_are_justified():
+    """Every grandfathered finding needs a hand-written reason, and no entry
+    may linger after its violation is fixed (run() flags those as stale)."""
+    path = os.path.join(repo_root(), "dev", "analysis_baseline.json")
+    baseline = load_baseline(path)
+    for key, reason in baseline.items():
+        assert reason.strip(), f"baseline entry {key!r} has no reason"
+        assert reason != "grandfathered; fix or justify", (
+            f"baseline entry {key!r} still carries the --update-baseline "
+            f"placeholder reason; write a real one"
+        )
+
+
+def test_cli_json_smoke():
+    from ballista_tpu.analysis.__main__ import main
+
+    assert main(["--json"]) == 0
+
+
+# -- suppression mechanics ----------------------------------------------------
+
+
+def _one_file_analyzer(rel: str, text: str) -> Analyzer:
+    from ballista_tpu.analysis.passes.bounded_cache import BoundedCachePass
+
+    return Analyzer(passes=[BoundedCachePass()], baseline_path="/dev/null",
+                    files=[SourceFile(rel, text)])
+
+
+def test_unsuppressed_cache_is_flagged():
+    report = _one_file_analyzer("ballista_tpu/x.py", "_CACHE = {}\n").run()
+    assert len(report.findings) == 1
+    assert report.findings[0].pass_id == "bounded-cache"
+    assert "_CACHE" in report.findings[0].message
+
+
+def test_line_suppression_with_reason():
+    report = _one_file_analyzer(
+        "ballista_tpu/x.py",
+        "# analysis: ignore[bounded-cache] bounded by protocol\n_CACHE = {}\n",
+    ).run()
+    assert not report.findings
+    assert len(report.suppressed) == 1
+    assert report.suppressed[0][1].reason == "bounded by protocol"
+
+
+def test_reasonless_suppression_does_not_count():
+    report = _one_file_analyzer(
+        "ballista_tpu/x.py",
+        "# analysis: ignore[bounded-cache]\n_CACHE = {}\n",
+    ).run()
+    assert len(report.findings) == 1
+    assert "lacks a reason" in report.findings[0].message
+
+
+def test_skip_file_suppression():
+    report = _one_file_analyzer(
+        "ballista_tpu/x.py",
+        "# analysis: skip-file[bounded-cache] generated registry module\n"
+        "_A = {}\n_B = []\n",
+    ).run()
+    assert not report.findings
+    assert len(report.suppressed) == 2
+
+
+def test_star_suppression_covers_every_pass():
+    report = _one_file_analyzer(
+        "ballista_tpu/x.py",
+        "_CACHE = {}  # analysis: ignore[*] scratch module\n",
+    ).run()
+    assert not report.findings and len(report.suppressed) == 1
+
+
+def test_baseline_grandfathers_and_goes_stale(tmp_path):
+    src = SourceFile("ballista_tpu/x.py", "_CACHE = {}\n")
+    from ballista_tpu.analysis.passes.bounded_cache import BoundedCachePass
+
+    finding = BoundedCachePass().run(
+        Analyzer(passes=[], baseline_path="/dev/null", files=[src])
+    )[0]
+    baseline = tmp_path / "b.json"
+    baseline.write_text(json.dumps({"findings": [
+        {"key": finding.key(), "reason": "pre-existing; tracked in #123"},
+        {"key": "bounded-cache:ballista_tpu/gone.py:_OLD", "reason": "x"},
+    ]}))
+    report = Analyzer(passes=[BoundedCachePass()], baseline_path=str(baseline),
+                      files=[src]).run()
+    assert not report.findings
+    assert [f.key() for f, _ in report.baselined] == [finding.key()]
+    # the entry for the deleted file no longer matches anything → stale → fail
+    assert report.stale_baseline == ["bounded-cache:ballista_tpu/gone.py:_OLD"]
+    assert not report.ok
+
+
+# -- the plan verifier over real planner output -------------------------------
+
+
+@pytest.fixture(scope="module")
+def q3_stages():
+    from ballista_tpu.scheduler.planner import DistributedPlanner
+
+    ctx = stats_context()
+    with open(query_path(3), encoding="utf-8") as f:
+        sql = f.read()
+    physical = ctx.create_physical_plan(ctx.sql(sql).plan)
+    return ctx, DistributedPlanner("q3gate").plan_query_stages(physical)
+
+
+def _fresh(ctx, n=3, job="fresh"):
+    from ballista_tpu.scheduler.planner import DistributedPlanner
+
+    with open(query_path(n), encoding="utf-8") as f:
+        sql = f.read()
+    physical = ctx.create_physical_plan(ctx.sql(sql).plan)
+    return DistributedPlanner(job).plan_query_stages(physical)
+
+
+def _leaves(plan):
+    from ballista_tpu.shuffle.reader import UnresolvedShuffleExec
+
+    out = []
+
+    def walk(n):
+        if isinstance(n, UnresolvedShuffleExec):
+            out.append(n)
+        for c in n.children():
+            walk(c)
+
+    walk(plan)
+    return out
+
+
+def test_planner_output_verifies_clean(q3_stages):
+    _, stages = q3_stages
+    assert verify_stages(stages) == []
+    check_stages(stages)  # does not raise
+
+
+def test_mesh_merged_output_verifies_clean():
+    from ballista_tpu.config import (
+        EXECUTOR_ENGINE,
+        TPU_MESH_ENABLED,
+        TPU_MIN_ROWS,
+        BallistaConfig,
+    )
+    from ballista_tpu.scheduler.planner import merge_mesh_stages
+
+    ctx = stats_context(engine="tpu")
+    stages = _fresh(ctx, n=3, job="q3mesh")
+    merged = merge_mesh_stages(
+        list(stages),
+        BallistaConfig({EXECUTOR_ENGINE: "tpu", TPU_MIN_ROWS: 0,
+                        TPU_MESH_ENABLED: True}),
+    )
+    assert any(s.mesh for s in merged), "q3 should mesh-fuse a hash edge"
+    assert verify_stages(merged) == []
+
+
+def test_rejects_schema_mismatch_on_shuffle_edge(q3_stages):
+    ctx, _ = q3_stages
+    stages = _fresh(ctx, job="corrupt-schema")
+    import pyarrow as pa
+
+    from ballista_tpu.plan.schema import DFField, DFSchema
+
+    corrupted = False
+    for s in stages:
+        for leaf in _leaves(s.plan):
+            leaf.df_schema = DFSchema([DFField("phantom_col", pa.int64())])
+            corrupted = True
+            break
+        if corrupted:
+            break
+    assert corrupted
+    with pytest.raises(PlanVerificationError) as ei:
+        check_stages(stages)
+    assert any(v.code == "edge-schema" for v in ei.value.violations)
+
+
+def test_rejects_partition_count_mismatch(q3_stages):
+    ctx, _ = q3_stages
+    stages = _fresh(ctx, job="corrupt-parts")
+    leaf = next(l for s in stages for l in _leaves(s.plan))
+    leaf.output_partitions += 7
+    with pytest.raises(PlanVerificationError) as ei:
+        check_stages(stages)
+    assert any(v.code == "edge-partitions" for v in ei.value.violations)
+
+
+def test_rejects_mesh_flag_without_exchange(q3_stages):
+    ctx, _ = q3_stages
+    stages = _fresh(ctx, job="corrupt-mesh")
+    stages[0].mesh = True  # no MeshExchangeExec anywhere in that plan
+    with pytest.raises(PlanVerificationError) as ei:
+        check_stages(stages)
+    assert any(v.code == "mesh-flag" for v in ei.value.violations)
+
+
+def test_rejects_dangling_and_duplicate_stage_ids(q3_stages):
+    ctx, _ = q3_stages
+    stages = _fresh(ctx, job="corrupt-ids")
+    # drop a PRODUCER some consumer still reads → dangling-input
+    victim = stages[0].stage_id
+    remaining = [s for s in stages if s.stage_id != victim]
+    violations = verify_stages(remaining)
+    assert any(v.code == "dangling-input" for v in violations)
+    dup = list(stages) + [stages[0]]
+    assert any(v.code == "dup-stage-id" for v in verify_stages(dup))
+
+
+# -- graph-level invariants ---------------------------------------------------
+
+
+def _graph(stages, config=None):
+    from ballista_tpu.scheduler.state.execution_graph import ExecutionGraph
+
+    return ExecutionGraph("jg", "gate", "sess", stages, config)
+
+
+def test_graph_of_planner_output_verifies_clean(q3_stages):
+    ctx, _ = q3_stages
+    g = _graph(_fresh(ctx, job="gclean"))
+    assert verify_graph(g) == []
+
+
+def test_graph_rejects_task_id_in_fast_lane_band(q3_stages):
+    from ballista_tpu.serving.fast_lane import FAST_TASK_ID_BASE
+
+    ctx, _ = q3_stages
+    g = _graph(_fresh(ctx, job="gband"))
+    g.next_task_id = FAST_TASK_ID_BASE + 5
+    assert any(v.code == "task-id-band" for v in verify_graph(g))
+
+
+def test_graph_rejects_aqe_growth(q3_stages):
+    ctx, _ = q3_stages
+    g = _graph(_fresh(ctx, job="ggrow"))
+    st = next(iter(g.stages.values()))
+    st.effective_partitions = st.spec.partitions + 1
+    assert any(v.code == "aqe-grew" for v in verify_graph(g))
+
+
+def test_debug_knob_fails_job_on_corrupt_graph(q3_stages):
+    """The ballista.debug.plan.verify wiring: _maybe_verify must fail the
+    job (not raise past the event loop) when the graph is corrupt."""
+    from ballista_tpu.config import DEBUG_PLAN_VERIFY, BallistaConfig
+    from ballista_tpu.scheduler.state.execution_graph import JobState
+
+    ctx, _ = q3_stages
+    g = _graph(_fresh(ctx, job="gknob"),
+               BallistaConfig({DEBUG_PLAN_VERIFY: True}))
+    next(iter(g.stages.values())).spec.mesh = True  # corrupt: no exchange
+    g._maybe_verify("unit test")
+    assert g.status is JobState.FAILED
+    assert "mesh-flag" in g.error
+
+    # knob off → same corruption goes unchecked (the gate is opt-in)
+    g2 = _graph(_fresh(ctx, job="gknob2"))
+    next(iter(g2.stages.values())).spec.mesh = True
+    g2._maybe_verify("unit test")
+    assert g2.status is JobState.RUNNING

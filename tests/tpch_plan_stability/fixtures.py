@@ -102,10 +102,14 @@ def staged_plan_text(ctx, sql: str) -> str:
     """SQL → optimized logical → physical → distributed stages → stable
     text. Any change to stage boundaries, join modes/orders, broadcast
     decisions, or partition counts changes this text and fails the pin."""
+    from ballista_tpu.analysis.plan_check import check_stages
     from ballista_tpu.scheduler.planner import DistributedPlanner
 
     physical = ctx.create_physical_plan(ctx.sql(sql).plan)
     stages = DistributedPlanner("golden").plan_query_stages(physical)
+    # every golden plan must also satisfy the static DAG invariants —
+    # unconditional, unlike the ballista.debug.plan.verify runtime gate
+    check_stages(stages)
     out = []
     for s in stages:
         flags = []
