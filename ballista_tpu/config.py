@@ -129,6 +129,14 @@ TPU_FILL_THREADS = "ballista.tpu.fill.threads"
 TPU_FILL_CHUNK_ROWS = "ballista.tpu.fill.chunk_rows"
 TPU_COMPILE_OVERLAP = "ballista.tpu.compile.overlap"
 TPU_COMPILE_CACHE_DIR = "ballista.tpu.compile.cache_dir"
+# out-of-core execution (HBM-budgeted admission, host spill, grace fallback)
+TPU_HBM_BUDGET_BYTES = "ballista.tpu.hbm.budget.bytes"
+TPU_HBM_BUDGET_FRACTION = "ballista.tpu.hbm.budget.fraction"
+TPU_HBM_SPILL_ENABLED = "ballista.tpu.hbm.spill.enabled"
+TPU_HBM_SPILL_HOST_BYTES = "ballista.tpu.hbm.spill.host.bytes"
+TPU_HBM_SPILL_DIR = "ballista.tpu.hbm.spill.dir"
+TPU_HBM_GRACE_BUCKETS = "ballista.tpu.hbm.grace.buckets"
+TPU_HBM_GRACE_DEPTH = "ballista.tpu.hbm.grace.max.depth"
 # mesh-wide stage execution (planner mesh merge + on-device all_to_all exchange)
 TPU_MESH_ENABLED = "ballista.tpu.mesh.enabled"
 TPU_MESH_DEVICES = "ballista.tpu.mesh.devices"
@@ -478,9 +486,18 @@ _ENTRIES: list[ConfigEntry] = [
         "session config, it is armed via env on the executor — "
         "BALLISTA_CHAOS_CORRUPT_P (probability per served range), "
         "BALLISTA_CHAOS_CORRUPT_ONCE=1 (corrupt only the first serve of each "
-        "range: deterministic transient corruption), BALLISTA_CHAOS_SEED.",
+        "range: deterministic transient corruption), BALLISTA_CHAOS_SEED. "
+        "'hbm_oom' exercises the out-of-core TPU path: it deterministically "
+        "shrinks the device memory budget the stage compiler admits against "
+        "(no plan wrapping — a wrapped scan leaf would hide the stage from "
+        "the device compiler), armed via env on the executor — "
+        "BALLISTA_CHAOS_HBM_BUDGET (forced budget bytes, default 1 MiB) and "
+        "BALLISTA_CHAOS_HBM_OOM_N (additionally raise a synthetic "
+        "RESOURCE_EXHAUSTED on the Nth device upload, 0 = never; fires once, "
+        "so the evict-spill-retry rung can be observed converging).",
         str, "transient",
-        choices=("transient", "fatal", "panic", "delay", "straggler", "overload", "corrupt"),
+        choices=("transient", "fatal", "panic", "delay", "straggler", "overload",
+                 "corrupt", "hbm_oom"),
     ),
     ConfigEntry(
         CHAOS_STRAGGLER_DELAY_S,
@@ -635,6 +652,68 @@ _ENTRIES: list[ConfigEntry] = [
         "Use ICI collectives (shard_map all_to_all) instead of file shuffle for "
         "co-scheduled intra-slice stages.",
         bool, False,
+    ),
+    ConfigEntry(
+        TPU_HBM_BUDGET_BYTES,
+        "Out-of-core admission: per-stage device-memory budget in bytes the "
+        "HBM planner admits stage working sets against (probe table + "
+        "dictionary LUTs + join build tables). 0 = auto: "
+        "ballista.tpu.hbm.budget.fraction of the detected device memory "
+        "(jax memory_stats bytes_limit), falling back to "
+        "ballista.tpu.max.device.bytes when detection is unavailable "
+        "(CPU-jax). Every admission decision lands in RUN_STATS as "
+        "hbm_plan / hbm_plan_reason.",
+        int, 0, _nonneg,
+    ),
+    ConfigEntry(
+        TPU_HBM_BUDGET_FRACTION,
+        "Out-of-core admission: fraction of detected device memory used as "
+        "the HBM budget when ballista.tpu.hbm.budget.bytes is 0 (headroom "
+        "for XLA scratch and fusion intermediates).",
+        float, 0.85, lambda v: 0.0 < v <= 1.0,
+    ),
+    ConfigEntry(
+        TPU_HBM_SPILL_ENABLED,
+        "Out-of-core spill: cold DeviceTableCache entries demote to host "
+        "buffers (and past the host budget, to attempt-unique tmp+rename "
+        "spill files) instead of being dropped, and re-upload transparently "
+        "on the next touch. Off, eviction drops the entry and a re-touch "
+        "pays the full re-encode + re-upload.",
+        bool, True,
+    ),
+    ConfigEntry(
+        TPU_HBM_SPILL_HOST_BYTES,
+        "Out-of-core spill: host-buffer budget of the spill pool. Entries "
+        "past it demote to the disk tier (npz files written with the CPU "
+        "spill pool's tmp+rename discipline). Host-buffer bytes are "
+        "split-accounted against the session memory pool's device headroom, "
+        "never against the CPU sort-spill budget.",
+        int, 2 * 1024**3, _pos,
+    ),
+    ConfigEntry(
+        TPU_HBM_SPILL_DIR,
+        "Out-of-core spill: directory for disk-tier spill files. Empty = "
+        "the system temp directory. Files are attempt-unique and removed "
+        "when their entry is dropped or re-uploaded.",
+        str, "",
+    ),
+    ConfigEntry(
+        TPU_HBM_GRACE_BUCKETS,
+        "Grace fallback: sub-bucket fan-out per recursion level. When a "
+        "hash-join stage's working set exceeds the HBM budget, the build "
+        "side is re-split by a secondary hash into this many sub-buckets "
+        "per level (buckets^depth total) and the stage kernel runs once per "
+        "sub-bucket, sequentially, with probe rows kept in producer order.",
+        int, 4, lambda v: v >= 2,
+    ),
+    ConfigEntry(
+        TPU_HBM_GRACE_DEPTH,
+        "Grace fallback: max recursion depth of the secondary-hash split "
+        "(buckets^depth sub-buckets at the deepest rung). A working set "
+        "that still exceeds the budget at this depth demotes the stage to "
+        "the CPU engine — the always-correct final rung. 0 disables grace "
+        "entirely (over-budget join stages demote straight to CPU).",
+        int, 2, _nonneg,
     ),
     ConfigEntry(
         TPU_MESH_ENABLED,

@@ -95,10 +95,24 @@ class PhysicalPlanner:
             # If the device stage is later DECLINED, the oversized
             # collect_left runs on the host; HashJoinExec._build_table
             # warns when the built table exceeds the CPU rows threshold
-            from ballista_tpu.config import TPU_BROADCAST_JOIN_ROWS
+            from ballista_tpu.config import (
+                TPU_BROADCAST_JOIN_ROWS,
+                TPU_HBM_BUDGET_BYTES,
+                TPU_HBM_GRACE_DEPTH,
+            )
 
             self.broadcast_rows = max(
                 self.broadcast_rows, int(self.config.get(TPU_BROADCAST_JOIN_ROWS)))
+            # out-of-core seam: a tight EXPLICIT HBM budget with grace
+            # splitting disabled leaves no fallback between "build fits"
+            # and CPU demotion, so don't let the TPU threshold raise collect
+            # sizes the device can never admit. ~16 B/row is the widest
+            # single-column build footprint (i64 key + i64 payload); with
+            # grace enabled the admission ladder handles oversize builds.
+            budget = int(self.config.get(TPU_HBM_BUDGET_BYTES))
+            if budget > 0 and int(self.config.get(TPU_HBM_GRACE_DEPTH)) <= 0:
+                self.broadcast_rows = min(
+                    self.broadcast_rows, max(budget // 16, 1))
 
     def plan(self, logical: LogicalPlan) -> ExecutionPlan:
         return self._plan(logical)

@@ -61,6 +61,9 @@ def maybe_compile_tpu(physical: ExecutionPlan, config: BallistaConfig) -> Execut
     if cc_dir:
         runtime.init_compile_cache(cc_dir)
 
+    # capture the AQE resolve-time stamp before any rewrite rebuilds the
+    # root node (with_children does not carry ad-hoc attributes)
+    observed_bytes = int(getattr(physical, "hbm_observed_input_bytes", 0) or 0)
     physical = _concretize_dynamic_joins(physical)
 
     def walk(node: ExecutionPlan) -> ExecutionPlan:
@@ -101,7 +104,29 @@ def maybe_compile_tpu(physical: ExecutionPlan, config: BallistaConfig) -> Execut
 
     out = walk(physical)
     _wire_device_routing(out)
+    _wire_observed_bytes(observed_bytes, out)
     return out
+
+
+def _wire_observed_bytes(observed: int, out: ExecutionPlan) -> None:
+    """Propagate the AQE resolve-time stamp (HbmPrePlanRule's
+    `hbm_observed_input_bytes`, ground-truth input volume from the finished
+    producers) from the stage root onto every compiled device stage, where
+    HBM admission uses it as a floor under the build-size estimate. Plain
+    attributes both sides — executor-local by design (the serde note:
+    sub-plans never cross the wire)."""
+    from ballista_tpu.ops.tpu.stage_compiler import TpuStageExec
+
+    if observed <= 0:
+        return
+
+    def walk(node: ExecutionPlan) -> None:
+        if isinstance(node, TpuStageExec):
+            node.hbm_observed_input_bytes = observed
+        for c in node.children():
+            walk(c)
+
+    walk(out)
 
 
 def _wire_device_routing(root: ExecutionPlan) -> None:

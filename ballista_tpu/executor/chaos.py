@@ -18,11 +18,23 @@ server flips a bit in the bytes it streams, keyed by `corrupt_roll`/
 time. The data plane has no session config, hence the env knobs
 BALLISTA_CHAOS_CORRUPT_P / BALLISTA_CHAOS_CORRUPT_ONCE / BALLISTA_CHAOS_SEED
 documented on `ballista.chaos.mode`.
+
+Mode 'hbm_oom' is the other exception: it faults the DEVICE memory path,
+which chaos cannot reach by wrapping plan leaves — the TPU engine seam
+runs after chaos injection, and a ChaosExec-wrapped scan would hide the
+stage from the device compiler's chain matcher entirely (silently testing
+the CPU path instead). It arms module state in `ops.tpu.hbm` instead:
+the admission budget shrinks to BALLISTA_CHAOS_HBM_BUDGET bytes (default
+1 MiB) and, with BALLISTA_CHAOS_HBM_OOM_N > 0, the Nth device upload
+raises a synthetic RESOURCE_EXHAUSTED once. CPU-exercisable: the whole
+out-of-core ladder (spill, grace, OOM-retry) runs under interpret-mode
+jax in tier-1.
 """
 
 from __future__ import annotations
 
 import hashlib
+import os
 import time
 from typing import Iterator
 
@@ -159,12 +171,31 @@ class ChaosExec(ExecutionPlan):
             time.sleep(min(0.05, max(0.0, end - time.time())))
 
 
+def _sync_hbm_chaos(enabled: bool, mode: str) -> None:
+    """Arm or disarm the hbm_oom override in ops.tpu.hbm. Always syncs —
+    a previous session's armed state must not leak into a chaos-off run.
+    `ops.tpu.hbm` is import-light (no jax at module scope), so this does
+    not drag a backend into CPU-only executors."""
+    from ballista_tpu.ops.tpu import hbm
+
+    if enabled and mode == "hbm_oom":
+        hbm.arm_chaos(
+            int(os.environ.get("BALLISTA_CHAOS_HBM_BUDGET", str(1 << 20))),
+            int(os.environ.get("BALLISTA_CHAOS_HBM_OOM_N", "0")))
+    else:
+        hbm.disarm_chaos()
+
+
 def maybe_inject_chaos(plan: ExecutionPlan, config: BallistaConfig, stage_attempt: int = 0) -> ExecutionPlan:
-    if not bool(config.get(CHAOS_ENABLED)):
+    enabled = bool(config.get(CHAOS_ENABLED))
+    mode = str(config.get(CHAOS_MODE)) if enabled else ""
+    _sync_hbm_chaos(enabled, mode)
+    if not enabled or mode == "hbm_oom":
+        # hbm_oom never wraps the plan (see module docstring): the fault
+        # lives in the device upload path, not in leaf execution
         return plan
     seed = int(config.get(CHAOS_SEED))
     prob = float(config.get(CHAOS_PROBABILITY))
-    mode = str(config.get(CHAOS_MODE))
     delay_s = float(config.get(CHAOS_STRAGGLER_DELAY_S))
     straggler_part = int(config.get(CHAOS_STRAGGLER_PARTITION))
     straggler_stage = int(config.get(CHAOS_STRAGGLER_STAGE))

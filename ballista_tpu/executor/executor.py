@@ -260,6 +260,15 @@ class Executor:
                     # concurrent tasks of one session share the pool: idle
                     # tasks lend spill budget to a heavy sort (try_grow)
                     ctx.memory_pool = self.session_pools.get(task.session_id)
+                    if str(cfg.get(EXECUTOR_ENGINE)) == "tpu":
+                        # attach the device-side ledger: HBM headroom is
+                        # split-accounted from the host spill budget (the
+                        # stage compiler resyncs device_reserved from the
+                        # device-cache residency each run)
+                        from ballista_tpu.ops.tpu import hbm
+
+                        ctx.memory_pool.set_device_capacity(
+                            hbm.resolve_hbm_budget(cfg))
                 for meta_batch in prepared.execute(p, ctx):
                     locations.extend(
                         metadata_to_locations(

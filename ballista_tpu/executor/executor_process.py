@@ -318,9 +318,17 @@ class ExecutorProcess:
         for key in ("fill_s", "encode_s", "upload_s", "compile_s",
                     "compile_overlap_s", "exec_s", "device_bytes",
                     "fused_spans", "fused_kernel_s",
-                    "mesh_devices", "exchange_bytes_on_device", "exchange_s"):
+                    "mesh_devices", "exchange_bytes_on_device", "exchange_s",
+                    "hbm_budget_bytes", "hbm_spill_bytes", "hbm_spill_events",
+                    "hbm_reupload_events", "grace_splits", "hbm_oom_retries"):
             if key in stats:
                 out.append((f"tpu_{key}", float(stats[key])))
+        if "hbm_plan" in stats:
+            # gauges are floats: the admission ladder's rungs in demotion
+            # order (the string hbm_plan_reason stays in RUN_STATS)
+            code = {"run_whole": 0.0, "spill_colds": 1.0, "grace_split": 2.0,
+                    "cpu_demote": 3.0}
+            out.append(("tpu_hbm_plan", code.get(str(stats["hbm_plan"]), -1.0)))
         if "fusion_mode" in stats:
             # gauges are floats: staged=0, fused_xla=1, fused_pallas=2
             code = {"staged": 0.0, "fused_xla": 1.0, "fused_pallas": 2.0}

@@ -23,6 +23,47 @@ class MemoryPool:
         # forced reservations past capacity (observability: a non-zero value
         # means the deadline backstop fired under real memory pressure)
         self.overcommitted = 0
+        # device headroom is split-accounted from host headroom: HBM spill
+        # demotes DEVICE bytes to HOST buffers, so one ledger would let a
+        # spill storm eat the budget CPU sorts spill against (and vice
+        # versa). 0 = no device attached to this session's tasks.
+        self.device_capacity = 0
+        self.device_reserved = 0
+
+    def set_device_capacity(self, nbytes: int) -> None:
+        """Attach (or retune) the device-side ledger; monotonic max so
+        concurrent tasks of one session can't shrink each other's view."""
+        with self._lock:
+            self.device_capacity = max(self.device_capacity, int(nbytes))
+
+    def try_grow_device(self, nbytes: int) -> bool:
+        with self._lock:
+            if self.device_capacity <= 0:
+                return False
+            if self.device_reserved + nbytes > self.device_capacity:
+                return False
+            self.device_reserved += nbytes
+            return True
+
+    def shrink_device(self, nbytes: int) -> None:
+        with self._lock:
+            self.device_reserved = max(0, self.device_reserved - nbytes)
+
+    def sync_device_reserved(self, nbytes: int) -> None:
+        """Absolute resync from the device-cache residency snapshot: the
+        stage compiler owns the cache (global, LRU, spill-demoting), so the
+        ledger mirrors it instead of tracking paired grow/shrink calls that
+        cache evictions on OTHER sessions' stages would unbalance."""
+        with self._lock:
+            self.device_reserved = max(0, int(nbytes))
+
+    def device_pressure(self) -> float:
+        """Device-ledger saturation; independent of host `pressure()` by
+        construction (the split-accounting contract)."""
+        with self._lock:
+            if self.device_capacity <= 0:
+                return 0.0
+            return self.device_reserved / self.device_capacity
 
     def try_grow(self, nbytes: int) -> bool:
         with self._lock:
@@ -123,6 +164,14 @@ class SessionPoolRegistry:
         with self._lock:
             pools = [p for p, _ in self._pools.values()]
         return max((p.pressure() for p in pools), default=0.0)
+
+    def aggregate_device_pressure(self) -> float:
+        """Max device-ledger saturation across live session pools — the
+        device-side twin of `aggregate_pressure`, kept separate so host
+        admission gating never confuses HBM pressure with sort pressure."""
+        with self._lock:
+            pools = [p for p, _ in self._pools.values()]
+        return max((p.device_pressure() for p in pools), default=0.0)
 
     def total_overcommitted(self) -> int:
         """Lifetime forced-overcommit bytes across live pools (satellite

@@ -277,12 +277,47 @@ class TpuFinalStageExec(ExecutionPlan):
                     logging.getLogger(__name__).info(
                         "tpu final-stage fallback (%s): %s", e, self.agg.node_str())
                     self._results = {}
-                except Exception:  # noqa: BLE001
-                    logging.getLogger(__name__).warning(
-                        "tpu final stage raised; falling back to cpu for %s",
-                        self.agg.node_str(), exc_info=True,
-                    )
+                except Exception as e:  # noqa: BLE001 — classified below
                     self._results = {}
+                    from ballista_tpu.config import TPU_HBM_SPILL_ENABLED
+                    from ballista_tpu.ops.tpu import hbm
+                    from ballista_tpu.ops.tpu import stage_compiler as _sc
+
+                    if hbm.is_resource_exhausted(e):
+                        # runtime OOM rung, final-stage edition: free the
+                        # device (spilling residents to host) and retry ONCE
+                        # on device before the CPU demotion — the retry
+                        # re-reads the child, which the decline contract
+                        # already permits (see _fallback's re-read branch)
+                        logging.getLogger(__name__).warning(
+                            "final stage RESOURCE_EXHAUSTED; spilling + "
+                            "retrying once: %s", e)
+                        spill_pool = (
+                            hbm.SPILL_POOL
+                            if bool(self.config.get(TPU_HBM_SPILL_ENABLED))
+                            else None)
+                        _sc.DEVICE_CACHE.spill_all(spill_pool)
+                        hbm.note_oom(self.fingerprint)
+                        hbm.consume_oom_hint(self.fingerprint)  # no grace rung here
+                        try:
+                            with device_scope(ctx.device_ordinal):
+                                self._results = self._tpu_run_all(ctx)
+                            self.tpu_count += 1
+                            self._device_ok = True
+                            self._mat_input = None
+                            _sc.RUN_STATS.set("hbm_oom_retries",
+                                              hbm.oom_retry_count())
+                        except Exception:  # noqa: BLE001
+                            logging.getLogger(__name__).warning(
+                                "final stage OOM persisted after spill+retry; "
+                                "falling back to cpu for %s",
+                                self.agg.node_str(), exc_info=True)
+                            self._results = {}
+                    else:
+                        logging.getLogger(__name__).warning(
+                            "tpu final stage raised; falling back to cpu for %s",
+                            self.agg.node_str(), exc_info=True,
+                        )
             if partition not in self._results and self._device_ok:
                 # results were already consumed (a consumer re-executed this
                 # partition); caches are hot, so re-running the device path
@@ -463,9 +498,27 @@ class TpuFinalStageExec(ExecutionPlan):
             if dc.valid is not None:
                 proj_bytes += cell_bytes  # bool validity plane
         max_bytes = int(self.config.get(TPU_MAX_DEVICE_BYTES))
+        # fold the HBM admission budget into the pre-upload cap: the final
+        # stage has no build side to grace-split, so the ladder here is just
+        # run-whole vs CPU demotion — but the decision still lands in
+        # RunStats so /api/executors sees WHY a final stage left the device
+        from ballista_tpu.ops.tpu import hbm
+        from ballista_tpu.ops.tpu.stage_compiler import RUN_STATS
+
+        budget = hbm.resolve_hbm_budget(self.config)
+        if budget > 0:
+            max_bytes = min(max_bytes, budget)
+        RUN_STATS.set("hbm_budget_bytes", budget)
         if proj_bytes > max_bytes:
+            RUN_STATS.set("hbm_plan", hbm.CPU_DEMOTE)
+            RUN_STATS.set(
+                "hbm_plan_reason",
+                f"final stage needs {proj_bytes} B > budget {max_bytes} B")
             raise Unsupported(
                 f"final stage needs {proj_bytes} device bytes (> cap {max_bytes})")
+        RUN_STATS.set("hbm_plan", hbm.RUN_WHOLE)
+        RUN_STATS.set("hbm_plan_reason",
+                      f"final stage fits: {proj_bytes} B <= {max_bytes} B")
 
         kinds, scales, dicts, cols_np, valids_np = [], [], [], [], []
         for dc in encoded:

@@ -85,6 +85,17 @@ class StageEstimate:
     max_probe_table: int  # largest direct build table (entries), 0 if none
     agg_funcs: tuple = ()
     spans: list = field(default_factory=list)
+    # HBM working-set bytes (admission inputs for the out-of-core planner).
+    # table_bytes reproduces DeviceTable.nbytes exactly — data stacks +
+    # validity planes + row mask, all [P, N] — so it is computable from a
+    # spec table before the uploads drain. dict_bytes prices the string
+    # LUTs the stage uploads per dictionary column (the undercount this
+    # field fixes: codes were budgeted, their dictionaries were not).
+    table_bytes: int = 0
+    dict_bytes: int = 0
+    build_bytes: int = 0  # all join build sides, device layout
+    max_build_bytes: int = 0  # largest single build (the grace-split target)
+    max_build_jidx: int = -1  # its join index, -1 when no builds
 
 
 @dataclass
@@ -225,6 +236,27 @@ def estimate_stage(scan, ops, agg, dt, builds) -> StageEstimate:
                 break
             group_domain *= _pow2(len(slot[1]))
 
+    import numpy as np
+
+    P, N = dt.shape
+    # mirror _load's nbytes accumulation term for term: data stacks,
+    # validity planes of nullable columns, then the [P, N] row mask
+    table_bytes = sum(P * N * np.dtype(c.dtype).itemsize for c in dt.cols)
+    table_bytes += sum(P * N for v in dt.valids if v is not None)
+    table_bytes += P * N
+    # each dictionary column uploads a pow2-padded LUT; 8 B/slot covers the
+    # widest remap target (int64 combined keys / i64 decode tables)
+    dict_bytes = sum(_pow2(len(d)) * 8 for d in dt.dicts if d)
+    build_bytes = 0
+    max_build_bytes = 0
+    max_build_jidx = -1
+    for j, bt in enumerate(builds or []):
+        b = sum(int(getattr(a, "nbytes", 0)) for a in bt.flat_arrays())
+        dict_bytes += sum(_pow2(len(d)) * 8 for d in bt.dicts if d)
+        build_bytes += b
+        if b > max_build_bytes:
+            max_build_bytes, max_build_jidx = b, j
+
     agg_funcs = tuple(d.func for d in agg.aggs) if agg is not None else ()
     return StageEstimate(
         rows=sum(dt.part_rows),
@@ -239,6 +271,11 @@ def estimate_stage(scan, ops, agg, dt, builds) -> StageEstimate:
         max_probe_table=max_probe_table,
         agg_funcs=agg_funcs,
         spans=spans,
+        table_bytes=table_bytes,
+        dict_bytes=dict_bytes,
+        build_bytes=build_bytes,
+        max_build_bytes=max_build_bytes,
+        max_build_jidx=max_build_jidx,
     )
 
 

@@ -46,11 +46,17 @@ from ballista_tpu.config import (
     TPU_FILL_THREADS,
     TPU_FUSION_PALLAS_MAX_GROUPS,
     TPU_FUSION_PALLAS_MAX_PROBE,
+    TPU_HBM_GRACE_BUCKETS,
+    TPU_HBM_GRACE_DEPTH,
+    TPU_HBM_SPILL_DIR,
+    TPU_HBM_SPILL_ENABLED,
+    TPU_HBM_SPILL_HOST_BYTES,
     TPU_MAX_DEVICE_BYTES,
     TPU_MIN_ROWS,
     BallistaConfig,
     _env_int,
 )
+from ballista_tpu.ops.tpu import hbm
 from ballista_tpu.ops.tpu.columnar import encode_column, encode_stacked, next_bucket
 from ballista_tpu.ops.tpu.kernels import (
     DevVal,
@@ -126,7 +132,14 @@ class RunStats(Mapping):
     mesh_devices (devices participating in a mesh-fused exchange stage),
     exchange_bytes_on_device (bytes moved by the on-device all_to_all),
     exchange_s (wall seconds of the exchange collective), mesh_mode_reason
-    (why the mesh merge pass did or did not fuse the exchange)."""
+    (why the mesh merge pass did or did not fuse the exchange),
+    hbm_budget_bytes (the resolved device budget the stage was admitted
+    against), hbm_plan (run_whole | spill_colds | grace_split | cpu_demote)
+    and hbm_plan_reason (the admission ladder's stated rationale),
+    hbm_spill_bytes / hbm_spill_events / hbm_reupload_events (cumulative
+    host-spill-pool counters), grace_splits (sub-buckets actually executed
+    by a grace-partitioned join), hbm_oom_retries (cumulative stage re-runs
+    after a caught RESOURCE_EXHAUSTED; the evict-spill-retry rung)."""
 
     _MAX_STAGES = 32
 
@@ -329,13 +342,17 @@ class DeviceTableCache:
         self._lock = threading.Lock()
         self._inflight: dict[tuple, threading.Event] = {}
 
-    def get(self, scan, buckets: list[int], ctx, max_bytes: int,
-            mesh=None, *, fill_threads: int = 0, chunk_rows: int = 0,
-            stats: dict | None = None, on_spec=None) -> DeviceTable:
+    def table_key(self, scan, ctx, mesh=None) -> tuple:
         # device_ordinal in the key: an in-process cluster of differently
         # pinned executors must not share tables committed to one chip
-        key = (self.key_of(scan) + ((mesh.devices.size,) if mesh is not None else ())
-               + (ctx.device_ordinal,))
+        return (self.key_of(scan) + ((mesh.devices.size,) if mesh is not None else ())
+                + (ctx.device_ordinal,))
+
+    def get(self, scan, buckets: list[int], ctx, max_bytes: int,
+            mesh=None, *, fill_threads: int = 0, chunk_rows: int = 0,
+            stats: dict | None = None, on_spec=None,
+            spill_pool=None) -> DeviceTable:
+        key = self.table_key(scan, ctx, mesh)
         with self._lock:
             hit = self._cache.get(key)
             if hit is not None:
@@ -355,21 +372,72 @@ class DeviceTableCache:
             return hit
         try:
             t0 = time.time()
-            dt = self._load(scan, buckets, ctx, mesh, fill_threads=fill_threads,
-                            chunk_rows=chunk_rows, stats=stats, on_spec=on_spec)
+            # spilled-entry fast path: a previously demoted table re-uploads
+            # from its host (or disk) copy instead of re-running the whole
+            # read+encode fill — the transparent-on-touch half of the spill
+            # contract. on_spec still fires so compile/fill overlap holds.
+            restored = spill_pool.pop(key) if spill_pool is not None else None
+            if restored is not None:
+                dt = _restore_device_table(restored, mesh)
+                if on_spec is not None:
+                    on_spec(dt)
+            else:
+                dt = self._load(scan, buckets, ctx, mesh, fill_threads=fill_threads,
+                                chunk_rows=chunk_rows, stats=stats, on_spec=on_spec)
             RUN_STATS.set("fill_s", round(time.time() - t0, 3), rec=stats)
             RUN_STATS.set("device_bytes", dt.nbytes, rec=stats)
             with self._lock:
                 total = sum(v.nbytes for v in self._cache.values())
                 while self._cache and total + dt.nbytes > max_bytes:
-                    _, old = self._cache.popitem(last=False)
+                    old_key, old = self._cache.popitem(last=False)
                     total -= old.nbytes
+                    if spill_pool is not None:
+                        _spill_device_table(spill_pool, old_key, old)
                 self._cache[key] = dt
             return dt
         finally:
             with self._lock:
                 self._inflight.pop(key, None)
             ev.set()
+
+    def resident_bytes(self, exclude_key: tuple | None = None) -> int:
+        """Device bytes held by cached tables other than `exclude_key` —
+        the admission planner's `resident_other` (cold residency that
+        spill_colds can reclaim)."""
+        with self._lock:
+            return sum(v.nbytes for k, v in self._cache.items() if k != exclude_key)
+
+    def ensure_headroom(self, max_bytes: int, keep_key: tuple | None,
+                        spill_pool=None) -> int:
+        """Demote cold entries (all but `keep_key`) until residency fits
+        `max_bytes`. Returns bytes freed. The spill_colds admission rung."""
+        freed = 0
+        victims = []
+        with self._lock:
+            total = sum(v.nbytes for v in self._cache.values())
+            for k in list(self._cache):
+                if total <= max_bytes:
+                    break
+                if k == keep_key:
+                    continue
+                old = self._cache.pop(k)
+                total -= old.nbytes
+                freed += old.nbytes
+                victims.append((k, old))
+        for k, old in victims:
+            if spill_pool is not None:
+                _spill_device_table(spill_pool, k, old)
+        return freed
+
+    def spill_all(self, spill_pool=None) -> None:
+        """Demote EVERY resident table — the runtime RESOURCE_EXHAUSTED
+        rung frees the whole device before the one retry."""
+        with self._lock:
+            items = list(self._cache.items())
+            self._cache.clear()
+        for k, old in items:
+            if spill_pool is not None:
+                _spill_device_table(spill_pool, k, old)
 
     def clear(self) -> None:
         with self._lock:
@@ -548,6 +616,51 @@ class DeviceTableCache:
         return DeviceTable(kinds, scales, dicts, cols, mask, part_rows, nbytes, valids)
 
 
+def _record_spill_stats(rec: dict, spill_pool) -> None:
+    """Mirror the host spill pool's cumulative counters into the run record
+    (the RUN_STATS → heartbeat → /api/executors gauge path)."""
+    if spill_pool is None:
+        return
+    st = spill_pool.stats()
+    RUN_STATS.set("hbm_spill_bytes", st["spill_bytes"], rec=rec)
+    RUN_STATS.set("hbm_spill_events", st["spill_events"], rec=rec)
+    RUN_STATS.set("hbm_reupload_events", st["reupload_events"], rec=rec)
+    RUN_STATS.set("hbm_oom_retries", hbm.oom_retry_count(), rec=rec)
+
+
+def _spill_device_table(pool, key: tuple, dt: DeviceTable) -> None:
+    """Demote one cached DeviceTable to the host spill pool: fetch every
+    device plane back to numpy and hand the flat list (cols, mask, valids —
+    None slots preserved) plus the encode metadata to the pool. The pool
+    owns tiering (host buffers vs tmp+rename disk files)."""
+    jax = ensure_jax()
+    flat = ([np.asarray(jax.device_get(c)) for c in dt.cols]
+            + [np.asarray(jax.device_get(dt.mask))]
+            + [None if v is None else np.asarray(jax.device_get(v))
+               for v in dt.valids])
+    meta = (list(dt.kinds), list(dt.scales), list(dt.dicts),
+            list(dt.part_rows), int(dt.nbytes))
+    pool.put(key, meta, flat, int(dt.nbytes))
+
+
+def _restore_device_table(restored, mesh) -> DeviceTable:
+    """Re-upload a spilled table: the inverse of _spill_device_table, using
+    the same placement chokepoint (_put) as the cold fill."""
+    meta, flat = restored
+    kinds, scales, dicts, part_rows, nbytes = meta
+    n = len(kinds)
+    if mesh is not None:
+        from jax.sharding import PartitionSpec
+
+        spec = PartitionSpec("part", None)
+    else:
+        spec = None
+    cols = [_put(mesh, a, spec) for a in flat[:n]]
+    mask = _put(mesh, flat[n], spec)
+    valids = [None if a is None else _put(mesh, a, spec) for a in flat[n + 1:]]
+    return DeviceTable(kinds, scales, dicts, cols, mask, part_rows, nbytes, valids)
+
+
 DEVICE_CACHE = DeviceTableCache()
 
 
@@ -559,6 +672,7 @@ def clear_device_caches() -> None:
     _COMPILE_CACHE.clear()
     _LUT_CACHE.clear()
     _BUILD_CACHE.clear()
+    hbm.SPILL_POOL.clear()
     from ballista_tpu.ops.tpu import final_stage
 
     final_stage.clear_compile_cache()
@@ -691,7 +805,7 @@ class TpuStageExec(ExecutionPlan):
 
     def _fallback(self, partition: int, ctx: TaskContext) -> list[pa.RecordBatch]:
         """Re-run the original CPU subtree (scan filters applied on host)."""
-        from ballista_tpu.plan.physical import HashJoinExec
+        from ballista_tpu.plan.physical import CoalescePartitionsExec, HashJoinExec
 
         self.fallback_count += 1
         node: ExecutionPlan = self.scan
@@ -700,14 +814,33 @@ class TpuStageExec(ExecutionPlan):
                 node = op.with_children([op.left, node])
             else:
                 node = op.with_children([node])
+        if self.emit_pid is not None:
+            # device-routed layout contract: the device path ships EVERY
+            # group through map task 0 (__pid routing) and empties the other
+            # map outputs. Tasks decide device-vs-CPU independently (a
+            # runtime OOM can demote ONE task after its peers served the
+            # routed layout), so a classic partition-p partial here would
+            # double-count surviving device outputs — or, demoting task 0,
+            # silently drop every other partition's groups. Keep the shape:
+            # task 0 aggregates the WHOLE input; the shuffle writer's host
+            # hash is the device routing's bit-exact twin, so each group
+            # still meets its partials in the same reduce partition.
+            if partition != 0:
+                return [_empty_batch(self.schema())]
+            node = CoalescePartitionsExec(node)
         agg = self.partial_agg.with_children([node])
         return [b for b in agg.execute(partition, ctx)]
 
     # ------------------------------------------------------------------
 
     def _prepare_build(self, join, jidx: int, ctx: TaskContext, table_key,
-                       mesh=None) -> BuildTable:
-        """Collect + encode + sort a join's build side for device probing."""
+                       mesh=None, grace: tuple[int, int] | None = None) -> BuildTable:
+        """Collect + encode + sort a join's build side for device probing.
+
+        `grace=(bucket, n_buckets)`: keep only the build rows whose combined
+        key falls in the given secondary-hash sub-bucket (the grace-split
+        path). Sub-builds carry their bucket in the cache key — a sub-build
+        and the whole build must never alias."""
         import numpy as np
 
         from ballista_tpu.ops.phys_expr import bind_expr, evaluate_to_array
@@ -716,7 +849,7 @@ class TpuStageExec(ExecutionPlan):
         jax = ensure_jax()
         jnp = jax.numpy
         cache_key = (table_key, self.fingerprint, jidx, mesh.devices.size if mesh else 0,
-                     ctx.device_ordinal)
+                     ctx.device_ordinal, grace)
         hit = _BUILD_CACHE.get(cache_key)
         if hit is not None:
             return hit
@@ -773,6 +906,15 @@ class TpuStageExec(ExecutionPlan):
                     raise Unsupported("primary join key out of combine range")
                 key_np = (key_np << shift) | vals
                 shifts.append(shift)
+        if grace is not None:
+            bucket, n_buckets = grace
+            sel = hbm.grace_bucket_of(key_np, n_buckets) == bucket
+            if not sel.any():
+                raise Unsupported(
+                    f"empty grace sub-bucket {bucket}/{n_buckets}")
+            key_np = key_np[sel]
+            tbl = tbl.filter(pa.array(sel)).combine_chunks()
+            batch = tbl.to_batches()[0]
         uniq, counts = np.unique(key_np, return_counts=True)
         dup = int(counts.max())
         membership_only = join.join_type in ("right_semi", "right_anti") and join.filter is None
@@ -874,7 +1016,36 @@ class TpuStageExec(ExecutionPlan):
     def _tpu_run_all(self, ctx: TaskContext) -> dict[int, list[pa.RecordBatch]]:
         tag = f"stage_{zlib.crc32(self.fingerprint.encode()):08x}"
         with RUN_STATS.run(tag) as rec:
-            return self._tpu_run_all_inner(ctx, rec)
+            try:
+                return self._tpu_run_all_inner(ctx, rec)
+            except Unsupported:
+                raise
+            except Exception as e:  # noqa: BLE001 — classified below
+                if not hbm.is_resource_exhausted(e):
+                    raise
+                # runtime OOM rung: the estimate said fit and the device
+                # disagreed. Free everything (spilling residents to host so
+                # their fills aren't lost), hint the planner to pre-plan
+                # grace for this fingerprint, and retry ONCE; a second OOM
+                # demotes to the CPU engine via the Unsupported ladder.
+                log.warning("device RESOURCE_EXHAUSTED; spilling + retrying "
+                            "stage once: %s", e)
+                spill_pool = (hbm.SPILL_POOL
+                              if bool(self.config.get(TPU_HBM_SPILL_ENABLED))
+                              else None)
+                DEVICE_CACHE.spill_all(spill_pool)
+                _LUT_CACHE.clear()
+                _BUILD_CACHE.clear()
+                hbm.note_oom(self.fingerprint)
+                rec["hbm_oom_retries"] = hbm.oom_retry_count()
+                try:
+                    return self._tpu_run_all_inner(ctx, rec)
+                except Exception as e2:  # noqa: BLE001
+                    if hbm.is_resource_exhausted(e2):
+                        raise Unsupported(
+                            f"device OOM persisted after spill+retry: {e2}"
+                        ) from e2
+                    raise
 
     def _compile_key(self, dt: DeviceTable, builds: list[BuildTable],
                      mode_req: str = "fused_xla") -> tuple:
@@ -960,6 +1131,17 @@ class TpuStageExec(ExecutionPlan):
         jax = ensure_jax()
 
         max_bytes = int(self.config.get(TPU_MAX_DEVICE_BYTES))
+        budget = hbm.resolve_hbm_budget(self.config)
+        if budget > 0:
+            # the cache cap never exceeds the admission budget: a chaos- or
+            # knob-shrunk budget drives real evictions (and thus spills)
+            max_bytes = min(max_bytes, budget)
+        spill_pool = None
+        if bool(self.config.get(TPU_HBM_SPILL_ENABLED)):
+            spill_pool = hbm.SPILL_POOL
+            spill_pool.configure(
+                int(self.config.get(TPU_HBM_SPILL_HOST_BYTES)),
+                str(self.config.get(TPU_HBM_SPILL_DIR) or ""))
         mesh = _stage_mesh(self.config)
         cc_dir = str(self.config.get(TPU_COMPILE_CACHE_DIR) or "")
         if cc_dir:
@@ -1033,7 +1215,7 @@ class TpuStageExec(ExecutionPlan):
                 dt = DEVICE_CACHE.get(
                     self.scan, self.buckets, ctx, max_bytes, mesh,
                     fill_threads=fill_threads, chunk_rows=chunk_rows,
-                    stats=rec, on_spec=on_spec)
+                    stats=rec, on_spec=on_spec, spill_pool=spill_pool)
                 fill_end = time.time()
                 if not spec_ev.is_set():
                     # device-cache hit: the fill never ran, so the spec never
@@ -1054,14 +1236,62 @@ class TpuStageExec(ExecutionPlan):
         else:
             dt = DEVICE_CACHE.get(self.scan, self.buckets, ctx, max_bytes, mesh,
                                   fill_threads=fill_threads,
-                                  chunk_rows=chunk_rows, stats=rec)
+                                  chunk_rows=chunk_rows, stats=rec,
+                                  spill_pool=spill_pool)
             if sum(dt.part_rows) < self.min_rows:
                 raise Unsupported(f"only {sum(dt.part_rows)} rows (< tpu min)")
             builds = [self._prepare_build(op, jidx, ctx, table_key, mesh)
                       for jidx, op in enumerate(join_ops)]
 
-        dec, _est = self._fusion_decision(dt, builds)
+        dec, est = self._fusion_decision(dt, builds)
         rec["fusion_reason"] = dec.reason
+
+        # ---- HBM admission: every stage states its memory plan before the
+        # dispatch, in the demotion-ladder style of fusion_reason. Splitting
+        # is only sound for an INNER join's build: a probe row's whole match
+        # set shares its key's sub-bucket, so wrong-bucket runs mask it like
+        # any unmatched probe; outer/anti would re-emit it per bucket.
+        grace_fanout = int(self.config.get(TPU_HBM_GRACE_BUCKETS))
+        grace_depth_cap = int(self.config.get(TPU_HBM_GRACE_DEPTH))
+        grace_eligible = (
+            not est.has_mult
+            and 0 <= est.max_build_jidx < len(join_ops)
+            and join_ops[est.max_build_jidx].join_type == "inner"
+        )
+        my_key = DEVICE_CACHE.table_key(self.scan, ctx, mesh)
+        plan = hbm.plan_stage(
+            est, budget,
+            grace_eligible=grace_eligible,
+            grace_fanout=grace_fanout,
+            grace_max_depth=grace_depth_cap,
+            resident_other=DEVICE_CACHE.resident_bytes(exclude_key=my_key),
+            observed_bytes=int(getattr(self, "hbm_observed_input_bytes", 0) or 0),
+            force_grace=hbm.consume_oom_hint(self.fingerprint),
+        )
+        rec["hbm_budget_bytes"] = budget
+        rec["hbm_plan"] = plan.decision
+        rec["hbm_plan_reason"] = plan.reason
+        mp = getattr(ctx, "memory_pool", None)
+        if mp is not None and hasattr(mp, "sync_device_reserved"):
+            # device vs host split-accounting: the session pool's device
+            # ledger mirrors the cache residency; host `pressure()` (the
+            # CPU sort-spill budget) never sees HBM bytes
+            mp.set_device_capacity(budget)
+            mp.sync_device_reserved(DEVICE_CACHE.resident_bytes())
+        if plan.decision == hbm.CPU_DEMOTE:
+            _record_spill_stats(rec, spill_pool)
+            raise Unsupported(f"hbm plan: {plan.reason}")
+        if plan.decision == hbm.SPILL_COLDS:
+            DEVICE_CACHE.ensure_headroom(
+                max(budget - plan.working_set, 0), my_key, spill_pool)
+        if plan.decision == hbm.GRACE_SPLIT:
+            try:
+                return self._grace_run(ctx, rec, dt, join_ops, builds, plan,
+                                       grace_fanout, grace_depth_cap, mesh,
+                                       table_key, dec)
+            finally:
+                _record_spill_stats(rec, spill_pool)
+
         if cached is None:
             cached, _, _ = self._compile_with_fallback(dt, builds, rec, dec.mode)
         fn, lowering, meta, state = cached
@@ -1120,7 +1350,91 @@ class TpuStageExec(ExecutionPlan):
             rec["persist_cache_hits"] = cc1["hits"] - cc0["hits"]
             rec["persist_cache_misses"] = (
                 (cc1["requests"] - cc0["requests"]) - (cc1["hits"] - cc0["hits"]))
+        _record_spill_stats(rec, spill_pool)
         return res
+
+    def _grace_run(self, ctx: TaskContext, rec: dict, dt: DeviceTable,
+                   join_ops: list, builds: list[BuildTable], plan,
+                   fanout: int, depth_cap: int, mesh, table_key,
+                   dec) -> dict[int, list[pa.RecordBatch]]:
+        """Grace-partitioned execution of a budget-breaking hash-join stage.
+
+        The split join's build side re-splits by a secondary hash of the
+        combined int64 key (hbm.grace_bucket_of — the splitmix64 lane
+        encoding lineage of the PR 7 exchange, salted so it is independent
+        of the routing hash) into `plan.grace_buckets` sub-buckets, each
+        executed sequentially on device as the SAME compiled stage shape
+        over the full probe table. Probe rows are never re-ordered: a row
+        whose key lives in bucket b matches only in run b and is masked (an
+        ordinary unmatched probe) in every other run, so concatenating the
+        per-partition partial-aggregate batches in bucket order reunifies
+        in producer row order and the downstream final aggregate merges
+        them exactly as it merges multi-partition partials — byte-identical
+        to the unconstrained run. Empty sub-builds are skipped; the
+        GraceReport postconditions are checked before results are served."""
+        jax = ensure_jax()
+        dicts = dt.dicts
+        P, _N = dt.shape
+        n_buckets = int(plan.grace_buckets)
+        jsplit = int(plan.split_jidx)
+        merged: dict[int, list[pa.RecordBatch]] = {p: [] for p in range(P)}
+        buckets_run: list[int] = []
+        buckets_empty: list[int] = []
+        for b in range(n_buckets):
+            try:
+                sub_builds = [
+                    self._prepare_build(op, j, ctx, table_key, mesh,
+                                        grace=(b, n_buckets))
+                    if j == jsplit else builds[j]
+                    for j, op in enumerate(join_ops)
+                ]
+            except Unsupported as e:
+                if "empty grace sub-bucket" in str(e):
+                    buckets_empty.append(b)
+                    continue
+                raise
+            cached, _, _ = self._compile_with_fallback(dt, sub_builds, rec, dec.mode)
+            fn, lowering, meta, state = cached
+            state["dispatched"] = True
+            # LUT cache bypass: sub-build dictionaries are bucket-dependent,
+            # and the (table, stage) LUT key has no bucket component
+            luts = [_put(mesh, l)
+                    for l in lowering.build_luts(dicts, [sb.dicts for sb in sub_builds])]
+            build_args = [sb.flat_arrays() for sb in sub_builds]
+            span_s: dict[str, float] = {}
+            if meta.get("exec") == "staged":
+                outs = fn(dt.flat_cols(), luts, dt.mask, build_args, span_s)
+            else:
+                outs = fn(dt.flat_cols(), luts, dt.mask, build_args)
+                jax.block_until_ready(list(outs))
+            if meta["mode"] == "sorted":
+                res = self._decode_sorted(outs, meta, P, dicts,
+                                          [sb.dicts for sb in sub_builds])
+            else:
+                outs = jax.device_get(list(outs))
+                res = self._decode_all(outs, meta, P, dicts,
+                                       [sb.dicts for sb in sub_builds])
+            for p, bl in res.items():
+                merged[p].extend(x for x in bl if x.num_rows)
+            buckets_run.append(b)
+
+        report = hbm.GraceReport(
+            stage_tag=f"stage_{zlib.crc32(self.fingerprint.encode()):08x}",
+            n_buckets=n_buckets, fanout=max(2, int(fanout)),
+            depth=int(plan.grace_depth), max_depth=int(depth_cap),
+            buckets_run=buckets_run, buckets_empty=buckets_empty)
+        from ballista_tpu.analysis.plan_check import check_grace
+
+        violations = check_grace(report)
+        if violations:
+            # a postcondition miss means the merged output cannot be trusted:
+            # demote to the always-correct CPU rung instead of serving it
+            raise Unsupported("grace postcondition violated: "
+                              + "; ".join(v.message for v in violations))
+        rec["grace_splits"] = len(buckets_run)
+        schema = self.schema()
+        return {p: (bl if bl else [_empty_batch(schema)])
+                for p, bl in merged.items()}
 
     # ------------------------------------------------------------------
 
@@ -2223,7 +2537,9 @@ class TpuStageExec(ExecutionPlan):
 def _put(mesh, arr, spec=None):
     """Place an array for stage execution: mesh-sharded/replicated under a
     mesh, plain device array otherwise. The single place that decides
-    placement (memory kind, donation would go here)."""
+    placement (memory kind, donation would go here) — which makes it the
+    single place chaos hbm_oom can fault an upload."""
+    hbm.maybe_chaos_oom()
     jax = ensure_jax()
     if mesh is None:
         return jax.numpy.asarray(arr)

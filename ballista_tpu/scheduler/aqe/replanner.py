@@ -335,13 +335,40 @@ class AlterFanoutRule:
         )
 
 
+class HbmPrePlanRule:
+    """Out-of-core pre-planning at resolution: once a stage's producers
+    have finished, its observed input volume is ground truth — stamp it on
+    the stage plan so the executor's HBM admission (ops/tpu/hbm.plan_stage)
+    floors its build-size estimate with reality instead of encode-time
+    guesses. This is what lets a RETRIED stage whose first attempt brushed
+    the budget pre-plan a grace split up front rather than rediscover the
+    overflow at dispatch. The stamp is a plain plan attribute, deliberately
+    outside the proto (the ISSUE 12 serde note: grace sub-plans are
+    executor-local and only stage stats ride heartbeats) — a multi-process
+    cluster that drops it on the wire simply falls back to estimate-only
+    admission, which is always safe.
+
+    Runs AFTER AlterFanoutRule: fan-out alteration rebuilds the writer
+    node, which would shed an earlier stamp."""
+
+    def on_resolve(self, graph, stage, inputs) -> None:
+        try:
+            total = sum(
+                l.stats.num_bytes for inp in inputs for l in inp.output_locations()
+            )
+        except Exception:  # noqa: BLE001 — a hint, never a scheduling failure
+            return
+        if total > 0:
+            stage.spec.plan.hbm_observed_input_bytes = int(total)
+
+
 class AdaptiveReplanner:
     """The pipeline driver. Owned by ExecutionGraph; every entry point runs
     under the graph lock."""
 
     def __init__(self):
         self.finalize_rules = [EmptyPropagationRule(), RuntimeJoinSelectionRule()]
-        self.resolve_rules = [AlterFanoutRule()]
+        self.resolve_rules = [AlterFanoutRule(), HbmPrePlanRule()]
 
     def replan_after_finalize(self, graph, finished, events: list[str]) -> None:
         from ballista_tpu.scheduler.state.execution_graph import JobState

@@ -46,6 +46,11 @@ class ExecutorSlot:
     corruption_strikes: int = 0
     checksum_failures: float = 0.0
     corruption_retries: float = 0.0
+    # -- out-of-core TPU execution (hbm.py demotion-ladder gauges) -----------
+    tpu_hbm_budget_bytes: float = 0.0
+    tpu_hbm_spill_bytes: float = 0.0
+    tpu_hbm_spill_events: float = 0.0
+    tpu_grace_splits: float = 0.0
 
     @property
     def failure_rate(self) -> float:
@@ -106,6 +111,14 @@ class ExecutorManager:
                     metrics.get("checksum_failures", ex.checksum_failures))
                 ex.corruption_retries = float(
                     metrics.get("corruption_retries", ex.corruption_retries))
+                ex.tpu_hbm_budget_bytes = float(
+                    metrics.get("tpu_hbm_budget_bytes", ex.tpu_hbm_budget_bytes))
+                ex.tpu_hbm_spill_bytes = float(
+                    metrics.get("tpu_hbm_spill_bytes", ex.tpu_hbm_spill_bytes))
+                ex.tpu_hbm_spill_events = float(
+                    metrics.get("tpu_hbm_spill_events", ex.tpu_hbm_spill_events))
+                ex.tpu_grace_splits = float(
+                    metrics.get("tpu_grace_splits", ex.tpu_grace_splits))
             return True
 
     def aggregate_pressure(self) -> float:
@@ -386,5 +399,9 @@ class ExecutorManager:
                     "corruption_strikes": e.corruption_strikes,
                     "checksum_failures": int(e.checksum_failures),
                     "corruption_retries": int(e.corruption_retries),
+                    "hbm_budget_bytes": int(e.tpu_hbm_budget_bytes),
+                    "hbm_spill_bytes": int(e.tpu_hbm_spill_bytes),
+                    "hbm_spill_events": int(e.tpu_hbm_spill_events),
+                    "grace_splits": int(e.tpu_grace_splits),
                 }
             return out

@@ -1,0 +1,134 @@
+"""Exercise the TPU out-of-core ladder end-to-end (CPU jax).
+
+    JAX_PLATFORMS=cpu python dev/oom_exercise.py
+
+Two legs:
+
+1. grace — TPC-H q3 runs unconstrained to learn its join stage's working
+   set W, then re-runs under an explicit HBM budget of W-1 bytes. The
+   admission planner must grace-split the join build (`hbm_plan =
+   grace_split`, `grace_splits > 0`) and the result must be
+   byte-identical to the unconstrained run.
+2. chaos — a standalone (executor-path) q3 with `chaos.mode = hbm_oom`
+   injecting one synthetic RESOURCE_EXHAUSTED on the first device upload
+   of each task. The runtime rung must spill + retry (`hbm_oom_retries
+   ≥ 1`, nonzero spill counters) and still return the baseline bytes.
+
+Exits non-zero if either leg fails.
+"""
+
+import os
+import re
+import sys
+import tempfile
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+_WORKING_RE = re.compile(r"working set (\d+) B")
+
+
+def q3_sql() -> str:
+    with open(os.path.join(ROOT, "benchmarks", "tpch", "queries", "q3.sql")) as f:
+        return f.read()
+
+
+def _fresh():
+    from ballista_tpu.ops.tpu import stage_compiler
+
+    stage_compiler.clear_device_caches()
+    stage_compiler.RUN_STATS.clear()
+
+
+def _join_stage_recs(stages: dict) -> list[dict]:
+    return [rec for rec in stages.values()
+            if _WORKING_RE.search(str(rec.get("hbm_plan_reason", "")))]
+
+
+def run_q3(data_dir: str, extra_cfg: dict | None = None, standalone: bool = False):
+    from ballista_tpu.client.context import SessionContext
+    from ballista_tpu.config import EXECUTOR_ENGINE, TPU_MIN_ROWS, BallistaConfig
+    from ballista_tpu.ops.tpu import stage_compiler
+    from ballista_tpu.testing.tpchgen import register_tpch
+
+    _fresh()
+    cfg = BallistaConfig({EXECUTOR_ENGINE: "tpu", TPU_MIN_ROWS: 0,
+                          **(extra_cfg or {})})
+    ctx = (SessionContext.standalone(cfg, num_executors=1, vcores=2)
+           if standalone else SessionContext(cfg))
+    try:
+        register_tpch(ctx, data_dir)
+        out = ctx.sql(q3_sql()).collect()
+    finally:
+        if standalone:
+            ctx.shutdown()
+    if out.num_rows == 0:
+        raise SystemExit("[q3] produced no rows")
+    return out, stage_compiler.RUN_STATS.stages()
+
+
+def leg_grace(data_dir: str) -> None:
+    from ballista_tpu.config import TPU_HBM_BUDGET_BYTES
+    from ballista_tpu.ops.tpu import hbm
+
+    whole, stages = run_q3(data_dir)
+    recs = _join_stage_recs(stages)
+    if not recs:
+        raise SystemExit(f"[grace] no join-stage hbm plan recorded: {stages}")
+    working = max(int(_WORKING_RE.search(r["hbm_plan_reason"]).group(1))
+                  for r in recs)
+
+    graced, stages = run_q3(data_dir, {TPU_HBM_BUDGET_BYTES: working - 1})
+    recs = [r for r in _join_stage_recs(stages)
+            if r.get("hbm_plan") == hbm.GRACE_SPLIT]
+    if not recs or not any(r.get("grace_splits", 0) > 0 for r in recs):
+        raise SystemExit(f"[grace] budget {working - 1} B did not grace-split: "
+                         f"{stages}")
+    if not graced.equals(whole):
+        raise SystemExit("[grace] grace-split result differs from the "
+                         "unconstrained run")
+    splits = max(r["grace_splits"] for r in recs)
+    print(f"[grace] ok: working set {working} B, budget {working - 1} B → "
+          f"{splits} sub-buckets, byte-identical")
+
+
+def leg_chaos(data_dir: str) -> None:
+    from ballista_tpu.config import CHAOS_ENABLED, CHAOS_MODE
+    from ballista_tpu.ops.tpu import hbm
+
+    baseline, _ = run_q3(data_dir, standalone=True)
+    os.environ["BALLISTA_CHAOS_HBM_BUDGET"] = str(1 << 30)
+    os.environ["BALLISTA_CHAOS_HBM_OOM_N"] = "1"
+    try:
+        chaotic, stages = run_q3(
+            data_dir, {CHAOS_ENABLED: True, CHAOS_MODE: "hbm_oom"},
+            standalone=True)
+    finally:
+        os.environ.pop("BALLISTA_CHAOS_HBM_BUDGET", None)
+        os.environ.pop("BALLISTA_CHAOS_HBM_OOM_N", None)
+        hbm.disarm_chaos()
+    retries = max((int(r.get("hbm_oom_retries", 0)) for r in stages.values()),
+                  default=0)
+    spills = max((int(r.get("hbm_spill_events", 0)) for r in stages.values()),
+                 default=0)
+    if retries < 1:
+        raise SystemExit(f"[chaos] injected OOM produced no spill+retry: {stages}")
+    if not chaotic.equals(baseline):
+        raise SystemExit("[chaos] post-OOM result differs from baseline")
+    print(f"[chaos] ok: {retries} spill+retry stage re-run(s), "
+          f"{spills} pool demotion(s), byte-identical")
+
+
+def main() -> None:
+    from ballista_tpu.testing.tpchgen import generate_tpch
+
+    with tempfile.TemporaryDirectory(prefix="oom-tpch-") as d:
+        print(f"generating TPC-H sf0.01 under {d} ...")
+        generate_tpch(d, scale=0.01, seed=42, files_per_table=2)
+        leg_grace(d)
+        leg_chaos(d)
+    print("oom exercise passed")
+
+
+if __name__ == "__main__":
+    main()

@@ -303,3 +303,23 @@ def test_fill_and_cache_knobs_registered():
     assert int(cfg.get(TPU_FILL_CHUNK_ROWS)) == 0
     assert bool(cfg.get(TPU_COMPILE_OVERLAP)) is True
     assert str(cfg.get(TPU_COMPILE_CACHE_DIR) or "") == ""
+
+
+def test_estimate_stage_matches_actual_device_bytes():
+    """The admission planner trusts estimate_stage byte-for-byte: its
+    table_bytes must equal the filled DeviceTable's nbytes (data stacks +
+    validity planes + row mask), and dictionary-coded string columns must
+    price their device LUTs in dict_bytes rather than undercounting to the
+    4-byte code plane alone."""
+    from ballista_tpu.ops.tpu import fusion
+
+    tbl = _mixed_table()
+    scan = _scan(tbl)
+    dt = _load(scan, fill_threads=1)
+    est = fusion.estimate_stage(scan, [], None, dt, [])
+    assert est.table_bytes == dt.nbytes
+    # "flag" is dictionary-encoded: the LUT rows must be priced
+    assert any(d for d in dt.dicts)
+    assert est.dict_bytes > 0
+    # the full working set the planner admits against is estimate-exact
+    assert est.table_bytes + est.dict_bytes >= dt.nbytes
