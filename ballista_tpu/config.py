@@ -124,6 +124,11 @@ TPU_FUSION_MODE = "ballista.tpu.fusion.mode"
 TPU_FUSION_MIN_ROWS = "ballista.tpu.fusion.min.rows"
 TPU_FUSION_PALLAS_MAX_GROUPS = "ballista.tpu.fusion.pallas.max.groups"
 TPU_FUSION_PALLAS_MAX_PROBE = "ballista.tpu.fusion.pallas.max.probe.rows"
+# on-device sort / window / top-k stage family
+TPU_SORT_ENABLED = "ballista.tpu.sort.enabled"
+TPU_SORT_PALLAS_MAX_ROWS = "ballista.tpu.sort.pallas.max.rows"
+TPU_TOPK_ENABLED = "ballista.tpu.topk.enabled"
+TPU_TOPK_MAX_K = "ballista.tpu.topk.max.k"
 # cold-path pipeline (fill/compile overlap + persistent XLA compile cache)
 TPU_FILL_THREADS = "ballista.tpu.fill.threads"
 TPU_FILL_CHUNK_ROWS = "ballista.tpu.fill.chunk_rows"
@@ -646,6 +651,40 @@ _ENTRIES: list[ConfigEntry] = [
         "to the Pallas hash-probe kernel (the key→row table must fit "
         "VMEM-resident per block). Larger tables probe via the XLA gather.",
         int, 1 << 18, _pos,
+    ),
+    ConfigEntry(
+        TPU_SORT_ENABLED,
+        "On-device sort / window / top-k stage family: when true the TPU "
+        "engine wraps eligible SortExec and WindowExec subtrees so ORDER "
+        "BY, window-aggregate, and ORDER BY ... LIMIT stages compute their "
+        "ordering permutation on device over the int64 lane encoding "
+        "(results stay byte-identical to the CPU engine; ineligible shapes "
+        "decline with a recorded reason and run on the host).",
+        bool, True,
+    ),
+    ConfigEntry(
+        TPU_SORT_PALLAS_MAX_ROWS,
+        "Cost model: max padded sort lanes (rows rounded up to a power of "
+        "two) routed to the Pallas bitonic segmented-sort kernel family. "
+        "Larger stages demote to the fused-XLA stable sort with the reason "
+        "recorded in fusion_reason.",
+        int, 1 << 17, _pos,
+    ),
+    ConfigEntry(
+        TPU_TOPK_ENABLED,
+        "Fused top-k for ORDER BY ... LIMIT final stages: select the k "
+        "smallest/largest lanes by chunked bitonic folding without ever "
+        "materializing the full sorted order. Off (or when the shape is "
+        "ineligible), LIMIT stages fall back to full sort + slice and "
+        "RUN_STATS sort_full_materializations counts it.",
+        bool, True,
+    ),
+    ConfigEntry(
+        TPU_TOPK_MAX_K,
+        "Cost model: max LIMIT fetch routed to the fused top-k kernel (the "
+        "kept set must stay a small power-of-two chunk per fold round). "
+        "Larger fetches use full sort + slice.",
+        int, 1024, _pos,
     ),
     ConfigEntry(
         TPU_COLLECTIVE_EXCHANGE,

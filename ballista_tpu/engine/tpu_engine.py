@@ -66,6 +66,17 @@ def maybe_compile_tpu(physical: ExecutionPlan, config: BallistaConfig) -> Execut
     observed_bytes = int(getattr(physical, "hbm_observed_input_bytes", 0) or 0)
     physical = _concretize_dynamic_joins(physical)
 
+    from ballista_tpu.ops.tpu.sort_window import (
+        TpuSortStageExec,
+        TpuWindowStageExec,
+        sort_family_enabled,
+        sort_static_ok,
+        window_static_ok,
+    )
+    from ballista_tpu.plan.physical import SortExec, WindowExec
+
+    sort_on = sort_family_enabled(config)
+
     def walk(node: ExecutionPlan) -> ExecutionPlan:
         fs = match_final_stage(node)
         if fs is not None:
@@ -94,6 +105,17 @@ def maybe_compile_tpu(physical: ExecutionPlan, config: BallistaConfig) -> Execut
                 pushed = _push_agg_through_union(node)
                 if pushed is not None:
                     return walk(pushed)
+        if (sort_on and isinstance(node, SortExec)
+                and sort_static_ok(node.keys, node.input.df_schema)):
+            # standalone ORDER BY [LIMIT] (final-stage shapes were claimed
+            # above): device permutation, host take — cost model picks the
+            # rung per shape at run time
+            return TpuSortStageExec(walk(node.input), node.keys, node.fetch,
+                                    config)
+        if (sort_on and isinstance(node, WindowExec)
+                and window_static_ok(node.window_exprs, node.input.df_schema)):
+            return TpuWindowStageExec(walk(node.input), node.window_exprs,
+                                      node.df_schema, config)
         kids = node.children()
         if not kids:
             return node

@@ -320,7 +320,10 @@ class ExecutorProcess:
                     "fused_spans", "fused_kernel_s",
                     "mesh_devices", "exchange_bytes_on_device", "exchange_s",
                     "hbm_budget_bytes", "hbm_spill_bytes", "hbm_spill_events",
-                    "hbm_reupload_events", "grace_splits", "hbm_oom_retries"):
+                    "hbm_reupload_events", "grace_splits", "hbm_oom_retries",
+                    "sort_kernel_s", "sort_invocations", "topk_invocations",
+                    "topk_rows_kept", "window_invocations",
+                    "window_partitions", "sort_full_materializations"):
             if key in stats:
                 out.append((f"tpu_{key}", float(stats[key])))
         if "hbm_plan" in stats:

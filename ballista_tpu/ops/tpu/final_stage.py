@@ -896,6 +896,10 @@ class TpuFinalStageExec(ExecutionPlan):
         n_seg, n_out = (int(x) for x in jax.device_get(outs[-2:]))
         if n_seg > C:
             raise Unsupported(f"group capacity overflow ({n_seg} > {C})")
+        if self.sort is not None and self.sort.fetch is not None:
+            from ballista_tpu.ops.tpu.sort_window import _count
+
+            _count("topk_rows_kept", n_out)
         results = {p: [_empty_batch(schema)] for p in range(P_result)}
         if n_out == 0:
             return results

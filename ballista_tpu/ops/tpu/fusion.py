@@ -57,6 +57,9 @@ PREDICATE = "predicate"
 PROJECT = "project"
 PROBE = "probe"
 AGGREGATE = "aggregate"
+SORT = "sort"
+WINDOW = "window"
+TOPK = "topk"
 
 
 @dataclass
@@ -96,6 +99,13 @@ class StageEstimate:
     build_bytes: int = 0  # all join build sides, device layout
     max_build_bytes: int = 0  # largest single build (the grace-split target)
     max_build_jidx: int = -1  # its join index, -1 when no builds
+    # ORDER BY / window family (estimate_sort_stage): key count, padded
+    # lane width (pow2 for the bitonic network), LIMIT fetch, window
+    # function count. Zero everywhere for aggregate stages.
+    sort_keys: int = 0
+    sort_lanes: int = 0
+    topk_k: int = 0
+    window_funcs: int = 0
 
 
 @dataclass
@@ -104,8 +114,14 @@ class FusionDecision:
     reason: str
 
 
-def plan_spans(n_scan_filters: int, ops, agg) -> list[Span]:
-    """Group the stage's op chain into fusible spans, dataflow order."""
+def plan_spans(n_scan_filters: int, ops, agg, *, sort_keys: int = 0,
+               fetch=None, window_funcs: int = 0) -> list[Span]:
+    """Group the stage's op chain into fusible spans, dataflow order.
+
+    The ORDER BY family rides the keyword tail: `sort_keys` > 0 appends a
+    SORT span (or a TOPK span when `fetch` bounds the output — the fused
+    top-k never materializes the full sort), and `window_funcs` > 0
+    appends a WINDOW span (segmented scans over the sorted layout)."""
     from ballista_tpu.plan.physical import (
         CoalesceBatchesExec,
         FilterExec,
@@ -136,6 +152,11 @@ def plan_spans(n_scan_filters: int, ops, agg) -> list[Span]:
             add(PROJECT)  # unknown residuals lower like projections or raise later
     if agg is not None:
         add(AGGREGATE)
+    if sort_keys > 0:
+        spans.append(Span(TOPK if fetch is not None else SORT,
+                          max(1, int(sort_keys))))
+    if window_funcs > 0:
+        spans.append(Span(WINDOW, max(1, int(window_funcs))))
     return spans
 
 
@@ -279,6 +300,59 @@ def estimate_stage(scan, ops, agg, dt, builds) -> StageEstimate:
     )
 
 
+def estimate_sort_stage(n_rows: int, key_meta, fetch=None,
+                        window_funcs: int = 0) -> StageEstimate:
+    """StageEstimate for an ORDER BY / window stage (the device-permutation
+    layout: only key lanes upload; payload columns stay host-side and are
+    gathered by the returned permutation).
+
+    `key_meta` is a sequence of (kind, nullable) per sort key — kind from
+    the lane encoding (i64/date/money/f64/code/bool). Priced per padded
+    lane (pow2 for the bitonic network):
+
+      per key: 8 B transformed i64 + 8 B null-rank tiebreak operand
+               (+ 1 B NaN-disambiguation plane for f64 keys)
+      fixed:   4 B position + 4 B permutation output
+      scans:   per window function, 8 B value lanes + 8 B scan state
+               + 4 B partition-boundary flags + 4 B peer-boundary flags
+               (boundary planes ship as int32 lanes)
+
+    The total lands in table_bytes so `hbm.plan_stage` admits the stage
+    through the same ladder as aggregate stages (no grace rung: sorts
+    have no splittable build side, so over-budget demotes to the CPU
+    engine with the reason recorded)."""
+    key_meta = list(key_meta)
+    lanes = _pow2(max(int(n_rows), 1))
+    per_key = 0
+    for kind, nullable in key_meta:
+        per_key += 8 + 8  # transformed key + tiebreak operand
+        if kind == "f64":
+            per_key += 1
+        if nullable:
+            per_key += 1
+    scratch = lanes * (per_key + 4 + 4)
+    scratch += int(window_funcs) * lanes * (8 + 8 + 4 + 4)
+    return StageEstimate(
+        rows=int(n_rows),
+        partitions=1,
+        group_domain=None,
+        n_group_keys=0,
+        lanes=1,
+        has_mult=False,
+        n_filters=0,
+        n_projections=0,
+        n_joins=0,
+        max_probe_table=0,
+        spans=plan_spans(0, (), None, sort_keys=len(key_meta),
+                         fetch=fetch, window_funcs=window_funcs),
+        table_bytes=scratch,
+        sort_keys=len(key_meta),
+        sort_lanes=lanes,
+        topk_k=int(fetch) if fetch is not None else 0,
+        window_funcs=int(window_funcs),
+    )
+
+
 @dataclass
 class CostModel:
     """Fuse-vs-stage chooser. All inputs are compile-time facts; the
@@ -292,6 +366,8 @@ class CostModel:
     pallas_max_probe: int = 1 << 18
     force_pallas: bool = False  # legacy ballista.tpu.pallas.enabled
     platform: str = "cpu"
+    sort_max_rows: int = 1 << 17  # pallas bitonic lane ceiling (padded)
+    topk_max_k: int = 1024  # above this, ORDER BY...LIMIT full-sorts
 
     @classmethod
     def from_config(cls, config) -> "CostModel":
@@ -302,6 +378,8 @@ class CostModel:
             TPU_FUSION_PALLAS_MAX_GROUPS,
             TPU_FUSION_PALLAS_MAX_PROBE,
             TPU_PALLAS,
+            TPU_SORT_PALLAS_MAX_ROWS,
+            TPU_TOPK_MAX_K,
         )
 
         return cls(
@@ -311,6 +389,8 @@ class CostModel:
             pallas_max_groups=int(config.get(TPU_FUSION_PALLAS_MAX_GROUPS)),
             pallas_max_probe=int(config.get(TPU_FUSION_PALLAS_MAX_PROBE)),
             force_pallas=bool(config.get(TPU_PALLAS)),
+            sort_max_rows=int(config.get(TPU_SORT_PALLAS_MAX_ROWS)),
+            topk_max_k=int(config.get(TPU_TOPK_MAX_K)),
         )
 
     def _pallas_eligible(self, est: StageEstimate) -> bool:
@@ -374,3 +454,44 @@ class CostModel:
         return FusionDecision(
             "fused_xla", "whole-chain XLA fusion (" + "; ".join(why) + ")"
         )
+
+    def _sort_pallas_eligible(self, est: StageEstimate) -> tuple[bool, str]:
+        from ballista_tpu.ops.tpu.pallas_kernels import MAX_SORT_LANES
+
+        cap = min(self.sort_max_rows, MAX_SORT_LANES)
+        if est.sort_lanes > cap:
+            return False, f"{est.sort_lanes} padded lanes > sort ceiling {cap}"
+        if est.topk_k and est.topk_k > self.topk_max_k:
+            return False, (f"fetch {est.topk_k} > topk.max.k {self.topk_max_k}"
+                           " — full sort + slice")
+        if est.topk_k and est.sort_keys > 1:
+            return False, (f"{est.sort_keys} sort keys — the top-k kernel "
+                           "takes one composite key; full sort + slice")
+        return True, ""
+
+    def choose_sort(self, est: StageEstimate) -> FusionDecision:
+        """Mode choice for the ORDER BY / window stage family. Same ladder
+        shape as `choose`: forced knob > disabled→staged > pallas on a
+        real TPU backend > fused_xla, every demotion with its reason."""
+        kinds = {s.kind for s in est.spans}
+        what = "window" if WINDOW in kinds else ("topk" if TOPK in kinds else "sort")
+        ok, why = self._sort_pallas_eligible(est)
+        if self.mode in ("staged", "fused_xla", "fused_pallas"):
+            if self.mode == "fused_pallas" and not ok:
+                return FusionDecision(
+                    "fused_xla", f"forced fused_pallas but {why}")
+            return FusionDecision(
+                self.mode, f"forced by ballista.tpu.fusion.mode={self.mode}")
+        if not self.enabled:
+            return FusionDecision(
+                "staged", "fusion disabled; per-pass lax.sort fallback")
+        if (self.platform == "tpu" or self.force_pallas) and ok:
+            return FusionDecision(
+                "fused_pallas",
+                f"{what} stage, {est.sort_lanes} lanes fit the kernel family")
+        parts = [why] if why else []
+        if self.platform != "tpu" and not self.force_pallas:
+            parts.append(f"platform={self.platform}")
+        return FusionDecision(
+            "fused_xla", f"{what} via whole-chain XLA sort ("
+                         + "; ".join(parts) + ")")

@@ -1,6 +1,6 @@
 """Pallas TPU kernel family for the fused stage-execution hot path.
 
-Two kernels back `fusion_mode=fused_pallas` in stage_compiler.py:
+Four kernel groups back `fusion_mode=fused_pallas`:
 
 - `masked_group_reduce`: per-(partition, group) masked (sum, count) over
   [P, N] value lanes. The per-group reduction is VECTORIZED inside the
@@ -16,6 +16,25 @@ Two kernels back `fusion_mode=fused_pallas` in stage_compiler.py:
   blocks stream through; the gather and the downstream predicate mask
   (in-range AND probe-valid AND row-present) fuse into one kernel so the
   match mask never round-trips through HBM.
+
+- `segmented_sort` / `topk_select`: the ORDER BY family over the int64
+  lane encoding (ints/dates widened, floats bit-twiddled order-preserving,
+  strings as lexicographic-rank dictionary codes, validity as a leading
+  null-rank operand). Each [P, N] row sorts independently with a bitonic
+  network expressed as static reshape + compare-exchange passes (no
+  gathers), over the lexicographic triple (key, tiebreak, position) — the
+  position operand makes the network's output identical to a STABLE sort
+  by (key, tiebreak). `topk_select` never materializes the full sort:
+  chunks of C = pow2(≥k) lanes sort locally, then pairs fold with the
+  elementwise-min bitonic trick (keep the C smallest of 2C, re-merge),
+  log2(N/C) rounds down to one sorted chunk.
+- `segmented_scan`: inclusive segmented sum/min/max over [P, N] lanes with
+  boundary resets — the window-aggregate primitive (Hillis-Steele with
+  flag propagation, log2(N) shift passes).
+- `dict_filter`: string predicates (eq / prefix / LIKE-literal) as a
+  VMEM-resident boolean LUT gather over dictionary codes, fused with the
+  incoming predicate mask — the hash_probe pattern applied to the host-
+  compiled predicate LUTs.
 
 Grid = (partition, [group tile,] row block); reduction outputs are
 revisited across row blocks and accumulated in place (the standard
@@ -183,3 +202,283 @@ def hash_probe(keys, table, mask, block_n: int = 2048):
         keys.astype(jnp.int32), mask.astype(jnp.int32), table.astype(jnp.int32)
     )
     return rows, matched != 0
+
+
+# ---------------------------------------------------------------------------
+# segmented sort / top-k (ORDER BY family)
+# ---------------------------------------------------------------------------
+
+MAX_SORT_LANES = 1 << 20  # absolute ceiling; the cost model caps lower
+
+
+def _cx3(jnp, lax, a, b, p, k: int, j: int):
+    """One bitonic compare-exchange pass over the last axis (length n,
+    pow2) of the lexicographic triple (a, b, p). Partner pairs at XOR
+    distance j are materialized by a reshape to [..., n/(2j), 2, j] — no
+    gathers, so the pass is pure VPU select traffic. Direction follows the
+    classic (index & k) == 0 rule; with k == n this is the all-ascending
+    merge of a bitonic sequence."""
+    sh = a.shape
+    n = sh[-1]
+    m = n // (2 * j)
+    s3 = sh[:-1] + (m, 2, j)
+    a3, b3, p3 = a.reshape(s3), b.reshape(s3), p.reshape(s3)
+    la, ha = a3[..., 0, :], a3[..., 1, :]
+    lb, hb = b3[..., 0, :], b3[..., 1, :]
+    lp, hp = p3[..., 0, :], p3[..., 1, :]
+    blk = lax.broadcasted_iota(jnp.int32, (m, j), 0)
+    up = ((blk * (2 * j)) & k) == 0
+    gt = (la > ha) | ((la == ha) & ((lb > hb) | ((lb == hb) & (lp > hp))))
+    sw = jnp.where(up, gt, ~gt)
+
+    def put(lo, hi):
+        return jnp.stack([jnp.where(sw, hi, lo), jnp.where(sw, lo, hi)],
+                         axis=-2).reshape(sh)
+
+    return put(la, ha), put(lb, hb), put(lp, hp)
+
+
+def _bitonic_sort3(jnp, lax, a, b, p):
+    """Full bitonic sort of each last-axis row, ascending by (a, b, p)."""
+    n = a.shape[-1]
+    k = 2
+    while k <= n:
+        j = k // 2
+        while j >= 1:
+            a, b, p = _cx3(jnp, lax, a, b, p, k, j)
+            j //= 2
+        k *= 2
+    return a, b, p
+
+
+@functools.lru_cache(maxsize=32)
+def _build_segmented_sort(P: int, N: int, interpret: bool):
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.experimental import pallas as pl
+
+    def kernel(a_ref, b_ref, p_ref, oa_ref, ob_ref, op_ref):
+        a, b, p = a_ref[0, :], b_ref[0, :], p_ref[0, :]
+        a, b, p = _bitonic_sort3(jnp, lax, a, b, p)
+        oa_ref[0, :] = a
+        ob_ref[0, :] = b
+        op_ref[0, :] = p
+
+    spec = pl.BlockSpec((1, N), lambda i: (i, 0))
+    fn = pl.pallas_call(
+        kernel,
+        grid=(P,),
+        in_specs=[spec, spec, spec],
+        out_specs=(spec, spec, spec),
+        out_shape=(
+            jax.ShapeDtypeStruct((P, N), jnp.int64),
+            jax.ShapeDtypeStruct((P, N), jnp.int64),
+            jax.ShapeDtypeStruct((P, N), jnp.int32),
+        ),
+        interpret=interpret,
+    )
+    return jax.jit(fn)
+
+
+def segmented_sort(a, b, pos):
+    """Sort each row of [P, N] ascending by the triple (a, b, pos).
+
+    a, b: i64 lanes (b is the tiebreak operand — zeros for single-key
+    sorts, the null-rank plane for nullable keys); pos: i32 original
+    positions. N must be a power of two; pad with (i64 max, i64 max,
+    i32 max) sentinels, which sort strictly after every real row.
+    Returns the sorted triple; the permutation is the pos output.
+    """
+    import jax.numpy as jnp
+
+    P, N = a.shape
+    if N & (N - 1):
+        raise ValueError(f"segmented_sort needs pow2 lanes, got {N}")
+    fn = _build_segmented_sort(P, N, interpret=_on_cpu())
+    return fn(a.astype(jnp.int64), b.astype(jnp.int64), pos.astype(jnp.int32))
+
+
+@functools.lru_cache(maxsize=32)
+def _build_topk(P: int, N: int, C: int, interpret: bool):
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.experimental import pallas as pl
+
+    def kernel(a_ref, b_ref, p_ref, oa_ref, ob_ref, op_ref):
+        nc = N // C
+        a = a_ref[0, :].reshape(nc, C)
+        b = b_ref[0, :].reshape(nc, C)
+        p = p_ref[0, :].reshape(nc, C)
+        # round 0: every C-lane chunk sorts locally (ascending)
+        a, b, p = _bitonic_sort3(jnp, lax, a, b, p)
+        # fold rounds: pair chunks, keep the C smallest of each 2C via the
+        # elementwise-min bitonic trick, re-merge (k=C ascending merge) —
+        # the full N-lane sort is never materialized
+        while a.shape[0] > 1:
+            ea, eb, ep = a[0::2], b[0::2], p[0::2]
+            oa, ob, op = a[1::2, ::-1], b[1::2, ::-1], p[1::2, ::-1]
+            lt = (ea < oa) | ((ea == oa) & ((eb < ob) | ((eb == ob) & (ep < op))))
+            a = jnp.where(lt, ea, oa)
+            b = jnp.where(lt, eb, ob)
+            p = jnp.where(lt, ep, op)
+            j = C // 2
+            while j >= 1:
+                a, b, p = _cx3(jnp, lax, a, b, p, C, j)
+                j //= 2
+        oa_ref[0, :] = a.reshape(C)
+        ob_ref[0, :] = b.reshape(C)
+        op_ref[0, :] = p.reshape(C)
+
+    in_spec = pl.BlockSpec((1, N), lambda i: (i, 0))
+    out_spec = pl.BlockSpec((1, C), lambda i: (i, 0))
+    fn = pl.pallas_call(
+        kernel,
+        grid=(P,),
+        in_specs=[in_spec, in_spec, in_spec],
+        out_specs=(out_spec, out_spec, out_spec),
+        out_shape=(
+            jax.ShapeDtypeStruct((P, C), jnp.int64),
+            jax.ShapeDtypeStruct((P, C), jnp.int64),
+            jax.ShapeDtypeStruct((P, C), jnp.int32),
+        ),
+        interpret=interpret,
+    )
+    return jax.jit(fn)
+
+
+def topk_select(a, b, pos, k: int):
+    """Per-row k smallest triples of [P, N] lanes, in sorted order —
+    ORDER BY ... LIMIT without the full sort. Same operand contract and
+    sentinel padding as segmented_sort. Returns [P, k] triples."""
+    import jax.numpy as jnp
+
+    P, N = a.shape
+    if N & (N - 1):
+        raise ValueError(f"topk_select needs pow2 lanes, got {N}")
+    C = 1
+    while C < max(k, 1):
+        C *= 2
+    C = min(max(C, 128), N)  # chunk floor keeps the fold shallow
+    fn = _build_topk(P, N, C, interpret=_on_cpu())
+    sa, sb, sp = fn(a.astype(jnp.int64), b.astype(jnp.int64),
+                    pos.astype(jnp.int32))
+    return sa[:, :k], sb[:, :k], sp[:, :k]
+
+
+# ---------------------------------------------------------------------------
+# segmented scans (window-aggregate primitive)
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=32)
+def _build_seg_scan(P: int, N: int, func: str, dtype_name: str, interpret: bool):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    dtype = jnp.dtype(dtype_name)
+    floating = jnp.issubdtype(dtype, jnp.floating)
+    # python scalars, not jnp arrays: the kernel must not capture tracers
+    if func == "sum":
+        ident = 0
+        op = jnp.add
+    elif func == "min":
+        ident = float("inf") if floating else int(jnp.iinfo(dtype).max)
+        op = jnp.minimum
+    else:  # max
+        ident = float("-inf") if floating else int(jnp.iinfo(dtype).min)
+        op = jnp.maximum
+
+    def kernel(v_ref, f_ref, o_ref):
+        v = v_ref[0, :]
+        f = f_ref[0, :] != 0
+        d = 1
+        # Hillis-Steele with boundary-flag OR-propagation: shifted-out
+        # positions read the identity under a True flag (the implicit
+        # segment boundary at lane 0)
+        while d < N:
+            pv = jnp.concatenate([jnp.full((d,), ident, dtype), v[:-d]])
+            pf = jnp.concatenate([jnp.ones((d,), jnp.bool_), f[:-d]])
+            v = jnp.where(f, v, op(v, pv))
+            f = f | pf
+            d *= 2
+        o_ref[0, :] = v
+
+    spec = pl.BlockSpec((1, N), lambda i: (i, 0))
+    fn = pl.pallas_call(
+        kernel,
+        grid=(P,),
+        in_specs=[spec, spec],
+        out_specs=spec,
+        out_shape=jax.ShapeDtypeStruct((P, N), dtype),
+        interpret=interpret,
+    )
+    return jax.jit(fn)
+
+
+def segmented_scan(vals, boundary, func: str):
+    """Inclusive segmented sum/min/max over each [P, N] row: the scan
+    resets wherever boundary is True (row 0 is an implicit boundary).
+    N must be a power of two; pad the tail with boundary=True lanes."""
+    import jax.numpy as jnp
+
+    P, N = vals.shape
+    if N & (N - 1):
+        raise ValueError(f"segmented_scan needs pow2 lanes, got {N}")
+    fn = _build_seg_scan(P, N, func, str(vals.dtype), interpret=_on_cpu())
+    return fn(vals, boundary.astype(jnp.int32))
+
+
+# ---------------------------------------------------------------------------
+# dictionary-code string predicates
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=32)
+def _build_dict_filter(P: int, N: int, block_n: int, T: int, interpret: bool):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    def kernel(codes_ref, mask_ref, lut_ref, keep_ref):
+        c = codes_ref[0, :]
+        m = mask_ref[0, :] != 0
+        lut = lut_ref[...]  # full [T] boolean LUT, VMEM-resident
+        keep_ref[0, :] = (m & (lut[c] != 0)).astype(jnp.int8)
+
+    grid = (P, N // block_n)
+    fn = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_n), lambda i, j: (i, j)),
+            pl.BlockSpec((1, block_n), lambda i, j: (i, j)),
+            pl.BlockSpec((T,), lambda i, j: (0,)),
+        ],
+        out_specs=pl.BlockSpec((1, block_n), lambda i, j: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((P, N), jnp.int8),
+        interpret=interpret,
+    )
+    return jax.jit(fn)
+
+
+def dict_filter(codes, lut, mask, block_n: int = 2048):
+    """String predicate over dictionary codes: keep = mask & lut[codes].
+
+    codes: i32 [P, N] dictionary indices (pre-clamped into [0, T));
+    lut: bool [T] host-compiled predicate truth table (eq / prefix /
+    LIKE-literal evaluated per dictionary entry, pow2-padded); mask:
+    bool [P, N]. Returns keep bool [P, N] — the gather and the mask
+    conjunction never round-trip through HBM."""
+    import jax.numpy as jnp
+
+    P, N = codes.shape
+    bn = min(block_n, N)
+    while N % bn:
+        bn //= 2
+    fn = _build_dict_filter(P, N, bn, int(lut.shape[0]), interpret=_on_cpu())
+    keep = fn(codes.astype(jnp.int32), mask.astype(jnp.int32),
+              lut.astype(jnp.int8))
+    return keep != 0

@@ -139,7 +139,15 @@ class RunStats(Mapping):
     hbm_spill_bytes / hbm_spill_events / hbm_reupload_events (cumulative
     host-spill-pool counters), grace_splits (sub-buckets actually executed
     by a grace-partitioned join), hbm_oom_retries (cumulative stage re-runs
-    after a caught RESOURCE_EXHAUSTED; the evict-spill-retry rung)."""
+    after a caught RESOURCE_EXHAUSTED; the evict-spill-retry rung),
+    sort_kernel_s (cumulative device seconds in the sort/window/top-k
+    family), sort_invocations / topk_invocations / window_invocations
+    (cumulative per-family kernel dispatch counts), topk_rows_kept
+    (cumulative rows surviving fused top-k cuts), window_partitions
+    (cumulative partitions swept by device window stages), and
+    sort_full_materializations (ORDER BY ... LIMIT stages that fell back
+    to a full sort instead of the fused top-k — nonzero means the top-k
+    rung demoted)."""
 
     _MAX_STAGES = 32
 
@@ -1461,6 +1469,7 @@ class TpuStageExec(ExecutionPlan):
         pallas_probe_max = int(self.config.get(TPU_FUSION_PALLAS_MAX_PROBE))
 
         ctx = Lowering(scan_schema, kinds, dicts)
+        ctx.pallas_dict_filter = use_pallas
         valid_idx = dt.valid_flat_idx()
         n_flat_cols = len(dt.cols) + sum(1 for v in dt.valids if v is not None)
         env_fns = []

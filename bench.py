@@ -434,6 +434,9 @@ def main() -> None:
     if len(sys.argv) > 1 and sys.argv[1] == "--device-leg":
         device_leg_main(*sys.argv[2:7])
         return
+    if len(sys.argv) > 1 and sys.argv[1] == "--tpcds-child":
+        tpcds_child(sys.argv[2], sys.argv[3])
+        return
 
     scale = float(os.environ.get("TPCH_SCALE", "10"))
     sf_tag = f"sf{scale:g}".replace(".", "p")
@@ -624,6 +627,9 @@ def main() -> None:
     if os.environ.get("BENCH_SERVING", "1") == "1":
         result["serving"] = serving_leg()
 
+    if os.environ.get("BENCH_TPCDS", "1") == "1":
+        result["tpcds"] = tpcds_leg()
+
     print(json.dumps(result))
 
 
@@ -661,6 +667,116 @@ def serving_leg() -> dict:
         return out
     except (Exception, SystemExit) as e:  # noqa: BLE001 — recorded, not fatal
         log(f"serving leg failed: {e}")
+        return {"error": str(e)}
+
+
+TPCDS_QUERIES = (36, 47, 67, 86, 98)
+
+
+def tpcds_child(data_dir: str, engine: str) -> None:
+    """Run the sort/window-heavy TPC-DS subset under one engine and print
+    per-query best-of-2 times plus the device sort/window counters."""
+    from ballista_tpu.client.context import SessionContext
+    from ballista_tpu.config import (
+        BallistaConfig,
+        EXECUTOR_ENGINE,
+        TPU_MIN_ROWS,
+    )
+    from ballista_tpu.ops.tpu.sort_window import counters_snapshot
+    from ballista_tpu.testing.tpcdsgen import register_tpcds
+
+    settings = {EXECUTOR_ENGINE: engine}
+    if engine == "tpu":
+        settings[TPU_MIN_ROWS] = 0
+    ctx = SessionContext(BallistaConfig(settings))
+    register_tpcds(ctx, data_dir)
+
+    root = os.path.dirname(os.path.abspath(__file__))
+    out = {"engine": engine, "queries": {}}
+    before = counters_snapshot()
+    for q in TPCDS_QUERIES:
+        sql = open(os.path.join(
+            root, "benchmarks", "tpcds", "queries", f"q{q}.sql")).read()
+        ctx.sql(sql).collect()  # warmup: parse/plan/compile out of the timing
+        best, rows = float("inf"), 0
+        for _ in range(2):
+            t0 = time.time()
+            res = ctx.sql(sql).collect()
+            best = min(best, time.time() - t0)
+            rows = res.num_rows
+        out["queries"][f"q{q}"] = {"best_s": round(best, 4), "rows": rows}
+    delta = {k: round(v - before[k], 4)
+             for k, v in counters_snapshot().items()}
+    out["counters"] = {k: v for k, v in delta.items() if v}
+    print("TPCDS_CHILD " + json.dumps(out))
+
+
+def tpcds_leg() -> dict:
+    """Sort/window/LIMIT-heavy TPC-DS subset (CPU jax, own small fixture):
+    the tpu engine's on-device ORDER BY / window / top-k stages vs the CPU
+    engine, per query. Each engine runs in a fresh subprocess so compile
+    caches can't bleed. Failures are recorded, never fatal — this leg must
+    not sink the device benchmark's result."""
+    log("running tpcds sort/window leg ...")
+    try:
+        from ballista_tpu.testing.tpcdsgen import generate_tpcds
+
+        scale = float(os.environ.get("BENCH_TPCDS_SCALE", "0.1"))
+        sf_tag = f"sf{scale:g}".replace(".", "p")
+        data_dir = os.environ.get("TPCDS_DATA", f"/tmp/ballista_tpcds_{sf_tag}")
+        if not os.path.isdir(os.path.join(data_dir, "store_sales")):
+            log(f"generating TPC-DS sf={scale:g} at {data_dir} ...")
+            t0 = time.time()
+            generate_tpcds(data_dir, scale=scale, seed=17, files_per_table=2)
+            log(f"tpcds datagen sf{scale:g}: {time.time() - t0:.1f}s")
+
+        legs = {}
+        for engine in ("cpu", "tpu"):
+            env = dict(os.environ)
+            env["JAX_PLATFORMS"] = "cpu"
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__),
+                 "--tpcds-child", data_dir, engine],
+                env=env, capture_output=True, text=True, timeout=900)
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    f"tpcds {engine} child failed:\n"
+                    f"{proc.stdout[-800:]}\n{proc.stderr[-800:]}")
+            for line in proc.stdout.splitlines():
+                if line.startswith("TPCDS_CHILD "):
+                    legs[engine] = json.loads(line[len("TPCDS_CHILD "):])
+                    break
+            else:
+                raise RuntimeError(f"tpcds {engine} child printed no stats")
+
+        out = {"metric": f"tpcds_sortwin_{sf_tag}_speedup_vs_cpu",
+               "scale": scale, "queries": {}}
+        for q in (f"q{n}" for n in TPCDS_QUERIES):
+            c, t = legs["cpu"]["queries"][q], legs["tpu"]["queries"][q]
+            if c["rows"] != t["rows"]:
+                raise RuntimeError(
+                    f"tpcds {q}: row-count divergence cpu={c['rows']} "
+                    f"tpu={t['rows']}")
+            out["queries"][q] = {
+                "cpu_s": c["best_s"], "tpu_s": t["best_s"], "rows": t["rows"],
+                "speedup": round(c["best_s"] / max(t["best_s"], 1e-9), 2),
+            }
+        ctr = legs["tpu"].get("counters", {})
+        out["device_counters"] = ctr
+        if not (ctr.get("sort_invocations") or ctr.get("window_invocations")
+                or ctr.get("topk_invocations")):
+            raise RuntimeError(
+                "tpcds tpu leg ran but the device sort/window family never "
+                f"fired (counters: {ctr})")
+        gmean = 1.0
+        for q in out["queries"].values():
+            gmean *= q["speedup"]
+        out["value"] = round(gmean ** (1.0 / len(out["queries"])), 2)
+        log(f"tpcds leg: geomean speedup {out['value']}x over "
+            f"{len(out['queries'])} queries (counters: {ctr})")
+        return out
+    except (Exception, SystemExit) as e:  # noqa: BLE001 — recorded, not fatal
+        log(f"tpcds leg failed: {e}")
         return {"error": str(e)}
 
 

@@ -1,0 +1,409 @@
+"""On-device sort / window / top-k stages: byte parity with the CPU engine
+on adversarial inputs, across all three fusion rungs.
+
+Parity is asserted per column over Arrow IPC stream bytes — bitwise
+(NaN payloads, ±0.0 signs) without the chunk-slicing layout artifacts a
+whole-table stream picks up from `Table.slice`."""
+
+import io
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.ipc as ipc
+import pytest
+
+from ballista_tpu.config import (
+    BallistaConfig,
+    TPU_FUSION_MODE,
+    TPU_MIN_ROWS,
+    TPU_SORT_ENABLED,
+    TPU_SORT_PALLAS_MAX_ROWS,
+    TPU_TOPK_ENABLED,
+)
+from ballista_tpu.plan.expressions import Column, SortKey, WindowFunction
+from ballista_tpu.plan.physical import (
+    ExecutionPlan,
+    SortExec,
+    TaskContext,
+    WindowExec,
+)
+from ballista_tpu.plan.schema import DFSchema
+
+MODES = ("staged", "fused_xla", "fused_pallas")
+
+
+class _Src(ExecutionPlan):
+    def __init__(self, tbl, df_schema, chunk=97):
+        super().__init__(df_schema)
+        self.tbl = tbl
+        self.chunk = chunk
+
+    def children(self):
+        return []
+
+    def output_partition_count(self):
+        return 1
+
+    def execute(self, partition, ctx):
+        yield from self.tbl.to_batches(max_chunksize=self.chunk)
+
+
+def _cfg(mode, **extra):
+    settings = {TPU_MIN_ROWS: 0, TPU_FUSION_MODE: mode}
+    settings.update(extra)
+    return BallistaConfig(settings)
+
+
+def _collect(plan, cfg):
+    ctx = TaskContext(cfg)
+    batches = list(plan.execute(0, ctx))
+    return pa.Table.from_batches(batches, schema=plan.schema())
+
+
+def _column_bytes(tbl):
+    out = []
+    for c in tbl.column_names:
+        one = pa.table({c: tbl.column(c).combine_chunks()})
+        buf = io.BytesIO()
+        with ipc.new_stream(buf, one.schema) as w:
+            w.write_table(one)
+        out.append(buf.getvalue())
+    return out
+
+
+def _assert_parity(cpu_plan, dev_plan, cfg):
+    cpu = _collect(cpu_plan, cfg)
+    dev = _collect(dev_plan, cfg)
+    assert dev_plan.tpu_count >= 1, "device path did not run"
+    assert dev_plan.fallback_count == 0, "device path fell back"
+    assert _column_bytes(cpu) == _column_bytes(dev)
+    return cpu, dev
+
+
+def _adversarial_table(n=384):
+    rng = np.random.default_rng(11)
+    f = rng.integers(-40, 40, n).astype(np.float64)
+    f[::7] = np.nan
+    f[::11] = 0.0
+    f[1::11] = -0.0
+    return pa.table({
+        "f": pa.array(f),
+        "i": pa.array(rng.integers(0, 12, n), pa.int64()),
+        "inull": pa.array(
+            [None if j % 5 == 0 else int(v)
+             for j, v in enumerate(rng.integers(0, 7, n))], pa.int32()),
+        "s": pa.array([["aa", "b", "aa", "zz", "m"][j % 5] if j % 13 else None
+                       for j in range(n)]),
+    })
+
+
+@pytest.mark.parametrize("mode", MODES)
+def test_sort_parity_adversarial(mode):
+    """NULLS FIRST/LAST per key, NaN and ±0.0 ordering, string keys, multi
+    key DESC — byte-identical to the CPU sort, with and without LIMIT."""
+    from ballista_tpu.ops.tpu.sort_window import TpuSortStageExec
+
+    tbl = _adversarial_table()
+    schema = DFSchema.from_arrow(tbl.schema)
+    cfg = _cfg(mode)
+    keysets = [
+        [SortKey(Column("i")), SortKey(Column("f"), ascending=False,
+                                       nulls_first=True)],
+        [SortKey(Column("inull"), nulls_first=True)],
+        [SortKey(Column("inull"), ascending=False, nulls_first=False)],
+        [SortKey(Column("s")), SortKey(Column("i"), ascending=False)],
+        [SortKey(Column("f"))],
+    ]
+    for keys in keysets:
+        for fetch in (None, 10):
+            _assert_parity(
+                SortExec(_Src(tbl, schema), keys, fetch),
+                TpuSortStageExec(_Src(tbl, schema), keys, fetch, cfg),
+                cfg)
+
+
+@pytest.mark.parametrize("mode", MODES)
+def test_topk_ties_at_cut_boundary(mode):
+    """Duplicate key values straddling the LIMIT cut: the fused top-k must
+    keep exactly the rows the stable full sort keeps."""
+    from ballista_tpu.ops.tpu.sort_window import TpuSortStageExec
+
+    n = 300
+    # every key value appears 20×, so any small LIMIT cuts inside a tie run
+    tbl = pa.table({
+        "k": pa.array([j % 15 for j in range(n)], pa.int64()),
+        "payload": pa.array(range(n), pa.int64()),
+    })
+    schema = DFSchema.from_arrow(tbl.schema)
+    cfg = _cfg(mode)
+    for fetch in (7, 20, 33):
+        keys = [SortKey(Column("k"))]
+        _assert_parity(SortExec(_Src(tbl, schema), keys, fetch),
+                       TpuSortStageExec(_Src(tbl, schema), keys, fetch, cfg),
+                       cfg)
+
+
+@pytest.mark.parametrize("mode", ("staged", "fused_pallas"))
+def test_sort_dictionary_duplicate_values(mode):
+    """A dictionary whose entries contain duplicate strings: equal strings
+    must share a rank (ties fall to stability), matching the CPU sort of
+    the decoded column. The CPU oracle itself cannot sort dictionary
+    columns, so this shape is pure device upside."""
+    from ballista_tpu.ops.tpu.sort_window import TpuSortStageExec
+
+    codes = pa.array([0, 1, 2, 3, 4, 0, 2, 1, 3, 0] * 30, pa.int32())
+    dup = pa.DictionaryArray.from_arrays(
+        codes, pa.array(["b", "aa", "b", "c", "aa"]))
+    payload = pa.array(range(300), pa.int64())
+    tbl = pa.table({"s": dup, "p": payload})
+    schema = DFSchema.from_arrow(tbl.schema)
+    dec = pa.table({"s": dup.cast(pa.string()), "p": payload})
+    dec_schema = DFSchema.from_arrow(dec.schema)
+    keys = [SortKey(Column("s"), ascending=False), SortKey(Column("p"))]
+    cfg = _cfg(mode)
+    devp = TpuSortStageExec(_Src(tbl, schema), keys, None, cfg)
+    dev = _collect(devp, cfg)
+    assert devp.tpu_count == 1 and devp.fallback_count == 0
+    cpu = _collect(SortExec(_Src(dec, dec_schema), keys, None), cfg)
+    assert (dev.column("s").cast(pa.string()).combine_chunks().to_pylist()
+            == cpu.column("s").combine_chunks().to_pylist())
+    assert dev.column("p").combine_chunks().equals(
+        cpu.column("p").combine_chunks())
+
+
+def _window_schema(tbl, wexprs, schema):
+    return DFSchema.from_arrow(pa.schema(
+        list(tbl.schema)
+        + [pa.field(f"w{j}", w.data_type(schema))
+           for j, w in enumerate(wexprs)]))
+
+
+@pytest.mark.parametrize("mode", MODES)
+def test_window_parity_adversarial(mode):
+    """row_number/rank/count/sum/min/max over partition+order with NaN
+    order keys, nullable agg args, and peer frames whose order values
+    repeat ACROSS partition boundaries (scan resets must isolate
+    partitions)."""
+    from ballista_tpu.ops.tpu.sort_window import TpuWindowStageExec
+
+    rng = np.random.default_rng(23)
+    n = 384
+    f = rng.integers(-10, 10, n).astype(np.float64)
+    f[::9] = np.nan
+    # order values drawn from a tiny domain: every partition contains the
+    # same order values, so peer groups abut identically-valued rows in
+    # the neighbor partition — any boundary leak shows up in rank/sum
+    tbl = pa.table({
+        "g": pa.array(rng.integers(0, 8, n), pa.int64()),
+        "o": pa.array(rng.integers(0, 3, n), pa.int64()),
+        "f": pa.array(f),
+        "vnull": pa.array(
+            [None if j % 4 == 0 else int(v)
+             for j, v in enumerate(rng.integers(-50, 50, n))], pa.int64()),
+    })
+    schema = DFSchema.from_arrow(tbl.schema)
+    over = ([Column("g")], [SortKey(Column("o"))])
+    wexprs = [
+        WindowFunction("row_number", [], *over, None),
+        WindowFunction("rank", [], *over, None),
+        WindowFunction("count", [Column("vnull")], *over, None),
+        WindowFunction("sum", [Column("vnull")], *over, None),
+        WindowFunction("min", [Column("f")], [Column("g")],
+                       [SortKey(Column("f"), nulls_first=True)], None),
+        WindowFunction("max", [Column("vnull")], [],
+                       [SortKey(Column("o"), ascending=False)], None),
+    ]
+    wschema = _window_schema(tbl, wexprs, schema)
+    cfg = _cfg(mode)
+    _assert_parity(WindowExec(_Src(tbl, schema), wexprs, wschema),
+                   TpuWindowStageExec(_Src(tbl, schema), wexprs, wschema, cfg),
+                   cfg)
+
+
+@pytest.mark.parametrize("mode", ("fused_xla", "fused_pallas"))
+def test_window_empty_and_all_null_partitions(mode):
+    """Partitions of size one and partitions whose aggregate argument is
+    entirely NULL (SQL: aggregate over zero valid rows is NULL)."""
+    from ballista_tpu.ops.tpu.sort_window import TpuWindowStageExec
+
+    g = pa.array([0] * 50 + [1] + [2] * 49 + [3], pa.int64())
+    v = pa.array([None] * 50                       # partition 0: all null
+                 + [7]                             # singleton partition
+                 + [int(x) for x in range(49)]     # dense partition
+                 + [None],                         # singleton, null arg
+                 pa.int64())
+    tbl = pa.table({"g": g, "v": v})
+    schema = DFSchema.from_arrow(tbl.schema)
+    over = ([Column("g")], [SortKey(Column("v"), nulls_first=True)])
+    wexprs = [
+        WindowFunction("sum", [Column("v")], *over, None),
+        WindowFunction("min", [Column("v")], *over, None),
+        WindowFunction("count", [Column("v")], *over, None),
+        WindowFunction("rank", [], *over, None),
+    ]
+    wschema = _window_schema(tbl, wexprs, schema)
+    cfg = _cfg(mode)
+    _assert_parity(WindowExec(_Src(tbl, schema), wexprs, wschema),
+                   TpuWindowStageExec(_Src(tbl, schema), wexprs, wschema, cfg),
+                   cfg)
+
+
+def test_zero_row_input():
+    from ballista_tpu.ops.tpu.sort_window import (
+        TpuSortStageExec,
+        TpuWindowStageExec,
+    )
+
+    tbl = pa.table({"a": pa.array([], pa.int64())})
+    schema = DFSchema.from_arrow(tbl.schema)
+    cfg = _cfg("fused_pallas")
+    keys = [SortKey(Column("a"))]
+    out = _collect(TpuSortStageExec(_Src(tbl, schema), keys, 5, cfg), cfg)
+    assert out.num_rows == 0
+    wexprs = [WindowFunction("row_number", [], [], [SortKey(Column("a"))], None)]
+    wschema = _window_schema(tbl, wexprs, schema)
+    out = _collect(TpuWindowStageExec(_Src(tbl, schema), wexprs, wschema, cfg),
+                   cfg)
+    assert out.num_rows == 0 and out.num_columns == 2
+
+
+@pytest.mark.parametrize("mode", ("fused_xla", "fused_pallas"))
+def test_estimate_covers_device_bytes(mode):
+    """Fill test: estimate_sort_stage must price at least the bytes the
+    stage actually shipped (RUN_STATS device_bytes) — for a plain sort, a
+    top-k, and a window stage."""
+    from ballista_tpu.ops.tpu import fusion
+    from ballista_tpu.ops.tpu.sort_window import (
+        TpuSortStageExec,
+        TpuWindowStageExec,
+        _encode_key_arrays,
+    )
+    from ballista_tpu.ops.tpu.stage_compiler import RUN_STATS
+
+    tbl = _adversarial_table()
+    n = tbl.num_rows
+    schema = DFSchema.from_arrow(tbl.schema)
+    cfg = _cfg(mode)
+    keys = [SortKey(Column("inull"), nulls_first=True),
+            SortKey(Column("f"), ascending=False)]
+    batch = tbl.combine_chunks().to_batches()[0]
+    arrays = [batch.column("inull"), batch.column("f")]
+    _, key_meta = _encode_key_arrays(
+        arrays, [(k.ascending, k.nulls_first) for k in keys])
+
+    for fetch in (None, 8):
+        devp = TpuSortStageExec(_Src(tbl, schema), keys, fetch, cfg)
+        _collect(devp, cfg)
+        assert devp.tpu_count == 1
+        actual = int(RUN_STATS.snapshot()["device_bytes"])
+        est = fusion.estimate_sort_stage(
+            n, key_meta, fetch=fetch if len(keys) == 1 else None)
+        assert est.table_bytes >= actual > 0, (est.table_bytes, actual)
+
+    wexprs = [
+        WindowFunction("sum", [Column("i")], [Column("i")],
+                       [SortKey(Column("f"))], None),
+        WindowFunction("rank", [], [Column("i")], [SortKey(Column("f"))],
+                       None),
+    ]
+    wschema = _window_schema(tbl, wexprs, schema)
+    devp = TpuWindowStageExec(_Src(tbl, schema), wexprs, wschema, cfg)
+    _collect(devp, cfg)
+    assert devp.tpu_count == 1
+    actual = int(RUN_STATS.snapshot()["device_bytes"])
+    warrays = [batch.column("i"), batch.column("f")]
+    _, wmeta = _encode_key_arrays(warrays, [(True, False), (True, False)])
+    west = fusion.estimate_sort_stage(n, wmeta, window_funcs=len(wexprs))
+    assert west.table_bytes >= actual > 0, (west.table_bytes, actual)
+
+
+def test_demotion_reason_recorded():
+    """A forced fused_pallas sort over the lane ceiling demotes to
+    fused_xla with the cost model's rationale in RUN_STATS."""
+    from ballista_tpu.ops.tpu.sort_window import TpuSortStageExec
+    from ballista_tpu.ops.tpu.stage_compiler import RUN_STATS
+
+    tbl = pa.table({"a": pa.array(range(600), pa.int64())})
+    schema = DFSchema.from_arrow(tbl.schema)
+    cfg = _cfg("fused_pallas", **{TPU_SORT_PALLAS_MAX_ROWS: 128})
+    devp = TpuSortStageExec(_Src(tbl, schema), [SortKey(Column("a"))], None,
+                            cfg)
+    _collect(devp, cfg)
+    assert devp.tpu_count == 1 and devp.fallback_count == 0
+    stats = RUN_STATS.snapshot()
+    assert stats["fusion_mode"] == "fused_xla"
+    assert "forced fused_pallas but" in stats["fusion_reason"]
+
+
+def test_counters_flow_to_heartbeat_gauges():
+    """RunStats → ExecutorProcess._tpu_metrics: the sort-family gauges are
+    exported once the family has run (stats-sync invariant, live)."""
+    from ballista_tpu.executor.executor_process import ExecutorProcess
+    from ballista_tpu.ops.tpu.sort_window import TpuSortStageExec
+
+    tbl = _adversarial_table()
+    schema = DFSchema.from_arrow(tbl.schema)
+    cfg = _cfg("fused_pallas")
+    devp = TpuSortStageExec(_Src(tbl, schema),
+                            [SortKey(Column("i"))], 5, cfg)
+    _collect(devp, cfg)
+    gauges = dict(ExecutorProcess._tpu_metrics())
+    for key in ("tpu_sort_kernel_s", "tpu_topk_invocations",
+                "tpu_topk_rows_kept"):
+        assert key in gauges, key
+    assert gauges["tpu_topk_invocations"] >= 1
+    assert gauges["tpu_topk_rows_kept"] >= 5
+
+
+def test_engine_wiring_and_knob_gate():
+    """maybe_compile_tpu wraps SortExec/WindowExec when the family knob is
+    on, and leaves the plan untouched when it is off."""
+    from ballista_tpu.client.context import SessionContext
+    from ballista_tpu.config import EXECUTOR_ENGINE
+    from ballista_tpu.engine.tpu_engine import maybe_compile_tpu
+    from ballista_tpu.ops.tpu.sort_window import (
+        TpuSortStageExec,
+        TpuWindowStageExec,
+    )
+
+    from .conftest import iter_plan
+
+    rng = np.random.default_rng(3)
+    t = pa.table({
+        "g": pa.array(rng.integers(0, 5, 500), pa.int64()),
+        "v": pa.array(rng.integers(0, 99, 500), pa.int64()),
+    })
+    sql = ("SELECT g, v, rank() OVER (PARTITION BY g ORDER BY v) rk "
+           "FROM t ORDER BY v DESC, g LIMIT 20")
+    for enabled in (True, False):
+        cfg = BallistaConfig({EXECUTOR_ENGINE: "tpu", TPU_MIN_ROWS: 0,
+                              TPU_SORT_ENABLED: enabled})
+        ctx = SessionContext(cfg)
+        ctx.register_arrow_table("t", t, partitions=2)
+        phys = maybe_compile_tpu(
+            ctx.create_physical_plan(ctx.sql(sql).plan), cfg)
+        nodes = [nd for nd in iter_plan(phys)
+                 if isinstance(nd, (TpuSortStageExec, TpuWindowStageExec))]
+        if enabled:
+            assert nodes, phys.display()
+        else:
+            assert not nodes, phys.display()
+
+
+def test_topk_knob_disables_fused_cut():
+    """ballista.tpu.topk.enabled=false: LIMIT sorts still run on device but
+    through the full sort (sort_full_materializations counts it)."""
+    from ballista_tpu.ops.tpu.sort_window import (
+        TpuSortStageExec,
+        counters_snapshot,
+    )
+
+    tbl = _adversarial_table()
+    schema = DFSchema.from_arrow(tbl.schema)
+    cfg = _cfg("fused_pallas", **{TPU_TOPK_ENABLED: False})
+    before = counters_snapshot()["sort_full_materializations"]
+    devp = TpuSortStageExec(_Src(tbl, schema), [SortKey(Column("i"))], 5, cfg)
+    cpu = SortExec(_Src(tbl, schema), [SortKey(Column("i"))], 5)
+    _assert_parity(cpu, devp, cfg)
+    after = counters_snapshot()["sort_full_materializations"]
+    assert after == before + 1
