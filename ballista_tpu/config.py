@@ -148,6 +148,12 @@ TPU_MESH_DEVICES = "ballista.tpu.mesh.devices"
 TPU_MESH_EXCHANGE_CAPACITY = "ballista.tpu.mesh.exchange.capacity.rows"
 TPU_MESH_MIN_ROWS = "ballista.tpu.mesh.min.rows"
 TPU_MESH_MAX_INPUT_BYTES = "ballista.tpu.mesh.max.input.bytes"
+# warm device-runtime daemon (ballista_tpu/device_daemon/)
+TPU_DAEMON_ENABLED = "ballista.tpu.daemon.enabled"
+TPU_DAEMON_SOCKET = "ballista.tpu.daemon.socket"
+TPU_DAEMON_SPAWN = "ballista.tpu.daemon.spawn"
+TPU_DAEMON_ATTACH_TIMEOUT_MS = "ballista.tpu.daemon.attach.timeout.ms"
+TPU_DAEMON_SESSION_QUOTA_BYTES = "ballista.tpu.daemon.session.hbm.quota.bytes"
 # debug verifiers
 DEBUG_PLAN_VERIFY = "ballista.debug.plan.verify"
 
@@ -839,6 +845,52 @@ _ENTRIES: list[ConfigEntry] = [
         str, _env_str("BALLISTA_TPU_COMPILE_CACHE", ""),
     ),
     ConfigEntry(
+        TPU_DAEMON_ENABLED,
+        "Warm device-runtime daemon: when true, TPU stage execution first "
+        "tries to attach to the persistent device daemon "
+        "(ballista_tpu/device_daemon/) over its unix socket and ship the "
+        "stage there — one long-lived process owns the platform init, the "
+        "device table cache, the HBM budget, and the persistent XLA compile "
+        "cache, so every attached caller skips the cold init. Attach "
+        "failure falls back to the in-process engine with the reason in "
+        "RUN_STATS daemon_mode/daemon_mode_reason. Off by default: the "
+        "in-process engine is unchanged unless a session opts in.",
+        bool, False,
+    ),
+    ConfigEntry(
+        TPU_DAEMON_SOCKET,
+        "Unix-domain socket path of the device daemon. Empty = the "
+        "per-user default under the system temp dir "
+        "(ballista-tpu-daemon-<uid>.sock). The daemon's structured init "
+        "probe report lives next to the socket at <socket>.probe.json.",
+        str, "",
+    ),
+    ConfigEntry(
+        TPU_DAEMON_SPAWN,
+        "Spawn-and-adopt: when attach finds no live daemon, start one "
+        "(detached, `python -m ballista_tpu.device_daemon`) and attach to "
+        "it instead of falling back in-process. The spawned daemon "
+        "outlives the client so later processes warm-attach.",
+        bool, False,
+    ),
+    ConfigEntry(
+        TPU_DAEMON_ATTACH_TIMEOUT_MS,
+        "Milliseconds the daemon client waits for the socket to accept "
+        "and answer a ping before falling back to the in-process engine "
+        "(also bounds the spawn-and-adopt wait for the socket to appear).",
+        int, 2000, _pos,
+    ),
+    ConfigEntry(
+        TPU_DAEMON_SESSION_QUOTA_BYTES,
+        "Per-session HBM quota enforced by the daemon's admission layer: "
+        "stages shipped by this session are admitted against "
+        "min(ballista.tpu.hbm.budget.*, this quota), so one attached "
+        "tenant's working set cannot evict every other session's resident "
+        "tables — spill/grace decisions become quota-aware. 0 = no "
+        "per-session ceiling.",
+        int, 0, _nonneg,
+    ),
+    ConfigEntry(
         DEBUG_PLAN_VERIFY,
         "Run the static plan verifier (analysis/plan_check.py) over every "
         "staged plan at submit time and after each AQE replan, failing the "
@@ -909,6 +961,22 @@ _ENV_KNOBS: list[EnvKnob] = [
         "BALLISTA_TPU_FINAL_CACHE_ENTRIES",
         "Entry cap of the final-stage program LruDict (ops/tpu/final_stage.py).",
         int, 64,
+    ),
+    EnvKnob(
+        "BALLISTA_TPU_DAEMON_INIT_TIMEOUT_S",
+        "Per-phase ceiling (seconds) of the device daemon's supervised init "
+        "state machine (platform probe → jax.devices() → first compile). A "
+        "phase that overruns gets a faulthandler stack snapshot written "
+        "into the probe report at <socket>.probe.json, then the daemon "
+        "exits — a hung platform claim is diagnosed, never waited out.",
+        int, 240,
+    ),
+    EnvKnob(
+        "BALLISTA_TPU_DAEMON_IDLE_TIMEOUT_S",
+        "Device daemon self-termination after this many seconds with no "
+        "request and no live parent (--parent-pid). 0 = persist forever "
+        "(the default: a warm daemon is the point).",
+        int, 0,
     ),
 ]
 

@@ -1,0 +1,287 @@
+"""Attach-first client for the device-runtime daemon.
+
+This module must stay importable WITHOUT jax: it is reached (lazily)
+from the stage compiler and from scheduler/executor-adjacent callers the
+jax-guard analysis pass keeps off the jax import graph. Everything here
+is sockets + JSON + pyarrow IPC; the device runtime lives daemon-side.
+
+Attach policy (`attach(config)` under the ballista.tpu.daemon.* knobs):
+
+1. daemon disabled          → (None, "in_process", "daemon disabled")
+2. live daemon answers ping → (client, "attached", socket path)
+3. stale socket (file exists, connect refused) → unlink it, then
+4. spawn knob on            → spawn `python -m ballista_tpu.device_daemon`
+   detached, wait for its socket within the attach timeout, adopt it
+5. otherwise                → (None, "in_process", the failure reason)
+
+The result is cached per (socket, daemon pid): a process that attached
+once keeps its client until the daemon dies, at which point the next
+attach retries the ladder from the top. Fallback is never an error —
+the in-process engine is always behind it.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+from ballista_tpu.device_daemon import protocol
+
+# set inside the daemon process itself: clear_attached_caches() and
+# attach() become no-ops there, so daemon-side stage execution can never
+# recurse into another daemon
+_IN_DAEMON = False
+
+_CACHE_LOCK = threading.Lock()
+# socket path → (DaemonClient, daemon_pid) for processes that attached
+# analysis: ignore[bounded-cache] one entry per daemon socket this process attaches to; bounded by deployment topology (typically 1)
+_ATTACHED: dict[str, tuple["DaemonClient", int]] = {}
+
+
+def mark_in_daemon() -> None:
+    global _IN_DAEMON
+    _IN_DAEMON = True
+
+
+def reset_attach_cache() -> None:
+    """Test hook: forget cached attachments (e.g. after killing a daemon)."""
+    with _CACHE_LOCK:
+        _ATTACHED.clear()
+
+
+class DaemonUnavailable(RuntimeError):
+    pass
+
+
+class DaemonClient:
+    """One request per connection; safe to share across threads."""
+
+    # default request ceiling: generous — a cold full-scale stage (fill +
+    # XLA compile + exec) legitimately takes minutes; attach liveness is
+    # separately bounded by ping's own 2s timeout
+    def __init__(self, socket_path: str, timeout_s: float = 3600.0):
+        self.socket_path = socket_path
+        self.timeout_s = timeout_s
+
+    def _request(self, header: dict, body: bytes = b"",
+                 timeout_s: float | None = None) -> tuple[dict, bytes]:
+        header = dict(header)
+        header["v"] = protocol.PROTOCOL_VERSION
+        sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        try:
+            sock.settimeout(timeout_s if timeout_s is not None else self.timeout_s)
+            try:
+                sock.connect(self.socket_path)
+            except OSError as e:
+                raise DaemonUnavailable(f"connect {self.socket_path}: {e}") from e
+            protocol.send_msg(sock, header, body)
+            try:
+                resp, resp_body = protocol.recv_msg(sock)
+            except (protocol.ProtocolError, OSError) as e:
+                raise DaemonUnavailable(f"daemon hung up: {e}") from e
+        finally:
+            sock.close()
+        return resp, resp_body
+
+    def ping(self, timeout_s: float = 2.0) -> dict:
+        resp, _ = self._request({"op": "ping"}, timeout_s=timeout_s)
+        return resp
+
+    def status(self) -> dict:
+        resp, _ = self._request({"op": "status"})
+        if not resp.get("ok"):
+            raise DaemonUnavailable(resp.get("error", "status failed"))
+        return resp
+
+    def wait_ready(self, timeout_s: float, poll_s: float = 0.5) -> dict:
+        """Poll status until init lands; raises with the init report's
+        last phase on timeout or daemon death. Tolerates the socket not
+        being bound yet (a just-spawned daemon binds before init, but the
+        bind itself takes a beat)."""
+        deadline = time.time() + timeout_s
+        last: dict = {}
+        while time.time() < deadline:
+            try:
+                last = self.status()
+            except DaemonUnavailable as e:
+                last = {"init": {"current": f"socket not up ({e})"}}
+                time.sleep(poll_s)
+                continue
+            if last.get("ready"):
+                return last
+            init = last.get("init") or {}
+            if init.get("error"):
+                raise DaemonUnavailable(f"daemon init failed: {init['error']}")
+            time.sleep(poll_s)
+        phase = ((last.get("init") or {}).get("current")) or "unknown"
+        raise DaemonUnavailable(
+            f"daemon not ready within {timeout_s}s (init phase: {phase})")
+
+    def execute(self, plan_bytes: bytes, pairs: list, partitions: list,
+                *, emit_pid=None, session: str = "", tag: str = "",
+                timeout_s: float | None = None) -> tuple[dict, dict]:
+        """Ship one stage; returns ({partition: [batches]}, response header
+        with daemon-side stats). Raises DaemonUnavailable on transport
+        failure and RuntimeError when the daemon reports an execution
+        error — both mean 'run it in-process instead'."""
+        header = {
+            "op": "execute",
+            "pairs": [[str(k), str(v)] for k, v in pairs],
+            "partitions": [int(p) for p in partitions],
+            "session": session or f"{socket.gethostname()}:{os.getpid()}",
+            "tag": tag,
+        }
+        if emit_pid is not None:
+            header["emit_pid"] = [list(emit_pid[0]), int(emit_pid[1])]
+        resp, body = self._request(header, plan_bytes, timeout_s=timeout_s)
+        if not resp.get("ok"):
+            raise RuntimeError(f"daemon execute failed: {resp.get('error')}")
+        return protocol.unpack_results(resp.get("segments", []), body), resp
+
+    def clear_caches(self) -> None:
+        resp, _ = self._request({"op": "clear_caches"})
+        if not resp.get("ok"):
+            raise RuntimeError(f"daemon clear failed: {resp.get('error')}")
+
+    def shutdown(self) -> None:
+        try:
+            self._request({"op": "shutdown"}, timeout_s=2.0)
+        except DaemonUnavailable:
+            pass  # already gone — the goal state
+
+
+# --------------------------------------------------------------- attach
+
+def resolve_socket(config) -> str:
+    from ballista_tpu.config import TPU_DAEMON_SOCKET
+
+    return str(config.get(TPU_DAEMON_SOCKET)) or protocol.default_socket_path()
+
+
+def _clean_stale_socket(path: str) -> bool:
+    """A socket file nobody answers on is litter from a dead daemon:
+    unlink it so a spawn (ours or a later one) can bind. True if the path
+    was stale and removed."""
+    if not os.path.exists(path):
+        return False
+    probe = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    try:
+        probe.settimeout(1.0)
+        probe.connect(path)
+        return False  # something is listening; not stale
+    except OSError:
+        try:
+            os.unlink(path)
+        except OSError:
+            return False
+        return True
+    finally:
+        probe.close()
+
+
+def spawn_daemon(socket_path: str, *, parent_pid: int = 0,
+                 idle_timeout_s: int | None = None,
+                 env: dict | None = None) -> subprocess.Popen:
+    """Start a detached daemon process; stdout/stderr land next to the
+    socket at <socket>.log. The caller still has to wait for the socket."""
+    pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    e = dict(os.environ if env is None else env)
+    e["PYTHONPATH"] = pkg_root + os.pathsep + e.get("PYTHONPATH", "")
+    cmd = [sys.executable, "-m", "ballista_tpu.device_daemon",
+           "--socket", socket_path]
+    if parent_pid:
+        cmd += ["--parent-pid", str(parent_pid)]
+    if idle_timeout_s is not None:
+        cmd += ["--idle-timeout-s", str(idle_timeout_s)]
+    logf = open(protocol.daemon_log_path(socket_path), "ab")
+    try:
+        return subprocess.Popen(cmd, stdin=subprocess.DEVNULL, stdout=logf,
+                                stderr=logf, start_new_session=True, env=e)
+    finally:
+        logf.close()
+
+
+def attach(config) -> tuple[DaemonClient | None, str, str]:
+    """The attach-first ladder. Returns (client|None, mode, reason) where
+    mode is "attached" or "in_process"; never raises."""
+    from ballista_tpu.config import (
+        TPU_DAEMON_ATTACH_TIMEOUT_MS,
+        TPU_DAEMON_ENABLED,
+        TPU_DAEMON_SPAWN,
+    )
+
+    if _IN_DAEMON:
+        return None, "in_process", "inside daemon"
+    if not config.get(TPU_DAEMON_ENABLED):
+        return None, "in_process", "daemon disabled"
+    path = resolve_socket(config)
+    timeout_s = int(config.get(TPU_DAEMON_ATTACH_TIMEOUT_MS)) / 1000.0
+
+    with _CACHE_LOCK:
+        cached = _ATTACHED.get(path)
+    if cached is not None:
+        client, pid = cached
+        try:
+            if client.ping().get("pid") == pid:
+                return client, "attached", path
+        except DaemonUnavailable:
+            pass
+        with _CACHE_LOCK:  # daemon died or was replaced; retry the ladder
+            _ATTACHED.pop(path, None)
+
+    client = DaemonClient(path)
+    deadline = time.time() + timeout_s
+    try:
+        pid = int(client.ping(timeout_s=max(0.2, timeout_s)).get("pid", 0))
+        with _CACHE_LOCK:
+            _ATTACHED[path] = (client, pid)
+        return client, "attached", path
+    except DaemonUnavailable as e:
+        reason = str(e)
+
+    stale = _clean_stale_socket(path)
+    if stale:
+        reason = f"stale socket removed: {path}"
+    if not config.get(TPU_DAEMON_SPAWN):
+        return None, "in_process", f"attach_failed: {reason}"
+
+    try:
+        spawn_daemon(path)
+    except OSError as e:
+        return None, "in_process", f"spawn_failed: {e}"
+    while time.time() < deadline:
+        try:
+            pid = int(client.ping(timeout_s=0.5).get("pid", 0))
+            with _CACHE_LOCK:
+                _ATTACHED[path] = (client, pid)
+            return client, "attached", f"spawned: {path}"
+        except DaemonUnavailable:
+            time.sleep(0.1)
+    return None, "in_process", (
+        f"spawn_timeout: daemon socket {path} did not come up within "
+        f"{timeout_s:.1f}s")
+
+
+def clear_attached_caches() -> bool:
+    """Route clear_device_caches() through to any daemon this process is
+    attached to: an attached executor's clear must evict DAEMON-resident
+    device state, not just its own (empty) in-process caches. Best-effort;
+    True when at least one daemon acknowledged. No-op inside the daemon
+    itself (the daemon's own clear already ran locally)."""
+    if _IN_DAEMON:
+        return False
+    with _CACHE_LOCK:
+        clients = [c for c, _ in _ATTACHED.values()]
+    ok = False
+    for c in clients:
+        try:
+            c.clear_caches()
+            ok = True
+        except (DaemonUnavailable, RuntimeError):
+            pass
+    return ok

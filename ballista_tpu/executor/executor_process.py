@@ -323,7 +323,9 @@ class ExecutorProcess:
                     "hbm_reupload_events", "grace_splits", "hbm_oom_retries",
                     "sort_kernel_s", "sort_invocations", "topk_invocations",
                     "topk_rows_kept", "window_invocations",
-                    "window_partitions", "sort_full_materializations"):
+                    "window_partitions", "sort_full_materializations",
+                    "daemon_attached", "init_platform_probe_s",
+                    "init_jax_devices_s", "init_first_compile_s"):
             if key in stats:
                 out.append((f"tpu_{key}", float(stats[key])))
         if "hbm_plan" in stats:
@@ -337,6 +339,15 @@ class ExecutorProcess:
             code = {"staged": 0.0, "fused_xla": 1.0, "fused_pallas": 2.0}
             out.append(("tpu_fusion_mode",
                         code.get(str(stats["fusion_mode"]), -1.0)))
+        # warm-daemon multiplexing gauges keep their RUN_STATS names (no
+        # tpu_ prefix: they describe the shared daemon, not this
+        # executor's own device work — tpu_daemon_attached above says
+        # whether THIS process rode it)
+        if "daemon_sessions" in stats:
+            out.append(("daemon_sessions", float(stats["daemon_sessions"])))
+        if "daemon_queue_depth" in stats:
+            out.append(("daemon_queue_depth",
+                        float(stats["daemon_queue_depth"])))
         if "mesh_mode_reason" in stats:
             # gauges are floats: 1 = the collective exchange ran on-device,
             # 0 = demoted to the host split (the string reason stays in

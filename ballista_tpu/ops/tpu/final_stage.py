@@ -432,8 +432,6 @@ class TpuFinalStageExec(ExecutionPlan):
         from ballista_tpu.ops.tpu.stage_compiler import _pow2, _put
         from ballista_tpu.plan.physical import RepartitionExec
 
-        jax = ensure_jax()
-
         child = self.child
         P_result = self.output_partition_count()
         bypass = False
@@ -474,7 +472,11 @@ class TpuFinalStageExec(ExecutionPlan):
         part_rows = [t.num_rows for t in tables]
         total = sum(part_rows)
         if total < max(self.min_rows, 1):
+            # declined BEFORE ensure_jax(): a daemon-attached client whose
+            # final merge is tiny (the common shape — partials did the heavy
+            # lifting device-side) never pays a platform init of its own
             raise Unsupported(f"only {total} rows (< tpu min)")
+        jax = ensure_jax()
 
         full = pa.concat_tables(tables)
         N = next_bucket(max(max(part_rows), 1), self.buckets)

@@ -37,6 +37,7 @@ injection and the analysis passes without pulling in a backend.
 
 from __future__ import annotations
 
+import contextlib
 import logging
 import os
 import tempfile
@@ -135,25 +136,56 @@ def detect_device_memory_bytes() -> int:
         return 0
 
 
+# per-session HBM quota (device daemon multi-tenancy): the daemon wraps
+# each attached session's stage execution in session_quota(q), and the
+# budget resolver clamps to it — every downstream admission decision
+# (plan_stage's spill/grace/demote ladder) becomes quota-aware without
+# the ladder itself knowing sessions exist.
+_QUOTA_TLS = threading.local()
+
+
+@contextlib.contextmanager
+def session_quota(quota_bytes: int):
+    """Scope a per-session ceiling over resolve_hbm_budget(). 0 = no
+    ceiling. Nests (inner scope wins); thread-local, matching the daemon's
+    one-handler-thread-per-request execution model."""
+    prev = getattr(_QUOTA_TLS, "quota", 0)
+    _QUOTA_TLS.quota = int(quota_bytes)
+    try:
+        yield
+    finally:
+        _QUOTA_TLS.quota = prev
+
+
+def active_session_quota() -> int:
+    return int(getattr(_QUOTA_TLS, "quota", 0) or 0)
+
+
 def resolve_hbm_budget(config) -> int:
     """The per-stage HBM budget in bytes. Precedence: armed chaos override,
     then the explicit knob, then fraction x detected device memory, then
-    fraction x ballista.tpu.max.device.bytes (CPU-jax fallback)."""
+    fraction x ballista.tpu.max.device.bytes (CPU-jax fallback). An active
+    session_quota() clamps whatever the ladder produced (chaos included:
+    a quota-ed tenant must not dodge its ceiling via a chaos knob)."""
     from ballista_tpu.config import (
         TPU_HBM_BUDGET_BYTES,
         TPU_HBM_BUDGET_FRACTION,
         TPU_MAX_DEVICE_BYTES,
     )
 
+    def _clamp(budget: int) -> int:
+        quota = active_session_quota()
+        return max(1, min(budget, quota)) if quota > 0 else budget
+
     forced = chaos_budget()
     if forced > 0:
-        return forced
+        return _clamp(forced)
     explicit = int(config.get(TPU_HBM_BUDGET_BYTES))
     if explicit > 0:
-        return explicit
+        return _clamp(explicit)
     frac = float(config.get(TPU_HBM_BUDGET_FRACTION))
     base = detect_device_memory_bytes() or int(config.get(TPU_MAX_DEVICE_BYTES))
-    return max(1, int(base * frac))
+    return _clamp(max(1, int(base * frac)))
 
 
 # ---------------------------------------------------------------------------

@@ -147,7 +147,15 @@ class RunStats(Mapping):
     (cumulative partitions swept by device window stages), and
     sort_full_materializations (ORDER BY ... LIMIT stages that fell back
     to a full sort instead of the fused top-k — nonzero means the top-k
-    rung demoted)."""
+    rung demoted). Warm-daemon routing (docs/device_daemon.md):
+    daemon_mode ("attached" when the stage was shipped to the device
+    daemon, "in_process" when the session opted in but execution stayed
+    local) and daemon_mode_reason (why — "daemon disabled",
+    "attach_failed: ...", "execute_failed: ..." or the socket attached
+    to); the numeric twins daemon_attached / daemon_sessions /
+    daemon_queue_depth and the daemon's per-phase init timings
+    init_platform_probe_s / init_jax_devices_s / init_first_compile_s
+    flow to the executor heartbeat as gauges."""
 
     _MAX_STAGES = 32
 
@@ -675,7 +683,14 @@ DEVICE_CACHE = DeviceTableCache()
 def clear_device_caches() -> None:
     """Release every module-level device cache: resident tables, compiled
     entries, string LUTs, and join build tables. Frees HBM (or host RAM
-    under CPU-jax) between unrelated workloads; caches refill on demand."""
+    under CPU-jax) between unrelated workloads; caches refill on demand.
+
+    When this process is attached to a device daemon, the clear is also
+    forwarded there: the state an attached executor actually uses is
+    daemon-resident, so a purely local clear would free nothing but this
+    process's cold twins while the daemon keeps serving from its caches.
+    The forwarding is best-effort (a dead daemon has nothing resident)
+    and a no-op inside the daemon itself."""
     DEVICE_CACHE.clear()
     _COMPILE_CACHE.clear()
     _LUT_CACHE.clear()
@@ -684,6 +699,9 @@ def clear_device_caches() -> None:
     from ballista_tpu.ops.tpu import final_stage
 
     final_stage.clear_compile_cache()
+    from ballista_tpu.device_daemon import client as daemon_client
+
+    daemon_client.clear_attached_caches()
 
 
 class TpuStageExec(ExecutionPlan):
@@ -753,15 +771,10 @@ class TpuStageExec(ExecutionPlan):
     # ------------------------------------------------------------------
 
     def _run(self, partition: int, ctx: TaskContext) -> list[pa.RecordBatch]:
-        from ballista_tpu.ops.tpu.runtime import device_scope
-
         with self._results_lock:
             if self._results is None:
                 try:
-                    # per-chip pinning: commit every upload/dispatch in this
-                    # call tree to the executor's bound device
-                    with device_scope(ctx.device_ordinal):
-                        self._results = self._tpu_run_all(ctx)
+                    self._results = self._dispatch_all(ctx)
                     self.tpu_count += 1
                     self._device_ok = True
                 except Unsupported as e:
@@ -782,8 +795,7 @@ class TpuStageExec(ExecutionPlan):
                 # hot, so re-dispatching costs ~the exec time — never fall
                 # through to a full host re-scan of the subtree
                 try:
-                    with device_scope(ctx.device_ordinal):
-                        self._results.update(self._tpu_run_all(ctx))
+                    self._results.update(self._dispatch_all(ctx))
                     self.tpu_count += 1
                     self._served_since_dispatch = set()
                     # serve WITHOUT popping: a consumer that re-reads one
@@ -811,17 +823,100 @@ class TpuStageExec(ExecutionPlan):
         if self._results and set(self._results) <= self._served_since_dispatch:
             self._results = {}
 
-    def _fallback(self, partition: int, ctx: TaskContext) -> list[pa.RecordBatch]:
-        """Re-run the original CPU subtree (scan filters applied on host)."""
-        from ballista_tpu.plan.physical import CoalescePartitionsExec, HashJoinExec
+    def _dispatch_all(self, ctx: TaskContext) -> dict[int, list[pa.RecordBatch]]:
+        """Route one whole-stage dispatch: warm device-runtime daemon first
+        when the session opted in (docs/device_daemon.md), else the
+        in-process engine pinned to the task's bound device."""
+        from ballista_tpu.ops.tpu.runtime import device_scope
 
-        self.fallback_count += 1
+        out = self._daemon_run_all(ctx)
+        if out is not None:
+            return out
+        # per-chip pinning: commit every upload/dispatch in this call tree
+        # to the executor's bound device
+        with device_scope(ctx.device_ordinal):
+            return self._tpu_run_all(ctx)
+
+    def _daemon_run_all(self, ctx: TaskContext) -> dict[int, list[pa.RecordBatch]] | None:
+        """Ship this stage to the device daemon: the RAW rebuilt subtree
+        (the same chain _fallback re-executes — this wrapper has no serde
+        encoding, that chain round-trips) goes over the socket and the
+        daemon runs it through the same maybe_compile_tpu entry, so an
+        attached result is byte-identical to an in-process one by
+        construction. Returns None to mean 'run locally' (daemon disabled,
+        unreachable, or failed mid-request) with the reason in RUN_STATS
+        daemon_mode/daemon_mode_reason; a reachable daemon's engine stats
+        for the run are mirrored into this process's RUN_STATS so the
+        heartbeat and bench artifacts still see the device work."""
+        from ballista_tpu.config import TPU_DAEMON_ENABLED
+
+        if not bool(self.config.get(TPU_DAEMON_ENABLED)):
+            return None
+        from ballista_tpu.device_daemon import client as daemon_client
+
+        tag = f"stage_{zlib.crc32(self.fingerprint.encode()):08x}"
+        client, mode, reason = daemon_client.attach(self.config)
+        if client is None:
+            RUN_STATS.set("daemon_mode", mode)
+            RUN_STATS.set("daemon_mode_reason", reason)
+            RUN_STATS.set("daemon_attached", 0.0)
+            log.info("daemon unavailable (%s); running stage in-process", reason)
+            return None
+        try:
+            from ballista_tpu import serde
+
+            raw = self.partial_agg.with_children([self._raw_chain()])
+            plan_bytes = serde.plan_to_bytes(raw)
+            partitions = list(range(self.scan.output_partition_count()))
+            results, resp = client.execute(
+                plan_bytes, self.config.to_key_value_pairs(), partitions,
+                emit_pid=self.emit_pid, tag=tag)
+        except Exception as e:  # noqa: BLE001 — the daemon must never fail
+            # a query the in-process engine can run
+            RUN_STATS.set("daemon_mode", "in_process")
+            RUN_STATS.set("daemon_mode_reason", f"execute_failed: {e}"[:300])
+            RUN_STATS.set("daemon_attached", 0.0)
+            log.warning("daemon execute failed; running stage in-process",
+                        exc_info=True)
+            return None
+        with RUN_STATS.run(tag) as rec:
+            for k, v in resp.get("stats", {}).items():
+                if isinstance(v, (int, float, str, bool)):
+                    rec[k] = v
+            rec["daemon_mode"] = "attached"
+            rec["daemon_mode_reason"] = reason
+            rec["daemon_attached"] = 1.0
+            rec["daemon_sessions"] = float(resp.get("sessions", 0))
+            rec["daemon_queue_depth"] = float(resp.get("queue_depth", 0))
+            init_s = resp.get("init_phase_s", {})
+            if "platform_probe" in init_s:
+                rec["init_platform_probe_s"] = float(init_s["platform_probe"])
+            if "jax_devices" in init_s:
+                rec["init_jax_devices_s"] = float(init_s["jax_devices"])
+            if "first_compile" in init_s:
+                rec["init_first_compile_s"] = float(init_s["first_compile"])
+        return results
+
+    def _raw_chain(self) -> ExecutionPlan:
+        """The original pre-aggregation subtree this wrapper replaced,
+        rebuilt from its pieces: what _fallback re-executes on the host and
+        what the daemon client serializes over the socket."""
+        from ballista_tpu.plan.physical import HashJoinExec
+
         node: ExecutionPlan = self.scan
         for op in self.ops:
             if isinstance(op, HashJoinExec):
                 node = op.with_children([op.left, node])
             else:
                 node = op.with_children([node])
+        return node
+
+    def _fallback(self, partition: int, ctx: TaskContext) -> list[pa.RecordBatch]:
+        """Re-run the original CPU subtree (scan filters applied on host)."""
+        from ballista_tpu.plan.physical import CoalescePartitionsExec
+
+        self.fallback_count += 1
+        node = self._raw_chain()
         if self.emit_pid is not None:
             # device-routed layout contract: the device path ships EVERY
             # group through map task 0 (__pid routing) and empties the other

@@ -48,15 +48,28 @@ grants). Design consequences:
     of the cold path was hidden by the fill/compile overlap — is computable
     from the artifact alone.
 
+Round 6: the platform claim moved into the warm device-runtime daemon
+(ballista_tpu/device_daemon/). Each leg spawns ONE daemon and merely
+watches its supervised init state machine (platform probe →
+jax.devices() → first compile, each phase wall-clock bounded, progress
+re-emitted under the historical event names); the timed iterations then
+run ATTACHED — the engine ships stages to the daemon over its unix
+socket, so a daemon that survives init serves every warmup/iter without
+re-paying the claim, and the leg process itself never touches the pool
+(its own jax is pinned to CPU).
+
 Failure policy: a dead accelerator pool must NOT look like parity. If the
 device leg cannot produce a time, the JSON carries value=0,
 vs_baseline=0.0, "device_error", the FULL init-event trail (iteration
 events truncated, init events never — ADVICE r4), per-leg /proc autopsies
 and stderr tails. "device_leg" states the leg's fate explicitly: "ok",
-"error", or "skipped_init_timeout" — the last when no leg reported
-devices_ok within INIT_PROBE_TIMEOUT (a hung backend init / pool claim),
-in which case the round degrades to a recorded CPU-only datum instead of
-burning the whole budget on a claim that will never land.
+"error", or "init_failed" — the last when no daemon's claim landed within
+INIT_PROBE_TIMEOUT (or every daemon died in a claim phase), in which case
+the round degrades to a recorded CPU-only datum AND the artifact carries
+each daemon's structured probe report under "init_probe": which phase,
+how long, and a faulthandler stack snapshot of the hang — the claim is
+diagnosed per-phase instead of re-timed-out (the retired
+"skipped_init_timeout" state said only that time passed).
 """
 
 import json
@@ -74,9 +87,9 @@ HEDGE_AFTER = int(os.environ.get("BENCH_HEDGE_AFTER", "300"))
 MAX_LEGS = int(os.environ.get("BENCH_INIT_ATTEMPTS", "3"))
 # bounded init probe: if NO leg has reported devices_ok by this point the
 # accelerator claim itself is hung (jax init / pool grant — the failure
-# mode where backend init blocks forever inside a C extension and the
-# subprocess can't even time itself out). Stop waiting, record the round
-# as CPU-only with device_leg="skipped_init_timeout", keep the autopsies.
+# mode where backend init blocks forever inside a C extension). Stop
+# waiting, record the round as CPU-only with device_leg="init_failed",
+# keep the autopsies AND the daemons' per-phase probe reports.
 INIT_PROBE_TIMEOUT = min(int(os.environ.get("BENCH_INIT_PROBE_TIMEOUT", "600")),
                          DEVICE_LEG_TIMEOUT)
 # estimated seconds the full-scale device phase needs after data-ready
@@ -91,12 +104,13 @@ def log(msg: str) -> None:
 
 
 def best_time(engine: str, data_dir: str, sql: str, warmups: int, iters: int,
-              progress=None) -> tuple[float, int]:
+              progress=None, extra_cfg: dict | None = None) -> tuple[float, int]:
     from ballista_tpu.client.context import SessionContext
     from ballista_tpu.config import BallistaConfig, EXECUTOR_ENGINE
     from ballista_tpu.testing.tpchgen import register_tpch
 
-    ctx = SessionContext(BallistaConfig({EXECUTOR_ENGINE: engine}))
+    ctx = SessionContext(BallistaConfig({EXECUTOR_ENGINE: engine,
+                                         **(extra_cfg or {})}))
     register_tpch(ctx, data_dir)
     rows = ctx.catalog.get("lineitem").statistics().num_rows or 0
 
@@ -140,10 +154,19 @@ def best_time(engine: str, data_dir: str, sql: str, warmups: int, iters: int,
 
 def device_leg_main(out_path: str, progress_path: str, ready_path: str,
                     parent_pid: str, attempt: str) -> None:
-    """Runs in the subprocess. Phase 1: device init (the slow, fragile part —
-    started before data even exists), with an event around every fragile
-    statement. Phase 2: wait for the parent's data-ready JSON. Phase 3:
-    warmup (cache fill) + timed iterations, full scale or SF1 fallback."""
+    """Runs in the subprocess. Phase 1: the device claim — now owned by the
+    warm device-runtime daemon (ballista_tpu/device_daemon/): this leg
+    spawns one daemon for the bench run and only WATCHES its supervised
+    init state machine (platform probe → jax.devices() → first compile),
+    mapping daemon phases onto the same progress events the parent has
+    always keyed on. The leg process itself NEVER touches the pool: its
+    own jax (the final-merge fallback path) is pinned to CPU, so a hung
+    claim wedges only the daemon — which self-diagnoses (per-phase
+    timeout + faulthandler stack into <socket>.probe.json) and exits,
+    letting the next attempt retry instead of wedging the leg. Phase 2:
+    wait for the parent's data-ready JSON. Phase 3: warmup (cache fill)
+    + timed iterations with the engine ATTACHED to the daemon, full
+    scale or SF1 fallback."""
     attempt = int(attempt)
     parent_pid = int(parent_pid)  # captured BEFORE spawn: survives re-parenting
     pf = open(progress_path, "a", buffering=1)
@@ -155,24 +178,18 @@ def device_leg_main(out_path: str, progress_path: str, ready_path: str,
         os.fsync(pf.fileno())
 
     progress("leg_start", pid=os.getpid())
-    progress("import_jax_start")
-    import jax
+    from ballista_tpu.device_daemon import client as dclient
+    from ballista_tpu.device_daemon import protocol as dproto
 
-    p = os.environ.get("JAX_PLATFORMS")
-    if p:
-        jax.config.update("jax_platforms", p)
-    progress("import_jax_ok", platforms=p or "(default)")
-    t0 = time.time()
-    progress("devices_start")  # ← the statement that hung rounds 1-4
-    d = jax.devices()[0]
-    progress("devices_ok", platform=d.platform, kind=d.device_kind,
-             init_s=round(time.time() - t0, 1))
-    import jax.numpy as jnp
-
-    t0 = time.time()
-    x = jnp.ones((256, 256), dtype=jnp.bfloat16)
-    (x @ x).block_until_ready()
-    progress("first_compile_ok", s=round(time.time() - t0, 1))
+    sock = os.path.join(os.path.dirname(out_path), f"daemon_a{attempt}.sock")
+    probe_path = dproto.probe_report_path(sock)
+    daemon_platforms = os.environ.get("JAX_PLATFORMS") or "(default)"
+    progress("daemon_spawn", socket=sock, probe=probe_path)
+    # spawn FIRST (the daemon inherits the real JAX_PLATFORMS and dies with
+    # this leg), THEN pin this process's own jax to CPU: only the daemon
+    # may claim the pool
+    daemon_proc = dclient.spawn_daemon(sock, parent_pid=os.getpid())
+    os.environ["JAX_PLATFORMS"] = "cpu"
 
     def parent_alive() -> bool:
         try:
@@ -180,6 +197,56 @@ def device_leg_main(out_path: str, progress_path: str, ready_path: str,
             return True
         except OSError:
             return False
+
+    def load_probe() -> dict:
+        try:
+            return json.load(open(probe_path))
+        except (OSError, ValueError):
+            return {}
+
+    # watch the daemon's init phases; re-emit them under the historical
+    # event names so the parent's grant/hedge/probe logic is unchanged
+    client = dclient.DaemonClient(sock)
+    progress("import_jax_start")
+    phase_events = {"platform_probe": (None, "import_jax_ok"),
+                    "jax_devices": ("devices_start", "devices_ok"),
+                    "first_compile": (None, "first_compile_ok")}
+    emitted: set = set()
+    while True:
+        if not parent_alive():
+            progress("orphaned")
+            sys.exit(3)
+        if daemon_proc.poll() is not None:
+            progress("daemon_init_failed", exit_code=daemon_proc.returncode,
+                     report=load_probe())
+            sys.exit(4)
+        try:
+            st = client.status()
+        except Exception:  # noqa: BLE001 — socket not up yet
+            time.sleep(0.5)
+            continue
+        init = st.get("init", {})
+        for ph in init.get("phases", []):
+            start_ev, ok_ev = phase_events.get(ph["name"], (None, None))
+            if ph["status"] != "pending" and start_ev and start_ev not in emitted:
+                emitted.add(start_ev)
+                progress(start_ev)
+            if ph["status"] == "ok" and ok_ev and ok_ev not in emitted:
+                emitted.add(ok_ev)
+                if ok_ev == "import_jax_ok":
+                    progress(ok_ev, platforms=daemon_platforms)
+                elif ok_ev == "devices_ok":
+                    progress(ok_ev, platform=st.get("platform"),
+                             kind=st.get("device_kind"),
+                             init_s=round(ph["s"], 1))
+                else:
+                    progress(ok_ev, s=round(ph["s"], 1))
+        if init.get("error"):
+            progress("daemon_init_failed", report=load_probe())
+            sys.exit(4)
+        if st.get("ready"):
+            break
+        time.sleep(0.5)
 
     while not os.path.exists(ready_path):
         if not parent_alive():  # parent died before the sentinel: don't
@@ -194,9 +261,18 @@ def device_leg_main(out_path: str, progress_path: str, ready_path: str,
              fallback=bool(use_fallback))
 
     def run(cfg) -> float:
+        from ballista_tpu.config import (
+            TPU_DAEMON_ATTACH_TIMEOUT_MS,
+            TPU_DAEMON_ENABLED,
+            TPU_DAEMON_SOCKET,
+        )
+
         sql = open(cfg["sql_path"]).read()
-        best, _rows = best_time("tpu", cfg["data_dir"], sql, warmups=1,
-                                iters=3, progress=progress)
+        best, _rows = best_time(
+            "tpu", cfg["data_dir"], sql, warmups=1, iters=3,
+            progress=progress,
+            extra_cfg={TPU_DAEMON_ENABLED: True, TPU_DAEMON_SOCKET: sock,
+                       TPU_DAEMON_ATTACH_TIMEOUT_MS: 10_000})
         return best
 
     try:
@@ -545,16 +621,18 @@ def main() -> None:
                 mid_autopsy_done = True
                 pool.autopsy_all("mid")
             if not devices_ok and now - T0 > INIT_PROBE_TIMEOUT:
-                # no leg ever got past backend init: don't burn the rest of
-                # the budget waiting on a hung claim — degrade to a recorded
-                # CPU-only round
+                # no daemon ever got past backend init: don't burn the rest
+                # of the budget waiting on a hung claim — degrade to a
+                # recorded CPU-only round WITH the daemons' per-phase probe
+                # reports (which phase, how long, stack snapshot) in the
+                # artifact
                 pool.autopsy_all("init_timeout")
                 stage = events[-1]["event"] if events else "no progress at all"
                 device_error = (
                     f"no devices_ok within init probe window "
                     f"({INIT_PROBE_TIMEOUT}s); last progress: {stage}; "
                     f"crashes: {pool.errors[-2:]}")
-                device_leg_state = "skipped_init_timeout"
+                device_leg_state = "init_failed"
                 log(device_error)
                 break
             if now > deadline:
@@ -595,6 +673,24 @@ def main() -> None:
     else:
         base_t, base_rows, base_tag = cpu_t_fb, rows_fb, "sf1"
 
+    trail = read_progress(paths["progress"])
+    # structured init evidence: every leg's daemon wrote a per-phase probe
+    # report next to its socket (phase timings; faulthandler stack on a
+    # hang) — collect them whether the leg won or wedged
+    init_probes = {}
+    for e in trail:
+        if e.get("event") == "daemon_spawn" and e.get("probe"):
+            try:
+                init_probes[f"a{e.get('attempt', '?')}"] = json.load(
+                    open(e["probe"]))
+            except (OSError, ValueError):
+                pass
+    if device_error is not None and device_leg_state is None and any(
+            e.get("event") == "daemon_init_failed" for e in trail):
+        # every leg died IN the claim (daemon init phase timeout/crash):
+        # that is an init failure with a diagnosis, not a generic error
+        device_leg_state = "init_failed"
+
     result = {
         "metric": f"tpch_q1_{base_tag}_rows_per_sec_per_chip",
         "unit": "rows/s",
@@ -615,10 +711,11 @@ def main() -> None:
         result["device_error"] = device_error
         result["relay_preflight"] = preflight
         result["autopsies"] = pool.autopsies
+        if init_probes:
+            result["init_probe"] = init_probes
     # partial evidence survives either way. Init-stage events are few and
     # load-bearing — keep ALL of them; only warmup/iter events truncate
     # (ADVICE r4).
-    trail = read_progress(paths["progress"])
     if trail:
         init_ev = [e for e in trail if e.get("event") not in ("warmup", "iter")]
         run_ev = [e for e in trail if e.get("event") in ("warmup", "iter")]

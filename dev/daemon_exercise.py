@@ -1,0 +1,162 @@
+"""Exercise the warm device-runtime daemon end-to-end (CPU jax).
+
+    JAX_PLATFORMS=cpu python dev/daemon_exercise.py
+
+One cold spawn, then N warm attaches, against TPC-H q1:
+
+1. baseline — q1 runs fully in-process (no daemon) in THIS process; its
+   result bytes are the parity reference.
+2. cold — a daemon is spawned on a fresh socket; it claims the platform,
+   runs `jax.devices()` and the first compile exactly once (probe report
+   on disk next to the socket).
+3. warm ×N — each attach leg is a FRESH subprocess that runs q1 against
+   the daemon. Every leg must report `daemon_mode = "attached"`, must
+   never import jax (`"jax" not in sys.modules` — zero platform inits in
+   the attached process; the tiny final merge declines the device below
+   TPU_MIN_ROWS before ensure_jax), and must return bytes identical to
+   the baseline.
+4. across the warm legs the daemon's pid and compile cache are stable:
+   `compiled_entries` after leg 1 == after leg N (zero XLA recompiles on
+   warm attach) and the init phase report never re-runs.
+
+Exits non-zero on any divergence.
+"""
+
+import io
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+WARM_ATTACHES = 3
+
+
+def q1_sql() -> str:
+    with open(os.path.join(ROOT, "benchmarks", "tpch", "queries", "q1.sql")) as f:
+        return f.read()
+
+
+def _ipc_bytes(tbl) -> bytes:
+    import pyarrow as pa
+
+    sink = io.BytesIO()
+    with pa.ipc.new_stream(sink, tbl.schema) as w:
+        w.write_table(tbl)
+    return sink.getvalue()
+
+
+def _run_q1(data_dir: str, extra_cfg: dict | None = None) -> bytes:
+    from ballista_tpu.client.context import SessionContext
+    from ballista_tpu.config import EXECUTOR_ENGINE, BallistaConfig
+    from ballista_tpu.testing.tpchgen import register_tpch
+
+    cfg = BallistaConfig({EXECUTOR_ENGINE: "tpu", **(extra_cfg or {})})
+    ctx = SessionContext(cfg)
+    register_tpch(ctx, data_dir)
+    out = ctx.sql(q1_sql()).collect()
+    if out.num_rows == 0:
+        raise SystemExit("[q1] produced no rows")
+    return _ipc_bytes(out)
+
+
+def attach_leg_main(data_dir: str, sock: str, out_path: str) -> None:
+    """One warm attach, run in a fresh process: q1 against the daemon.
+    Writes {mode, reason, jax_imported} JSON and the result IPC bytes."""
+    from ballista_tpu.config import (
+        TPU_DAEMON_ATTACH_TIMEOUT_MS,
+        TPU_DAEMON_ENABLED,
+        TPU_DAEMON_SOCKET,
+    )
+    from ballista_tpu.ops.tpu import stage_compiler as sc
+
+    blob = _run_q1(data_dir, {
+        TPU_DAEMON_ENABLED: True, TPU_DAEMON_SOCKET: sock,
+        TPU_DAEMON_ATTACH_TIMEOUT_MS: 15_000,
+    })
+    stats = sc.RUN_STATS.snapshot()
+    with open(out_path, "wb") as f:
+        f.write(blob)
+    with open(out_path + ".json", "w") as f:
+        json.dump({
+            "mode": stats.get("daemon_mode"),
+            "reason": stats.get("daemon_mode_reason"),
+            # the proof that the attached process did ZERO platform inits:
+            # the device runtime was never even imported here
+            "jax_imported": "jax" in sys.modules,
+        }, f)
+
+
+def main() -> None:
+    from ballista_tpu.device_daemon import client as dclient
+    from ballista_tpu.device_daemon import protocol as dproto
+    from ballista_tpu.testing.tpchgen import generate_tpch
+
+    with tempfile.TemporaryDirectory(prefix="daemon-ex-") as d:
+        data_dir = os.path.join(d, "tpch")
+        print(f"generating TPC-H sf0.01 under {data_dir} ...")
+        generate_tpch(data_dir, scale=0.01, seed=42, files_per_table=2)
+
+        print("[baseline] q1 in-process ...")
+        baseline = _run_q1(data_dir)
+
+        sock = os.path.join(d, "daemon.sock")
+        print(f"[cold] spawning daemon on {sock} ...")
+        dclient.spawn_daemon(sock, parent_pid=os.getpid())
+        client = dclient.DaemonClient(sock)
+        st = client.wait_ready(timeout_s=120)
+        pid = st["pid"]
+        phases = {p["name"]: p["status"] for p in st["init"]["phases"]}
+        if not all(v == "ok" for v in phases.values()):
+            raise SystemExit(f"[cold] init phases not ok: {phases}")
+        report = json.load(open(dproto.probe_report_path(sock)))
+        print(f"[cold] ok: pid {pid}, phases {phases}, "
+              f"probe report ok={report['ok']}")
+
+        compiled_after_first = None
+        for i in range(1, WARM_ATTACHES + 1):
+            out_path = os.path.join(d, f"warm{i}.arrow")
+            r = subprocess.run(
+                [sys.executable, os.path.abspath(__file__),
+                 "--attach-leg", data_dir, sock, out_path],
+                capture_output=True, text=True)
+            if r.returncode != 0:
+                raise SystemExit(f"[warm {i}] leg failed:\n{r.stdout}\n{r.stderr}")
+            leg = json.load(open(out_path + ".json"))
+            if leg["mode"] != "attached":
+                raise SystemExit(f"[warm {i}] not attached: {leg}")
+            if leg["jax_imported"]:
+                raise SystemExit(f"[warm {i}] attached process imported jax — "
+                                 "it performed platform work of its own")
+            if open(out_path, "rb").read() != baseline:
+                raise SystemExit(f"[warm {i}] result bytes differ from the "
+                                 "in-process baseline")
+            st = client.status()
+            if st["pid"] != pid:
+                raise SystemExit(f"[warm {i}] daemon restarted: pid {pid} → "
+                                 f"{st['pid']}")
+            if i == 1:
+                compiled_after_first = st["compiled_entries"]
+                if compiled_after_first < 1:
+                    raise SystemExit("[warm 1] daemon compiled nothing")
+            elif st["compiled_entries"] != compiled_after_first:
+                raise SystemExit(
+                    f"[warm {i}] compile cache grew "
+                    f"({compiled_after_first} → {st['compiled_entries']}): "
+                    "a warm attach recompiled")
+            print(f"[warm {i}] ok: attached, jax-free client, byte-identical, "
+                  f"compiled_entries={st['compiled_entries']}")
+
+        client.shutdown()
+    print(f"daemon exercise passed: 1 cold init, {WARM_ATTACHES} warm attaches, "
+          "0 recompiles, 0 client platform inits")
+
+
+if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "--attach-leg":
+        attach_leg_main(sys.argv[2], sys.argv[3], sys.argv[4])
+    else:
+        main()

@@ -1,0 +1,279 @@
+"""Warm device-runtime daemon: lifecycle, parity, quotas, fallback.
+
+Everything runs under jax CPU (JAX_PLATFORMS=cpu — the tier-1 harness
+env, forced onto spawned daemons by the fixtures): the daemon protocol,
+attach ladder, session quotas, and byte parity are platform-independent,
+which is the point — the attached path must be indistinguishable from
+the in-process engine in everything but where the work happened.
+"""
+
+import io
+import json
+import os
+import socket as socketlib
+import time
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from ballista_tpu.config import (
+    EXECUTOR_ENGINE,
+    TPU_DAEMON_ATTACH_TIMEOUT_MS,
+    TPU_DAEMON_ENABLED,
+    TPU_DAEMON_SESSION_QUOTA_BYTES,
+    TPU_DAEMON_SOCKET,
+    TPU_DAEMON_SPAWN,
+    TPU_MIN_ROWS,
+    BallistaConfig,
+)
+from ballista_tpu.device_daemon import client as dclient
+from ballista_tpu.device_daemon import protocol as dproto
+
+SQL = ("SELECT cat, sum(price) AS s, count(*) AS c, avg(qty) AS q "
+       "FROM t GROUP BY cat ORDER BY cat")
+
+
+def _table(n=20_000, seed=11):
+    rng = np.random.default_rng(seed)
+    return pa.table({
+        "cat": rng.choice([f"c{i}" for i in range(7)], n),
+        "price": np.round(rng.uniform(1, 100, n), 2),
+        "qty": rng.integers(1, 50, n),
+    })
+
+
+def _run_query(tbl, **cfg_extra):
+    import ballista_tpu.ops.tpu.stage_compiler as sc
+    from ballista_tpu.client.context import SessionContext
+
+    cfg = BallistaConfig({EXECUTOR_ENGINE: "tpu", TPU_MIN_ROWS: 0, **cfg_extra})
+    ctx = SessionContext(cfg)
+    ctx.register_arrow_table("t", tbl, partitions=3)
+    sc.RUN_STATS.clear()
+    out = ctx.sql(SQL).collect()
+    return out, sc.RUN_STATS.snapshot()
+
+
+def _spawn_and_wait(sock_path, timeout_s=60.0):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = dclient.spawn_daemon(sock_path, parent_pid=os.getpid(), env=env)
+    client = dclient.DaemonClient(sock_path)
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        if proc.poll() is not None:
+            raise RuntimeError(
+                f"daemon died rc={proc.returncode}: "
+                + open(dproto.daemon_log_path(sock_path)).read()[-2000:])
+        try:
+            client.wait_ready(timeout_s=5.0, poll_s=0.2)
+            return proc, client
+        except dclient.DaemonUnavailable:
+            time.sleep(0.2)
+    raise RuntimeError(f"daemon not ready in {timeout_s}s")
+
+
+@pytest.fixture(scope="module")
+def daemon(tmp_path_factory):
+    sock = str(tmp_path_factory.mktemp("daemon") / "d.sock")
+    proc, client = _spawn_and_wait(sock)
+    yield sock, client
+    client.shutdown()
+    try:
+        proc.wait(timeout=10)
+    except Exception:  # noqa: BLE001
+        proc.kill()
+    dclient.reset_attach_cache()
+
+
+@pytest.fixture(autouse=True)
+def _clean_attach_cache():
+    yield
+    dclient.reset_attach_cache()
+
+
+def _daemon_cfg(sock, **extra):
+    return {TPU_DAEMON_ENABLED: True, TPU_DAEMON_SOCKET: sock,
+            TPU_DAEMON_ATTACH_TIMEOUT_MS: 10_000, **extra}
+
+
+# ------------------------------------------------------------- lifecycle
+
+def test_spawn_attach_status(daemon):
+    sock, client = daemon
+    st = client.status()
+    assert st["ready"] is True
+    phases = {p["name"]: p for p in st["init"]["phases"]}
+    assert set(phases) == {"platform_probe", "jax_devices", "first_compile"}
+    assert all(p["status"] == "ok" for p in phases.values())
+    # probe report persisted next to the socket, matching status
+    report = json.load(open(dproto.probe_report_path(sock)))
+    assert report["ok"] is True
+    assert report["pid"] == st["pid"]
+
+
+def test_attach_is_cached_and_reattaches(daemon):
+    sock, _ = daemon
+    cfg = BallistaConfig(_daemon_cfg(sock))
+    c1, mode1, _ = dclient.attach(cfg)
+    assert mode1 == "attached" and c1 is not None
+    c2, mode2, _ = dclient.attach(cfg)
+    assert c2 is c1  # cached per (socket, pid)
+    # a "crashed" client (lost state) re-runs the ladder and lands on the
+    # same live daemon without spawning a second one
+    dclient.reset_attach_cache()
+    c3, mode3, _ = dclient.attach(cfg)
+    assert mode3 == "attached"
+    assert c3.ping()["pid"] == c1.ping()["pid"]
+
+
+def test_daemon_survives_client_crash_mid_frame(daemon):
+    sock, client = daemon
+    # a client that dies mid-message must not take the daemon down
+    raw = socketlib.socket(socketlib.AF_UNIX, socketlib.SOCK_STREAM)
+    raw.connect(sock)
+    raw.sendall(b"\x00\x00\x10\x00garbage-partial-frame")
+    raw.close()
+    time.sleep(0.2)
+    assert client.ping()["pid"] > 0
+    out, stats = _run_query(_table(), **_daemon_cfg(sock))
+    assert stats.get("daemon_mode") == "attached"
+    assert out.num_rows == 7
+
+
+# ---------------------------------------------------------------- parity
+
+def test_attached_byte_identical_to_in_process(daemon):
+    sock, client = daemon
+    tbl = _table()
+    base, base_stats = _run_query(tbl)
+    att, att_stats = _run_query(tbl, **_daemon_cfg(sock))
+    assert att_stats.get("daemon_mode") == "attached"
+    assert att_stats.get("daemon_attached") == 1.0
+    assert "daemon_mode" not in base_stats
+
+    def ipc_bytes(t):
+        sink = io.BytesIO()
+        with pa.ipc.new_stream(sink, t.schema) as w:
+            w.write_table(t)
+        return sink.getvalue()
+
+    assert att.equals(base)
+    assert ipc_bytes(att) == ipc_bytes(base)
+    # the daemon mirrored its engine stats into the client's RUN_STATS
+    assert att_stats.get("exec_s") is not None
+    # daemon-side init phase timings rode back for the heartbeat gauges
+    assert att_stats.get("init_jax_devices_s") is not None
+
+
+def test_executor_heartbeat_exports_daemon_gauges(daemon):
+    sock, _ = daemon
+    _run_query(_table(), **_daemon_cfg(sock))
+    from ballista_tpu.executor.executor_process import ExecutorProcess
+
+    metrics = dict(ExecutorProcess._tpu_metrics())
+    assert metrics.get("tpu_daemon_attached") == 1.0
+    assert "daemon_sessions" in metrics
+    assert "daemon_queue_depth" in metrics
+    assert "tpu_init_jax_devices_s" in metrics
+
+
+# ------------------------------------------------------- session quotas
+
+def test_session_quota_clamps_budget():
+    from ballista_tpu.config import TPU_HBM_BUDGET_BYTES
+    from ballista_tpu.ops.tpu import hbm
+
+    cfg = BallistaConfig({TPU_HBM_BUDGET_BYTES: 1 << 30})
+    assert hbm.resolve_hbm_budget(cfg) == 1 << 30
+    with hbm.session_quota(1 << 20):
+        assert hbm.resolve_hbm_budget(cfg) == 1 << 20
+        with hbm.session_quota(0):  # inner scope: no ceiling
+            assert hbm.resolve_hbm_budget(cfg) == 1 << 30
+    assert hbm.resolve_hbm_budget(cfg) == 1 << 30
+
+
+def test_session_quota_forces_spill_plan():
+    from ballista_tpu.config import TPU_HBM_BUDGET_BYTES
+    from ballista_tpu.ops.tpu import hbm
+    from ballista_tpu.ops.tpu.fusion import StageEstimate
+
+    est = StageEstimate(
+        rows=1 << 20, partitions=2, group_domain=8, n_group_keys=1, lanes=1,
+        has_mult=False, n_filters=0, n_projections=0, n_joins=0,
+        max_probe_table=0, table_bytes=4 << 20, dict_bytes=1 << 20)
+    cfg = BallistaConfig({TPU_HBM_BUDGET_BYTES: 1 << 30})
+    roomy = hbm.plan_stage(est, hbm.resolve_hbm_budget(cfg),
+                           grace_eligible=True, grace_fanout=8,
+                           grace_max_depth=2, resident_other=2 << 20)
+    assert roomy.decision == hbm.RUN_WHOLE
+    # same stage, same knobs, but admitted under a 6 MiB session quota:
+    # the cold residents no longer fit beside it — spill becomes the plan
+    with hbm.session_quota(6 << 20):
+        tight = hbm.plan_stage(est, hbm.resolve_hbm_budget(cfg),
+                               grace_eligible=True, grace_fanout=8,
+                               grace_max_depth=2, resident_other=2 << 20)
+    assert tight.decision == hbm.SPILL_COLDS
+
+
+def test_session_quota_enforced_through_daemon(daemon):
+    sock, client = daemon
+    import ballista_tpu.ops.tpu.stage_compiler as sc
+
+    quota = 2 << 20
+    _, stats = _run_query(
+        _table(), **_daemon_cfg(sock, **{TPU_DAEMON_SESSION_QUOTA_BYTES: quota}))
+    assert stats.get("daemon_mode") == "attached"
+    # the daemon-side admission ran against the clamped budget and
+    # mirrored it back into the attached stage's record. (The flat
+    # snapshot also carries the CLIENT-side final stage's budget, which
+    # is unclamped by design — the quota governs daemon-resident work.)
+    attached = [r for r in sc.RUN_STATS.stages().values()
+                if r.get("daemon_mode") == "attached"]
+    assert attached and attached[-1].get("hbm_budget_bytes") == quota
+    st = client.status()
+    sess = [s for s in st["session_detail"].values()
+            if s["quota_bytes"] == quota]
+    assert sess and sess[0]["executes"] >= 1
+
+
+# ------------------------------------------------- stale socket + fallback
+
+def test_stale_socket_cleanup(tmp_path):
+    stale = str(tmp_path / "stale.sock")
+    lst = socketlib.socket(socketlib.AF_UNIX, socketlib.SOCK_STREAM)
+    lst.bind(stale)
+    lst.close()  # the path stays behind: classic dead-daemon litter
+    assert os.path.exists(stale)
+    cfg = BallistaConfig(_daemon_cfg(stale, **{TPU_DAEMON_SPAWN: False,
+                                               TPU_DAEMON_ATTACH_TIMEOUT_MS: 500}))
+    c, mode, reason = dclient.attach(cfg)
+    assert c is None and mode == "in_process"
+    assert "stale socket removed" in reason
+    assert not os.path.exists(stale)
+
+
+def test_graceful_fallback_when_no_daemon(tmp_path):
+    sock = str(tmp_path / "nobody-home.sock")
+    out, stats = _run_query(
+        _table(), **_daemon_cfg(sock, **{TPU_DAEMON_ATTACH_TIMEOUT_MS: 300}))
+    assert out.num_rows == 7  # the query still ran, in-process
+    assert stats.get("daemon_mode") == "in_process"
+    assert str(stats.get("daemon_mode_reason", "")).startswith("attach_failed")
+    assert stats.get("daemon_attached") == 0.0
+
+
+# -------------------------------------------------------- cache clearing
+
+def test_clear_device_caches_routes_to_daemon(daemon):
+    sock, client = daemon
+    import ballista_tpu.ops.tpu.stage_compiler as sc
+
+    _run_query(_table(), **_daemon_cfg(sock))
+    before = client.status()
+    assert before["compiled_entries"] >= 1
+    clears = before["clear_count"]
+    sc.clear_device_caches()  # attached process: must forward to the daemon
+    after = client.status()
+    assert after["clear_count"] == clears + 1
+    assert after["compiled_entries"] == 0
