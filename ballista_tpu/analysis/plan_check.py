@@ -18,10 +18,21 @@ stage-list invariants (`verify_stages`):
   span
 
 graph invariants (`verify_graph`): all of the above on the stage specs,
-plus `effective_partitions <= spec.partitions` (AQE only shrinks), task
-ids below the fast-lane band (`FAST_TASK_ID_BASE` — graph tasks and fast
-jobs share the executor's task-id namespace), and resolved readers
-tagged with a live `source_stage_id`.
+plus `effective_partitions <= spec.partitions + skew growth` (AQE may
+shrink by coalescing, and may grow ONLY by the slice count its
+SkewSplitReport accounts for), task ids below the fast-lane band
+(`FAST_TASK_ID_BASE` — graph tasks and fast jobs share the executor's
+task-id namespace), and resolved readers tagged with a live
+`source_stage_id`.
+
+skew-split postconditions (`verify_graph`, when a stage carries a
+SkewSplitReport): for every split hot bucket and every non-broadcast
+resolved reader, the slice tasks' location lists must either each equal
+the producer's full bucket list (a duplicated join build side) or
+concatenate to EXACTLY that list in (map_partition, path) order —
+cover, no overlap, and order, the same three legs the grace verifier
+checks. A violated split would silently drop, duplicate, or permute
+probe rows.
 
 Wiring: `ballista.debug.plan.verify` runs `check_stages` at submit time
 (after `merge_mesh_stages`) and `check_graph` after AQE replans, failing
@@ -208,11 +219,16 @@ def verify_graph(graph) -> list[PlanViolation]:
             f"band (FAST_TASK_ID_BASE={FAST_TASK_ID_BASE}); graph and fast "
             f"tasks would collide in the executor task-id namespace"))
     for st in graph.stages.values():
-        if st.effective_partitions > st.spec.partitions:
+        report = getattr(st, "skew_report", None)
+        allowed_growth = getattr(report, "extra_partitions", 0) if report else 0
+        if st.effective_partitions > st.spec.partitions + allowed_growth:
             v.append(PlanViolation(
                 "aqe-grew", st.stage_id,
                 f"effective_partitions={st.effective_partitions} exceeds the "
-                f"planned {st.spec.partitions}; AQE may only shrink a stage"))
+                f"planned {st.spec.partitions} plus the {allowed_growth} "
+                f"slice partitions the skew report accounts for; AQE growth "
+                f"must be backed by a SkewSplitReport"))
+        v.extend(_verify_skew_splits(graph, st))
         for task_id in st.running:
             if task_id >= FAST_TASK_ID_BASE:
                 v.append(PlanViolation(
@@ -228,6 +244,67 @@ def verify_graph(graph) -> list[PlanViolation]:
                         "reader-source", st.stage_id,
                         f"resolved reader tagged source_stage_id={src}, which "
                         f"is not a stage of this graph"))
+    return v
+
+
+def _verify_skew_splits(graph, st) -> list[PlanViolation]:
+    """Postconditions of an AQE skew split, checked against the stage's
+    SkewSplitReport before any slice task runs. For each hot bucket, each
+    non-broadcast reader's lists at the slice partitions must either each
+    equal the producer's full bucket location list (duplicated build side)
+    or concatenate exactly to it — cover / no-overlap / order over
+    (map_partition, path) identity."""
+    v: list[PlanViolation] = []
+    report = getattr(st, "skew_report", None)
+    if report is None or st.resolved_plan is None:
+        return v
+    readers = [l for l in _shuffle_leaves(st.resolved_plan)
+               if isinstance(l, ShuffleReaderExec) and not l.broadcast]
+    for split in report.splits:
+        for r in readers:
+            src = getattr(r, "source_stage_id", None)
+            prod = graph.stages.get(src) if src is not None else None
+            if prod is None:
+                continue
+            want = sorted(
+                (l.map_partition, l.path) for l in prod.output_locations()
+                if l.output_partition == split.bucket
+            )
+            slices: list[list[tuple]] = []
+            truncated = False
+            for p in split.partitions:
+                if p >= len(r.partition_locations):
+                    v.append(PlanViolation(
+                        "skew-cover", st.stage_id,
+                        f"split of bucket {split.bucket} names slice "
+                        f"partition {p} but a reader of stage {src} only has "
+                        f"{len(r.partition_locations)} partition lists"))
+                    truncated = True
+                    break
+                slices.append([(l.map_partition, l.path)
+                               for l in r.partition_locations[p]])
+            if truncated:
+                continue
+            if want and all(s == want for s in slices):
+                continue  # duplicated join build side: every slice sees it all
+            got = [t for s in slices for t in s]
+            if got == want:
+                continue  # clean slicing: cover, no overlap, in order
+            if sorted(got) == want:
+                v.append(PlanViolation(
+                    "skew-order", st.stage_id,
+                    f"split of bucket {split.bucket} (stage {src} input) "
+                    f"covers the bucket but permutes its map outputs; only "
+                    f"in-order concatenation is byte-identical"))
+            else:
+                missing = len(set(want) - set(got))
+                v.append(PlanViolation(
+                    "skew-cover", st.stage_id,
+                    f"split of bucket {split.bucket} (stage {src} input) "
+                    f"does not partition the bucket's map outputs: "
+                    f"{len(got)} slice locations vs {len(want)} produced "
+                    f"({missing} missing); every map output must be read "
+                    f"exactly once across the slices"))
     return v
 
 

@@ -47,6 +47,12 @@ AQE_COALESCE_MERGED_FACTOR = "ballista.planner.adaptive.coalesce.merged.factor"
 AQE_EMPTY_PROPAGATION = "ballista.planner.adaptive.empty.propagation"
 AQE_DYNAMIC_JOIN_SELECTION = "ballista.planner.adaptive.join.selection"
 AQE_ALTER_FANOUT = "ballista.planner.adaptive.alter.fanout"
+AQE_JOIN_HEDGE_FACTOR = "ballista.planner.adaptive.join.hedge.factor"
+# AQE skew defense: hot reduce partitions split into slice tasks
+AQE_SKEW_ENABLED = "ballista.aqe.skew.enabled"
+AQE_SKEW_FACTOR = "ballista.aqe.skew.factor"
+AQE_SKEW_MIN_BYTES = "ballista.aqe.skew.min.bytes"
+AQE_SKEW_MAX_SLICES = "ballista.aqe.skew.max.slices"
 GRPC_CLIENT_MAX_MESSAGE_SIZE = "ballista.grpc.client.max.message.size.bytes"
 GRPC_SERVER_MAX_MESSAGE_SIZE = "ballista.grpc.server.max.message.size.bytes"
 FLIGHT_PROXY = "ballista.client.flight.proxy"
@@ -94,6 +100,7 @@ CHAOS_MODE = "ballista.chaos.mode"
 CHAOS_STRAGGLER_DELAY_S = "ballista.chaos.straggler.delay.seconds"
 CHAOS_STRAGGLER_PARTITION = "ballista.chaos.straggler.partition"
 CHAOS_STRAGGLER_STAGE = "ballista.chaos.straggler.stage"
+CHAOS_SKEW_FRACTION = "ballista.chaos.skew.fraction"
 # straggler defense (speculation / deadlines)
 SPECULATION_ENABLED = "ballista.scheduler.speculation.enabled"
 SPECULATION_QUANTILE = "ballista.scheduler.speculation.quantile"
@@ -278,6 +285,46 @@ _ENTRIES: list[ConfigEntry] = [
     ConfigEntry(AQE_EMPTY_PROPAGATION, "AQE: prune stages proven empty by runtime stats.", bool, True),
     ConfigEntry(AQE_DYNAMIC_JOIN_SELECTION, "AQE: choose join strategy at runtime from actual input sizes.", bool, True),
     ConfigEntry(AQE_ALTER_FANOUT, "AQE: shrink a resolving stage's hash fan-out when observed input volume proves the planned bucket count too high.", bool, True),
+    ConfigEntry(
+        AQE_JOIN_HEDGE_FACTOR,
+        "AQE join hedging: a join whose build-side row ESTIMATE lands within "
+        "this factor of the broadcast row threshold (estimate * factor > "
+        "threshold) is too close to call at plan time, so the planner keeps "
+        "the partitioned layout with a deferred DynamicJoinSelectionExec "
+        "carrying the broadcast preference. Runtime bytes then decide both "
+        "ways: a build that finishes tiny is promoted to CollectLeft (with "
+        "probe-shuffle elision when it finishes first), one that comes in "
+        "oversized is DEMOTED to the partitioned join the hedge preserved. "
+        "0 disables hedging (estimates commit broadcast statically, the "
+        "pre-hedge behavior).",
+        float, 4.0, _nonneg,
+    ),
+    ConfigEntry(
+        AQE_SKEW_ENABLED,
+        "AQE skew defense: split a hot reduce partition into K slice tasks "
+        "at stage resolution when its observed bytes exceed both the "
+        "median-multiple factor and the bytes floor.",
+        bool, True,
+    ),
+    ConfigEntry(
+        AQE_SKEW_FACTOR,
+        "AQE skew defense: a reduce partition is hot when its combined input "
+        "bytes exceed factor * median(partition bytes).",
+        float, 4.0, lambda v: v >= 1.0,
+    ),
+    ConfigEntry(
+        AQE_SKEW_MIN_BYTES,
+        "AQE skew defense: never split a partition below this byte size "
+        "(splitting tiny skew trades task overhead for nothing).",
+        int, 16 * 1024 * 1024, _pos,
+    ),
+    ConfigEntry(
+        AQE_SKEW_MAX_SLICES,
+        "AQE skew defense: hard cap on the slice tasks one hot partition "
+        "splits into (the actual count is ceil(bytes/coalesce-target) "
+        "clamped here and to the partition's map-output count).",
+        int, 8, lambda v: v >= 2,
+    ),
     ConfigEntry(GRPC_CLIENT_MAX_MESSAGE_SIZE, "Client-side gRPC message ceiling.", int, 256 * 1024 * 1024, _pos),
     ConfigEntry(CLIENT_JOB_TIMEOUT_S, "How long a client waits for a submitted job before giving up.", int, 600, _pos),
     ConfigEntry(GRPC_SERVER_MAX_MESSAGE_SIZE, "Server-side gRPC message ceiling.", int, 256 * 1024 * 1024, _pos),
@@ -505,10 +552,15 @@ _ENTRIES: list[ConfigEntry] = [
         "BALLISTA_CHAOS_HBM_BUDGET (forced budget bytes, default 1 MiB) and "
         "BALLISTA_CHAOS_HBM_OOM_N (additionally raise a synthetic "
         "RESOURCE_EXHAUSTED on the Nth device upload, 0 = never; fires once, "
-        "so the evict-spill-retry rung can be observed converging).",
+        "so the evict-spill-retry rung can be observed converging). 'skew' "
+        "faults the shuffle-writer PARTITIONER (no plan wrapping): a seeded "
+        "fraction of rows — chosen as a pure function of the row's key hash, "
+        "so equal keys always co-locate and results stay byte-identical — is "
+        "rerouted to one hot reduce partition (ballista.chaos.skew.fraction), "
+        "deterministic fuel for the AQE skew-split defense.",
         str, "transient",
         choices=("transient", "fatal", "panic", "delay", "straggler", "overload",
-                 "corrupt", "hbm_oom"),
+                 "corrupt", "hbm_oom", "skew"),
     ),
     ConfigEntry(
         CHAOS_STRAGGLER_DELAY_S,
@@ -530,6 +582,15 @@ _ENTRIES: list[ConfigEntry] = [
         "single-task final stage drives the same indices the scan did — so "
         "tests that need exactly one straggling task pin the stage too.",
         int, -1, lambda v: v >= -1,
+    ),
+    ConfigEntry(
+        CHAOS_SKEW_FRACTION,
+        "chaos mode=skew: approximate fraction of shuffled rows rerouted to "
+        "the hot reduce partition (seeded; the hot partition index is "
+        "seed % K). Rerouting is keyed on the row's key hash, never on row "
+        "position, so both sides of a co-partitioned join skew identically "
+        "and query results are unchanged.",
+        float, 0.5, lambda v: 0.0 <= v <= 1.0,
     ),
     ConfigEntry(
         SPECULATION_ENABLED,

@@ -19,6 +19,7 @@ import pyarrow as pa
 
 from ballista_tpu.config import (
     AQE_DYNAMIC_JOIN_SELECTION,
+    AQE_JOIN_HEDGE_FACTOR,
     BROADCAST_JOIN_ROWS_THRESHOLD,
     BROADCAST_JOIN_THRESHOLD,
     BROADCAST_SEMI_KEYS_THRESHOLD,
@@ -88,7 +89,8 @@ class PhysicalPlanner:
         self.shuffle_partitions = int(self.config.get(DEFAULT_SHUFFLE_PARTITIONS))
         self.target_partitions = int(self.config.get(TARGET_PARTITIONS))
         self.broadcast_rows = int(self.config.get(BROADCAST_JOIN_ROWS_THRESHOLD))
-        if str(self.config.get(EXECUTOR_ENGINE)) == "tpu":
+        self.device_engine = str(self.config.get(EXECUTOR_ENGINE)) == "tpu"
+        if self.device_engine:
             # device joins probe an HBM-resident sorted build: the collect
             # budget scales to HBM, not to the CPU broadcast wire budget —
             # and only collect-build chains compile into device stages.
@@ -577,7 +579,36 @@ class PhysicalPlanner:
         if build_emitting and probe.output_partition_count() > 1:
             broadcast = False
 
-        if broadcast:
+        adaptive_defer = (
+            bool(self.config.get(PLANNER_ADAPTIVE_ENABLED))
+            and bool(self.config.get(AQE_DYNAMIC_JOIN_SELECTION))
+            and int(self.config.get(BROADCAST_JOIN_THRESHOLD)) > 0
+        )
+        # HEDGE: a broadcast whose build ESTIMATE lands within
+        # `aqe.join.hedge.factor` of the threshold is one bad cardinality
+        # guess away from collecting an oversized build on every probe task.
+        # When AQE can re-decide with actual sizes, keep the co-partitioned
+        # layout and defer: the node resolves to collect_left when the build
+        # truly fits (broadcast confirmed / promoted) or to a partitioned
+        # join when it came in oversized (broadcast DEMOTED,
+        # aqe_stats.broadcast_demotions). Never hedge a single-partition
+        # probe (collect there is free and sometimes the only legal mode)
+        # or the keys-only semi relaxation (its build intentionally exceeds
+        # the row budget). Never hedge under engine=tpu either: only
+        # collect-build chains compile into device stages, so demoting a
+        # near-threshold broadcast there trades a compilable plan for a
+        # host-only one — the out-of-core admission ladder already covers
+        # oversized device builds.
+        hedged = (
+            broadcast and adaptive_defer
+            and not self.device_engine
+            and probe.output_partition_count() > 1
+            and exec_jt in ("inner", "right", "right_semi", "right_anti")
+            and 0 < build_rows <= self.broadcast_rows
+            and build_rows * float(self.config.get(AQE_JOIN_HEDGE_FACTOR))
+            > self.broadcast_rows
+        )
+        if broadcast and not hedged:
             mode = "collect_left"
         else:
             mode = "partitioned"
@@ -586,10 +617,7 @@ class PhysicalPlanner:
             probe = RepartitionExec(probe, "hash", n, [r for _, r in on])
 
         exec_schema = _join_exec_schema(build_schema, probe_schema, exec_jt)
-        if (mode == "partitioned"
-                and bool(self.config.get(PLANNER_ADAPTIVE_ENABLED))
-                and bool(self.config.get(AQE_DYNAMIC_JOIN_SELECTION))
-                and int(self.config.get(BROADCAST_JOIN_THRESHOLD)) > 0):
+        if mode == "partitioned" and adaptive_defer:
             # the partitioned decision rests on row ESTIMATES: defer it.
             # The node resolves to a concrete join either at stage
             # resolution (stats known, scheduler/aqe/rules.py) or at
@@ -598,7 +626,8 @@ class PhysicalPlanner:
             from ballista_tpu.ops.cpu.dynamic_join import DynamicJoinSelectionExec
 
             j: ExecutionPlan = DynamicJoinSelectionExec(
-                build, probe, on, exec_jt, node.filter, exec_schema)
+                build, probe, on, exec_jt, node.filter, exec_schema,
+                planned_mode="collect_left" if hedged else "partitioned")
         else:
             j = HashJoinExec(build, probe, on, exec_jt, node.filter, mode, exec_schema)
 

@@ -19,7 +19,17 @@ time. The data plane has no session config, hence the env knobs
 BALLISTA_CHAOS_CORRUPT_P / BALLISTA_CHAOS_CORRUPT_ONCE / BALLISTA_CHAOS_SEED
 documented on `ballista.chaos.mode`.
 
-Mode 'hbm_oom' is the other exception: it faults the DEVICE memory path,
+Mode 'skew' faults the shuffle-writer PARTITIONER rather than leaf
+execution (wrapping leaves would hide device-compiled stages from the
+chain matcher, same trap 'hbm_oom' avoids): when armed, every bucketed
+ShuffleWriterExec reroutes a seeded fraction of rows into one hot reduce
+partition via `skew_remap_pids` below. The reroute is a pure function of
+the row's KEY HASH — never of row position — so equal keys still
+co-locate, both sides of a co-partitioned join skew identically, and
+query results stay byte-identical while one partition absorbs the load.
+Deterministic fuel for the AQE skew-split defense (docs/aqe.md).
+
+Mode 'hbm_oom' is the other plan-wrapping exception: it faults the DEVICE memory path,
 which chaos cannot reach by wrapping plan leaves — the TPU engine seam
 runs after chaos injection, and a ChaosExec-wrapped scan would hide the
 stage from the device compiler's chain matcher entirely (silently testing
@@ -38,17 +48,21 @@ import os
 import time
 from typing import Iterator
 
+import numpy as np
+
 from ballista_tpu.config import (
     CHAOS_ENABLED,
     CHAOS_MODE,
     CHAOS_PROBABILITY,
     CHAOS_SEED,
+    CHAOS_SKEW_FRACTION,
     CHAOS_STRAGGLER_DELAY_S,
     CHAOS_STRAGGLER_PARTITION,
     CHAOS_STRAGGLER_STAGE,
     BallistaConfig,
 )
 from ballista_tpu.errors import Cancelled, ExecutionError
+from ballista_tpu.ops.hashing import splitmix64
 from ballista_tpu.plan.physical import ExecutionPlan, TaskContext
 
 
@@ -74,6 +88,40 @@ def flip_bit(data: bytes, seed: int, key: str) -> bytes:
     out = bytearray(data)
     out[pos] ^= 1 << bit
     return bytes(out)
+
+
+def skew_params(config: BallistaConfig) -> tuple[int, float] | None:
+    """(seed, fraction) when chaos mode=skew is armed, else None. The
+    shuffle writer polls this per task — skew never wraps the plan."""
+    try:
+        if not bool(config.get(CHAOS_ENABLED)):
+            return None
+        if str(config.get(CHAOS_MODE)) != "skew":
+            return None
+        return int(config.get(CHAOS_SEED)), float(config.get(CHAOS_SKEW_FRACTION))
+    except Exception:
+        return None
+
+
+def skew_remap_pids(h: np.ndarray, k: int, seed: int, fraction: float) -> np.ndarray:
+    """Chaos mode=skew partitioner remap: route ~`fraction` of rows to the
+    hot partition `seed % k`, the rest to their honest `h % k` home.
+
+    The reroute decision re-mixes the row's key hash with a seeded salt,
+    so it is a pure function of the KEY — equal keys always land together
+    (results stay byte-identical) and every writer of a co-partitioned
+    exchange, host- or device-hashed, skews the same rows."""
+    h = h.astype(np.uint64, copy=False)
+    pids = (h % np.uint64(k)).astype(np.uint64)
+    if k <= 1 or fraction <= 0.0:
+        return pids
+    hot = np.uint64(seed % k)
+    if fraction >= 1.0:
+        return np.full_like(pids, hot)
+    salt = splitmix64(np.array([seed & 0xFFFFFFFFFFFFFFFF], dtype=np.uint64))[0]
+    mixed = splitmix64(h ^ salt)
+    threshold = np.uint64(int(fraction * float(2**64)))
+    return np.where(mixed < threshold, hot, pids)
 
 
 class ChaosExec(ExecutionPlan):
@@ -190,9 +238,10 @@ def maybe_inject_chaos(plan: ExecutionPlan, config: BallistaConfig, stage_attemp
     enabled = bool(config.get(CHAOS_ENABLED))
     mode = str(config.get(CHAOS_MODE)) if enabled else ""
     _sync_hbm_chaos(enabled, mode)
-    if not enabled or mode == "hbm_oom":
-        # hbm_oom never wraps the plan (see module docstring): the fault
-        # lives in the device upload path, not in leaf execution
+    if not enabled or mode in ("hbm_oom", "skew"):
+        # hbm_oom and skew never wrap the plan (see module docstring): those
+        # faults live in the device upload path / the shuffle partitioner,
+        # not in leaf execution
         return plan
     seed = int(config.get(CHAOS_SEED))
     prob = float(config.get(CHAOS_PROBABILITY))

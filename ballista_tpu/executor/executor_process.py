@@ -339,6 +339,14 @@ class ExecutorProcess:
             code = {"staged": 0.0, "fused_xla": 1.0, "fused_pallas": 2.0}
             out.append(("tpu_fusion_mode",
                         code.get(str(stats["fusion_mode"]), -1.0)))
+        # AQE decision counters likewise keep their RUN_STATS names (no
+        # tpu_ prefix: they count scheduler replans — skew splits, join
+        # mode switches, mesh replans — not this executor's device work)
+        for key in ("skew_splits", "coalesced_partitions",
+                    "broadcast_promotions", "broadcast_demotions",
+                    "aqe_mesh_replans"):
+            if key in stats:
+                out.append((key, float(stats[key])))
         # warm-daemon multiplexing gauges keep their RUN_STATS names (no
         # tpu_ prefix: they describe the shared daemon, not this
         # executor's own device work — tpu_daemon_attached above says

@@ -166,7 +166,7 @@ class DynamicJoinSelectionExec(ExecutionPlan):
     def __init__(self, left: ExecutionPlan, right: ExecutionPlan,
                  on: list[tuple[Expr, Expr]], join_type: str,
                  filter: Optional[Expr], df_schema: DFSchema,
-                 mode: str = "partitioned"):
+                 mode: str = "partitioned", planned_mode: str = "partitioned"):
         super().__init__(df_schema)
         self.left = left
         self.right = right
@@ -174,6 +174,11 @@ class DynamicJoinSelectionExec(ExecutionPlan):
         self.join_type = join_type
         self.filter = filter
         self.mode = mode
+        # what the STATIC planner would have committed to without the
+        # deferral — "collect_left" marks a hedged broadcast whose build
+        # estimate sat inside the hedge band; runtime resolution against it
+        # is what distinguishes a broadcast DEMOTION from a confirmation
+        self.planned_mode = planned_mode
         self._lock = threading.Lock()
         self._resolved: ExecutionPlan | None = None
         self.decision: str = ""  # Broadcast | BroadcastSwapped | Partitioned | PartitionedSwapped | AsPlanned
@@ -183,7 +188,8 @@ class DynamicJoinSelectionExec(ExecutionPlan):
 
     def with_children(self, c):
         return DynamicJoinSelectionExec(
-            c[0], c[1], self.on, self.join_type, self.filter, self.df_schema, self.mode)
+            c[0], c[1], self.on, self.join_type, self.filter, self.df_schema,
+            self.mode, self.planned_mode)
 
     def output_partition_count(self) -> int:
         return self.right.output_partition_count()
@@ -191,7 +197,17 @@ class DynamicJoinSelectionExec(ExecutionPlan):
     def node_str(self) -> str:
         on = ", ".join(f"{l} = {r}" for l, r in self.on)
         d = f" decision={self.decision}" if self.decision else ""
-        return f"DynamicJoinSelectionExec: type={self.join_type}, on=[{on}]{d}"
+        h = " planned=collect_left" if self.planned_mode == "collect_left" else ""
+        return f"DynamicJoinSelectionExec: type={self.join_type}, on=[{on}]{h}{d}"
+
+    def _note_switch(self, mode: str) -> None:
+        """Count a runtime reversal of the planned strategy (best-effort)."""
+        from ballista_tpu.ops.tpu import aqe_stats
+
+        if self.planned_mode == "collect_left" and mode == "partitioned":
+            aqe_stats.note_broadcast_demotion()
+        elif self.planned_mode != "collect_left" and mode == "collect_left":
+            aqe_stats.note_broadcast_promotion()
 
     # ------------------------------------------------------------- execute
 
@@ -238,6 +254,7 @@ class DynamicJoinSelectionExec(ExecutionPlan):
             probe_single,
             byte_thr, rows_thr,
         )
+        self._note_switch(mode)
         if self.decision == "AsPlanned":
             out = self._as_planned(l_obs, r_obs)
         else:
@@ -268,6 +285,7 @@ class DynamicJoinSelectionExec(ExecutionPlan):
             l_bytes, l_rows, True, r_bytes, r_rows, True, self.join_type,
             self.right.output_partition_count() == 1, byte_thr, rows_thr,
         )
+        self._note_switch(mode)
         if self.decision == "AsPlanned":
             return self._as_planned(None, None)
         return self._concrete(swap, mode, self.left, self.right)

@@ -145,6 +145,16 @@ class MeshExchangeExec(ExecutionPlan):
         out.demote_reason = self.demote_reason
         return out
 
+    def with_file_partitions(self, k: int) -> "MeshExchangeExec":
+        """Fresh exchange at a different bucket count — AQE's mesh bucket
+        replan. Hash routing is count-parametric (`h % K` on both the
+        device and host paths), so any K yields a valid partitioning; a
+        fresh node (new lock, empty cache) keeps the replan from aliasing
+        a prior resolution's buckets."""
+        out = MeshExchangeExec(self.producer, self.keys, k)
+        out.demote_reason = self.demote_reason
+        return out
+
     def output_partition_count(self) -> int:
         return self.file_partitions
 

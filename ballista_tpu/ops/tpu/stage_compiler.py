@@ -155,7 +155,14 @@ class RunStats(Mapping):
     to); the numeric twins daemon_attached / daemon_sessions /
     daemon_queue_depth and the daemon's per-phase init timings
     init_platform_probe_s / init_jax_devices_s / init_first_compile_s
-    flow to the executor heartbeat as gauges."""
+    flow to the executor heartbeat as gauges. AQE decision counters
+    (ops/tpu/aqe_stats.py, docs/aqe.md): skew_splits (hot reduce
+    partitions split into slice tasks), coalesced_partitions (reduce
+    partitions merged away), broadcast_promotions / broadcast_demotions
+    (runtime join mode switches in either direction), and
+    aqe_mesh_replans (mesh stages whose bucket count was replanned or
+    whose fused exchange was demoted on skew) — all cumulative, all
+    forwarded to the heartbeat under their own names."""
 
     _MAX_STAGES = 32
 

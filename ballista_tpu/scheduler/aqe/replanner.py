@@ -49,6 +49,7 @@ from ballista_tpu.config import (
     AQE_EMPTY_PROPAGATION,
     AQE_TARGET_PARTITION_BYTES,
     BROADCAST_JOIN_ROWS_THRESHOLD,
+    BROADCAST_JOIN_THRESHOLD,
     PLANNER_ADAPTIVE_ENABLED,
 )
 from ballista_tpu.ops.cpu.dynamic_join import DynamicJoinSelectionExec
@@ -132,11 +133,34 @@ class RuntimeJoinSelectionRule:
         if not bool(graph.config.get(AQE_DYNAMIC_JOIN_SELECTION)):
             return False
         threshold = int(graph.config.get(BROADCAST_JOIN_ROWS_THRESHOLD)) // ELISION_MARGIN
+        byte_limit = int(graph.config.get(BROADCAST_JOIN_THRESHOLD)) // ELISION_MARGIN
 
         def passthrough(writer: ShuffleWriterExec) -> ShuffleWriterExec:
             return ShuffleWriterExec(
                 writer.input, graph.job_id, writer.stage_id, 0, [], sort_shuffle=False
             )
+
+        def reader_refs(pid: int) -> int:
+            """How many shuffle-reader leaves across live stage specs read
+            stage `pid`. The elision rewrites the PRODUCERS (probe writer →
+            passthrough, build stage → broadcast), so it is only sound when
+            this join holds the sole reference — a second consumer would
+            keep expecting the original hash layout (the q68 shape: one
+            producer fans out to two join stages)."""
+            n = 0
+            for s in graph.stages.values():
+                if s.state is StageState.SUCCESSFUL:
+                    continue
+
+                def walk(node):
+                    nonlocal n
+                    if isinstance(node, UnresolvedShuffleExec) and node.stage_id == pid:
+                        n += 1
+                    for c in node.children():
+                        walk(c)
+
+                walk(s.spec.plan)
+            return n
 
         any_changed = False
         for stage in graph.stages.values():
@@ -176,7 +200,13 @@ class RuntimeJoinSelectionRule:
                     ):
                         return node, changed  # probe started (or already passthrough)
                     rows = sum(loc.stats.num_rows for loc in build.output_locations())
-                    if rows > threshold:
+                    nbytes = sum(loc.stats.num_bytes for loc in build.output_locations())
+                    # byte-aware as well as row-aware: the collected build
+                    # ships to every probe task, so wide payloads that
+                    # squeak under the row budget must still stay put
+                    if rows > threshold or nbytes > byte_limit:
+                        return node, changed
+                    if reader_refs(probe.stage_id) != 1 or reader_refs(build.stage_id) != 1:
                         return node, changed
                     probe.spec.plan = passthrough(probe.spec.plan)
                     probe.spec.output_partitions = probe.spec.partitions
@@ -192,11 +222,15 @@ class RuntimeJoinSelectionRule:
                         broadcast=False,
                     )
                     log.info(
-                        "AQE replan: build stage %d finished with %d rows → "
-                        "CollectLeft broadcast; probe stage %d hash shuffle elided "
-                        "(passthrough, %d partitions)",
-                        build.stage_id, rows, probe.stage_id, probe.spec.partitions,
+                        "AQE replan: build stage %d finished with %d rows / %d "
+                        "bytes → CollectLeft broadcast; probe stage %d hash "
+                        "shuffle elided (passthrough, %d partitions)",
+                        build.stage_id, rows, nbytes, probe.stage_id,
+                        probe.spec.partitions,
                     )
+                    from ballista_tpu.ops.tpu import aqe_stats
+
+                    aqe_stats.note_broadcast_promotion()
                     return (
                         HashJoinExec(
                             new_left, new_right, node.on, node.join_type, node.filter,
@@ -264,6 +298,7 @@ class AlterFanoutRule:
         # safety guards (unresolved + single-input): a half-patched chain
         # would execute partitions that no longer exist.
         affected: list[tuple[int, object]] = []  # (producer_id, consumer)
+        bcast_readers: list[tuple[int, int]] = []  # (producer_id, consumer_id)
         seen: set[int] = set()
         frontier = [(stage.stage_id, cid) for cid in graph.output_links.get(stage.stage_id, [])]
         if not frontier:
@@ -277,10 +312,21 @@ class AlterFanoutRule:
                 return
             seen.add(cid)
             affected.append((pid, c))
-            if c.spec.plan.output_partitions <= 0 and not c.spec.broadcast:
-                # broadcast outputs are read whole regardless of count;
-                # only non-broadcast passthrough output counts propagate
-                frontier.extend((cid, g) for g in graph.output_links.get(cid, []))
+            if c.spec.plan.output_partitions <= 0:
+                nxt = [(cid, g) for g in graph.output_links.get(cid, [])]
+                if not c.spec.broadcast:
+                    frontier.extend(nxt)
+                else:
+                    # broadcast outputs are read whole regardless of count,
+                    # so consumers past a broadcast passthrough keep their
+                    # task layout — but their reader leaves still advertise
+                    # the producer's count, which must follow the new K or
+                    # the plan verifier sees a phantom partition mismatch
+                    bcast_readers.extend(nxt)
+        for _, cid in bcast_readers:
+            c = graph.stages.get(cid)
+            if c is None or c.state is not StageState.UNRESOLVED:
+                return  # can't patch a built reader: abort before mutating
         total_bytes = sum(
             l.stats.num_bytes for inp in inputs for l in inp.output_locations()
         )
@@ -299,15 +345,15 @@ class AlterFanoutRule:
         )
         stage.spec.output_partitions = new_k
 
-        def patch(node, pid: int, count: int):
+        def patch(node, pid: int, count: int, bcast: bool = False):
             if (isinstance(node, UnresolvedShuffleExec)
-                    and node.stage_id == pid and not node.broadcast):
+                    and node.stage_id == pid and bool(node.broadcast) == bcast):
                 return UnresolvedShuffleExec(
-                    node.stage_id, node.df_schema, count, broadcast=False)
+                    node.stage_id, node.df_schema, count, broadcast=bcast)
             kids = node.children()
             if not kids:
                 return node
-            new_kids = [patch(c, pid, count) for c in kids]
+            new_kids = [patch(c, pid, count, bcast) for c in kids]
             if all(a is b for a, b in zip(new_kids, kids)):
                 return node
             return node.with_children(new_kids)
@@ -328,6 +374,9 @@ class AlterFanoutRule:
                 new_out[c.stage_id] = new_parts
             c.pending = list(range(new_parts))
             c.effective_partitions = new_parts
+        for pid, cid in bcast_readers:
+            c = graph.stages[cid]
+            c.spec.plan = patch(c.spec.plan, pid, new_out[pid], bcast=True)
         log.info(
             "AQE replan: stage %d inputs totalled %d bytes — hash fan-out "
             "altered %d → %d buckets (consumers repartitioned)",

@@ -16,6 +16,22 @@ inputs finished, actual per-partition stats in hand):
   reader, skipping the per-partition build (join swap by ACTUAL sizes, not
   estimates). Build-side-emitting join types keep partitioned mode — the
   correctness constraint from the physical planner applies at runtime too.
+- SkewSplitRule (docs/aqe.md): a reduce partition whose observed bytes
+  exceed `median × ballista.aqe.skew.factor` (median via a T-Digest over
+  the per-bucket histogram) and the `ballista.aqe.skew.min.bytes` floor is
+  split into K partition-SLICE tasks. Each slice's reader consumes a
+  distinct contiguous sub-range of the hot partition's map outputs
+  (shuffle.reader.split_location_ranges), so concatenating the slices in
+  partition order is byte-identical to the unsplit read; a join's build
+  side is DUPLICATED into every slice instead. plan_check's skew rule
+  verifies cover / no-overlap / order from the SkewSplitReport before the
+  replanned DAG runs.
+- Mesh composition: mesh-fused stages no longer disable AQE wholesale
+  (the PR 7 blanket skip). A hot key demotes the fused edge to the host
+  split with `mesh_mode_reason="demoted:aqe:skew"`; otherwise, when the
+  observed input volume warrants far fewer device buckets, the exchange
+  is rebuilt at the smaller count and the stage's task span shrinks with
+  it.
 
 The reference plans stages incrementally (AdaptivePlanner::replan_stages);
 this build plans statically and rewrites at resolution — same signals,
@@ -26,13 +42,19 @@ item that also unlocks probe-side-shuffle elision.
 from __future__ import annotations
 
 import logging
-from dataclasses import dataclass
+from dataclasses import dataclass, field
+
+import numpy as np
 
 from ballista_tpu.config import (
     AQE_COALESCE_MERGED_FACTOR,
     AQE_DYNAMIC_JOIN_SELECTION,
     AQE_EMPTY_PROPAGATION,
     AQE_MIN_PARTITION_BYTES,
+    AQE_SKEW_ENABLED,
+    AQE_SKEW_FACTOR,
+    AQE_SKEW_MAX_SLICES,
+    AQE_SKEW_MIN_BYTES,
     AQE_TARGET_PARTITION_BYTES,
     BROADCAST_JOIN_ROWS_THRESHOLD,
     BROADCAST_JOIN_THRESHOLD,
@@ -40,8 +62,17 @@ from ballista_tpu.config import (
     BallistaConfig,
 )
 from ballista_tpu.ops.cpu.dynamic_join import DynamicJoinSelectionExec
-from ballista_tpu.plan.physical import EmptyExec, ExecutionPlan, HashJoinExec
-from ballista_tpu.shuffle.reader import ShuffleReaderExec
+from ballista_tpu.plan.physical import (
+    CoalesceBatchesExec,
+    EmptyExec,
+    ExecutionPlan,
+    FilterExec,
+    HashJoinExec,
+    ProjectionExec,
+)
+from ballista_tpu.shuffle.reader import ShuffleReaderExec, split_location_ranges
+from ballista_tpu.shuffle.writer import ShuffleWriterExec
+from ballista_tpu.utils.tdigest import TDigest
 
 log = logging.getLogger(__name__)
 
@@ -79,19 +110,56 @@ class InputStageStats:
     total_bytes: int
     bucket_bytes: list[int]  # per output partition
     broadcast: bool
+    # T-Digest over this input's per-bucket byte histogram; the skew rule's
+    # robust-median threshold merges these across hash inputs
+    bytes_digest: "TDigest | None" = None
+
+
+@dataclass
+class SkewSplit:
+    """One hot reduce partition split into slice tasks."""
+
+    bucket: int            # original output-partition index that ran hot
+    partitions: list[int]  # stage partition indices now holding the slices
+    bytes: int             # observed combined bytes of the hot bucket
+
+
+@dataclass
+class SkewSplitReport:
+    """Resolution-time record of skew splits on a stage, consumed by
+    plan_check's skew rule (cover / no-overlap / order over the slice
+    readers' location lists) and by the aqe-grew partition accounting."""
+
+    splits: list[SkewSplit] = field(default_factory=list)
+    extra_partitions: int = 0
+
+
+# join types whose output is a pure function of (full build side, probe
+# rows): slicing the probe and concatenating slice outputs in probe order
+# reproduces the unsplit join. Build-emitting types (left/full/anti-left)
+# would emit their unmatched-build rows once PER slice — never split those.
+_SPLIT_SAFE_JOINS = ("inner", "right", "right_semi", "right_anti")
 
 
 def apply_aqe(plan: ExecutionPlan, input_stats: dict[int, InputStageStats],
               config: BallistaConfig,
-              stage_partitions: int | None = None) -> tuple[ExecutionPlan, int | None]:
+              stage_partitions: int | None = None,
+              stage_unconsumed: bool = False,
+              ) -> tuple[ExecutionPlan, int | None, SkewSplitReport | None]:
     """Rewrite a freshly-resolved stage plan using actual input statistics.
 
     `plan` has concrete ShuffleReaderExec leaves tagged with their source
-    stage id (set by the graph at resolution). Returns (new_plan,
-    coalesced_partition_count or None).
+    stage id (set by the graph at resolution). `stage_unconsumed` marks a
+    stage with no downstream consumers (results are collected, not read by
+    another stage) — a passthrough-rooted stage may only change its task
+    count then, because passthrough outputs are indexed by map partition.
+    Returns (new_plan, new_partition_count or None, SkewSplitReport or
+    None); a non-None count replaces the stage's pending/effective
+    partitions — it may exceed the planned count when a skew split grew
+    the stage.
     """
     if not bool(config.get(PLANNER_ADAPTIVE_ENABLED)):
-        return plan, None
+        return plan, None, None
 
     if bool(config.get(AQE_EMPTY_PROPAGATION)):
         plan = _propagate_empty(plan, input_stats)
@@ -99,20 +167,18 @@ def apply_aqe(plan: ExecutionPlan, input_stats: dict[int, InputStageStats],
     if bool(config.get(AQE_DYNAMIC_JOIN_SELECTION)):
         plan = _select_joins(plan, input_stats, config)
 
-    # mesh-wide stages: the fused exchange's bucket count is a fixed K baked
-    # into MeshExchangeExec — coalescing this stage's partitions below K
-    # would orphan every bucket >= the coalesced count (silent data loss),
-    # so the coalescing rule never applies here. AQE's contribution instead
-    # is the input-bytes demotion guard: a mesh exchange whose observed
-    # input stages exceed `ballista.tpu.mesh.max.input.bytes` would blow the
-    # fixed-capacity collective anyway — demote it before the wasted
-    # dispatch, with the reason on record.
+    # a stage whose root writer hash-routes (output_partitions > 0) can take
+    # any task count — every task feeds the same K output buckets. A
+    # passthrough root writes one output PER map partition, so its task
+    # count is only negotiable when nothing downstream indexes those outputs
+    repartitionable = isinstance(plan, ShuffleWriterExec) and (
+        plan.output_partitions > 0 or stage_unconsumed
+    )
+
     mesh_nodes = _mesh_nodes(plan)
     if mesh_nodes:
-        _demote_oversized_mesh(mesh_nodes, input_stats, config)
-        return plan, None
+        return _mesh_aqe(plan, mesh_nodes, input_stats, config, repartitionable)
 
-    new_parts = None
     target = int(config.get(AQE_TARGET_PARTITION_BYTES))
     min_b = int(config.get(AQE_MIN_PARTITION_BYTES))
     factor = float(config.get(AQE_COALESCE_MERGED_FACTOR))
@@ -121,42 +187,282 @@ def apply_aqe(plan: ExecutionPlan, input_stats: dict[int, InputStageStats],
     ]
     readers = _hash_readers(plan)
     k_in = len(hash_inputs[0].bucket_bytes) if hash_inputs else 0
-    # coalescing regroups reader partition lists IN PLACE of the stage's
+    # regrouping replaces reader partition lists IN PLACE of the stage's
     # partition indexing — only sound when the stage's partitions ARE the
     # readers' (a Union stage concatenates branch partition ranges, so its
-    # indexing is not reader-aligned; never coalesce it)
+    # indexing is not reader-aligned; never regroup it)
     aligned = stage_partitions is None or stage_partitions == k_in
-    if hash_inputs and readers and aligned and all(
+    if not (hash_inputs and readers and aligned and all(
         len(r.partition_locations) == k_in for r in readers
-    ):
-        k = len(hash_inputs[0].bucket_bytes)
-        combined = [0] * k
-        for s in hash_inputs:
-            if len(s.bucket_bytes) == k:
-                for i, b in enumerate(s.bucket_bytes):
-                    combined[i] += b
-        groups = coalesce_groups(combined, target, min_b, factor)
-        if 0 < len(groups) < k:
-            # build FRESH readers rather than mutating shared ones in place:
-            # a reader aliased by a replayed/retried resolution must never
-            # see half-regrouped location lists (the stale-alias class of
-            # bug this codebase hit once already)
-            replacements: dict[int, ShuffleReaderExec] = {}
-            for r in readers:
-                nr = ShuffleReaderExec(
-                    r.df_schema,
-                    [[loc for i in g for loc in r.partition_locations[i]] for g in groups],
-                    r.broadcast,
-                )
-                nr.source_stage_id = getattr(r, "source_stage_id", None)
-                replacements[id(r)] = nr
-            plan = _replace_readers(plan, replacements)
-            new_parts = len(groups)
-            from ballista_tpu.ops.cpu.range_repartition import retarget_routers
+    )):
+        return plan, None, None
 
-            plan = retarget_routers(plan, new_parts)
-            log.info("AQE coalesced %d reduce partitions into %d groups", k, len(groups))
-    return plan, new_parts
+    k = k_in
+    combined = _combined_bucket_bytes(input_stats)
+
+    # -- skew detection: which buckets split, into how many slices ---------
+    splits: dict[int, int] = {}
+    dup_ids: set[int] = set()
+    if repartitionable and bool(config.get(AQE_SKEW_ENABLED)):
+        safe, sliced_ids, dup_ids = _classify_split_readers(plan)
+        if safe:
+            sliced = [r for r in readers if id(r) in sliced_ids]
+
+            def min_locs(b: int) -> int:
+                return min((len(r.partition_locations[b]) for r in sliced), default=0)
+
+            splits = _plan_splits(combined, config, min_locs)
+
+    # -- unit construction: slices for hot buckets, coalesce groups for the
+    #    cold segments between them ----------------------------------------
+    report = None
+    if not splits:
+        groups = coalesce_groups(combined, target, min_b, factor)
+        if not (0 < len(groups) < k):
+            return plan, None, None
+        units: list[tuple] = [("group", g) for g in groups]
+        log.info("AQE coalesced %d reduce partitions into %d groups", k, len(groups))
+    else:
+        units = []
+        rsplits: list[SkewSplit] = []
+        seg: list[int] = []
+
+        def flush_segment() -> None:
+            if not seg:
+                return
+            for g in coalesce_groups([combined[i] for i in seg], target, min_b, factor):
+                units.append(("group", [seg[x] for x in g]))
+            seg.clear()
+
+        for b in range(k):
+            if b in splits:
+                flush_segment()
+                n = splits[b]
+                rsplits.append(SkewSplit(
+                    bucket=b,
+                    partitions=list(range(len(units), len(units) + n)),
+                    bytes=combined[b],
+                ))
+                for j in range(n):
+                    units.append(("slice", b, j, n))
+            else:
+                seg.append(b)
+        flush_segment()
+        report = SkewSplitReport(
+            splits=rsplits,
+            extra_partitions=sum(len(s.partitions) - 1 for s in rsplits),
+        )
+        log.info(
+            "AQE skew split: buckets %s → %d slices each (%d stage partitions total)",
+            sorted(splits), max(splits.values()), len(units),
+        )
+
+    # -- rebuild readers over the unit layout. FRESH readers rather than
+    #    mutating shared ones in place: a reader aliased by a replayed or
+    #    retried resolution must never see half-regrouped location lists
+    #    (the stale-alias class of bug this codebase hit once already) ------
+    replacements: dict[int, ShuffleReaderExec] = {}
+    for r in readers:
+        dup = id(r) in dup_ids
+        ranges: dict[int, list[list]] = {}
+        lists: list[list] = []
+        for u in units:
+            if u[0] == "group":
+                lists.append([loc for i in u[1] for loc in r.partition_locations[i]])
+            else:
+                _, b, j, n = u
+                if dup:
+                    # a join's build side sees the WHOLE hot bucket in every
+                    # slice — each slice re-builds the full hash table and
+                    # probes its own sub-range
+                    lists.append(list(r.partition_locations[b]))
+                else:
+                    if b not in ranges:
+                        ranges[b] = split_location_ranges(r.partition_locations[b], n)
+                    lists.append(ranges[b][j])
+        nr = ShuffleReaderExec(r.df_schema, lists, r.broadcast)
+        nr.source_stage_id = getattr(r, "source_stage_id", None)
+        replacements[id(r)] = nr
+    plan = _replace_readers(plan, replacements)
+    new_parts = len(units)
+    from ballista_tpu.ops.cpu.range_repartition import retarget_routers
+
+    plan = retarget_routers(plan, new_parts)
+
+    from ballista_tpu.ops.tpu import aqe_stats
+
+    if splits:
+        aqe_stats.note_skew_splits(len(splits))
+    coalesced_away = (k - len(splits)) - sum(1 for u in units if u[0] == "group")
+    aqe_stats.note_coalesced_partitions(coalesced_away)
+    return plan, new_parts, report
+
+
+def _combined_bucket_bytes(input_stats: dict[int, InputStageStats]) -> list[int]:
+    """Per-reduce-partition bytes summed over every hash input (the joint
+    histogram the coalesce and skew thresholds both read)."""
+    hash_inputs = [
+        s for s in input_stats.values() if not s.broadcast and len(s.bucket_bytes) > 1
+    ]
+    if not hash_inputs:
+        return []
+    k = len(hash_inputs[0].bucket_bytes)
+    combined = [0] * k
+    for s in hash_inputs:
+        if len(s.bucket_bytes) == k:
+            for i, b in enumerate(s.bucket_bytes):
+                combined[i] += b
+    return combined
+
+
+def _hot_buckets(combined: list[int], config: BallistaConfig) -> list[int]:
+    """Buckets exceeding `median × skew.factor` AND the skew bytes floor.
+    The median comes from a T-Digest over the bucket histogram — the same
+    sketch the runtime range repartitioner uses, robust to the hot bucket
+    dragging a plain mean."""
+    factor = float(config.get(AQE_SKEW_FACTOR))
+    floor = int(config.get(AQE_SKEW_MIN_BYTES))
+    if factor <= 0 or len(combined) < 2:
+        return []
+    digest = TDigest()
+    digest.add_array(np.asarray(combined, dtype=np.float64))
+    med = digest.quantile(0.5)
+    if med != med:  # empty digest
+        return []
+    threshold = max(med * factor, float(floor))
+    return [i for i, v in enumerate(combined) if v > threshold]
+
+
+def _plan_splits(combined: list[int], config: BallistaConfig,
+                 min_locs) -> dict[int, int]:
+    """bucket → slice count for every splittable hot bucket. The count
+    aims each slice at the coalesce target, capped by skew.max.slices and
+    by the bucket's map-output count (`min_locs`) — a single map output is
+    never subdivided, so fewer than 2 available locations means no split."""
+    hot = _hot_buckets(combined, config)
+    if not hot:
+        return {}
+    target = max(1, int(config.get(AQE_TARGET_PARTITION_BYTES)))
+    max_slices = int(config.get(AQE_SKEW_MAX_SLICES))
+    out: dict[int, int] = {}
+    for b in hot:
+        n = max(2, min(max_slices, -(-combined[b] // target)))
+        n = min(n, min_locs(b))
+        if n >= 2:
+            out[b] = n
+    return out
+
+
+def _classify_split_readers(plan: ExecutionPlan) -> tuple[bool, set[int], set[int]]:
+    """Can this stage tolerate splitting one reduce partition into slices,
+    and how does each hash reader participate?
+
+    Walks from the root writer through partition-wise operators. Filter /
+    projection / batch-coalescing are transparent (row-wise, order
+    preserving). A join whose type is in _SPLIT_SAFE_JOINS contributes its
+    LEFT (build) subtree's readers as duplicates — the full build executes
+    per slice — and recurses down the probe side; any other operator
+    (sorts, aggregates, unions, build-emitting joins) makes the plan
+    unsplittable. Returns (safe, sliced_reader_ids, dup_reader_ids)."""
+    sliced: set[int] = set()
+    dup: set[int] = set()
+    ok = True
+
+    def collect(n: ExecutionPlan) -> None:
+        if isinstance(n, ShuffleReaderExec):
+            if not n.broadcast:
+                dup.add(id(n))
+            return
+        for c in n.children():
+            collect(c)
+
+    def walk(n: ExecutionPlan) -> None:
+        nonlocal ok
+        if not ok:
+            return
+        if isinstance(n, ShuffleReaderExec):
+            if not n.broadcast:
+                sliced.add(id(n))
+            return
+        if isinstance(n, (HashJoinExec, DynamicJoinSelectionExec)):
+            if n.join_type not in _SPLIT_SAFE_JOINS:
+                ok = False
+                return
+            collect(n.left)
+            walk(n.right)
+            return
+        if isinstance(n, (FilterExec, ProjectionExec, CoalesceBatchesExec)):
+            walk(n.children()[0])
+            return
+        ok = False
+
+    if isinstance(plan, ShuffleWriterExec):
+        walk(plan.input)
+    else:
+        ok = False
+    return ok and bool(sliced), sliced, dup
+
+
+def _mesh_aqe(plan: ExecutionPlan, mesh_nodes: list,
+              input_stats: dict[int, InputStageStats], config: BallistaConfig,
+              repartitionable: bool,
+              ) -> tuple[ExecutionPlan, int | None, SkewSplitReport | None]:
+    """AQE over a mesh-fused stage — composition, not mutual exclusion.
+
+    Partition-slicing and reader regrouping cannot apply (the exchange
+    stands where the readers stood), but the runtime stats still drive two
+    decisions:
+
+    1. **skew demotion**: a hot bucket in the input histogram means the
+       fixed-capacity collective would see one device's receive lane blow
+       past its peers — demote the fused edge to the host split up front,
+       with `mesh_mode_reason="demoted:aqe:skew"` on record.
+    2. **bucket replan**: when the observed input volume wants far fewer
+       buckets than planned (the coalescing signal), REBUILD the exchange
+       at the smaller count — hash routing is bucket-count-parametric
+       (`h % K` on both the device and host paths), so any K is valid —
+       and shrink the stage's task span to match.
+    """
+    from ballista_tpu.ops.tpu import aqe_stats
+
+    _demote_oversized_mesh(mesh_nodes, input_stats, config)
+
+    combined = _combined_bucket_bytes(input_stats)
+    if bool(config.get(AQE_SKEW_ENABLED)) and combined and _hot_buckets(combined, config):
+        demoted = False
+        for n in mesh_nodes:
+            if not n.demote_reason:
+                n.demote_reason = "aqe:skew"
+                demoted = True
+        if demoted:
+            aqe_stats.note_mesh_replan()
+            log.info("AQE demoted mesh exchange: hot reduce bucket detected "
+                     "(mesh_mode_reason=demoted:aqe:skew)")
+        return plan, None, None
+
+    if not repartitionable or len(mesh_nodes) != 1:
+        return plan, None, None
+    ex = mesh_nodes[0]
+    if ex.demote_reason:
+        return plan, None, None
+    target = int(config.get(AQE_TARGET_PARTITION_BYTES))
+    total = sum(s.total_bytes for s in input_stats.values() if not s.broadcast)
+    if target <= 0 or total <= 0:
+        return plan, None, None
+    k = ex.file_partitions
+    new_k = max(1, -(-total // target))
+    # same hysteresis as the fan-out rule: only replan on a big win, the
+    # device dispatch amortizes small imbalances anyway
+    if new_k > k // 2 or new_k >= k:
+        return plan, None, None
+    plan = _replace_readers(plan, {id(ex): ex.with_file_partitions(new_k)})
+    from ballista_tpu.ops.cpu.range_repartition import retarget_routers
+
+    plan = retarget_routers(plan, new_k)
+    aqe_stats.note_mesh_replan()
+    log.info("AQE replanned mesh exchange: %d → %d device buckets "
+             "(%d observed input bytes)", k, new_k, total)
+    return plan, new_k, None
 
 
 def _mesh_nodes(plan: ExecutionPlan) -> list:
@@ -315,12 +621,20 @@ def _select_joins(plan: ExecutionPlan, input_stats, config: BallistaConfig) -> E
             and isinstance(n.left, ShuffleReaderExec)
         ):
             s = _stats_of(n.left, input_stats)
-            if s is not None and s.total_rows <= rows_threshold // 8:
+            # promotion is byte-aware as well as row-aware: a build whose
+            # rows squeak under the budget but whose BYTES are broadcast-
+            # hostile (wide payloads) stays partitioned
+            if (s is not None and s.total_rows <= rows_threshold // 8
+                    and 0 < s.total_bytes <= byte_threshold // 8):
                 bcast = ShuffleReaderExec(n.left.df_schema, n.left.partition_locations, broadcast=True)
                 bcast.source_stage_id = getattr(n.left, "source_stage_id", None)
                 log.info(
-                    "AQE join selection: build side has %d rows → CollectLeft broadcast", s.total_rows
+                    "AQE join selection: build side has %d rows / %d bytes → "
+                    "CollectLeft broadcast", s.total_rows, s.total_bytes,
                 )
+                from ballista_tpu.ops.tpu import aqe_stats
+
+                aqe_stats.note_broadcast_promotion()
                 return HashJoinExec(
                     bcast, n.right, n.on, n.join_type, n.filter, "collect_left", n.df_schema
                 )

@@ -93,7 +93,12 @@ class ExecutionStage:
         self.attempt = 0
         self.resolved_plan = stage.plan if not stage.input_stage_ids else None
         self.pending: list[int] = list(range(stage.partitions))
-        self.effective_partitions = stage.partitions  # may shrink via AQE coalescing
+        # may shrink via AQE coalescing or GROW via skew splitting
+        self.effective_partitions = stage.partitions
+        # SkewSplitReport when AQE split hot reduce partitions at this
+        # stage's resolution; plan_check verifies the slice readers against
+        # it (cover / no-overlap / order)
+        self.skew_report = None
         self.running: dict[int, RunningTask] = {}
         # map_partition → locations published by the finished task
         self.completed: dict[int, list[PartitionLocation]] = {}
@@ -122,6 +127,7 @@ class ExecutionStage:
         self.attempt += 1
         self.pending = list(range(self.spec.partitions))
         self.effective_partitions = self.spec.partitions
+        self.skew_report = None
         self.running.clear()
         self.completed.clear()
         self.task_durations = []
@@ -554,6 +560,8 @@ class ExecutionGraph:
         # adaptive replanning with the inputs' ACTUAL statistics
         from ballista_tpu.scheduler.aqe.rules import InputStageStats, apply_aqe
 
+        from ballista_tpu.utils.tdigest import TDigest
+
         stats: dict[int, InputStageStats] = {}
         for inp in inputs:
             locs = inp.output_locations()
@@ -562,16 +570,27 @@ class ExecutionGraph:
             for l in locs:
                 if l.output_partition < k:
                     buckets[l.output_partition] += l.stats.num_bytes
+            digest = TDigest()
+            if buckets:
+                import numpy as np
+
+                digest.add_array(np.asarray(buckets, dtype=np.float64))
             stats[inp.stage_id] = InputStageStats(
                 stage_id=inp.stage_id,
                 total_rows=sum(l.stats.num_rows for l in locs),
                 total_bytes=sum(l.stats.num_bytes for l in locs),
                 bucket_bytes=buckets,
                 broadcast=inp.spec.broadcast,
+                bytes_digest=digest,
             )
-        plan, new_parts = apply_aqe(plan, stats, self.config, stage.spec.partitions)
+        unconsumed = not self.output_links.get(stage.spec.stage_id)
+        plan, new_parts, report = apply_aqe(
+            plan, stats, self.config, stage.spec.partitions,
+            stage_unconsumed=unconsumed,
+        )
         stage.resolved_plan = plan
-        if new_parts is not None and new_parts < stage.spec.partitions:
+        stage.skew_report = report
+        if new_parts is not None and new_parts != stage.spec.partitions:
             stage.pending = list(range(new_parts))
             stage.effective_partitions = new_parts
         stage.state = StageState.RESOLVED

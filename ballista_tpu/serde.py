@@ -415,6 +415,11 @@ def encode_plan(plan: ExecutionPlan) -> pb.PhysicalPlanNode:
         if plan.filter is not None:
             n.filter.CopyFrom(encode_expr(plan.filter))
         n.mode = plan.mode
+        if _is_dynamic_join(plan) and getattr(plan, "planned_mode", "") == "collect_left":
+            # a hedged broadcast's planned strategy rides the mode string
+            # (frozen proto): the executor-side resolution needs it to tell
+            # a demotion from a plain partitioned decision
+            n.mode = f"{plan.mode}:planned=collect_left"
         n.schema.CopyFrom(encode_schema(plan.df_schema))
         n.dynamic = _is_dynamic_join(plan)
     elif isinstance(plan, CrossJoinExec):
@@ -466,7 +471,11 @@ def encode_plan(plan: ExecutionPlan) -> pb.PhysicalPlanNode:
         # set ("hash"/"round_robin")
         n = out.repartition
         n.input.CopyFrom(encode_plan(plan.producer))
-        n.scheme = "mesh_exchange"
+        # an AQE demotion verdict (skew, oversized input) must survive the
+        # wire — the executor-side exchange takes the host path and reports
+        # the scheduler's reason, instead of re-litigating the device ladder
+        n.scheme = ("mesh_exchange" if not plan.demote_reason
+                    else f"mesh_exchange:demoted={plan.demote_reason}")
         n.n = plan.file_partitions
         for k in plan.keys:
             n.keys.append(encode_expr(k))
@@ -573,9 +582,10 @@ def decode_plan(p: pb.PhysicalPlanNode) -> ExecutionPlan:
         if n.dynamic:
             from ballista_tpu.ops.cpu.dynamic_join import DynamicJoinSelectionExec
 
+            mode, _, planned = n.mode.partition(":planned=")
             return DynamicJoinSelectionExec(
                 decode_plan(n.left), decode_plan(n.right), on, n.join_type, filt,
-                decode_schema(n.schema), n.mode,
+                decode_schema(n.schema), mode, planned or "partitioned",
             )
         return HashJoinExec(
             decode_plan(n.left), decode_plan(n.right), on, n.join_type, filt,
@@ -611,8 +621,11 @@ def decode_plan(p: pb.PhysicalPlanNode) -> ExecutionPlan:
         return GlobalLimitExec(decode_plan(n.input), None if n.fetch < 0 else n.fetch, n.skip)
     if which == "repartition":
         n = p.repartition
-        if n.scheme == "mesh_exchange":
-            return MeshExchangeExec(decode_plan(n.input), [decode_expr(k) for k in n.keys], n.n)
+        if n.scheme == "mesh_exchange" or n.scheme.startswith("mesh_exchange:"):
+            ex = MeshExchangeExec(decode_plan(n.input), [decode_expr(k) for k in n.keys], n.n)
+            if n.scheme.startswith("mesh_exchange:demoted="):
+                ex.demote_reason = n.scheme.split("demoted=", 1)[1]
+            return ex
         if n.scheme.startswith("range_unordered:"):
             flags = dict(kv.split("=") for kv in n.scheme.split(":", 1)[1].split(","))
             key = SortKey(decode_expr(n.keys[0]), ascending=flags["asc"] == "1",

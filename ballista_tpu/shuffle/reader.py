@@ -103,6 +103,43 @@ class ShuffleReaderExec(ExecutionPlan):
             yield _empty_batch(self.schema())
 
 
+def split_location_ranges(locs: list[PartitionLocation], k: int) -> list[list[PartitionLocation]]:
+    """Split one reduce partition's location list into k contiguous,
+    byte-balanced sub-ranges — the unit AQE's skew defense hands to each
+    partition-slice task.
+
+    Contiguity over the scheduler's canonical (map_partition, path) order
+    is the whole point: each slice reads a distinct sub-range of the hot
+    partition's map outputs, so concatenating the slices in range order
+    reproduces the unsplit read byte-for-byte (cover, no overlap, order —
+    the postconditions plan_check's skew rule verifies). The greedy
+    boundary walk balances bytes without ever reordering; k is clamped to
+    the location count because a single map output is never subdivided."""
+    k = max(1, min(int(k), len(locs)))
+    if k <= 1:
+        return [list(locs)]
+    total = sum(max(0, l.stats.num_bytes) for l in locs)
+    out: list[list[PartitionLocation]] = []
+    cur: list[PartitionLocation] = []
+    cur_bytes = 0
+    done_bytes = 0
+    for i, l in enumerate(locs):
+        cur.append(l)
+        cur_bytes += max(0, l.stats.num_bytes)
+        locs_left = len(locs) - i - 1
+        slices_after = k - len(out) - 1  # slices still owed after closing cur
+        if slices_after <= 0:
+            continue
+        ideal = (total - done_bytes) / (slices_after + 1)
+        if cur_bytes >= ideal or locs_left == slices_after:
+            out.append(cur)
+            done_bytes += cur_bytes
+            cur, cur_bytes = [], 0
+    if cur:
+        out.append(cur)
+    return out
+
+
 class UnresolvedShuffleExec(ExecutionPlan):
     """Placeholder leaf: 'stage N's output, not yet materialized'
     (reference: unresolved_shuffle.rs:35). The scheduler swaps it for a

@@ -245,24 +245,37 @@ class ShuffleWriterExec(ExecutionPlan):
                     break
             pool_held += nbytes
 
-        from ballista_tpu.ops.hashing import split_batch_by_partition
+        from ballista_tpu.executor.chaos import skew_params, skew_remap_pids
+        from ballista_tpu.ops.hashing import hash_arrays, split_batch_by_partition
 
+        skew = skew_params(ctx.config)
         try:
             for b in self.input.execute(map_partition, ctx):
                 if b.num_rows == 0:
                     continue
                 pids = None
                 if getattr(self, "device_routed", False) and "__pid" in b.schema.names:
-                    # device-side routing: the TPU stage already hashed rows to
-                    # partitions (bit-exact twin); consume and drop the column.
-                    # Gated on the engine-set flag so a user column named __pid
-                    # is never misinterpreted.
-                    i = b.schema.get_field_index("__pid")
-                    pids = b.column(i).to_numpy(zero_copy_only=False).astype(np.uint64)
-                    b = b.select([n for n in b.schema.names if n != "__pid"])
-                    key_arrays = []
+                    if skew is not None and bound:
+                        # chaos skew reroutes by the row's KEY HASH, but the
+                        # device only shipped final partition ids. Recompute
+                        # the keys on the host (the jax hash is a bit-exact
+                        # twin) so every writer of this exchange — host- or
+                        # device-hashed — remaps the same rows.
+                        key_arrays = [evaluate_to_array(kb, b) for kb in bound]
+                        b = b.select([n for n in b.schema.names if n != "__pid"])
+                    else:
+                        # device-side routing: the TPU stage already hashed
+                        # rows to partitions (bit-exact twin); consume and
+                        # drop the column. Gated on the engine-set flag so a
+                        # user column named __pid is never misinterpreted.
+                        i = b.schema.get_field_index("__pid")
+                        pids = b.column(i).to_numpy(zero_copy_only=False).astype(np.uint64)
+                        b = b.select([n for n in b.schema.names if n != "__pid"])
+                        key_arrays = []
                 else:
                     key_arrays = [evaluate_to_array(kb, b) for kb in bound]
+                if skew is not None and key_arrays:
+                    pids = skew_remap_pids(hash_arrays(key_arrays), K, *skew)
                 for k, part in split_batch_by_partition(b, key_arrays, K, precomputed_pids=pids):
                     reserve(part.nbytes)
                     buckets[k].append(part)

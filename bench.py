@@ -513,6 +513,9 @@ def main() -> None:
     if len(sys.argv) > 1 and sys.argv[1] == "--tpcds-child":
         tpcds_child(sys.argv[2], sys.argv[3])
         return
+    if len(sys.argv) > 1 and sys.argv[1] == "--tpcds-skew-child":
+        tpcds_skew_child(sys.argv[2])
+        return
 
     scale = float(os.environ.get("TPCH_SCALE", "10"))
     sf_tag = f"sf{scale:g}".replace(".", "p")
@@ -726,6 +729,7 @@ def main() -> None:
 
     if os.environ.get("BENCH_TPCDS", "1") == "1":
         result["tpcds"] = tpcds_leg()
+        result["tpcds_skew"] = tpcds_skew_leg()
 
     print(json.dumps(result))
 
@@ -874,6 +878,141 @@ def tpcds_leg() -> dict:
         return out
     except (Exception, SystemExit) as e:  # noqa: BLE001 — recorded, not fatal
         log(f"tpcds leg failed: {e}")
+        return {"error": str(e)}
+
+
+TPCDS_SKEW_QUERIES = (3, 68)
+
+
+def tpcds_skew_child(data_dir: str) -> None:
+    """Run the skewed-join TPC-DS subset under chaos `skew` (seeded
+    hot-key routing at the shuffle partitioner, docs/aqe.md) through the
+    distributed standalone path, with the AQE skew defense ON, then re-run
+    the pure-join probe with the defense OFF as the unsplit oracle. Prints
+    per-query times, the AQE decision counters, and byte parity."""
+    from ballista_tpu.client.context import SessionContext
+    from ballista_tpu.config import (
+        AQE_SKEW_ENABLED,
+        AQE_SKEW_MIN_BYTES,
+        AQE_TARGET_PARTITION_BYTES,
+        BROADCAST_JOIN_ROWS_THRESHOLD,
+        CHAOS_ENABLED,
+        CHAOS_MODE,
+        CHAOS_SEED,
+        CHAOS_SKEW_FRACTION,
+        DEBUG_PLAN_VERIFY,
+        DEFAULT_SHUFFLE_PARTITIONS,
+        BallistaConfig,
+        PLANNER_ADAPTIVE_ENABLED,
+    )
+    from ballista_tpu.ops.tpu.stage_compiler import RUN_STATS
+    from ballista_tpu.testing.tpcdsgen import register_tpcds
+
+    probe_sql = ("select ss_item_sk, ss_ticket_number, i_brand from store_sales "
+                 "join item on ss_item_sk = i_item_sk")
+
+    def cfg(skew_aqe: bool) -> BallistaConfig:
+        return BallistaConfig({
+            DEFAULT_SHUFFLE_PARTITIONS: 8,
+            PLANNER_ADAPTIVE_ENABLED: True,
+            BROADCAST_JOIN_ROWS_THRESHOLD: 100,  # force partitioned joins
+            CHAOS_ENABLED: True, CHAOS_MODE: "skew", CHAOS_SEED: 5,
+            CHAOS_SKEW_FRACTION: 0.7,
+            AQE_SKEW_ENABLED: skew_aqe, AQE_SKEW_MIN_BYTES: 4096,
+            AQE_TARGET_PARTITION_BYTES: 128 * 1024,
+            DEBUG_PLAN_VERIFY: True,
+        })
+
+    def counters() -> dict:
+        snap = RUN_STATS.snapshot()
+        return {k: int(snap.get(k, 0) or 0) for k in
+                ("skew_splits", "coalesced_partitions", "broadcast_promotions",
+                 "broadcast_demotions", "aqe_mesh_replans")}
+
+    root = os.path.dirname(os.path.abspath(__file__))
+    out = {"queries": {}}
+    before = counters()
+
+    ctx = SessionContext.standalone(cfg(True), num_executors=1, vcores=4)
+    register_tpcds(ctx, data_dir)
+    for q in TPCDS_SKEW_QUERIES:
+        sql = open(os.path.join(
+            root, "benchmarks", "tpcds", "queries", f"q{q}.sql")).read()
+        best, rows = float("inf"), 0
+        for _ in range(2):
+            t0 = time.time()
+            res = ctx.sql(sql).collect()
+            best = min(best, time.time() - t0)
+            rows = res.num_rows
+        out["queries"][f"q{q}"] = {"best_s": round(best, 4), "rows": rows}
+    t0 = time.time()
+    split_res = ctx.sql(probe_sql).collect()
+    out["queries"]["join_probe"] = {
+        "best_s": round(time.time() - t0, 4), "rows": split_res.num_rows}
+    ctx.shutdown()
+    out["counters"] = {k: v - before[k] for k, v in counters().items() if v - before[k]}
+
+    # unsplit oracle: same chaos routing, defense off — byte parity proves
+    # the slice/merge path reproduced the exact unsplit stream
+    ctx = SessionContext.standalone(cfg(False), num_executors=1, vcores=4)
+    register_tpcds(ctx, data_dir)
+    t0 = time.time()
+    oracle = ctx.sql(probe_sql).collect()
+    out["oracle_s"] = round(time.time() - t0, 4)
+    ctx.shutdown()
+    out["parity"] = bool(split_res.to_pandas().equals(oracle.to_pandas()))
+    print("TPCDS_SKEW_CHILD " + json.dumps(out))
+    if not out["parity"]:
+        sys.exit(3)
+
+
+def tpcds_skew_leg() -> dict:
+    """AQE skew-defense leg (CPU jax, shares the tpcds fixture): star
+    joins plus a pure-join probe under seeded hot-key chaos. Valid only
+    when the probe actually split (skew_splits >= 1) and the split result
+    is byte-identical to the unsplit oracle. Failures are recorded, never
+    fatal."""
+    log("running tpcds skew-defense leg ...")
+    try:
+        from ballista_tpu.testing.tpcdsgen import generate_tpcds
+
+        scale = float(os.environ.get("BENCH_TPCDS_SCALE", "0.1"))
+        sf_tag = f"sf{scale:g}".replace(".", "p")
+        data_dir = os.environ.get("TPCDS_DATA", f"/tmp/ballista_tpcds_{sf_tag}")
+        if not os.path.isdir(os.path.join(data_dir, "store_sales")):
+            log(f"generating TPC-DS sf={scale:g} at {data_dir} ...")
+            generate_tpcds(data_dir, scale=scale, seed=17, files_per_table=2)
+
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--tpcds-skew-child", data_dir],
+            env=env, capture_output=True, text=True, timeout=900)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"tpcds skew child failed (rc={proc.returncode}):\n"
+                f"{proc.stdout[-800:]}\n{proc.stderr[-800:]}")
+        for line in proc.stdout.splitlines():
+            if line.startswith("TPCDS_SKEW_CHILD "):
+                child = json.loads(line[len("TPCDS_SKEW_CHILD "):])
+                break
+        else:
+            raise RuntimeError("tpcds skew child printed no stats")
+
+        ctr = child.get("counters", {})
+        if not ctr.get("skew_splits"):
+            raise RuntimeError(
+                f"tpcds skew leg ran but no partition split fired ({ctr})")
+        if not child.get("parity"):
+            raise RuntimeError("tpcds skew leg: split result diverged from oracle")
+        out = {"metric": f"tpcds_skew_{sf_tag}_parity",
+               "scale": scale, "queries": child["queries"],
+               "counters": ctr, "oracle_s": child["oracle_s"], "value": 1}
+        log(f"tpcds skew leg: parity ok, counters {ctr}")
+        return out
+    except (Exception, SystemExit) as e:  # noqa: BLE001 — recorded, not fatal
+        log(f"tpcds skew leg failed: {e}")
         return {"error": str(e)}
 
 

@@ -1,0 +1,237 @@
+"""Exercise the AQE skew defenses end-to-end on a tiny skewed join.
+
+    JAX_PLATFORMS=cpu python dev/skew_exercise.py
+
+Three legs, each in its own subprocess so the process-global AQE
+counters (ops/tpu/aqe_stats.py) start from zero:
+
+1. split — chaos skew mode piles ~70% of fact rows onto one reduce
+   bucket; the resolution-time replan must split it into partition-slice
+   tasks (skew_splits >= 1) and the merged result must be byte-identical
+   to the unsplit oracle (AQE skew off, same chaos seed).
+2. coalesce — the same join without chaos: AQE must still bin-pack the
+   cold reduce partitions (coalesced_partitions >= 1) with the result
+   byte-identical to a non-adaptive run.
+3. mesh-demote — apply_aqe over a mesh-fused stage: a hot bucket
+   demotes the fused exchange (mesh_mode_reason=demoted:aqe:skew), a
+   uniformly small input replans the device bucket count instead.
+
+Exits non-zero if any leg fails a counter or byte-parity check.
+Mechanism docs: docs/aqe.md.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+JOIN_SQL = "select fact.k, v, s, x from fact join dim on fact.k = dim.k"
+
+
+def write_tables(d: str) -> None:
+    """Parquet join inputs with nulls, strings and duplicate keys. Multiple
+    fact files matter: slicing needs >= 2 map outputs per hot bucket, and a
+    single-file scan would collapse to one map task."""
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    rng = np.random.default_rng(11)
+    os.makedirs(f"{d}/fact", exist_ok=True)
+    os.makedirs(f"{d}/dim", exist_ok=True)
+    for i in range(4):
+        n = 15_000
+        pq.write_table(pa.table({
+            "k": rng.integers(0, 2000, n),
+            "v": rng.integers(0, 100, n),
+            "s": pa.array([f"row{j % 97}" if j % 13 else None for j in range(n)]),
+        }), f"{d}/fact/part{i}.parquet")
+    for i in range(2):
+        pq.write_table(pa.table({
+            "k": np.arange(i * 1000, (i + 1) * 1000),
+            "x": rng.integers(0, 200, 1000),
+        }), f"{d}/dim/part{i}.parquet")
+
+
+def counters() -> dict:
+    from ballista_tpu.ops.tpu.stage_compiler import RUN_STATS
+
+    snap = RUN_STATS.snapshot()
+    return {k: int(snap.get(k, 0) or 0)
+            for k in ("skew_splits", "coalesced_partitions",
+                      "broadcast_promotions", "broadcast_demotions",
+                      "aqe_mesh_replans")}
+
+
+def run_join(d: str, *, chaos: bool, adaptive: bool, skew_aqe: bool):
+    """One standalone run of the skewed join; returns (table, graph)."""
+    from ballista_tpu.client.context import SessionContext
+    from ballista_tpu.config import (
+        AQE_SKEW_ENABLED,
+        AQE_SKEW_MIN_BYTES,
+        AQE_TARGET_PARTITION_BYTES,
+        BROADCAST_JOIN_ROWS_THRESHOLD,
+        CHAOS_ENABLED,
+        CHAOS_MODE,
+        CHAOS_SEED,
+        CHAOS_SKEW_FRACTION,
+        DEBUG_PLAN_VERIFY,
+        DEFAULT_SHUFFLE_PARTITIONS,
+        PLANNER_ADAPTIVE_ENABLED,
+        BallistaConfig,
+    )
+
+    cfg = BallistaConfig({
+        DEFAULT_SHUFFLE_PARTITIONS: 8,
+        PLANNER_ADAPTIVE_ENABLED: adaptive,
+        BROADCAST_JOIN_ROWS_THRESHOLD: 100,  # keep the join partitioned
+        CHAOS_ENABLED: chaos, CHAOS_MODE: "skew", CHAOS_SEED: 5,
+        CHAOS_SKEW_FRACTION: 0.7,
+        AQE_SKEW_ENABLED: skew_aqe,
+        AQE_SKEW_MIN_BYTES: 1024,
+        AQE_TARGET_PARTITION_BYTES: 64 * 1024,
+        DEBUG_PLAN_VERIFY: True,  # plan_check gates every resolution
+    })
+    ctx = SessionContext.standalone(cfg, num_executors=1, vcores=4)
+    ctx.register_parquet("fact", f"{d}/fact")
+    ctx.register_parquet("dim", f"{d}/dim")
+    try:
+        out = ctx.sql(JOIN_SQL).collect()
+        sched = ctx._cluster.scheduler
+        with sched._jobs_lock:
+            g = list(sched.jobs.values())[-1]
+        if g.status.value != "successful":
+            raise SystemExit(f"join run failed:\n{g.display()}")
+        return out, g
+    finally:
+        ctx.shutdown()
+
+
+def leg_split(d: str) -> None:
+    out, g = run_join(d, chaos=True, adaptive=True, skew_aqe=True)
+    ctr = counters()
+    reports = [s.skew_report for s in g.stages.values() if s.skew_report]
+    if ctr["skew_splits"] < 1 or not reports:
+        raise SystemExit(f"[split] no skew split fired: {ctr}")
+    if not all(len(s.partitions) >= 2 for r in reports for s in r.splits):
+        raise SystemExit("[split] a hot partition produced fewer than 2 slices")
+    oracle, og = run_join(d, chaos=True, adaptive=True, skew_aqe=False)
+    if any(s.skew_report for s in og.stages.values()):
+        raise SystemExit("[split] oracle run split despite skew AQE off")
+    if not out.to_pandas().equals(oracle.to_pandas()):
+        raise SystemExit("[split] DIVERGED from the unsplit oracle")
+    print(f"[split] ok: rows={out.num_rows} counters={json.dumps(ctr)}")
+
+
+def leg_coalesce(d: str) -> None:
+    out, _ = run_join(d, chaos=False, adaptive=True, skew_aqe=True)
+    ctr = counters()
+    if ctr["coalesced_partitions"] < 1:
+        raise SystemExit(f"[coalesce] nothing coalesced: {ctr}")
+    if ctr["skew_splits"]:
+        raise SystemExit("[coalesce] split fired without injected skew")
+    oracle, _ = run_join(d, chaos=False, adaptive=False, skew_aqe=False)
+    if not out.to_pandas().equals(oracle.to_pandas()):
+        raise SystemExit("[coalesce] DIVERGED from the non-adaptive oracle")
+    print(f"[coalesce] ok: rows={out.num_rows} counters={json.dumps(ctr)}")
+
+
+def leg_mesh_demote(d: str) -> None:
+    import numpy as np
+    import pyarrow as pa
+
+    from ballista_tpu.config import (
+        AQE_SKEW_MIN_BYTES,
+        AQE_TARGET_PARTITION_BYTES,
+        PLANNER_ADAPTIVE_ENABLED,
+        BallistaConfig,
+    )
+    from ballista_tpu.ops.tpu.mesh_stage import MeshExchangeExec
+    from ballista_tpu.plan.expressions import Column
+    from ballista_tpu.plan.physical import MemoryScanExec
+    from ballista_tpu.plan.schema import DFSchema
+    from ballista_tpu.scheduler.aqe.rules import InputStageStats, apply_aqe
+    from ballista_tpu.shuffle.writer import ShuffleWriterExec
+
+    cfg = BallistaConfig({
+        PLANNER_ADAPTIVE_ENABLED: True,
+        AQE_SKEW_MIN_BYTES: 1024,
+        AQE_TARGET_PARTITION_BYTES: 64 * 1024,
+    })
+
+    def mesh_plan(buckets=8):
+        t = pa.table({"k": np.arange(64, dtype="int64")})
+        scan = MemoryScanExec(DFSchema.from_arrow(t.schema), t.to_batches(), 4)
+        return ShuffleWriterExec(MeshExchangeExec(scan, [Column("k")], buckets),
+                                 "jm", 2, buckets, [Column("k")])
+
+    def stats(bucket_bytes):
+        return {1: InputStageStats(
+            stage_id=1, total_rows=sum(bucket_bytes) // 8,
+            total_bytes=sum(bucket_bytes), bucket_bytes=list(bucket_bytes),
+            broadcast=False)}
+
+    def exchanges(plan):
+        found, stack = [], [plan]
+        while stack:
+            n = stack.pop()
+            if isinstance(n, MeshExchangeExec):
+                found.append(n)
+            stack.extend(getattr(n, "children", lambda: [])())
+        return found
+
+    # hot bucket → the fused edge demotes rather than splitting under it
+    out, new_parts, report = apply_aqe(
+        mesh_plan(), stats([4096] * 7 + [1 << 20]), cfg, stage_partitions=8)
+    (ex,) = exchanges(out)
+    if new_parts is not None or report is not None or ex.demote_reason != "aqe:skew":
+        raise SystemExit(f"[mesh-demote] hot bucket did not demote: "
+                         f"reason={ex.demote_reason!r} parts={new_parts}")
+
+    # uniformly small input → bucket-count replan, no demotion
+    out, new_parts, report = apply_aqe(
+        mesh_plan(), stats([8192] * 8), cfg, stage_partitions=8)
+    (ex,) = exchanges(out)
+    if report is not None or not new_parts or new_parts > 4 \
+            or ex.file_partitions != new_parts or ex.demote_reason:
+        raise SystemExit(f"[mesh-demote] uniform input did not replan: "
+                         f"parts={new_parts} reason={ex.demote_reason!r}")
+
+    ctr = counters()
+    if ctr["aqe_mesh_replans"] != 2:
+        raise SystemExit(f"[mesh-demote] expected 2 mesh replans: {ctr}")
+    print(f"[mesh-demote] ok: counters={json.dumps(ctr)}")
+
+
+LEGS = {"split": leg_split, "coalesce": leg_coalesce,
+        "mesh-demote": leg_mesh_demote}
+
+
+def main() -> None:
+    if len(sys.argv) > 2 and sys.argv[1] == "--leg":
+        LEGS[sys.argv[2]](sys.argv[3])
+        return
+
+    with tempfile.TemporaryDirectory(prefix="skew-join-") as d:
+        print(f"generating skewed join tables under {d} ...")
+        write_tables(d)
+        env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+        failed = []
+        for name in LEGS:
+            r = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--leg", name, d],
+                env=env, cwd=ROOT, timeout=600)
+            if r.returncode != 0:
+                failed.append(name)
+        if failed:
+            raise SystemExit(f"skew exercise FAILED: {failed}")
+
+    print("skew exercise passed")
+
+
+if __name__ == "__main__":
+    main()

@@ -417,3 +417,357 @@ def test_alter_fanout_end_to_end(tpch_dir, tpch_ref_tables):
         assert altered, g.display()
     finally:
         ctx.shutdown()
+
+
+# ---------------------------------------------------------------- skew AQE
+
+
+def _write_skew_tables(d):
+    """Parquet join inputs with nulls, strings and duplicate keys: 4 fact
+    files (the multi-file scan is what gives each map task its own output
+    locations — slicing needs >= 2 map outputs per hot bucket) + 2 dim
+    files so the dim side shuffles too."""
+    import os
+
+    import numpy as np
+    import pyarrow.parquet as pq
+
+    rng = np.random.default_rng(11)
+    os.makedirs(f"{d}/fact")
+    os.makedirs(f"{d}/dim")
+    for i in range(4):
+        n = 15_000
+        pq.write_table(pa.table({
+            "k": rng.integers(0, 2000, n),
+            "v": rng.integers(0, 100, n),
+            "s": pa.array([f"row{j % 97}" if j % 13 else None for j in range(n)]),
+        }), f"{d}/fact/part{i}.parquet")
+    for i in range(2):
+        pq.write_table(pa.table({
+            "k": np.arange(i * 1000, (i + 1) * 1000),
+            "x": rng.integers(0, 200, 1000),
+        }), f"{d}/dim/part{i}.parquet")
+
+
+def _aqe_counter(key: str) -> int:
+    from ballista_tpu.ops.tpu.stage_compiler import RUN_STATS
+
+    return int(RUN_STATS.snapshot().get(key, 0) or 0)
+
+
+def _run_skew_join(d, skew_aqe: bool):
+    """Skewed fact⋈dim under chaos skew; returns (result, graph)."""
+    from ballista_tpu.client.context import SessionContext
+    from ballista_tpu.config import (
+        AQE_SKEW_ENABLED,
+        AQE_SKEW_MIN_BYTES,
+        BROADCAST_JOIN_ROWS_THRESHOLD,
+        CHAOS_SKEW_FRACTION,
+        DEBUG_PLAN_VERIFY,
+    )
+
+    cfg = BallistaConfig({
+        DEFAULT_SHUFFLE_PARTITIONS: 8,
+        PLANNER_ADAPTIVE_ENABLED: True,
+        BROADCAST_JOIN_ROWS_THRESHOLD: 100,  # force the partitioned join
+        CHAOS_ENABLED: True, CHAOS_MODE: "skew", CHAOS_SEED: 5,
+        CHAOS_SKEW_FRACTION: 0.7,
+        AQE_SKEW_ENABLED: skew_aqe,
+        AQE_SKEW_MIN_BYTES: 1024,
+        AQE_TARGET_PARTITION_BYTES: 64 * 1024,
+        DEBUG_PLAN_VERIFY: True,  # plan_check gates every resolution
+    })
+    ctx = SessionContext.standalone(cfg, num_executors=1, vcores=4)
+    ctx.register_parquet("fact", f"{d}/fact")
+    ctx.register_parquet("dim", f"{d}/dim")
+    try:
+        out = ctx.sql(
+            "select fact.k, v, s, x from fact join dim on fact.k = dim.k"
+        ).collect()
+        sched = ctx._cluster.scheduler
+        with sched._jobs_lock:
+            g = list(sched.jobs.values())[-1]
+        assert g.status.value == "successful", g.display()
+        return out, g
+    finally:
+        ctx.shutdown()
+
+
+def test_skew_split_byte_parity_and_coalesce_interaction(tmp_path):
+    """Chaos `skew` piles ~70% of fact rows onto one reduce bucket; the
+    resolution-time split must slice it into partition-range tasks while
+    the cold buckets still coalesce, and the merged result must be
+    byte-identical to the unsplit oracle (null/string/duplicate-key rows
+    cross the slice boundaries)."""
+    _write_skew_tables(tmp_path)
+    before = _aqe_counter("skew_splits")
+    split_out, g = _run_skew_join(tmp_path, skew_aqe=True)
+    oracle_out, og = _run_skew_join(tmp_path, skew_aqe=False)
+
+    reports = {s.stage_id: s.skew_report for s in g.stages.values() if s.skew_report}
+    assert reports, g.display()
+    (report,) = reports.values()
+    assert report.splits and report.extra_partitions >= 1
+    assert all(len(s.partitions) >= 2 for s in report.splits)
+    # interaction: the same resolution also coalesced the cold segment, so
+    # the stage's effective count is NOT planned + extra_partitions
+    st = g.stages[next(iter(reports))]
+    assert st.effective_partitions != st.spec.partitions
+    assert st.effective_partitions < st.spec.partitions + report.extra_partitions
+    assert not any(s.skew_report for s in og.stages.values())
+    assert _aqe_counter("skew_splits") >= before + 1
+
+    assert split_out.num_rows == oracle_out.num_rows
+    assert split_out.to_pandas().equals(oracle_out.to_pandas()), \
+        "skew-split result diverged from unsplit oracle"
+
+
+def test_plan_check_rejects_corrupted_split(tmp_path):
+    """plan_check's skew rule proves cover/no-overlap/order of the slice
+    readers against the producer's locations — corrupting either property
+    after resolution must raise a skew-cover / skew-order violation."""
+    import copy
+
+    from ballista_tpu.analysis.plan_check import verify_graph
+
+    _write_skew_tables(tmp_path)
+    _, g = _run_skew_join(tmp_path, skew_aqe=True)
+    st = next(s for s in g.stages.values() if s.skew_report)
+    assert not verify_graph(g), "resolved split graph must verify clean"
+
+    split = st.skew_report.splits[0]
+    from ballista_tpu.shuffle.reader import ShuffleReaderExec
+
+    def probe_readers():
+        from ballista_tpu.analysis.plan_check import _shuffle_leaves
+
+        return [
+            r for r in _shuffle_leaves(st.resolved_plan)
+            if isinstance(r, ShuffleReaderExec) and not r.broadcast
+            # the sliced (probe) reader's slice lists differ; the
+            # duplicated build side's are identical
+            and r.partition_locations[split.partitions[0]]
+            != r.partition_locations[split.partitions[1]]
+        ]
+
+    # order corruption: swap two slices' location lists in place
+    r = probe_readers()[0]
+    p0, p1 = split.partitions[0], split.partitions[1]
+    saved = copy.copy(r.partition_locations)
+    r.partition_locations[p0], r.partition_locations[p1] = (
+        r.partition_locations[p1], r.partition_locations[p0])
+    codes = {v.code for v in verify_graph(g)}
+    assert "skew-order" in codes, codes
+    r.partition_locations = saved
+
+    # cover corruption: a slice loses one of its map outputs
+    r = probe_readers()[0]
+    victim = next(p for p in split.partitions if len(r.partition_locations[p]) > 0)
+    saved_list = r.partition_locations[victim]
+    r.partition_locations[victim] = saved_list[:-1]
+    codes = {v.code for v in verify_graph(g)}
+    assert "skew-cover" in codes, codes
+    r.partition_locations[victim] = saved_list
+    assert not verify_graph(g)
+
+
+# -------------------------------------------------- runtime join switching
+
+
+def _dyn_join(planned_mode: str):
+    import numpy as np
+
+    from ballista_tpu.engine.physical_planner import _join_exec_schema
+    from ballista_tpu.ops.cpu.dynamic_join import DynamicJoinSelectionExec
+    from ballista_tpu.plan.expressions import Column
+    from ballista_tpu.plan.physical import MemoryScanExec
+    from ballista_tpu.plan.schema import DFSchema
+
+    def scan(name):
+        t = pa.table({name: np.arange(8, dtype="int64")})
+        return MemoryScanExec(DFSchema.from_arrow(t.schema), t.to_batches(), 4)
+
+    left, right = scan("bk"), scan("pk")
+    schema = _join_exec_schema(left.df_schema, right.df_schema, "inner")
+    return DynamicJoinSelectionExec(
+        left, right, [(Column("bk"), Column("pk"))], "inner", None, schema,
+        planned_mode=planned_mode)
+
+
+def test_broadcast_demotion_on_oversized_build():
+    """A hedged broadcast (planned_mode=collect_left) whose build arrives
+    past BOTH thresholds must resolve to a partitioned join and count a
+    broadcast demotion; a build that confirms small keeps collect_left and
+    counts nothing."""
+    from ballista_tpu.plan.physical import HashJoinExec
+
+    before = _aqe_counter("broadcast_demotions")
+    j = _dyn_join("collect_left")
+    out = j.resolve_with_stats(
+        l_bytes=1 << 30, l_rows=1 << 22, r_bytes=1 << 31, r_rows=1 << 23,
+        byte_thr=1 << 20, rows_thr=1 << 20)
+    assert isinstance(out, HashJoinExec) and out.mode == "partitioned"
+    assert _aqe_counter("broadcast_demotions") == before + 1
+
+    # oversized-in-rows-only demotes too (the wire budget is byte-bound,
+    # but the collect hash table is row-bound)
+    j = _dyn_join("collect_left")
+    out = j.resolve_with_stats(
+        l_bytes=1 << 10, l_rows=1 << 22, r_bytes=1 << 30, r_rows=1 << 23,
+        byte_thr=1 << 20, rows_thr=1 << 20)
+    assert getattr(out, "mode", "") != "collect_left"
+    assert _aqe_counter("broadcast_demotions") == before + 2
+
+    # confirmation: the hedge was paranoia, the build really is small
+    base_p = _aqe_counter("broadcast_promotions")
+    j = _dyn_join("collect_left")
+    out = j.resolve_with_stats(
+        l_bytes=1 << 10, l_rows=100, r_bytes=1 << 30, r_rows=1 << 23,
+        byte_thr=1 << 20, rows_thr=1 << 20)
+    assert getattr(out, "mode", "") == "collect_left"
+    assert _aqe_counter("broadcast_demotions") == before + 2
+    assert _aqe_counter("broadcast_promotions") == base_p
+
+
+def test_broadcast_promotion_counts():
+    """The mirror switch: a join planned partitioned whose build proves
+    tiny at resolution promotes to collect_left and counts a promotion."""
+    before = _aqe_counter("broadcast_promotions")
+    j = _dyn_join("partitioned")
+    out = j.resolve_with_stats(
+        l_bytes=1 << 10, l_rows=100, r_bytes=1 << 30, r_rows=1 << 23,
+        byte_thr=1 << 20, rows_thr=1 << 20)
+    assert getattr(out, "mode", "") == "collect_left"
+    assert _aqe_counter("broadcast_promotions") == before + 1
+
+
+def test_planner_hedges_near_threshold_broadcasts():
+    """A build ESTIMATE within hedge.factor of the broadcast cap plans as a
+    co-partitioned DynamicJoinSelectionExec with planned_mode=collect_left
+    (demotable at runtime); far below the band it stays a static broadcast,
+    and engine=tpu never hedges (only collect-build chains compile into
+    device stages)."""
+    import numpy as np
+
+    from ballista_tpu.client.context import SessionContext
+    from ballista_tpu.config import EXECUTOR_ENGINE
+    from ballista_tpu.ops.cpu.dynamic_join import DynamicJoinSelectionExec
+    from ballista_tpu.plan.physical import HashJoinExec
+    from ballista_tpu.plan.provider import MemoryTable, TableStats
+
+    from .conftest import iter_plan
+
+    class LyingStats(MemoryTable):
+        def __init__(self, batches, schema, partitions, rows):
+            super().__init__(batches, schema, partitions)
+            self._rows = rows
+
+        def statistics(self):
+            return TableStats(num_rows=self._rows, total_bytes=self._rows * 64)
+
+    build = pa.table({"k": np.arange(100, dtype="int64"), "v": np.arange(100.0)})
+    probe = pa.table({"k": np.arange(100, dtype="int64"), "w": np.arange(100.0)})
+
+    def plan_with(engine, build_rows):
+        from ballista_tpu.config import EXECUTOR_ENGINE as ENG
+
+        ctx = SessionContext(BallistaConfig({
+            ENG: engine, PLANNER_ADAPTIVE_ENABLED: True,
+        }))
+        ctx.register_table("b", LyingStats(build.to_batches(), build.schema, 4, build_rows))
+        ctx.register_table("p", LyingStats(probe.to_batches(), probe.schema, 4, 40_000_000))
+        sql = "SELECT sum(w + v) AS s FROM p JOIN b ON p.k = b.k"
+        return list(iter_plan(ctx.create_physical_plan(ctx.sql(sql).plan)))
+
+    # 900k rows: under the 1M cap but within the 4x hedge band → hedged
+    hedged = [n for n in plan_with("cpu", 900_000)
+              if isinstance(n, DynamicJoinSelectionExec)]
+    assert hedged and hedged[0].planned_mode == "collect_left"
+
+    # 100k rows: far below the band → the static broadcast stands
+    nodes = plan_with("cpu", 100_000)
+    assert not any(isinstance(n, DynamicJoinSelectionExec) for n in nodes)
+    assert any(isinstance(n, HashJoinExec) and n.mode == "collect_left"
+               for n in nodes)
+
+    # engine=tpu: same 900k estimate must NOT hedge
+    nodes = plan_with("tpu", 900_000)
+    assert not any(isinstance(n, DynamicJoinSelectionExec) for n in nodes)
+    assert any(isinstance(n, HashJoinExec) and n.mode == "collect_left"
+               for n in nodes)
+
+
+# ------------------------------------------------------------- mesh rungs
+
+
+def _mesh_stage_plan(buckets: int = 8):
+    import numpy as np
+
+    from ballista_tpu.ops.tpu.mesh_stage import MeshExchangeExec
+    from ballista_tpu.plan.expressions import Column
+    from ballista_tpu.plan.physical import MemoryScanExec
+    from ballista_tpu.plan.schema import DFSchema
+    from ballista_tpu.shuffle.writer import ShuffleWriterExec
+
+    t = pa.table({"k": np.arange(64, dtype="int64")})
+    scan = MemoryScanExec(DFSchema.from_arrow(t.schema), t.to_batches(), 4)
+    ex = MeshExchangeExec(scan, [Column("k")], buckets)
+    return ShuffleWriterExec(ex, "jm", 2, buckets, [Column("k")]), ex
+
+
+def _mesh_stats(bucket_bytes):
+    from ballista_tpu.scheduler.aqe.rules import InputStageStats
+
+    return {1: InputStageStats(
+        stage_id=1, total_rows=sum(bucket_bytes) // 8,
+        total_bytes=sum(bucket_bytes), bucket_bytes=list(bucket_bytes),
+        broadcast=False)}
+
+
+def test_mesh_aqe_demote_vs_replan():
+    """The two mesh-AQE rungs: a hot bucket demotes the fused exchange
+    (mesh_mode_reason=demoted:aqe:skew) instead of splitting under it; a
+    uniformly small input replans the device bucket count instead of
+    coalescing readers; an already-demoted exchange is left alone."""
+    from ballista_tpu.config import AQE_SKEW_MIN_BYTES
+    from ballista_tpu.ops.tpu.mesh_stage import MeshExchangeExec
+    from ballista_tpu.scheduler.aqe.rules import apply_aqe
+
+    from .conftest import iter_plan
+
+    cfg = BallistaConfig({
+        PLANNER_ADAPTIVE_ENABLED: True,
+        AQE_SKEW_MIN_BYTES: 1024,
+        AQE_TARGET_PARTITION_BYTES: 64 * 1024,
+    })
+
+    # rung 1: hot bucket → demote, never a split under the exchange
+    before = _aqe_counter("aqe_mesh_replans")
+    plan, ex = _mesh_stage_plan()
+    stats = _mesh_stats([4096] * 7 + [1 << 20])
+    out, new_parts, report = apply_aqe(plan, stats, cfg, stage_partitions=8)
+    assert new_parts is None and report is None
+    # the upstream AQE passes may rebuild the tree, so read the exchange
+    # back out of the returned plan rather than trusting the original node
+    (demoted,) = [n for n in iter_plan(out) if isinstance(n, MeshExchangeExec)]
+    assert demoted.demote_reason == "aqe:skew"
+    assert _aqe_counter("aqe_mesh_replans") == before + 1
+
+    # rung 2: uniform small input → bucket-count replan on a fresh exchange
+    plan, ex = _mesh_stage_plan()
+    stats = _mesh_stats([8192] * 8)  # 64 KiB total → 1 bucket wanted
+    out, new_parts, report = apply_aqe(plan, stats, cfg, stage_partitions=8)
+    assert report is None
+    assert new_parts is not None and 0 < new_parts <= 4
+    replanned = [n for n in iter_plan(out) if isinstance(n, MeshExchangeExec)]
+    assert replanned and replanned[0].file_partitions == new_parts
+    assert not replanned[0].demote_reason
+    assert _aqe_counter("aqe_mesh_replans") == before + 2
+
+    # rung 3: an exchange already demoted for capacity is never replanned
+    plan, ex = _mesh_stage_plan()
+    ex.demote_reason = "capacity"
+    out, new_parts, report = apply_aqe(plan, stats, cfg, stage_partitions=8)
+    assert new_parts is None and report is None
+    assert ex.demote_reason == "capacity"
+    assert _aqe_counter("aqe_mesh_replans") == before + 2
