@@ -34,6 +34,13 @@ cover, no overlap, and order, the same three legs the grace verifier
 checks. A violated split would silently drop, duplicate, or permute
 probe rows.
 
+lease-band invariants (`verify_lease_bands`): direct-dispatch leases
+reserve task-id bands at/above `DIRECT_TASK_ID_BASE`, pairwise disjoint,
+with allocation cursors inside their band — and `verify_graph` flags any
+scheduler-run task whose id strays into that band. Together these prove
+a direct-dispatched task id can never collide with a scheduler-assigned
+one in the executor's shared namespace.
+
 Wiring: `ballista.debug.plan.verify` runs `check_stages` at submit time
 (after `merge_mesh_stages`) and `check_graph` after AQE replans, failing
 the job instead of executing a corrupt DAG. The TPC-H plan-stability
@@ -218,6 +225,8 @@ def verify_graph(graph) -> list[PlanViolation]:
             f"next_task_id={graph.next_task_id} has crossed the fast-lane "
             f"band (FAST_TASK_ID_BASE={FAST_TASK_ID_BASE}); graph and fast "
             f"tasks would collide in the executor task-id namespace"))
+    from ballista_tpu.serving.lease import DIRECT_TASK_ID_BASE
+
     for st in graph.stages.values():
         report = getattr(st, "skew_report", None)
         allowed_growth = getattr(report, "extra_partitions", 0) if report else 0
@@ -230,7 +239,14 @@ def verify_graph(graph) -> list[PlanViolation]:
                 f"must be backed by a SkewSplitReport"))
         v.extend(_verify_skew_splits(graph, st))
         for task_id in st.running:
-            if task_id >= FAST_TASK_ID_BASE:
+            if task_id >= DIRECT_TASK_ID_BASE:
+                v.append(PlanViolation(
+                    "lease-band", st.stage_id,
+                    f"running task {task_id} is inside the direct-dispatch "
+                    f"lease band (>= {DIRECT_TASK_ID_BASE}); only a client "
+                    f"holding an executor lease may mint ids there, never "
+                    f"the scheduler's graph loop"))
+            elif task_id >= FAST_TASK_ID_BASE:
                 v.append(PlanViolation(
                     "task-id-band", st.stage_id,
                     f"running task {task_id} is inside the fast-lane id band"))
@@ -361,6 +377,62 @@ def check_grace(report) -> list[PlanViolation]:
     """verify_grace, returned (not raised): the executor turns violations
     into a CPU demotion, the analysis CLI renders them."""
     return verify_grace(report)
+
+
+def verify_lease_bands(leases) -> list[PlanViolation]:
+    """Direct-dispatch band invariants over a set of `ExecutorLease`s
+    (live or historical). A lease hands a client a private task-id range;
+    byte-identity of direct results depends on those ids never colliding
+    with scheduler-assigned ids (graph tasks < FAST_TASK_ID_BASE, fast
+    jobs < DIRECT_TASK_ID_BASE) or with each other:
+
+    - **floor**: every band starts at or above `DIRECT_TASK_ID_BASE`;
+    - **disjoint**: no two bands overlap (the registry allocates them
+      monotonically — an overlap means two clients can mint the same id
+      at one executor);
+    - **cursor**: a lease's allocation cursor stays within its band
+      (`0 <= next_offset <= band_size`).
+    """
+    from ballista_tpu.serving.lease import DIRECT_TASK_ID_BASE
+
+    v: list[PlanViolation] = []
+
+    def bad(code: str, lease, message: str) -> None:
+        v.append(PlanViolation(code, 0, f"[lease {lease.lease_id}] {message}"))
+
+    ranges = []
+    for lease in leases:
+        start, size = lease.band_start, lease.band_size
+        if size <= 0:
+            bad("lease-band", lease, f"band_size={size}; an empty band can "
+                f"never admit a task")
+            continue
+        if start < DIRECT_TASK_ID_BASE:
+            bad("lease-band", lease,
+                f"band [{start}, {start + size}) starts below "
+                f"DIRECT_TASK_ID_BASE={DIRECT_TASK_ID_BASE}; direct ids "
+                f"would collide with scheduler-assigned task ids")
+        cursor = getattr(lease, "next_offset", 0)
+        if not 0 <= cursor <= size:
+            bad("lease-band", lease,
+                f"allocation cursor next_offset={cursor} is outside "
+                f"[0, band_size={size}]; ids minted past the band spill "
+                f"into a neighbouring lease's range")
+        ranges.append((start, start + size, lease))
+    ranges.sort(key=lambda r: r[0])
+    for (a_lo, a_hi, a), (b_lo, b_hi, b) in zip(ranges, ranges[1:]):
+        if b_lo < a_hi:
+            bad("lease-band", b,
+                f"band [{b_lo}, {b_hi}) overlaps lease {a.lease_id}'s "
+                f"band [{a_lo}, {a_hi}); two clients could mint the same "
+                f"task id at one executor")
+    return v
+
+
+def check_lease_bands(leases) -> None:
+    violations = verify_lease_bands(leases)
+    if violations:
+        raise PlanVerificationError(violations)
 
 
 def check_stages(stages) -> None:

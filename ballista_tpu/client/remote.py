@@ -188,7 +188,10 @@ class RemoteSchedulerClient:
 
     def wait_for_job(self, job_id: str, timeout: float = 600.0) -> dict:
         deadline = time.time() + timeout
-        poll = POLL_INTERVAL_S
+        # jittered floor: a herd of clients submitting together must not
+        # poll in lockstep — each client's cadence starts (and grows) at a
+        # random phase, so the scheduler sees a smear instead of spikes
+        poll = POLL_INTERVAL_S * (1.0 + random.random())
         while time.time() < deadline:
             resp = self._call_idempotent(
                 self.stub.GetJobStatus, pb.GetJobStatusParams(job_id=job_id), "GetJobStatus")
@@ -197,8 +200,9 @@ class RemoteSchedulerClient:
                 return status
             time.sleep(poll)
             # exponential poll growth: fast feedback on short jobs, gentle
-            # on the scheduler for long ones
-            poll = min(POLL_INTERVAL_MAX_S, poll * 1.5)
+            # on the scheduler for long ones; jittering the factor keeps
+            # initially-synchronized clients from re-converging
+            poll = min(POLL_INTERVAL_MAX_S, poll * (1.25 + 0.5 * random.random()))
         raise ExecutionError(f"job {job_id} timed out")
 
     # -- prepared statements -------------------------------------------------

@@ -40,6 +40,12 @@ BROADCAST_JOIN_ROWS_THRESHOLD = "ballista.optimizer.broadcast.join.threshold.row
 BROADCAST_SEMI_KEYS_THRESHOLD = "ballista.optimizer.broadcast.semi.keys.threshold.rows"
 MAX_PARTITIONS_PER_TASK = "ballista.scheduler.max_partitions_per_task"
 JOB_RESUBMIT_INTERVAL_MS = "ballista.scheduler.job.resubmit.interval.ms"
+# scheduler scale-out: sharded event loops + direct-dispatch leases
+SCHEDULER_SHARDS = "ballista.scheduler.shards"
+SCHEDULER_LEASE_ENABLED = "ballista.scheduler.lease.enabled"
+SCHEDULER_LEASE_TTL_S = "ballista.scheduler.lease.ttl.seconds"
+SCHEDULER_LEASE_SLOTS = "ballista.scheduler.lease.slots"
+SCHEDULER_LEASE_BAND_SIZE = "ballista.scheduler.lease.band.size"
 PLANNER_ADAPTIVE_ENABLED = "ballista.planner.adaptive.enabled"
 AQE_TARGET_PARTITION_BYTES = "ballista.planner.adaptive.coalesce.target.bytes"
 AQE_MIN_PARTITION_BYTES = "ballista.planner.adaptive.coalesce.min.bytes"
@@ -278,6 +284,11 @@ _ENTRIES: list[ConfigEntry] = [
     ConfigEntry(BROADCAST_SEMI_KEYS_THRESHOLD, "Max build-side rows to collect a filterless semi/anti join's membership keys instead of co-partitioning (the build ships join keys only, so the collect threshold relaxes past the row-broadcast one).", int, 8_000_000, _nonneg),
     ConfigEntry(MAX_PARTITIONS_PER_TASK, "Group up to N partitions into one task (partition slices).", int, 1, _pos),
     ConfigEntry(JOB_RESUBMIT_INTERVAL_MS, "Periodically re-offer jobs holding runnable-but-unscheduled tasks (0 = off; offers otherwise fire on task/executor events only).", int, 0, _nonneg),
+    ConfigEntry(SCHEDULER_SHARDS, "Scheduler event-loop shards: jobs partition by crc32(job_id) mod N, each shard running its own event loop and admission-lag EWMA.", int, 1, _pos),
+    ConfigEntry(SCHEDULER_LEASE_ENABLED, "Direct-dispatch leases: mint revocable executor capacity slices so prepared-statement clients can skip the scheduler on the hot path.", bool, False),
+    ConfigEntry(SCHEDULER_LEASE_TTL_S, "Direct-dispatch lease lifetime; expired tokens are rejected at the executor and swept by the scheduler.", float, 30.0, _pos),
+    ConfigEntry(SCHEDULER_LEASE_SLOTS, "Executor task slots reserved per direct-dispatch lease (taken out of the shared slot ledger).", int, 2, _pos),
+    ConfigEntry(SCHEDULER_LEASE_BAND_SIZE, "Task ids reserved per lease; direct-dispatch ids live in a private band above all scheduler-assigned ids.", int, 10_000, _pos),
     ConfigEntry(PLANNER_ADAPTIVE_ENABLED, "Adaptive query execution: replan remaining stages with runtime stats.", bool, True),
     ConfigEntry(AQE_TARGET_PARTITION_BYTES, "AQE coalescing: target bytes per post-shuffle partition.", int, 64 * 1024 * 1024, _pos),
     ConfigEntry(AQE_MIN_PARTITION_BYTES, "AQE coalescing: never coalesce below this size.", int, 1024 * 1024, _pos),

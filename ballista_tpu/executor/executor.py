@@ -118,6 +118,10 @@ class Executor:
         # session-shared pools (runtime_cache.rs:59): set by the executor
         # process once the executor-wide capacity is known
         self.session_pools = None  # SessionPoolRegistry | None
+        # direct-dispatch lease enforcement: the scheduler pushes grants/
+        # revocations here; admit() gates every scheduler-less task
+        from ballista_tpu.serving.lease import LeaseTable
+        self.lease_table = LeaseTable()
         self._warned_tpu_downgrade = False
         # process-isolated tasks currently inflight (spill budget is split
         # across them; see process_worker.run_task_in_subprocess)

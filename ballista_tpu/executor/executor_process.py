@@ -296,6 +296,9 @@ class ExecutorProcess:
             ("queued_tasks", float(self.service._queue.qsize())),
             # serving tier: fast-lane dispatches seen by this executor
             ("fast_lane_tasks", float(self.executor.fast_lane_tasks)),
+            # direct dispatch: granted leases + scheduler-less tasks run
+            ("active_leases", float(self.executor.lease_table.active_count())),
+            ("direct_dispatch_tasks", float(self.executor.lease_table.tasks_total)),
             # shuffle-integrity counters (reader-side verification outcomes)
             ("checksum_failures", float(integrity["checksum_failures"])),
             ("corruption_retries", float(integrity["corruption_retries"])),

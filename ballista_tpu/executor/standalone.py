@@ -48,11 +48,22 @@ class InProcessTaskLauncher(TaskLauncher):
             for task_id, stage_id in items:
                 ex.cancel_task(job_id, stage_id, task_id)
 
+    def grant_lease(self, executor_id: str, lease, server: SchedulerServer) -> None:
+        ex = self.executors.get(executor_id)
+        if ex is not None:
+            ex.lease_table.grant(lease)
+
+    def revoke_lease(self, executor_id: str, lease_id: str, server: SchedulerServer) -> None:
+        ex = self.executors.get(executor_id)
+        if ex is not None:
+            ex.lease_table.revoke(lease_id)
+
 
 class StandaloneCluster:
     def __init__(self, num_executors: int = 1, vcores: int = 4,
                  work_dir: str | None = None, config: BallistaConfig | None = None,
-                 with_flight: bool = True, engine_factory=None):
+                 with_flight: bool = True, engine_factory=None,
+                 shards: int | None = None, job_state=None):
         self.work_dir = work_dir or tempfile.mkdtemp(prefix="ballista-tpu-")
         self.flight_server = None
         flight_port = 0
@@ -73,8 +84,17 @@ class StandaloneCluster:
 
                 ex.isolation = str(config.get(EXECUTOR_TASK_ISOLATION))
             self.executors[meta.id] = ex
+            if self.flight_server is not None:
+                # direct-dispatch target: lease grants + scheduler-less
+                # task execution arrive as Flight actions
+                self.flight_server.attach_executor(ex)
         self.launcher = InProcessTaskLauncher(self.executors)
-        self.scheduler = SchedulerServer(self.launcher)
+        if shards is None and config is not None:
+            from ballista_tpu.config import SCHEDULER_SHARDS
+
+            shards = int(config.get(SCHEDULER_SHARDS))
+        self.scheduler = SchedulerServer(self.launcher, job_state=job_state,
+                                         shards=shards or 1)
         self.scheduler.start()
         for ex in self.executors.values():
             self.scheduler.register_executor(ex.metadata)
@@ -84,3 +104,93 @@ class StandaloneCluster:
         self.launcher.pool.shutdown(wait=False)
         if self.flight_server is not None:
             self.flight_server.shutdown()
+
+
+class MultiSchedulerCluster:
+    """N real SchedulerServer instances over ONE shared executor fleet and
+    ONE shared FileJobState directory — the in-process shape of a
+    multi-scheduler deployment behind the Flight/gRPC proxy. Clients may
+    submit to any live instance (`pick()` round-robins); a killed
+    instance's jobs sit in the shared store until a live peer's orphan
+    sweep (`resubmit_stuck_jobs` → `recover_jobs(only_active=True)`)
+    adopts their stale ownership lease and resumes from the last
+    checkpointed stage."""
+
+    def __init__(self, num_schedulers: int = 2, num_executors: int = 2,
+                 vcores: int = 4, work_dir: str | None = None,
+                 config: BallistaConfig | None = None,
+                 lease_s: float = 2.0, shards: int = 1,
+                 sweep_interval_s: float = 0.5):
+        import os
+
+        from ballista_tpu.scheduler.state.job_state import FileJobState
+
+        self.work_dir = work_dir or tempfile.mkdtemp(prefix="ballista-tpu-")
+        self.state_dir = os.path.join(self.work_dir, "job-state")
+        self.executors: dict[str, Executor] = {}
+        for _ in range(num_executors):
+            meta = ExecutorMetadata(id=str(new_executor_id()), vcores=vcores,
+                                    host="localhost")
+            self.executors[meta.id] = Executor(self.work_dir, meta, config=config)
+        self.launcher = InProcessTaskLauncher(self.executors)
+        self.schedulers: list[SchedulerServer] = []
+        for i in range(num_schedulers):
+            # each instance gets its OWN FileJobState handle on the SHARED
+            # dir: ownership arbitration runs through the on-disk markers,
+            # exactly like separate scheduler processes
+            s = SchedulerServer(
+                self.launcher, scheduler_id=f"scheduler-{i}",
+                job_state=FileJobState(self.state_dir, lease_s=lease_s),
+                shards=shards)
+            s.start()
+            for ex in self.executors.values():
+                s.register_executor(ex.metadata)
+            self.schedulers.append(s)
+        self._rr = 0
+        self._killed: set[int] = set()
+        self._sweeping = True
+        self._sweeper = threading.Thread(
+            target=self._sweep_loop, args=(sweep_interval_s,), daemon=True,
+            name="multi-scheduler-sweep")
+        self._sweeper.start()
+
+    def _sweep_loop(self, interval_s: float) -> None:
+        # stands in for SchedulerProcess._expiry_loop: live instances
+        # periodically revive stuck jobs and adopt orphans
+        import time as _time
+
+        while self._sweeping:
+            _time.sleep(interval_s)
+            for i, s in enumerate(self.schedulers):
+                if i in self._killed:
+                    continue
+                try:
+                    s.resubmit_stuck_jobs()
+                except Exception:  # noqa: BLE001 — sweep must survive a flaky store
+                    pass
+
+    def alive(self) -> list[SchedulerServer]:
+        return [s for i, s in enumerate(self.schedulers) if i not in self._killed]
+
+    def pick(self) -> SchedulerServer:
+        live = self.alive()
+        self._rr += 1
+        return live[self._rr % len(live)]
+
+    def kill(self, i: int) -> None:
+        """Chaos-kill instance i: its loops stop AND it loses the shared
+        store (a dead process can't write late checkpoints over its
+        successor's progress)."""
+        from ballista_tpu.scheduler.state.job_state import InMemoryJobState
+
+        self._killed.add(i)
+        s = self.schedulers[i]
+        s.stop()
+        s.job_state = InMemoryJobState()
+
+    def shutdown(self) -> None:
+        self._sweeping = False
+        for i in range(len(self.schedulers)):
+            if i not in self._killed:
+                self.schedulers[i].stop()
+        self.launcher.pool.shutdown(wait=False)

@@ -156,7 +156,11 @@ class BallistaFlightServer(flight.FlightServerBase):
                       "locations_served": 0, "bytes_served": 0,
                       "streams_rejected": 0, "streams_stalled": 0,
                       "checksum_failures": 0, "short_reads": 0,
-                      "chaos_corruptions": 0}
+                      "chaos_corruptions": 0,
+                      "lease_dispatch": 0, "lease_rejections": 0}
+        # executors attached for direct dispatch: lease grants/revocations
+        # and scheduler-less task execution arrive as Flight actions
+        self._executors: dict[str, object] = {}
         self._stats_lock = threading.Lock()
         # overload knobs are environmental: the data plane has no session
         # config (same precedent as BALLISTA_SHUFFLE_MMAP)
@@ -361,12 +365,72 @@ class BallistaFlightServer(flight.FlightServerBase):
                 shutil.rmtree(d, ignore_errors=True)
             yield flight.Result(pa.py_buffer(b"ok"))
             return
+        if action.type == "lease_grant":
+            t = json.loads(action.body.to_pybytes().decode())
+            from ballista_tpu.serving.lease import ExecutorLease
+
+            ex = self._executors.get(t.get("executor_id", ""))
+            if ex is None:
+                raise flight.FlightServerError(
+                    f"no executor {t.get('executor_id')!r} attached")
+            ex.lease_table.grant(ExecutorLease.from_wire(t))
+            yield flight.Result(pa.py_buffer(b"ok"))
+            return
+        if action.type == "lease_revoke":
+            t = json.loads(action.body.to_pybytes().decode())
+            ex = self._executors.get(t.get("executor_id", ""))
+            if ex is not None:
+                ex.lease_table.revoke(str(t.get("lease_id", "")))
+            yield flight.Result(pa.py_buffer(b"ok"))
+            return
+        if action.type == "lease_dispatch":
+            # frame: one JSON header line, then a TaskDefinitionProto. The
+            # response is a JSON header (admitted or rejection reason)
+            # followed, when admitted, by the TaskStatusProto.
+            yield from self._lease_dispatch(action.body.to_pybytes())
+            return
         raise flight.FlightServerError(f"unknown action {action.type}")
+
+    def attach_executor(self, executor) -> None:
+        """Register an in-process Executor as a direct-dispatch target of
+        this data-plane endpoint (daemon/standalone wiring)."""
+        self._executors[executor.metadata.id] = executor
+
+    def _lease_dispatch(self, body: bytes):
+        from ballista_tpu.proto import pb
+        from ballista_tpu.serde_control import decode_task_definition, encode_task_status
+
+        head, _, payload = body.partition(b"\n")
+        t = json.loads(head.decode())
+        lease_id = str(t.get("lease_id", ""))
+        ex = self._executors.get(t.get("executor_id", ""))
+        if ex is None:
+            self._bump("lease_rejections")
+            yield flight.Result(pa.py_buffer(json.dumps(
+                {"rejected": "no-executor-attached"}).encode()))
+            return
+        task = decode_task_definition(pb.TaskDefinitionProto.FromString(payload))
+        reason = ex.lease_table.admit(lease_id, task.task_id)
+        if reason is not None:
+            self._bump("lease_rejections")
+            yield flight.Result(pa.py_buffer(json.dumps({"rejected": reason}).encode()))
+            return
+        try:
+            result = ex.run_task(task)
+        finally:
+            ex.lease_table.release(lease_id)
+        self._bump("lease_dispatch")
+        status = encode_task_status(result, ex.metadata.id).SerializeToString()
+        yield flight.Result(pa.py_buffer(json.dumps({"ok": True}).encode()))
+        yield flight.Result(pa.py_buffer(status))
 
     def list_actions(self, context):
         return [("io_block_transport", "raw IPC block stream"),
                 (COALESCED_ACTION, "framed multi-location raw IPC block stream"),
-                ("remove_job_data", "GC a job's shuffle files")]
+                ("remove_job_data", "GC a job's shuffle files"),
+                ("lease_grant", "install a direct-dispatch lease on an attached executor"),
+                ("lease_revoke", "revoke a direct-dispatch lease"),
+                ("lease_dispatch", "run one leased single-stage task scheduler-less")]
 
 
 def start_flight_server(work_dir: str, host: str = "0.0.0.0", port: int = 0,

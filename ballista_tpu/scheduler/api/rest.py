@@ -97,6 +97,11 @@ def start_rest_api(scheduler: SchedulerServer, metrics: InMemoryMetricsCollector
                         scheduler.executors.aggregate_pressure(), 4),
                     # serving tier: plan/result cache hit rates + fast lane
                     "serving": scheduler.serving.snapshot(),
+                    # scheduler scale-out: per-shard queue depth/lag/job
+                    # counts, direct-dispatch lease ledger, heartbeat fan-in
+                    "shards": scheduler.shards_snapshot(),
+                    "leases": scheduler.leases.snapshot(),
+                    "fanin": dict(scheduler._fanin),
                 })
             if p == "/api/executors":
                 out = []

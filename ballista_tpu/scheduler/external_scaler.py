@@ -7,6 +7,12 @@ served on the scheduler's own gRPC port so a k8s ScaledObject pointing at
 counts. Same contract: IsActive always true (the scheduler itself stays
 up), GetMetricSpec advertises `pending_jobs` with target 0, GetMetrics
 reports pending_jobs and running_jobs.
+
+Scheduler scale-out extends the signal set with the REAL load-shedding
+inputs: per-lane admission counters (interactive/batch inflight, lifetime
+sheds), the deepest shard event queue, and the count of outstanding
+direct-dispatch leases — so a ScaledObject can scale on control-plane
+saturation, not just job counts.
 """
 
 from __future__ import annotations
@@ -18,6 +24,11 @@ from ballista_tpu.scheduler.server import JobState, SchedulerServer
 
 PENDING_JOBS = "pending_jobs"
 RUNNING_JOBS = "running_jobs"
+INTERACTIVE_INFLIGHT = "interactive_inflight"
+BATCH_INFLIGHT = "batch_inflight"
+LANE_SHED_TOTAL = "lane_shed_total"
+SHARD_QUEUE_DEPTH = "shard_queue_depth"
+ACTIVE_LEASES = "active_leases"
 SERVICE_NAME = "externalscaler.ExternalScaler"
 
 
@@ -52,6 +63,9 @@ class ExternalScalerService:
                 pass
         out = kpb.GetMetricSpecResponse()
         out.metricSpecs.append(kpb.MetricSpec(metricName=PENDING_JOBS, targetSize=target))
+        # shard queue depth scales SCHEDULER replicas, not executors: a
+        # ScaledObject selecting it targets the scheduler deployment
+        out.metricSpecs.append(kpb.MetricSpec(metricName=SHARD_QUEUE_DEPTH, targetSize=target))
         return out
 
     def GetMetrics(self, request: kpb.GetMetricsRequest, context) -> kpb.GetMetricsResponse:
@@ -59,6 +73,25 @@ class ExternalScalerService:
         out = kpb.GetMetricsResponse()
         out.metricValues.append(kpb.MetricValue(metricName=PENDING_JOBS, metricValue=pending))
         out.metricValues.append(kpb.MetricValue(metricName=RUNNING_JOBS, metricValue=running))
+        # per-lane admission pressure straight off the controller snapshot
+        lanes = self.scheduler.admission.snapshot().get("lanes", {})
+        out.metricValues.append(kpb.MetricValue(
+            metricName=INTERACTIVE_INFLIGHT,
+            metricValue=int(lanes.get("interactive", {}).get("inflight", 0))))
+        out.metricValues.append(kpb.MetricValue(
+            metricName=BATCH_INFLIGHT,
+            metricValue=int(lanes.get("batch", {}).get("inflight", 0))))
+        out.metricValues.append(kpb.MetricValue(
+            metricName=LANE_SHED_TOTAL,
+            metricValue=sum(int(l.get("shed_total", 0)) for l in lanes.values())))
+        # deepest shard event queue: the control-plane saturation signal
+        shards = self.scheduler.shards_snapshot()
+        out.metricValues.append(kpb.MetricValue(
+            metricName=SHARD_QUEUE_DEPTH,
+            metricValue=max((s["queue_depth"] for s in shards), default=0)))
+        out.metricValues.append(kpb.MetricValue(
+            metricName=ACTIVE_LEASES,
+            metricValue=self.scheduler.leases.active_count()))
         return out
 
 

@@ -9,6 +9,7 @@ Includes the wire-protocol version gate on registration/poll
 from __future__ import annotations
 
 import logging
+import threading
 
 import grpc
 
@@ -27,9 +28,56 @@ log = logging.getLogger(__name__)
 SERVICE_NAME = "ballista_tpu.SchedulerGrpc"
 
 
+class _PollCoalescer:
+    """Single-flight for identical in-flight job-status polls: when a herd
+    of clients waits on one job, the FIRST poll in computes the status and
+    every poll that arrives while it is in flight piggybacks on that
+    result instead of taking the jobs lock again. Correctness is safe
+    because a follower's answer is at most one leader-computation stale —
+    strictly fresher than the poll interval that triggered it."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._inflight: dict[str, tuple[threading.Event, list]] = {}
+        self.computed = 0
+        self.coalesced = 0
+
+    def get(self, key: str, compute):
+        with self._lock:
+            entry = self._inflight.get(key)
+            if entry is None:
+                entry = self._inflight[key] = (threading.Event(), [])
+                leader = True
+                self.computed += 1
+            else:
+                leader = False
+                self.coalesced += 1
+        ev, slot = entry
+        if leader:
+            try:
+                slot.append(compute())
+            except BaseException as e:  # noqa: BLE001 — followers re-raise it
+                slot.append(e)
+                raise
+            finally:
+                with self._lock:
+                    self._inflight.pop(key, None)
+                ev.set()
+            return slot[0]
+        # follower: a missing/late leader result degrades to computing our
+        # own answer — coalescing is an optimization, never a correctness gate
+        if not ev.wait(timeout=5.0) or not slot:
+            return compute()
+        result = slot[0]
+        if isinstance(result, BaseException):
+            raise result
+        return result
+
+
 class SchedulerGrpcService:
     def __init__(self, scheduler: SchedulerServer):
         self.scheduler = scheduler
+        self._poll_coalescer = _PollCoalescer()
 
     # -- client-facing -------------------------------------------------------
 
@@ -107,7 +155,8 @@ class SchedulerGrpcService:
         return pb.ExecuteQueryResult(job_id=job_id, session_id=request.session_id)
 
     def GetJobStatus(self, request: pb.GetJobStatusParams, context) -> pb.GetJobStatusResult:
-        status = self.scheduler.job_status(request.job_id)
+        status = self._poll_coalescer.get(
+            request.job_id, lambda: self.scheduler.job_status(request.job_id))
         out = pb.GetJobStatusResult()
         if status is not None:
             out.status.CopyFrom(encode_job_status(status))

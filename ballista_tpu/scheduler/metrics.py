@@ -50,6 +50,12 @@ class SchedulerMetricsCollector:
 
     def record_lane_admitted(self, lane: str) -> None: ...
 
+    # -- direct-dispatch leases (scheduler scale-out) ----------------------
+
+    def record_lease(self, event: str) -> None: ...
+
+    def record_direct_dispatch(self, outcome: str) -> None: ...
+
 
 class NoopMetricsCollector(SchedulerMetricsCollector):
     pass
@@ -111,6 +117,9 @@ class InMemoryMetricsCollector(SchedulerMetricsCollector):
         self.result_cache_misses = 0
         self.fast_lane: dict[str, int] = {}  # executed | fallback
         self.lane_admitted: dict[str, int] = {}
+        # direct dispatch: lease lifecycle + dispatch outcomes
+        self.lease_events: dict[str, int] = {}  # minted | revoked | expired
+        self.direct_dispatch: dict[str, int] = {}  # dispatched | reconciled | demoted
         self.exec_hist = _Histogram(_LATENCY_BUCKETS)
         self.plan_hist = _Histogram(_PLANNING_BUCKETS)
 
@@ -182,6 +191,14 @@ class InMemoryMetricsCollector(SchedulerMetricsCollector):
         with self._lock:
             self.lane_admitted[lane] = self.lane_admitted.get(lane, 0) + 1
 
+    def record_lease(self, event: str) -> None:
+        with self._lock:
+            self.lease_events[event] = self.lease_events.get(event, 0) + 1
+
+    def record_direct_dispatch(self, outcome: str) -> None:
+        with self._lock:
+            self.direct_dispatch[outcome] = self.direct_dispatch.get(outcome, 0) + 1
+
     def set_overload_state(self, state: str) -> None:
         with self._lock:
             self.overload_state = state
@@ -237,6 +254,14 @@ class InMemoryMetricsCollector(SchedulerMetricsCollector):
             lines.append("# TYPE ballista_scheduler_fast_lane_total counter")
             for outcome in sorted(self.fast_lane):
                 lines.append(f'ballista_scheduler_fast_lane_total{{outcome="{outcome}"}} {self.fast_lane[outcome]}')
+            lines.append("# HELP ballista_scheduler_lease_events_total Direct-dispatch lease lifecycle events, by kind")
+            lines.append("# TYPE ballista_scheduler_lease_events_total counter")
+            for event in sorted(self.lease_events):
+                lines.append(f'ballista_scheduler_lease_events_total{{event="{event}"}} {self.lease_events[event]}')
+            lines.append("# HELP ballista_scheduler_direct_dispatch_total Direct-dispatch jobs, by outcome")
+            lines.append("# TYPE ballista_scheduler_direct_dispatch_total counter")
+            for outcome in sorted(self.direct_dispatch):
+                lines.append(f'ballista_scheduler_direct_dispatch_total{{outcome="{outcome}"}} {self.direct_dispatch[outcome]}')
             lines.append("# HELP ballista_scheduler_overload_state Overload posture (0=normal 1=shedding 2=draining)")
             lines.append("# TYPE ballista_scheduler_overload_state gauge")
             state_code = {"normal": 0, "shedding": 1, "draining": 2}.get(self.overload_state, 0)

@@ -96,7 +96,8 @@ class SchedulerProcess:
                  quarantine_threshold: float = 0.5,
                  quarantine_min_events: float = 4.0,
                  health_half_life_s: float = 60.0,
-                 probe_backoff_s: float = 10.0):
+                 probe_backoff_s: float = 10.0,
+                 shards: int = 1):
         self.metrics = InMemoryMetricsCollector()
         job_state = None
         if job_state_dir:
@@ -124,6 +125,7 @@ class SchedulerProcess:
             quarantine_min_events=quarantine_min_events,
             health_half_life_s=health_half_life_s,
             probe_backoff_s=probe_backoff_s,
+            shards=shards,
         )
         from ballista_tpu.utils.grpc_util import server_options
 
@@ -214,6 +216,8 @@ def main(argv=None) -> None:
     ap.add_argument("--job-state-dir", default=None,
                     help="persist job graphs here for fail-over recovery")
     ap.add_argument("--scheduler-id", default="scheduler-0")
+    ap.add_argument("--shards", type=int, default=1,
+                    help="event-loop shard count: jobs partition by crc32(job_id) mod N")
     ap.add_argument("--tls-cert", default=None, help="server certificate chain (PEM) — enables TLS")
     ap.add_argument("--tls-key", default=None, help="server private key (PEM)")
     ap.add_argument("--tls-client-ca", default=None,
@@ -253,6 +257,7 @@ def main(argv=None) -> None:
         quarantine_min_events=args.quarantine_min_events,
         health_half_life_s=args.health_half_life_seconds,
         probe_backoff_s=args.probe_backoff_seconds,
+        shards=args.shards,
     )
     signal.signal(signal.SIGTERM, lambda *_: proc.shutdown())
     proc.start()

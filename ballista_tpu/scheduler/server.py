@@ -18,7 +18,6 @@ state/mod.rs:98):
 from __future__ import annotations
 
 import logging
-import queue
 import threading
 import time
 from dataclasses import dataclass, field
@@ -37,6 +36,7 @@ from ballista_tpu.ids import JobId, new_job_id
 from ballista_tpu.scheduler.admission import LANE_BATCH, LANE_INTERACTIVE, AdmissionController
 from ballista_tpu.scheduler.metrics import NoopMetricsCollector, SchedulerMetricsCollector
 from ballista_tpu.scheduler.planner import DistributedPlanner
+from ballista_tpu.scheduler.shard import SchedulerShard, shard_of
 from ballista_tpu.scheduler.state.execution_graph import (
     ExecutionGraph,
     JobState,
@@ -45,6 +45,8 @@ from ballista_tpu.scheduler.state.execution_graph import (
 from ballista_tpu.scheduler.state.executor_manager import ExecutorManager
 from ballista_tpu.scheduler.state.session_manager import SessionManager
 from ballista_tpu.serving.fast_lane import FAST_TASK_ID_BASE, FastJob
+from ballista_tpu.serving.lease import (
+    DEFAULT_LEASE_SLOTS, DEFAULT_LEASE_TTL_S, ExecutorLease, LeaseRegistry)
 from ballista_tpu.serving.normalize import (
     bind_logical,
     bind_physical,
@@ -75,6 +77,18 @@ class TaskLauncher:
         """Best-effort shuffle-GC push for a finished/cleaned job."""
         return
 
+    def grant_lease(self, executor_id: str, lease, server: "SchedulerServer") -> None:
+        """Push a freshly minted direct-dispatch lease to the executor's
+        lease table (in-process launchers set it directly; gRPC/Flight
+        launchers ship the wire form)."""
+        return
+
+    def revoke_lease(self, executor_id: str, lease_id: str,
+                     server: "SchedulerServer") -> None:
+        """Best-effort revocation push; the executor-side expiry check is
+        the backstop when this never arrives."""
+        return
+
 
 @dataclass
 class Event:
@@ -97,7 +111,8 @@ class SchedulerServer:
                  health_half_life_s: float = 60.0,
                  probe_backoff_s: float = 10.0,
                  sweep_interval_s: float = 0.5,
-                 admission: AdmissionController | None = None):
+                 admission: AdmissionController | None = None,
+                 shards: int = 1):
         from ballista_tpu.scheduler.state.job_state import InMemoryJobState
 
         self.scheduler_id = scheduler_id
@@ -115,12 +130,19 @@ class SchedulerServer:
         self.launcher = launcher
         self.metrics = metrics or NoopMetricsCollector()
         self.admission = admission or AdmissionController()
-        self._events: "queue.Queue[Event]" = queue.Queue(maxsize=10_000)
-        self._loop_lag_s = 0.0  # EWMA of post→dequeue delay
+        # sharded event loops: job ownership partitions by
+        # shard_of(job_id) % num_shards; each shard has its own bounded
+        # queue and lag EWMA (fleet lag = max over shards)
+        self.num_shards = max(1, int(shards))
+        self._shards = [SchedulerShard(self, i) for i in range(self.num_shards)]
+        # heartbeat fan-in accounting: executor signals arrive ONCE and
+        # fleet-scoped events multicast to the shards owning work
+        self._fanin = {"heartbeats": 0, "events_multicast": 0}
+        # direct-dispatch lease ledger (capacity slices on warm executors)
+        self.leases = LeaseRegistry()
         self._jobs_lock = threading.RLock()
         self._job_rr = 0  # round-robin offer fairness across jobs
         self._running = False
-        self._loop_thread: threading.Thread | None = None
         self._watchers: dict[str, list[threading.Event]] = {}
         # serving tier: plan/result caches + fast-lane jobs executing
         # outside the execution-graph machinery (keyed by job_id)
@@ -135,8 +157,8 @@ class SchedulerServer:
 
     def start(self) -> None:
         self._running = True
-        self._loop_thread = threading.Thread(target=self._event_loop, daemon=True, name="scheduler-events")
-        self._loop_thread.start()
+        for sh in self._shards:
+            sh.start()
         if self.sweep_interval_s > 0:
             threading.Thread(target=self._sweep_timer, daemon=True, name="straggler-sweep").start()
 
@@ -152,47 +174,95 @@ class SchedulerServer:
 
     def stop(self) -> None:
         self._running = False
-        self._events.put(Event("shutdown"))
-        if self._loop_thread is not None:
-            self._loop_thread.join(timeout=5)
+        for sh in self._shards:
+            sh.post(Event("shutdown"))
+        for sh in self._shards:
+            sh.join(timeout=5)
+
+    @property
+    def _loop_lag_s(self) -> float:
+        """Fleet admission-lag signal: the WORST shard's EWMA (one wedged
+        shard must still trip the overload state machine)."""
+        return max(sh.loop_lag_s for sh in self._shards)
+
+    def _shard_for(self, job_id: str) -> SchedulerShard:
+        return self._shards[shard_of(job_id, self.num_shards)]
 
     def post(self, ev: Event) -> None:
-        self._events.put(ev)
+        """Route an event to its owning shard. Job-scoped events go to
+        hash(job_id) % N; fleet-scoped events (revive / sweep /
+        executor_lost / shutdown) fan in once here and multicast."""
+        if self.num_shards == 1:
+            self._shards[0].post(ev)
+            return
+        if ev.kind == "job_queued":
+            self._shard_for(ev.payload[0]).post(ev)
+        elif ev.kind == "cancel":
+            self._shard_for(ev.payload).post(ev)
+        elif ev.kind == "revive" and ev.payload is not None:
+            # job-scoped revive (a specific job became runnable): only its
+            # owning shard can offer it; multicasting would make every
+            # dispatch cost N offer scans
+            self._shard_for(ev.payload).post(ev)
+        elif ev.kind == "task_update":
+            executor_id, results = ev.payload
+            by_shard: dict[int, list] = {}
+            for r in results:
+                by_shard.setdefault(shard_of(r.job_id, self.num_shards), []).append(r)
+            for idx, rs in by_shard.items():
+                self._shards[idx].post(
+                    Event("task_update", (executor_id, rs), posted_at=ev.posted_at))
+        else:
+            self._fanin["events_multicast"] += 1
+            for sh in self._shards:
+                sh.post(Event(ev.kind, ev.payload, posted_at=ev.posted_at))
 
-    def _event_loop(self) -> None:
-        while self._running:
-            try:
-                ev = self._events.get(timeout=0.2)
-            except queue.Empty:
-                # an idle loop has zero lag by definition; decay toward it
-                self._loop_lag_s *= 0.5
-                continue
-            lag = max(0.0, time.monotonic() - ev.posted_at)
-            self._loop_lag_s = 0.8 * self._loop_lag_s + 0.2 * lag
-            try:
-                self._handle(ev)
-            except Exception:  # noqa: BLE001
-                log.exception("event loop error on %s", ev.kind)
-
-    def _handle(self, ev: Event) -> None:
+    def _handle(self, ev: Event, shard: SchedulerShard | None = None) -> None:
+        """Per-event dispatch, scoped to `shard`'s slice of the jobs dict
+        (None = unsharded view, e.g. direct calls from tests)."""
         if ev.kind == "shutdown":
             return
         if ev.kind == "job_queued":
             # planning off the event loop (query_stage_scheduler.rs:372)
             threading.Thread(target=self._plan_job, args=(ev.payload,), daemon=True).start()
         elif ev.kind == "revive":
-            self._offer_reservation()
+            self._offer_reservation(shard)
         elif ev.kind == "task_update":
             executor_id, results = ev.payload
             self._apply_task_updates(executor_id, results)
-            self._offer_reservation()
+            self._offer_reservation(shard)
+            # the completions above freed slots OTHER shards' starved jobs
+            # may be waiting on, and those shards see no event for it.
+            # Nudge idle peers ONLY while slots stay free after our own
+            # offer: under saturation the gate stays shut, so the nudge
+            # never turns one completion into N offer scans
+            if (shard is not None and self.num_shards > 1
+                    and self.executors.free_slot_count() > 0):
+                for sh in self._shards:
+                    if sh.shard_id != shard.shard_id and sh.queue_depth() == 0:
+                        sh.post(Event("revive"))
         elif ev.kind == "executor_lost":
-            self._on_executor_lost(ev.payload)
-            self._offer_reservation()
+            self._on_executor_lost(ev.payload, shard)
+            self._offer_reservation(shard)
         elif ev.kind == "cancel":
             self._cancel_job(ev.payload)
         elif ev.kind == "sweep":
-            self._sweep_stragglers()
+            self._sweep_stragglers(shard)
+
+    def shards_snapshot(self) -> list[dict]:
+        """Per-shard queue depth / lag / owned-job counts (REST + KEDA)."""
+        counts: dict[int, int] = {}
+        with self._jobs_lock:
+            for job_id in self.jobs:
+                idx = shard_of(job_id, self.num_shards)
+                counts[idx] = counts.get(idx, 0) + 1
+        return [{
+            "shard": sh.shard_id,
+            "queue_depth": sh.queue_depth(),
+            "loop_lag_s": round(sh.loop_lag_s, 4),
+            "handled": sh.handled,
+            "jobs": counts.get(sh.shard_id, 0),
+        } for sh in self._shards]
 
     # -- job submission --------------------------------------------------------
 
@@ -348,7 +418,7 @@ class SchedulerServer:
                 self._rc_pending[job_id] = rkey
         if self.job_state.acquire(job_id, self.scheduler_id):
             self.job_state.save_graph(graph)
-        self.post(Event("revive"))
+        self.post(Event("revive", job_id))
         return job_id
 
     @staticmethod
@@ -551,7 +621,7 @@ class SchedulerServer:
                 # never clobber a peer's checkpoint on an id collision
                 log.warning("job %s is owned by another scheduler; not persisting", job_id)
             self.metrics.record_planning_ms(job_id, (time.time() - t0) * 1000)
-            self.post(Event("revive"))
+            self.post(Event("revive", job_id))
         except BaseException as e:  # noqa: BLE001
             log.warning("planning failed for %s: %s", job_id, e, exc_info=True)
             with self._jobs_lock:
@@ -565,27 +635,32 @@ class SchedulerServer:
 
     # -- scheduling (push mode) -------------------------------------------------
 
-    def _running_jobs_rotated(self) -> list:
+    def _running_jobs_rotated(self, shard: SchedulerShard | None = None) -> list:
         """Round-robin fairness across jobs: each offer starts at a rotating
         position, so a long job can no longer starve later submissions
-        (the reference round-robins offers across jobs)."""
+        (the reference round-robins offers across jobs). With a shard scope,
+        only that shard's slice is enumerated — the offer scan is O(jobs/N)
+        per event instead of O(jobs)."""
         with self._jobs_lock:
             running = [g for g in self.jobs.values() if g.status is JobState.RUNNING]
+            if shard is not None and self.num_shards > 1:
+                running = [g for g in running if shard.owns(g.job_id)]
             if len(running) > 1:
                 off = self._job_rr % len(running)
                 self._job_rr += 1
                 running = running[off:] + running[:off]
         return running
 
-    def _offer_reservation(self) -> None:
+    def _offer_reservation(self, shard: SchedulerShard | None = None) -> None:
         """Bind runnable tasks to free executor slots and launch them
         (state/mod.rs:181-221: offer → bind → launch → unbind leftovers).
         Launches leave the event loop immediately: one slow executor's gRPC
         round trip must never stall scheduling for the rest of the cluster
-        (the reference spawns launch_tasks)."""
+        (the reference spawns launch_tasks). The slot ledger is shared, so
+        concurrent shard offers stay safe."""
         if self.launcher is None:
             return
-        running = self._running_jobs_rotated()
+        running = self._running_jobs_rotated(shard)
         demand = sum(g.available_task_count() for g in running)
         if demand == 0:
             return
@@ -747,7 +822,7 @@ class SchedulerServer:
         self.metrics.record_fast_lane("fallback")
         log.warning("fast lane fell back to full DAG for %s: %s",
                     job.job_id, reason.splitlines()[0][:200] if reason else "timeout")
-        self.post(Event("revive"))
+        self.post(Event("revive", job.job_id))
 
     def _maybe_cache_result(self, job: FastJob) -> None:
         """Fetch a finished fast job's partitions and fill its result-cache
@@ -861,16 +936,22 @@ class SchedulerServer:
 
     # -- straggler defense -------------------------------------------------------------
 
-    def _sweep_stragglers(self) -> None:
+    def _sweep_stragglers(self, shard: SchedulerShard | None = None) -> None:
         """Event-loop sweep: (1) expire tasks past deadline+grace (backstop
         for executors too wedged to self-report the timeout), (2) launch
         speculative duplicates of a nearly-done stage's slowest tasks on a
-        DIFFERENT executor, (3) re-offer when quarantine probes come due."""
+        DIFFERENT executor, (3) re-offer when quarantine probes come due.
+        Each shard sweeps only the jobs it owns; fleet-scoped work (lease
+        expiry, admission update) runs once, on shard 0."""
         now = time.time()
+        scoped = shard is not None and self.num_shards > 1
         with self._jobs_lock:
             fast = list(self._fast_jobs.values())
             running = [g for g in self.jobs.values()
                        if g.status is JobState.RUNNING and not isinstance(g, FastJob)]
+            if scoped:
+                fast = [j for j in fast if shard.owns(j.job_id)]
+                running = [g for g in running if shard.owns(g.job_id)]
         for job in fast:
             # backstop for fast jobs whose executor died or wedged: demote
             # to a full graph, which has retries and deadline machinery
@@ -891,7 +972,8 @@ class SchedulerServer:
                     self.metrics.record_failed(g.job_id)
                     self._notify(g.job_id)
                 else:
-                    self.post(Event("revive"))  # expired partitions re-pended
+                    # expired partitions re-pended on this specific graph
+                    self.post(Event("revive", g.job_id))
             if self.launcher is None:
                 continue  # speculation is push-only; pull executors can't be targeted
             for stage_id, task_id, victim in g.speculation_candidates(now):
@@ -906,8 +988,17 @@ class SchedulerServer:
                          task.task_id, g.job_id, stage_id, task_id, executor_id, victim)
                 self.metrics.record_speculative_launched(g.job_id, stage_id)
                 self._spawn_launch(executor_id, [task])
+        # cross-shard slot-release backstop: slots freed by another shard's
+        # completions (or by lease expiry) generate no event on this shard,
+        # so every sweep re-offers this shard's slice; zero demand exits in
+        # one pass over the scoped jobs
+        if scoped:
+            self._offer_reservation(shard)
+        if shard is not None and shard.shard_id != 0:
+            return  # fleet-scoped sweep work below runs once per round
         if self.executors.probes_due():
-            self._offer_reservation()
+            self._offer_reservation(shard)
+        self._sweep_leases(now)
         self.metrics.set_quarantined_executors(self.executors.quarantined_count())
         pressure = self.executors.aggregate_pressure()
         transition = self.admission.update(self._loop_lag_s, pressure)
@@ -930,7 +1021,11 @@ class SchedulerServer:
                            metrics: dict[str, float] | None = None) -> bool:
         """Heartbeat + overload-signal ingestion. `metrics` is the decoded
         HeartBeatParams.metrics map (memory_pressure et al.); the
-        pressure feeds the admission state machine on the next sweep."""
+        pressure feeds the admission state machine on the next sweep.
+        Fans in ONCE: shards never see heartbeats directly — executor
+        state lives in the shared ExecutorManager, and only the derived
+        executor_lost events multicast."""
+        self._fanin["heartbeats"] += 1
         if metrics and metrics.get("pressure_rejections"):
             # gauge, not delta: only count growth over the last report
             prev = self.executors.get(executor_id)
@@ -940,10 +1035,15 @@ class SchedulerServer:
                 self.metrics.record_pressure_rejection(executor_id)
         return self.executors.heartbeat(executor_id, metrics)
 
-    def _on_executor_lost(self, executor_id: str) -> None:
+    def _on_executor_lost(self, executor_id: str,
+                          shard: SchedulerShard | None = None) -> None:
+        # deregister is idempotent: the event multicasts, every shard rolls
+        # back only its own jobs' stages
         self.executors.deregister(executor_id)
         with self._jobs_lock:
             graphs = list(self.jobs.values())
+            if shard is not None and self.num_shards > 1:
+                graphs = [g for g in graphs if shard.owns(g.job_id)]
         for g in graphs:
             n = g.reset_stages_on_lost_executor(executor_id)
             if n:
@@ -958,9 +1058,19 @@ class SchedulerServer:
         """ballista.scheduler.job.resubmit.interval.ms: periodically re-offer
         jobs holding runnable-but-unscheduled tasks (missed offers, executors
         that freed slots without an event, scale-out while idle) — the
-        reference's job-resubmit behavior for jobs that couldn't schedule."""
+        reference's job-resubmit behavior for jobs that couldn't schedule.
+        In a multi-scheduler deployment this is also the orphan reviver:
+        jobs whose owner died mid-flight sit in the shared store with a
+        stale lease until a live peer's sweep adopts them here."""
         from ballista_tpu.config import JOB_RESUBMIT_INTERVAL_MS
 
+        try:
+            orphans = self.recover_jobs(only_active=True)
+        except Exception:  # noqa: BLE001 — a wedged store must not kill the sweep
+            log.exception("orphan recovery sweep failed")
+            orphans = []
+        for job_id in orphans:
+            log.warning("adopted orphaned job %s (owner lease expired)", job_id)
         with self._jobs_lock:
             running = [g for g in self.jobs.values() if g.status is JobState.RUNNING]
         stuck = []
@@ -986,6 +1096,89 @@ class SchedulerServer:
             log.info("resubmitting stuck job %s (%d runnable tasks, cause: %s)",
                      g.job_id, g.available_task_count(), reason)
         self.post(Event("revive"))
+
+    # -- direct-dispatch leases ----------------------------------------------------------
+
+    def mint_executor_lease(self, session_id: str, slots: int | None = None,
+                            ttl_s: float | None = None,
+                            band_size: int | None = None) -> "ExecutorLease | None":
+        """Mint a revocable direct-dispatch lease on ONE warm executor: a
+        capacity slice (slots), an expiry, and a reserved task-id band.
+        Slots come out of the shared ledger up front, so graph scheduling
+        and direct dispatch can never oversubscribe the same executor.
+        Returns None (and counts a denial) when no single executor has
+        the headroom — callers fall back to the scheduled path."""
+        want = DEFAULT_LEASE_SLOTS if slots is None else max(1, int(slots))
+        ttl = DEFAULT_LEASE_TTL_S if ttl_s is None else float(ttl_s)
+        candidates = [e for e in self.executors.alive_executors()
+                      if e.schedulable and e.free_slots >= want]
+        if not candidates:
+            self.leases.denied += 1
+            return None
+        best = max(candidates, key=lambda e: e.free_slots)
+        eid = best.metadata.id
+        if self.executors.take_slots(eid, want) < want:
+            self.leases.denied += 1
+            return None
+        lease = self.leases.mint(
+            executor_id=eid, host=best.metadata.host,
+            flight_port=best.metadata.flight_port, session_id=session_id,
+            slots=want, ttl_s=ttl, band_size=band_size)
+        self.metrics.record_lease("minted")
+        if self.launcher is not None:
+            try:
+                self.launcher.grant_lease(eid, lease, self)
+            except Exception as e:  # noqa: BLE001 — executor admits nothing it wasn't granted
+                log.warning("lease grant push to %s failed: %s", eid, e)
+                self.executors.free_slot(eid, want)
+                self.leases.revoke(lease.lease_id)
+                self.leases.denied += 1
+                return None
+        return lease
+
+    def revoke_executor_lease(self, lease_id: str) -> bool:
+        """Revoke a lease: return its slots to the ledger and push the
+        revocation to the executor off-thread (best effort — the
+        executor-side expiry check is the backstop)."""
+        lease = self.leases.revoke(lease_id)
+        if lease is None:
+            return False
+        self.executors.free_slot(lease.executor_id, lease.slots)
+        self.metrics.record_lease("revoked")
+        self._push_lease_revocations([lease])
+        return True
+
+    def _sweep_leases(self, now: float) -> None:
+        """Sweep-time backstop: expired leases return their slots and get a
+        best-effort revocation push (clients normally stop first — the
+        token itself rejects past expiry)."""
+        expired = self.leases.expire(now)
+        for lease in expired:
+            self.executors.free_slot(lease.executor_id, lease.slots)
+            self.metrics.record_lease("expired")
+        if expired:
+            self._push_lease_revocations(expired)
+
+    def _push_lease_revocations(self, leases: list) -> None:
+        if self.launcher is None:
+            return
+
+        def run():
+            for lease in leases:
+                try:
+                    self.launcher.revoke_lease(lease.executor_id, lease.lease_id, self)
+                except Exception as e:  # noqa: BLE001 — expiry at the executor is the backstop
+                    log.debug("lease revoke push to %s failed: %s", lease.executor_id, e)
+
+        threading.Thread(target=run, daemon=True, name="lease-revoke-push").start()
+
+    def reconcile_direct_dispatch(self, record: dict) -> None:
+        """Asynchronous reconciliation: the client already has its bytes;
+        the scheduler just folds the completed direct-dispatch work into
+        its ledgers (job accounting, KEDA counters) after the fact."""
+        tasks = int(record.get("tasks", 1))
+        self.leases.note_reconciled(record.get("lease_id"), tasks)
+        self.metrics.record_direct_dispatch("reconciled")
 
     # -- job control ---------------------------------------------------------------------
 
@@ -1061,22 +1254,31 @@ class SchedulerServer:
 
     # -- fail-over recovery ------------------------------------------------
 
-    def recover_jobs(self, force: bool = False) -> list[str]:
+    def recover_jobs(self, force: bool = False,
+                     only_active: bool = False) -> list[str]:
         """Adopt persisted job graphs (scheduler restart / standby takeover).
         Successful stages resume from their materialized shuffle outputs;
         mid-flight work recomputes. Jobs owned by a LIVE peer are skipped
         unless force (the reference's JobAcquired/JobReleased arbitration,
-        cluster/mod.rs:221)."""
+        cluster/mod.rs:221). `only_active` is the periodic orphan sweep in
+        a multi-scheduler deployment: adopt only non-terminal jobs whose
+        owner's lease went stale (a peer died mid-job), and release
+        terminal graphs back rather than hoarding them."""
         recovered = []
         for job_id in self.job_state.list_jobs():
             with self._jobs_lock:
                 if job_id in self.jobs:
                     continue
             if not self.job_state.acquire(job_id, self.scheduler_id, force=force):
-                log.info("job %s owned by another scheduler; skipping", job_id)
+                if not only_active:
+                    log.info("job %s owned by another scheduler; skipping", job_id)
                 continue
             g = self.job_state.load_graph(job_id)
             if g is None:
+                continue
+            if only_active and g.status in (
+                    JobState.SUCCESSFUL, JobState.FAILED, JobState.CANCELLED):
+                self.job_state.release(job_id, self.scheduler_id)
                 continue
             with self._jobs_lock:
                 self.jobs[job_id] = g

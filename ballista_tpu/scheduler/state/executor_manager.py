@@ -46,6 +46,9 @@ class ExecutorSlot:
     corruption_strikes: int = 0
     checksum_failures: float = 0.0
     corruption_retries: float = 0.0
+    # -- direct-dispatch leases (heartbeat-reported gauges) ------------------
+    active_leases: float = 0.0
+    direct_dispatch_tasks: float = 0.0
     # -- out-of-core TPU execution (hbm.py demotion-ladder gauges) -----------
     tpu_hbm_budget_bytes: float = 0.0
     tpu_hbm_spill_bytes: float = 0.0
@@ -111,6 +114,10 @@ class ExecutorManager:
                     metrics.get("checksum_failures", ex.checksum_failures))
                 ex.corruption_retries = float(
                     metrics.get("corruption_retries", ex.corruption_retries))
+                ex.active_leases = float(
+                    metrics.get("active_leases", ex.active_leases))
+                ex.direct_dispatch_tasks = float(
+                    metrics.get("direct_dispatch_tasks", ex.direct_dispatch_tasks))
                 ex.tpu_hbm_budget_bytes = float(
                     metrics.get("tpu_hbm_budget_bytes", ex.tpu_hbm_budget_bytes))
                 ex.tpu_hbm_spill_bytes = float(
@@ -192,6 +199,12 @@ class ExecutorManager:
             e = self.executors.get(executor_id)
             if e is not None:
                 e.free_slots = min(e.total_slots, e.free_slots + n)
+
+    def free_slot_count(self) -> int:
+        """Fleet-wide schedulable free slots (cross-shard revive gate)."""
+        with self._lock:
+            return sum(e.free_slots for e in self.executors.values()
+                       if e.schedulable and not e.terminating)
 
     def take_slots(self, executor_id: str, n: int) -> int:
         """Reserve up to n slots on ONE executor (pull-mode handout: the
@@ -397,6 +410,8 @@ class ExecutorManager:
                     "pool_overcommitted_bytes": int(e.pool_overcommitted_bytes),
                     "pressure_rejections": int(e.pressure_rejections),
                     "corruption_strikes": e.corruption_strikes,
+                    "active_leases": int(e.active_leases),
+                    "direct_dispatch_tasks": int(e.direct_dispatch_tasks),
                     "checksum_failures": int(e.checksum_failures),
                     "corruption_retries": int(e.corruption_retries),
                     "hbm_budget_bytes": int(e.tpu_hbm_budget_bytes),

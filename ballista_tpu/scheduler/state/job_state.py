@@ -93,11 +93,25 @@ class FileJobState(JobStateStore):
     # without --force (the lease expiry the reference stubs)
     LEASE_S = 600.0
 
-    def __init__(self, state_dir: str, lease_s: float | None = None):
+    def __init__(self, state_dir: str, lease_s: float | None = None,
+                 fsync: bool = False):
         self.dir = state_dir
         os.makedirs(state_dir, exist_ok=True)
-        self._lock = threading.Lock()
+        # per-job locks, not one global one: checkpoints of DIFFERENT jobs
+        # are independent files (mkstemp + os.replace is already safe across
+        # jobs), and sharded scheduler event loops checkpoint concurrently —
+        # a global lock would serialize every shard's file I/O again
+        self._locks_guard = threading.Lock()
+        self._job_locks: dict[str, threading.Lock] = {}
         self.lease_s = self.LEASE_S if lease_s is None else lease_s
+        self.fsync = fsync
+
+    def _job_lock(self, job_id: str) -> threading.Lock:
+        with self._locks_guard:
+            lock = self._job_locks.get(job_id)
+            if lock is None:
+                lock = self._job_locks[job_id] = threading.Lock()
+            return lock
 
     def _graph_path(self, job_id: str) -> str:
         return os.path.join(self.dir, f"{job_id}.graph")
@@ -115,21 +129,26 @@ class FileJobState(JobStateStore):
             os.utime(self._owner_path(graph.job_id))
         except OSError:
             pass
-        with self._lock:
+        with self._job_lock(graph.job_id):
             # unique tmp name: two scheduler PROCESSES (forced takeover with
             # a partitioned old owner) must never interleave into one file
             fd, tmp = tempfile.mkstemp(dir=self.dir, suffix=".tmp")
             with os.fdopen(fd, "wb") as f:
                 f.write(data)
+                if self.fsync:
+                    f.flush()
+                    os.fsync(f.fileno())
             os.replace(tmp, path)  # atomic: readers never see a torn graph
 
     def remove_job(self, job_id: str) -> None:
-        with self._lock:
+        with self._job_lock(job_id):
             for p in (self._graph_path(job_id), self._owner_path(job_id)):
                 try:
                     os.remove(p)
                 except FileNotFoundError:
                     pass
+        with self._locks_guard:
+            self._job_locks.pop(job_id, None)
 
     def list_jobs(self) -> list[str]:
         try:

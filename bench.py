@@ -743,13 +743,14 @@ def serving_leg() -> dict:
     try:
         sys.path.insert(0, os.path.join(
             os.path.dirname(os.path.abspath(__file__)), "dev"))
-        from qps_exercise import run_qps_comparison
+        from qps_exercise import run_qps_comparison, run_shard_comparison
 
         from ballista_tpu.testing.tpchgen import generate_tpch
 
         with tempfile.TemporaryDirectory(prefix="bench_qps_") as qd:
             generate_tpch(qd, scale=0.01, seed=42, files_per_table=2)
             stats = run_qps_comparison(qd)
+            shard_stats = run_shard_comparison(qd)
         out = {
             "speedup_qps": stats["speedup_qps"],
             "speedup_p50": stats["speedup_p50"],
@@ -764,7 +765,18 @@ def serving_leg() -> dict:
             "result_cache": stats["serving"]["serving"]["result_cache"],
             "fast_lane": stats["serving"]["serving"]["fast_lane"],
         }
-        log(f"serving leg: {out['speedup_qps']}x QPS, {out['speedup_p50']}x p50")
+        # scheduler scale-out: N=1 vs N=4 event-loop shards over the same
+        # fleet + the direct-dispatch parity probe
+        out["scheduler_shards"] = shard_stats["scheduler_shards"]
+        out["shard_speedup_qps"] = shard_stats["shard_speedup_qps"]
+        out["direct_dispatch_rate"] = shard_stats["direct_dispatch_rate"]
+        for key in ("shards_1", "shards_4"):
+            s = shard_stats[key]
+            out[key] = {k: s[k] for k in
+                        ("queries", "wall_s", "qps", "p50_ms", "p99_ms")}
+        log(f"serving leg: {out['speedup_qps']}x QPS, {out['speedup_p50']}x p50, "
+            f"shard scale-out {out['shard_speedup_qps']}x, "
+            f"direct rate {out['direct_dispatch_rate']}")
         return out
     except (Exception, SystemExit) as e:  # noqa: BLE001 — recorded, not fatal
         log(f"serving leg failed: {e}")

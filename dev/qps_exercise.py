@@ -15,8 +15,19 @@ reports sustained QPS and p50/p99 latency for both, then enforces:
 3. speedup — serving-mode sustained QPS >= 2x legacy and a lower p50;
    warm serving p99 must beat the uncached legacy p50.
 
-Exits non-zero if any check fails. `run_qps_comparison` is importable
-(bench.py's serving leg reuses it).
+A third leg exercises SCHEDULER scale-out instead of the serving tier:
+the same executor fleet behind N=1 vs N=4 scheduler event-loop shards
+(serving disabled, checkpointing FileJobState, multi-stage aggregation
+queries), enforcing that N=4 sustains strictly more QPS than N=1 with
+byte-identical results — the sharded loops overlap the GIL-releasing
+checkpoint fsyncs a single loop serializes. A direct-dispatch probe then
+runs the prepared-statement hot path through an executor lease
+(`client/direct.py`), checks byte parity against the scheduler path, and
+reports `direct_dispatch_rate`.
+
+Exits non-zero if any check fails. `run_qps_comparison` and
+`run_shard_comparison` are importable (bench.py's serving leg reuses
+them).
 """
 
 import os
@@ -200,6 +211,228 @@ def run_qps_comparison(data_dir: str) -> dict:
     return out
 
 
+# multi-stage shape for the shard leg: the GROUP BY forces a shuffle
+# (partial agg stage -> final agg stage), so every job crosses the event
+# loop several times and checkpoints at each stage transition
+SHARD_QUERY = ("SELECT l_returnflag, COUNT(*) AS c, SUM(l_quantity) AS q "
+               "FROM lineitem WHERE l_quantity < {k} GROUP BY l_returnflag")
+SHARD_SESSIONS = int(os.environ.get("QPS_SHARD_SESSIONS", "24"))
+SHARD_REPEATS = int(os.environ.get("QPS_SHARD_REPEATS", "4"))
+# modeled commit RTT of the shared job-state store (see RemoteStoreJobState)
+SHARD_COMMIT_MS = float(os.environ.get("QPS_SHARD_COMMIT_MS", "15"))
+
+
+def _remote_store_job_state(state_dir: str, commit_latency_s: float):
+    """FileJobState plus a modeled commit round trip.
+
+    A multi-scheduler deployment checkpoints through a SHARED remote
+    store (etcd/sled behind the reference's JobState trait); every
+    `save_graph` pays that store's commit RTT — milliseconds of wall
+    time during which the committing event loop holds no CPU. Standalone
+    mode's local-file store understates this to microseconds, which
+    would let a single loop checkpoint hundreds of jobs a second and
+    hide exactly the serialization scheduler sharding removes. The
+    sleep (GIL released, like the real socket wait) restores the
+    deployment-shaped cost; everything else is the real FileJobState."""
+    from ballista_tpu.scheduler.state.job_state import FileJobState
+
+    class RemoteStoreJobState(FileJobState):
+        def save_graph(self, graph) -> None:
+            time.sleep(commit_latency_s)
+            super().save_graph(graph)
+
+    return RemoteStoreJobState(state_dir, fsync=True)
+
+
+def shard_leg(data_dir: str, shards: int) -> dict:
+    """One shard-count leg: concurrent sessions firing multi-stage jobs at
+    a StandaloneCluster whose scheduler runs `shards` event loops over a
+    checkpointing job-state store with a realistic commit RTT — the
+    serialized wait the sharded loops overlap. The plan cache stays ON
+    (planning happens once, off the event loop) and the result cache OFF
+    (every job really executes), so the leg measures the scheduling path,
+    not parse/optimize throughput."""
+    from ballista_tpu.client.context import SessionContext, fetch_job_results
+    from ballista_tpu.config import (
+        DEFAULT_SHUFFLE_PARTITIONS,
+        SERVING_FAST_LANE,
+        SERVING_PLAN_CACHE,
+        SERVING_RESULT_CACHE,
+        BallistaConfig,
+    )
+    from ballista_tpu.executor.standalone import StandaloneCluster
+    from ballista_tpu.testing.tpchgen import register_tpch
+
+    cfg = BallistaConfig({
+        DEFAULT_SHUFFLE_PARTITIONS: 2,
+        SERVING_PLAN_CACHE: True,
+        # fast lane can't take a 2-stage plan, but keep it off so a future
+        # planner improvement doesn't silently reroute the leg off the loop
+        SERVING_FAST_LANE: False,
+        SERVING_RESULT_CACHE: False,
+    })
+    ctx = SessionContext(cfg)
+    register_tpch(ctx, data_dir)
+    state_dir = tempfile.mkdtemp(prefix=f"qps-shard{shards}-state-")
+    cluster = StandaloneCluster(
+        num_executors=2, vcores=8, config=cfg, shards=shards,
+        job_state=_remote_store_job_state(state_dir, SHARD_COMMIT_MS / 1000.0))
+    scheduler = cluster.scheduler
+    latencies: list[float] = []
+    fingerprints: dict[int, set] = {k: set() for k in PARAMS}
+    errors: list[str] = []
+    lock = threading.Lock()
+
+    def session_worker(n: int) -> None:
+        session_id = scheduler.sessions.create_or_update(
+            cfg.to_key_value_pairs(), f"shard{shards}-{n}")
+        try:
+            for _rep in range(SHARD_REPEATS):
+                for k in PARAMS:
+                    t0 = time.monotonic()
+                    job_id = scheduler.submit_sql(
+                        SHARD_QUERY.format(k=k), session_id, inline_results=True)
+                    status = scheduler.wait_for_job(job_id, timeout=120)
+                    if status["state"] != "successful":
+                        raise RuntimeError(
+                            f"job {job_id} {status['state']}: {status.get('error')}")
+                    tbl = fetch_job_results(status, cfg)
+                    dt = time.monotonic() - t0
+                    with lock:
+                        latencies.append(dt)
+                        fingerprints[k].add(_fingerprint(tbl))
+        except Exception as e:  # noqa: BLE001 — collected and reported
+            with lock:
+                errors.append(f"session {n}: {e}")
+
+    try:
+        warm_sid = scheduler.sessions.create_or_update(
+            cfg.to_key_value_pairs(), f"shard{shards}-warmup")
+        wj = scheduler.submit_sql(SHARD_QUERY.format(k=PARAMS[0]), warm_sid)
+        if scheduler.wait_for_job(wj, timeout=120)["state"] != "successful":
+            raise SystemExit(f"[shards={shards}] warmup query failed")
+
+        threads = [threading.Thread(target=session_worker, args=(i,))
+                   for i in range(SHARD_SESSIONS)]
+        t_start = time.monotonic()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.monotonic() - t_start
+        shard_snap = scheduler.shards_snapshot()
+    finally:
+        cluster.shutdown()
+
+    if errors:
+        raise SystemExit(f"[shards={shards}] worker failures: {errors[:3]}")
+    lat = sorted(latencies)
+    return {
+        "shards": shards,
+        "queries": len(latencies),
+        "wall_s": round(wall, 3),
+        "qps": round(len(latencies) / wall, 2),
+        "p50_ms": round(_pct(lat, 50) * 1000, 1),
+        "p99_ms": round(_pct(lat, 99) * 1000, 1),
+        "fingerprints": fingerprints,
+        "shard_snapshot": shard_snap,
+    }
+
+
+def direct_probe(data_dir: str) -> dict:
+    """Prepared-statement direct dispatch vs the scheduler path on one
+    cluster: byte parity per param, plus the achieved direct rate."""
+    from ballista_tpu.client.context import SessionContext, fetch_job_results
+    from ballista_tpu.client.direct import DirectDispatcher, LocalLeaseTransport
+    from ballista_tpu.config import (
+        DEFAULT_SHUFFLE_PARTITIONS,
+        BallistaConfig,
+    )
+    from ballista_tpu.executor.standalone import StandaloneCluster
+    from ballista_tpu.testing.tpchgen import register_tpch
+
+    cfg = BallistaConfig({DEFAULT_SHUFFLE_PARTITIONS: 2})
+    ctx = SessionContext(cfg)
+    register_tpch(ctx, data_dir)
+    cluster = StandaloneCluster(num_executors=2, vcores=4, config=cfg)
+    scheduler = cluster.scheduler
+    try:
+        session_id = scheduler.sessions.create_or_update(
+            cfg.to_key_value_pairs(), "direct-probe")
+        d = DirectDispatcher(scheduler, LocalLeaseTransport(cluster.executors),
+                             session_id)
+        # prepare takes concrete SQL; literal lifting parameterizes it
+        d.prepare(QUERY.format(k=PARAMS[0]))
+        for rep in range(3):
+            for k in PARAMS:
+                st_direct = d.execute((k,))
+                direct_fp = _fingerprint(fetch_job_results(st_direct, cfg))
+                jid = scheduler.execute_prepared(
+                    d.statement_id, (k,), session_id=session_id)
+                st_sched = scheduler.wait_for_job(jid, timeout=120)
+                if st_sched["state"] != "successful":
+                    raise SystemExit(f"[direct] scheduler path failed: {st_sched}")
+                sched_fp = _fingerprint(fetch_job_results(st_sched, cfg))
+                if direct_fp != sched_fp:
+                    raise SystemExit(
+                        f"[direct] param {k} rep {rep}: direct-dispatch bytes "
+                        f"diverge from the scheduler path")
+        rate = d.direct_dispatch_rate()
+        if rate <= 0.0:
+            raise SystemExit("[direct] every dispatch demoted — the lease "
+                             "path never actually ran")
+        return {"direct_dispatch_rate": round(rate, 3), "stats": dict(d.stats),
+                "leases": scheduler.leases.snapshot()}
+    finally:
+        cluster.shutdown()
+
+
+def run_shard_comparison(data_dir: str) -> dict:
+    """N=1 vs N=4 scheduler shards over the same fleet, plus the
+    direct-dispatch parity probe; asserts the scale-out acceptance bars.
+
+    The shard legs run on their own TINY dataset (sf0.001): the leg
+    measures control-plane throughput, and scan-heavy tasks on one core
+    would put the ceiling at the data plane for both shard counts."""
+    from ballista_tpu.testing.tpchgen import generate_tpch
+
+    with tempfile.TemporaryDirectory(prefix="qps-shard-data-") as tiny:
+        generate_tpch(tiny, scale=0.001, seed=42, files_per_table=1)
+        n1 = shard_leg(tiny, shards=1)
+        n4 = shard_leg(tiny, shards=4)
+
+    # byte-identical results across shard counts and repeats
+    for k in PARAMS:
+        fps = n1["fingerprints"][k] | n4["fingerprints"][k]
+        if len(fps) != 1:
+            raise SystemExit(
+                f"[shards] param {k}: results diverged across shard counts "
+                f"({len(n4['fingerprints'][k])} distinct at N=4, "
+                f"{len(fps)} combined)")
+
+    # the loops actually sharded: every shard saw events
+    snap = n4["shard_snapshot"]
+    if len(snap) != 4 or any(s["handled"] == 0 for s in snap):
+        raise SystemExit(f"[shards] N=4 leg left idle shards: {snap}")
+
+    # scale-out bar: more event loops -> strictly more sustained QPS
+    if n4["qps"] <= n1["qps"]:
+        raise SystemExit(f"[shards] N=4 {n4['qps']} QPS not above N=1 "
+                         f"{n1['qps']} QPS")
+
+    direct = direct_probe(data_dir)
+    out = {}
+    for leg in (n1, n4):
+        leg = dict(leg)
+        leg.pop("fingerprints")
+        out[f"shards_{leg['shards']}"] = leg
+    out["scheduler_shards"] = 4
+    out["shard_speedup_qps"] = round(n4["qps"] / max(n1["qps"], 1e-9), 2)
+    out["direct_dispatch_rate"] = direct["direct_dispatch_rate"]
+    out["direct"] = direct
+    return out
+
+
 def main() -> None:
     from ballista_tpu.testing.tpchgen import generate_tpch
 
@@ -219,6 +452,17 @@ def main() -> None:
               f"fast_lane={srv['fast_lane']}")
         print(f"qps exercise passed: {stats['speedup_qps']}x QPS, "
               f"{stats['speedup_p50']}x p50")
+
+        shard_stats = run_shard_comparison(d)
+        for key in ("shards_1", "shards_4"):
+            s = shard_stats[key]
+            print(f"[shards={s['shards']}] {s['queries']} queries in "
+                  f"{s['wall_s']}s -> {s['qps']} QPS  "
+                  f"p50={s['p50_ms']}ms p99={s['p99_ms']}ms")
+        print(f"[direct  ] rate={shard_stats['direct_dispatch_rate']} "
+              f"stats={shard_stats['direct']['stats']}")
+        print(f"shard exercise passed: {shard_stats['shard_speedup_qps']}x QPS "
+              f"at N=4, direct dispatch byte-identical")
 
 
 if __name__ == "__main__":

@@ -298,7 +298,10 @@ def test_keda_external_scaler(grpc_cluster, remote_ctx):
         stub = external_scaler_stub(ch)
         assert stub.IsActive(kpb.ScaledObjectRef(name="x")).result is True
         spec = stub.GetMetricSpec(kpb.ScaledObjectRef(name="x"))
-        assert [(m.metricName, m.targetSize) for m in spec.metricSpecs] == [("pending_jobs", 1)]
+        # executor scaling on pending_jobs, scheduler scaling on the
+        # deepest shard event queue
+        assert [(m.metricName, m.targetSize) for m in spec.metricSpecs] == [
+            ("pending_jobs", 1), ("shard_queue_depth", 1)]
         spec5 = stub.GetMetricSpec(
             kpb.ScaledObjectRef(name="x", scalerMetadata={"targetSize": "5"}))
         assert spec5.metricSpecs[0].targetSize == 5
