@@ -107,6 +107,8 @@ CHAOS_STRAGGLER_DELAY_S = "ballista.chaos.straggler.delay.seconds"
 CHAOS_STRAGGLER_PARTITION = "ballista.chaos.straggler.partition"
 CHAOS_STRAGGLER_STAGE = "ballista.chaos.straggler.stage"
 CHAOS_SKEW_FRACTION = "ballista.chaos.skew.fraction"
+CHAOS_DAEMON_ARM = "ballista.chaos.daemon.arm"
+CHAOS_DAEMON_ONCE = "ballista.chaos.daemon.once"
 # straggler defense (speculation / deadlines)
 SPECULATION_ENABLED = "ballista.scheduler.speculation.enabled"
 SPECULATION_QUANTILE = "ballista.scheduler.speculation.quantile"
@@ -167,6 +169,8 @@ TPU_DAEMON_SOCKET = "ballista.tpu.daemon.socket"
 TPU_DAEMON_SPAWN = "ballista.tpu.daemon.spawn"
 TPU_DAEMON_ATTACH_TIMEOUT_MS = "ballista.tpu.daemon.attach.timeout.ms"
 TPU_DAEMON_SESSION_QUOTA_BYTES = "ballista.tpu.daemon.session.hbm.quota.bytes"
+TPU_DAEMON_EXECUTE_TIMEOUT_S = "ballista.tpu.daemon.execute.timeout.s"
+TPU_DAEMON_POISON_TTL_S = "ballista.tpu.daemon.poison.ttl.s"
 # debug verifiers
 DEBUG_PLAN_VERIFY = "ballista.debug.plan.verify"
 
@@ -568,10 +572,18 @@ _ENTRIES: list[ConfigEntry] = [
         "fraction of rows — chosen as a pure function of the row's key hash, "
         "so equal keys always co-locate and results stay byte-identical — is "
         "rerouted to one hot reduce partition (ballista.chaos.skew.fraction), "
-        "deterministic fuel for the AQE skew-split defense.",
+        "deterministic fuel for the AQE skew-split defense. 'daemon_crash' / "
+        "'daemon_hang' fault the device-runtime DAEMON (no plan wrapping — "
+        "the fault fires inside the daemon's execute handler, at the arming "
+        "point ballista.chaos.daemon.arm): daemon_crash hard-exits the daemon "
+        "process (SIGKILL-style, exit 137) so the client's typed "
+        "DaemonCrashed → respawn-and-retry → poison-quarantine ladder is "
+        "exercised end to end; daemon_hang wedges the execute thread so the "
+        "per-request watchdog trips, writes the <socket>.crash.json "
+        "post-mortem, and exits 4 (docs/device_daemon.md#failure-domain).",
         str, "transient",
         choices=("transient", "fatal", "panic", "delay", "straggler", "overload",
-                 "corrupt", "hbm_oom", "skew"),
+                 "corrupt", "hbm_oom", "skew", "daemon_crash", "daemon_hang"),
     ),
     ConfigEntry(
         CHAOS_STRAGGLER_DELAY_S,
@@ -602,6 +614,28 @@ _ENTRIES: list[ConfigEntry] = [
         "position, so both sides of a co-partitioned join skew identically "
         "and query results are unchanged.",
         float, 0.5, lambda v: 0.0 <= v <= 1.0,
+    ),
+    ConfigEntry(
+        CHAOS_DAEMON_ARM,
+        "chaos mode=daemon_crash/daemon_hang: the arming point inside the "
+        "device daemon's execute handler where the fault fires — "
+        "pre_execute (before the plan decodes), mid_execute (holding the "
+        "device, before the stage runs), or post_execute (results computed, "
+        "reply not yet sent). The session config carries the arming to the "
+        "daemon; the executor-side plan is never wrapped "
+        "(docs/device_daemon.md#failure-domain).",
+        str, "mid_execute",
+        lambda v: v in ("pre_execute", "mid_execute", "post_execute"),
+    ),
+    ConfigEntry(
+        CHAOS_DAEMON_ONCE,
+        "chaos mode=daemon_crash/daemon_hang: limit the fault to the FIRST "
+        "armed request per daemon socket, via a marker file next to the "
+        "socket that deliberately survives daemon respawns — so the "
+        "respawn-and-retry recovery path succeeds deterministically. False "
+        "= every incarnation dies, which exercises the poison-stage "
+        "quarantine instead.",
+        bool, True,
     ),
     ConfigEntry(
         SPECULATION_ENABLED,
@@ -961,6 +995,30 @@ _ENTRIES: list[ConfigEntry] = [
         "tables — spill/grace decisions become quota-aware. 0 = no "
         "per-session ceiling.",
         int, 0, _nonneg,
+    ),
+    ConfigEntry(
+        TPU_DAEMON_EXECUTE_TIMEOUT_S,
+        "Floor (seconds) of the per-request execute deadline both sides of "
+        "the daemon protocol enforce: the client derives the actual bound "
+        "from the stage's byte estimate (floor + bytes at a pessimistic "
+        "16 MiB/s, capped at 8x the floor — "
+        "protocol.derive_execute_timeout_s) and ships it in the request "
+        "header; the daemon's watchdog kills the process on overrun with a "
+        "post-mortem at <socket>.crash.json (all thread stacks, the "
+        "offending request header, rusage) so a wedged XLA call cannot "
+        "hold the chip hostage. The client waits slightly longer than the "
+        "deadline, so the watchdog's diagnosed kill wins the race.",
+        int, 120, _pos,
+    ),
+    ConfigEntry(
+        TPU_DAEMON_POISON_TTL_S,
+        "Seconds a stage fingerprint stays in the on-disk poison quarantine "
+        "(<socket>.poison.json) after crashing "
+        "two daemon incarnations. While quarantined, respawned daemons "
+        "refuse the stage and clients demote it straight to the "
+        "in-process/CPU ladder (RUN_STATS daemon_failover=poisoned) — no "
+        "crash loops. After the TTL the stage may try the daemon again.",
+        int, 600, _pos,
     ),
     ConfigEntry(
         DEBUG_PLAN_VERIFY,

@@ -3,7 +3,9 @@
 Runs the warm device-runtime daemon in the foreground (spawn-and-adopt
 clients detach it themselves via start_new_session). Exit codes: 0 clean
 shutdown, 2 socket already owned by a live daemon, 3 init phase timed
-out (probe report + stack snapshot at <socket>.probe.json)."""
+out (probe report + stack snapshot at <socket>.probe.json), 4 execute
+watchdog killed a wedged request (post-mortem with the offending request
+header and all thread stacks at <socket>.crash.json)."""
 
 from __future__ import annotations
 

@@ -55,8 +55,35 @@ def probe_report_path(socket_path: str) -> str:
     return socket_path + ".probe.json"
 
 
+def crash_report_path(socket_path: str) -> str:
+    """The execute watchdog's post-mortem artifact: written by a daemon
+    whose in-flight request overran its deadline, immediately before the
+    process exits. Next to the socket for the same reason as the probe
+    report — the corpse must be readable without a live daemon."""
+    return socket_path + ".crash.json"
+
+
+def poison_path(socket_path: str) -> str:
+    """The on-disk poison-stage quarantine shared by every client of this
+    socket AND by respawned daemons (which refuse quarantined stages):
+    {tag: {crashes, updated, fingerprint}} with TTL'd entries."""
+    return socket_path + ".poison.json"
+
+
 def daemon_log_path(socket_path: str) -> str:
     return socket_path + ".log"
+
+
+def derive_execute_timeout_s(floor_s: float, est_bytes: int) -> float:
+    """The execute deadline both sides agree on: the knob
+    `ballista.tpu.daemon.execute.timeout.s` is the floor for small stages,
+    the bound grows with the stage's estimated bytes at a pessimistic
+    16 MiB/s (encode + upload + XLA compile + exec all counted), and the
+    same knob caps the growth at 8x — a wedged XLA call must trip the
+    watchdog in bounded time no matter how big the stage claimed to be."""
+    floor_s = max(1.0, float(floor_s))
+    derived = floor_s + max(0, int(est_bytes)) / float(16 * 1024 * 1024)
+    return min(derived, floor_s * 8.0)
 
 
 def send_msg(sock: socket.socket, header: dict, body: bytes = b"") -> None:

@@ -26,6 +26,22 @@ through the SAME maybe_compile_tpu entry the in-process engine uses
 (hbm.session_quota), and streams the result batches back as Arrow IPC.
 Device dispatch is serialized — one stage on the device at a time — and
 the wait count is exported as daemon_queue_depth.
+
+The RUNTIME failure domain mirrors the init one
+(docs/device_daemon.md#failure-domain): every execute runs under a
+per-request watchdog whose deadline the client derived from the stage's
+byte estimate (protocol.derive_execute_timeout_s, floored/capped by
+ballista.tpu.daemon.execute.timeout.s). A request that overruns is
+wedged inside an uncancellable XLA call, so the watchdog dumps every
+thread's stack plus the offending request header into
+<socket>.crash.json and exits nonzero — the chip must not be held
+hostage. A boot GENERATION token minted at bind time is echoed in every
+ping/status/execute response: clients key their attach cache on it
+(recycled pids cannot alias daemons) and the serving tier's leases
+carry it to fence direct dispatch against a silently restarted daemon.
+Stages quarantined in <socket>.poison.json by a client that watched
+them kill two daemon incarnations are refused outright — a respawned
+daemon never crash-loops on a poison stage.
 """
 
 from __future__ import annotations
@@ -37,6 +53,7 @@ import json
 import logging
 import os
 import socket
+import sys
 import threading
 import time
 import traceback
@@ -59,6 +76,7 @@ class DaemonServer:
                  idle_timeout_s: int = _IDLE_TIMEOUT_S):
         self.socket_path = socket_path
         self.report_path = protocol.probe_report_path(socket_path)
+        self.crash_path = protocol.crash_report_path(socket_path)
         self.parent_pid = parent_pid
         self.device_ordinal = device_ordinal
         self.work_dir = work_dir or os.path.join(
@@ -86,6 +104,16 @@ class DaemonServer:
         self.execute_count = 0
         self.clear_count = 0
         self._sessions: dict[str, dict] = {}
+        # boot generation token: minted at bind, echoed in every response.
+        # Empty until the socket is bound — a daemon that never owned the
+        # address has no incarnation to name.
+        self.generation = ""
+        # per-request execute watchdog: in-flight requests keyed by a
+        # monotonic id; the watchdog thread kills the process (with a
+        # crash artifact) when one overruns its deadline
+        self._inflight_lock = threading.Lock()
+        self._inflight: dict[int, dict] = {}
+        self._inflight_seq = 0
 
     # ---------------------------------------------------------- init phases
 
@@ -207,6 +235,14 @@ class DaemonServer:
         lst = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
         lst.bind(self.socket_path)
         lst.listen(16)
+        # the address is ours: mint this incarnation's generation token
+        # (time + pid — unique even across pid recycling) and remove the
+        # previous corpse's artifacts, so post-mortem tooling never reads
+        # a stale probe/crash report as if it were this daemon's
+        self.generation = f"{int(time.time() * 1e6):x}-{os.getpid():x}"
+        for stale in (self.report_path, self.crash_path):
+            with contextlib.suppress(OSError):
+                os.unlink(stale)
         return lst
 
     def serve_forever(self) -> int:
@@ -223,6 +259,8 @@ class DaemonServer:
         threading.Thread(target=self._supervise_init, name="daemon-init-watch",
                          daemon=True).start()
         threading.Thread(target=self._reaper, name="daemon-reaper",
+                         daemon=True).start()
+        threading.Thread(target=self._watchdog, name="daemon-exec-watch",
                          daemon=True).start()
         log.info("device daemon pid=%d serving %s", os.getpid(), self.socket_path)
         self._listener.settimeout(1.0)
@@ -267,6 +305,138 @@ class DaemonServer:
             if self._listener is not None:
                 self._listener.close()
 
+    # ------------------------------------------------- execute watchdog
+
+    @contextlib.contextmanager
+    def _watched(self, header: dict, deadline_s: float):
+        """Register one execute request with the watchdog for its on-device
+        span. The entry carries everything the post-mortem needs: the
+        request header (minus the bulky config pairs), the session, and a
+        mutable phase the handler advances (recompile → execute → pack)."""
+        entry = {
+            "header": {k: v for k, v in header.items() if k != "pairs"},
+            "session": str(header.get("session") or "anonymous"),
+            "phase": "recompile",
+            "started": time.time(),
+            "deadline_s": float(deadline_s),
+        }
+        with self._inflight_lock:
+            self._inflight_seq += 1
+            rid = self._inflight_seq
+            self._inflight[rid] = entry
+        try:
+            yield entry
+        finally:
+            with self._inflight_lock:
+                self._inflight.pop(rid, None)
+
+    def _watchdog(self) -> None:
+        """Kill the process when an in-flight execute overruns its
+        deadline. Same rationale as the init supervisor: a wedged XLA call
+        cannot be cancelled, so the honest move is a diagnosed death — the
+        crash artifact names the offending request, and the client's
+        respawn ladder (plus the poison quarantine on a repeat) takes it
+        from there."""
+        while not self._stop.wait(0.5):
+            now = time.time()
+            with self._inflight_lock:
+                overrun = [dict(e) for e in self._inflight.values()
+                           if e["deadline_s"] > 0
+                           and now - e["started"] > e["deadline_s"]]
+            if overrun:
+                worst = max(overrun, key=lambda e: now - e["started"])
+                self._write_crash_report("watchdog", worst)
+                log.error(
+                    "execute watchdog: request %s overran %.1fs deadline in "
+                    "phase %s — exiting with crash report at %s",
+                    worst["header"].get("tag"), worst["deadline_s"],
+                    worst["phase"], self.crash_path)
+                os._exit(4)
+
+    def _write_crash_report(self, kind: str, entry: dict) -> None:
+        """<socket>.crash.json: every thread's stack (faulthandler), the
+        offending request header, session, phase, and process rusage —
+        written tmp+rename immediately before the process exits."""
+        from ballista_tpu.ops.tpu import runtime
+
+        # faulthandler writes at the fd level (it must work even when the
+        # interpreter is wedged), so dump through a real file, not StringIO
+        stacks = ""
+        try:
+            with open(self.crash_path + ".stacks", "w+") as f:
+                faulthandler.dump_traceback(file=f)
+                f.seek(0)
+                stacks = f.read()
+            os.unlink(self.crash_path + ".stacks")
+        except Exception:  # noqa: BLE001 — post-mortem must still be written
+            stacks = "".join(
+                f"\nThread {tid}:\n" + "".join(traceback.format_stack(frame))
+                for tid, frame in sys._current_frames().items())
+        report = {
+            "kind": kind,
+            "pid": os.getpid(),
+            "generation": self.generation,
+            "socket": self.socket_path,
+            "request": entry.get("header", {}),
+            "session": entry.get("session"),
+            "phase": entry.get("phase"),
+            "deadline_s": entry.get("deadline_s"),
+            "elapsed_s": round(time.time() - entry.get("started", time.time()), 3),
+            "rusage": runtime.process_rusage(),
+            "stacks": stacks[-16000:],
+            "written_at": time.time(),
+        }
+        tmp = self.crash_path + ".tmp"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(report, f, indent=1)
+            os.replace(tmp, self.crash_path)
+        except OSError:
+            log.warning("could not write crash report %s", self.crash_path,
+                        exc_info=True)
+
+    # ---------------------------------------------------- chaos arming
+
+    def _maybe_chaos(self, cfg, point: str) -> None:
+        """Deterministic daemon-fault injection (executor/chaos.py modes
+        daemon_crash / daemon_hang). Armed through the session config the
+        client already ships, at exactly one arming point
+        (ballista.chaos.daemon.arm). With ballista.chaos.daemon.once a
+        marker file next to the socket limits the fault to the FIRST
+        armed request PER SOCKET — the marker survives respawns, so the
+        retry against the fresh daemon succeeds (the recovery test);
+        without it every incarnation dies and the poison quarantine is
+        what breaks the crash loop (the quarantine test)."""
+        from ballista_tpu.config import (
+            CHAOS_DAEMON_ARM,
+            CHAOS_DAEMON_ONCE,
+            CHAOS_ENABLED,
+            CHAOS_MODE,
+        )
+
+        if not bool(cfg.get(CHAOS_ENABLED)):
+            return
+        mode = str(cfg.get(CHAOS_MODE))
+        if mode not in ("daemon_crash", "daemon_hang"):
+            return
+        if str(cfg.get(CHAOS_DAEMON_ARM)) != point:
+            return
+        if bool(cfg.get(CHAOS_DAEMON_ONCE)):
+            marker = f"{self.socket_path}.chaos.{mode}.{point}"
+            try:
+                os.close(os.open(marker, os.O_CREAT | os.O_EXCL | os.O_WRONLY))
+            except FileExistsError:
+                return  # already fired once for this socket
+            except OSError:
+                pass  # unmarkable filesystem: fire anyway, stay deterministic
+        if mode == "daemon_crash":
+            log.error("chaos: daemon_crash armed at %s — dying uncleanly", point)
+            os._exit(137)  # SIGKILL's exit code: an undiagnosed death
+        log.error("chaos: daemon_hang armed at %s — wedging the execute "
+                  "thread until the watchdog fires", point)
+        while True:  # the watchdog converts this into a diagnosed kill
+            time.sleep(0.25)
+
     def _serve_conn(self, conn: socket.socket) -> None:
         try:
             with conn:
@@ -289,7 +459,7 @@ class DaemonServer:
         if header.get("v", protocol.PROTOCOL_VERSION) != protocol.PROTOCOL_VERSION:
             return {"ok": False, "error": "protocol version mismatch"}, b""
         if op == "ping":
-            return {"ok": True, "pid": os.getpid(),
+            return {"ok": True, "pid": os.getpid(), "gen": self.generation,
                     "ready": self._init_ok}, b""
         if op == "status":
             return {"ok": True, **self._status()}, b""
@@ -325,6 +495,7 @@ class DaemonServer:
             persist = runtime.compile_cache_stats()
         return {
             "pid": os.getpid(),
+            "gen": self.generation,
             "uptime_s": round(time.time() - self.started_at, 1),
             "ready": self._init_ok,
             "init": init,
@@ -367,9 +538,12 @@ class DaemonServer:
         from ballista_tpu import serde
         from ballista_tpu.config import (
             TPU_DAEMON_ENABLED,
+            TPU_DAEMON_EXECUTE_TIMEOUT_S,
+            TPU_DAEMON_POISON_TTL_S,
             TPU_DAEMON_SESSION_QUOTA_BYTES,
             BallistaConfig,
         )
+        from ballista_tpu.device_daemon import client as dclient
         from ballista_tpu.engine.tpu_engine import maybe_compile_tpu
         from ballista_tpu.ops.tpu import hbm
         from ballista_tpu.plan.physical import TaskContext
@@ -380,58 +554,104 @@ class DaemonServer:
             [(k, v) for k, v in header.get("pairs", [])], scrub_restricted=True)
         # never re-enter the daemon path from inside the daemon
         cfg.set(TPU_DAEMON_ENABLED, False)
-        plan = serde.plan_from_bytes(body)
-        compiled = maybe_compile_tpu(plan, cfg)
-        emit_pid = header.get("emit_pid")
-        if emit_pid is not None:
-            if not isinstance(compiled, sc.TpuStageExec):
-                return {"ok": False, "error":
-                        "device-routed stage did not recompile to a device "
-                        "stage daemon-side; client must run it locally"}, b""
-            compiled.emit_pid = (list(emit_pid[0]), int(emit_pid[1]))
-
-        session = str(header.get("session") or "anonymous")
-        quota = int(cfg.get(TPU_DAEMON_SESSION_QUOTA_BYTES))
-        with self._counters_lock:
-            s = self._sessions.setdefault(
-                session, {"quota_bytes": quota, "executes": 0,
-                          "last_used": time.time()})
-            s["quota_bytes"] = quota
-            s["last_used"] = time.time()
-            s["executes"] += 1
-            self._queue_depth += 1
+        tag = str(header.get("tag", ""))
+        poison_ttl = float(cfg.get(TPU_DAEMON_POISON_TTL_S))
+        if tag and dclient.is_poisoned(self.socket_path, tag, poison_ttl):
+            # this stage has killed two daemon incarnations already; refusing
+            # it here is what breaks the crash loop — the client demotes it
+            # to the in-process/CPU ladder
+            return {"ok": False, "poisoned": True, "gen": self.generation,
+                    "error": f"stage {tag} is quarantined in "
+                             f"{protocol.poison_path(self.socket_path)}; "
+                             "run it in-process"}, b""
+        deadline_s = float(header.get("deadline_s") or 0.0)
+        if deadline_s <= 0:
+            deadline_s = protocol.derive_execute_timeout_s(
+                float(cfg.get(TPU_DAEMON_EXECUTE_TIMEOUT_S)), 0)
         try:
-            with self._exec_lock:
+            with self._watched(header, deadline_s) as went:
+                self._maybe_chaos(cfg, "pre_execute")
+                plan = serde.plan_from_bytes(body)
+                compiled = maybe_compile_tpu(plan, cfg)
+                emit_pid = header.get("emit_pid")
+                if emit_pid is not None:
+                    if not isinstance(compiled, sc.TpuStageExec):
+                        return {"ok": False, "gen": self.generation, "error":
+                                "device-routed stage did not recompile to a "
+                                "device stage daemon-side; client must run it "
+                                "locally"}, b""
+                    compiled.emit_pid = (list(emit_pid[0]), int(emit_pid[1]))
+
+                session = str(header.get("session") or "anonymous")
+                quota = int(cfg.get(TPU_DAEMON_SESSION_QUOTA_BYTES))
                 with self._counters_lock:
-                    self._queue_depth -= 1
-                ctx = TaskContext(cfg, task_id=f"daemon-{self.execute_count}",
-                                  work_dir=self.work_dir)
-                ctx.device_ordinal = self.device_ordinal
-                tag = str(header.get("tag", ""))
-                partitions = [int(p) for p in header.get("partitions", [])]
-                with hbm.session_quota(quota):
-                    results = {p: list(compiled.execute(p, ctx))
-                               for p in partitions}
-            with self._counters_lock:
-                self.execute_count += 1
-        except Exception:  # noqa: BLE001
-            with self._counters_lock:
-                self._queue_depth = max(0, self._queue_depth)
-            return {"ok": False, "error": traceback.format_exc(limit=10)}, b""
-        segments, resp_body = protocol.pack_results(results)
-        # mirror this run's engine stats back to the caller: the client's
-        # RUN_STATS (heartbeat, bench events) reports the device work even
-        # though it happened in this process
-        rec = sc.RUN_STATS.stages().get(tag) or {}
-        stats = {k: v for k, v in rec.items()
-                 if isinstance(v, (int, float, str, bool))}
-        init_s = {p["name"]: p["s"] for p in self._status()["init"]["phases"]}
-        return {"ok": True, "segments": segments, "stats": stats,
-                "sessions": len(self._sessions),
-                "queue_depth": self._queue_depth,
-                "init_phase_s": init_s,
-                "device_runs": getattr(compiled, "tpu_count", 0),
-                "cpu_fallbacks": getattr(compiled, "fallback_count", 0)}, resp_body
+                    s = self._sessions.setdefault(
+                        session, {"quota_bytes": quota, "executes": 0,
+                                  "last_used": time.time()})
+                    s["quota_bytes"] = quota
+                    s["last_used"] = time.time()
+                    s["executes"] += 1
+                    self._queue_depth += 1
+                try:
+                    with self._exec_lock:
+                        with self._counters_lock:
+                            self._queue_depth -= 1
+                        # the deadline covers the on-device span, not the
+                        # queue wait behind other sessions: restart the
+                        # clock now that the device is ours
+                        went["phase"] = "execute"
+                        went["started"] = time.time()
+                        self._maybe_chaos(cfg, "mid_execute")
+                        ctx = TaskContext(
+                            cfg, task_id=f"daemon-{self.execute_count}",
+                            work_dir=self.work_dir)
+                        ctx.device_ordinal = self.device_ordinal
+                        partitions = [int(p)
+                                      for p in header.get("partitions", [])]
+                        # snapshot the engine stats so the mirror below can
+                        # diff: a routed final/mesh stage publishes its inner
+                        # partial-stage recs under THEIR tags, not the
+                        # request's
+                        before = {t: dict(r)
+                                  for t, r in sc.RUN_STATS.stages().items()}
+                        with hbm.session_quota(quota):
+                            results = {p: list(compiled.execute(p, ctx))
+                                       for p in partitions}
+                    with self._counters_lock:
+                        self.execute_count += 1
+                except Exception:  # noqa: BLE001
+                    with self._counters_lock:
+                        self._queue_depth = max(0, self._queue_depth)
+                    return {"ok": False, "gen": self.generation,
+                            "error": traceback.format_exc(limit=10)}, b""
+                went["phase"] = "pack"
+                segments, resp_body = protocol.pack_results(results)
+                # mirror this run's engine stats back to the caller: the
+                # client's RUN_STATS (heartbeat, bench events) reports the
+                # device work even though it happened in this process. Merge
+                # every rec the request CHANGED (a daemon-routed final/mesh
+                # stage runs inner partial stages under their own tags), with
+                # the request's own tag applied last so it wins collisions.
+                stats: dict = {}
+                after = sc.RUN_STATS.stages()
+                changed = [t for t, r in after.items() if r != before.get(t)]
+                for t in sorted(changed, key=lambda t: t == tag):
+                    stats.update({k: v for k, v in after[t].items()
+                                  if isinstance(v, (int, float, str, bool))})
+                init_s = {p["name"]: p["s"]
+                          for p in self._status()["init"]["phases"]}
+                self._maybe_chaos(cfg, "post_execute")
+                return {"ok": True, "segments": segments, "stats": stats,
+                        "gen": self.generation,
+                        "sessions": len(self._sessions),
+                        "queue_depth": self._queue_depth,
+                        "init_phase_s": init_s,
+                        "device_runs": getattr(compiled, "tpu_count", 0),
+                        "cpu_fallbacks": getattr(compiled, "fallback_count", 0),
+                        }, resp_body
+        except Exception:  # noqa: BLE001 — serde/compile failures pre-exec
+            return {"ok": False, "gen": self.generation,
+                    "error": traceback.format_exc(limit=10)}, b""
 
 
 # ------------------------------------------------------- Flight variant

@@ -29,6 +29,18 @@ co-locate, both sides of a co-partitioned join skew identically, and
 query results stay byte-identical while one partition absorbs the load.
 Deterministic fuel for the AQE skew-split defense (docs/aqe.md).
 
+Modes 'daemon_crash' and 'daemon_hang' fault the DEVICE-DAEMON process
+(docs/device_daemon.md#failure-domain) and never wrap the plan either —
+wrapping leaves would hide device stages from the chain matcher, and the
+fault must fire in the DAEMON's process, not the executor's. The session
+config carries the arming (`ballista.chaos.daemon.arm` picks the point:
+pre_execute / mid_execute / post_execute; `ballista.chaos.daemon.once`
+bounds it to the first armed request per socket) to the daemon, whose
+execute handler kills itself uncleanly (daemon_crash → os._exit(137)) or
+wedges until the execute watchdog fires (daemon_hang → diagnosed exit 4
+with a <socket>.crash.json post-mortem). Deterministic fuel for the
+crash-recovery / quarantine ladder in ops/tpu/daemon_route.py.
+
 Mode 'hbm_oom' is the other plan-wrapping exception: it faults the DEVICE memory path,
 which chaos cannot reach by wrapping plan leaves — the TPU engine seam
 runs after chaos injection, and a ChaosExec-wrapped scan would hide the
@@ -238,10 +250,10 @@ def maybe_inject_chaos(plan: ExecutionPlan, config: BallistaConfig, stage_attemp
     enabled = bool(config.get(CHAOS_ENABLED))
     mode = str(config.get(CHAOS_MODE)) if enabled else ""
     _sync_hbm_chaos(enabled, mode)
-    if not enabled or mode in ("hbm_oom", "skew"):
-        # hbm_oom and skew never wrap the plan (see module docstring): those
-        # faults live in the device upload path / the shuffle partitioner,
-        # not in leaf execution
+    if not enabled or mode in ("hbm_oom", "skew", "daemon_crash", "daemon_hang"):
+        # these modes never wrap the plan (see module docstring): the
+        # faults live in the device upload path / the shuffle partitioner /
+        # the device-daemon process, not in leaf execution
         return plan
     seed = int(config.get(CHAOS_SEED))
     prob = float(config.get(CHAOS_PROBABILITY))

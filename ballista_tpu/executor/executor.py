@@ -119,9 +119,14 @@ class Executor:
         # process once the executor-wide capacity is known
         self.session_pools = None  # SessionPoolRegistry | None
         # direct-dispatch lease enforcement: the scheduler pushes grants/
-        # revocations here; admit() gates every scheduler-less task
+        # revocations here; admit() gates every scheduler-less task. The
+        # generation probe fences leases against a silently restarted
+        # device daemon (jax-free: the client module only reads its
+        # attach cache)
+        from ballista_tpu.device_daemon import client as _dclient
         from ballista_tpu.serving.lease import LeaseTable
-        self.lease_table = LeaseTable()
+        self.lease_table = LeaseTable(
+            generation_probe=_dclient.attached_generation)
         self._warned_tpu_downgrade = False
         # process-isolated tasks currently inflight (spill budget is split
         # across them; see process_worker.run_task_in_subprocess)

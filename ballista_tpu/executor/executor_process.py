@@ -359,6 +359,19 @@ class ExecutorProcess:
         if "daemon_queue_depth" in stats:
             out.append(("daemon_queue_depth",
                         float(stats["daemon_queue_depth"])))
+        # daemon failure-domain recovery counters (ops/tpu/daemon_route.py
+        # mirrors the client's process-lifetime totals into RUN_STATS);
+        # RUN_STATS names, no tpu_ prefix — they count daemon incarnations
+        # and quarantine events, not this executor's device work
+        if "daemon_restarts" in stats:
+            out.append(("daemon_restarts", float(stats["daemon_restarts"])))
+        if "daemon_crashes_detected" in stats:
+            out.append(("daemon_crashes_detected",
+                        float(stats["daemon_crashes_detected"])))
+        if "watchdog_kills" in stats:
+            out.append(("watchdog_kills", float(stats["watchdog_kills"])))
+        if "poisoned_stages" in stats:
+            out.append(("poisoned_stages", float(stats["poisoned_stages"])))
         if "mesh_mode_reason" in stats:
             # gauges are floats: 1 = the collective exchange ran on-device,
             # 0 = demoted to the host split (the string reason stays in

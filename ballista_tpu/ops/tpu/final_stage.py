@@ -267,6 +267,17 @@ class TpuFinalStageExec(ExecutionPlan):
 
         with self._results_lock:
             if self._results is None:
+                # protected-surface routing (docs/device_daemon.md): ship
+                # the whole final-merge stage to the warm daemon first; the
+                # route's failure domain (crash retry, poison quarantine)
+                # demotes to the local attempt below by returning None
+                routed = self._daemon_run_all(ctx)
+                if routed is not None:
+                    self._results = routed
+                    self.tpu_count += 1
+                    self._device_ok = True
+                    self._mat_input = None
+            if self._results is None:
                 try:
                     with device_scope(ctx.device_ordinal):
                         self._results = self._tpu_run_all(ctx)
@@ -352,6 +363,22 @@ class TpuFinalStageExec(ExecutionPlan):
         self._served_since_dispatch.add(partition)
         if self._results and set(self._results) <= self._served_since_dispatch:
             self._results = {}
+
+    def _daemon_run_all(self, ctx: TaskContext):
+        """Route the final-merge stage through the device daemon.
+        unwrap_device_stages rebuilds the raw sort/post_ops/agg subtree
+        from this wrapper (re-adding the CoalescePartitionsExec the
+        matcher consumed), so the daemon re-derives the identical stage —
+        byte parity and stable compile-cache keys by construction."""
+        from ballista_tpu.ops.tpu import daemon_route
+
+        return daemon_route.run_via_daemon(
+            self.config,
+            plan_builder=lambda: self,
+            partitions=list(range(self.output_partition_count())),
+            tag=daemon_route.stage_tag("final", self.fingerprint),
+            fingerprint=self.fingerprint,
+            est_bytes=int(getattr(self, "hbm_observed_input_bytes", 0) or 0))
 
     def _materialized_scan(self):
         """Build (once) a MemoryScanExec over the child output a declined
@@ -457,9 +484,16 @@ class TpuFinalStageExec(ExecutionPlan):
             bypass = True
         P_in = child.output_partition_count()
 
+        # the session quota is thread-local (one-handler-thread-per-request
+        # in the daemon); re-scope it on the pool threads or a daemon-routed
+        # final stage would run its inner partials with no ceiling
+        from ballista_tpu.ops.tpu import hbm
+        quota = hbm.active_session_quota()
+
         def read(p):
-            return _concat([b for b in child.execute(p, ctx) if b.num_rows],
-                           child.schema())
+            with hbm.session_quota(quota):
+                return _concat([b for b in child.execute(p, ctx) if b.num_rows],
+                               child.schema())
 
         with fut.ThreadPoolExecutor(max_workers=min(max(P_in, 1), 8)) as pool:
             tables = list(pool.map(read, range(P_in)))

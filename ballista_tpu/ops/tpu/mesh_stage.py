@@ -176,6 +176,10 @@ class MeshExchangeExec(ExecutionPlan):
     def _exchange(self, ctx: TaskContext) -> list[list[pa.RecordBatch]]:
         from ballista_tpu.ops.tpu.stage_compiler import RUN_STATS
 
+        routed = self._daemon_exchange(ctx)
+        if routed is not None:
+            return routed
+
         part_tables: list[pa.Table] = []
         schema = self.producer.schema()
         for p in range(self.producer.output_partition_count()):
@@ -192,6 +196,29 @@ class MeshExchangeExec(ExecutionPlan):
                 buckets = self._host_split(part_tables)
             RUN_STATS.set("mesh_mode_reason", reason, rec=rec)
         return buckets
+
+    def _daemon_exchange(self, ctx: TaskContext):
+        """Route the whole mesh-wide stage (producer partitions + fused
+        exchange) through the device daemon, which owns the device span
+        the mesh pins. The request tag stays "mesh_exchange" so the
+        daemon's mirrored rec — mesh_mode_reason included, capacity/dtype
+        demotions and all — lands under the SAME stage key local runs
+        use. None = run locally (daemon off, crashed out, quarantined, or
+        an AQE veto already demoted the exchange)."""
+        if self.demote_reason:
+            return None
+        from ballista_tpu.ops.tpu import daemon_route
+
+        fp = f"{self.node_str()}|{self.producer.node_str()}"
+        results = daemon_route.run_via_daemon(
+            ctx.config,
+            plan_builder=lambda: self,
+            partitions=list(range(self.file_partitions)),
+            tag="mesh_exchange",
+            fingerprint=fp)
+        if results is None:
+            return None
+        return [results.get(p, []) for p in range(self.file_partitions)]
 
     # -- demotion ladder -------------------------------------------------
 

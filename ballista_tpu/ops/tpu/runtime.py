@@ -137,6 +137,23 @@ def device_kind() -> str:
         return "cpu"
 
 
+def process_rusage() -> dict:
+    """Resource-usage snapshot of THIS process for post-mortem artifacts
+    (the device daemon's crash report): peak RSS and CPU split. jax-free
+    and never raises — diagnostics must not add failure modes."""
+    try:
+        import resource
+
+        ru = resource.getrusage(resource.RUSAGE_SELF)
+        return {
+            "max_rss_kb": int(ru.ru_maxrss),
+            "user_s": round(ru.ru_utime, 3),
+            "system_s": round(ru.ru_stime, 3),
+        }
+    except Exception:  # noqa: BLE001 — platform without getrusage
+        return {}
+
+
 # ---------------------------------------------------------------- binding
 # Per-chip executor pinning (SURVEY §7 step 7: one executor per chip,
 # scheduler slot = chip; reference analog: the vcore slot model in

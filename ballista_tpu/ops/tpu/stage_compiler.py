@@ -155,7 +155,16 @@ class RunStats(Mapping):
     to); the numeric twins daemon_attached / daemon_sessions /
     daemon_queue_depth and the daemon's per-phase init timings
     init_platform_probe_s / init_jax_devices_s / init_first_compile_s
-    flow to the executor heartbeat as gauges. AQE decision counters
+    flow to the executor heartbeat as gauges. Daemon failure-domain
+    outcomes (ops/tpu/daemon_route.py,
+    docs/device_daemon.md#failure-domain): daemon_failover
+    ("daemon_restarted" when a crash was recovered by respawn+retry,
+    "crashed" when the retry also died, "poisoned" when the stage sits
+    in — or just entered — the on-disk quarantine) with the narrative in
+    daemon_failover_reason, plus the process-lifetime recovery counters
+    daemon_restarts / daemon_crashes_detected / watchdog_kills /
+    poisoned_stages mirrored from the daemon client into the merged view
+    so they ride the heartbeat. AQE decision counters
     (ops/tpu/aqe_stats.py, docs/aqe.md): skew_splits (hot reduce
     partitions split into slice tasks), coalesced_partitions (reduce
     partitions merged away), broadcast_promotions / broadcast_demotions
@@ -850,59 +859,21 @@ class TpuStageExec(ExecutionPlan):
         encoding, that chain round-trips) goes over the socket and the
         daemon runs it through the same maybe_compile_tpu entry, so an
         attached result is byte-identical to an in-process one by
-        construction. Returns None to mean 'run locally' (daemon disabled,
-        unreachable, or failed mid-request) with the reason in RUN_STATS
-        daemon_mode/daemon_mode_reason; a reachable daemon's engine stats
-        for the run are mirrored into this process's RUN_STATS so the
-        heartbeat and bench artifacts still see the device work."""
-        from ballista_tpu.config import TPU_DAEMON_ENABLED
+        construction. The whole failure domain — derived execute deadline,
+        crash detection, respawn-and-retry, poison quarantine — lives in
+        daemon_route.run_via_daemon; None means 'run locally' with the
+        reason in RUN_STATS daemon_mode/daemon_mode_reason."""
+        from ballista_tpu.ops.tpu import daemon_route
 
-        if not bool(self.config.get(TPU_DAEMON_ENABLED)):
-            return None
-        from ballista_tpu.device_daemon import client as daemon_client
-
-        tag = f"stage_{zlib.crc32(self.fingerprint.encode()):08x}"
-        client, mode, reason = daemon_client.attach(self.config)
-        if client is None:
-            RUN_STATS.set("daemon_mode", mode)
-            RUN_STATS.set("daemon_mode_reason", reason)
-            RUN_STATS.set("daemon_attached", 0.0)
-            log.info("daemon unavailable (%s); running stage in-process", reason)
-            return None
-        try:
-            from ballista_tpu import serde
-
-            raw = self.partial_agg.with_children([self._raw_chain()])
-            plan_bytes = serde.plan_to_bytes(raw)
-            partitions = list(range(self.scan.output_partition_count()))
-            results, resp = client.execute(
-                plan_bytes, self.config.to_key_value_pairs(), partitions,
-                emit_pid=self.emit_pid, tag=tag)
-        except Exception as e:  # noqa: BLE001 — the daemon must never fail
-            # a query the in-process engine can run
-            RUN_STATS.set("daemon_mode", "in_process")
-            RUN_STATS.set("daemon_mode_reason", f"execute_failed: {e}"[:300])
-            RUN_STATS.set("daemon_attached", 0.0)
-            log.warning("daemon execute failed; running stage in-process",
-                        exc_info=True)
-            return None
-        with RUN_STATS.run(tag) as rec:
-            for k, v in resp.get("stats", {}).items():
-                if isinstance(v, (int, float, str, bool)):
-                    rec[k] = v
-            rec["daemon_mode"] = "attached"
-            rec["daemon_mode_reason"] = reason
-            rec["daemon_attached"] = 1.0
-            rec["daemon_sessions"] = float(resp.get("sessions", 0))
-            rec["daemon_queue_depth"] = float(resp.get("queue_depth", 0))
-            init_s = resp.get("init_phase_s", {})
-            if "platform_probe" in init_s:
-                rec["init_platform_probe_s"] = float(init_s["platform_probe"])
-            if "jax_devices" in init_s:
-                rec["init_jax_devices_s"] = float(init_s["jax_devices"])
-            if "first_compile" in init_s:
-                rec["init_first_compile_s"] = float(init_s["first_compile"])
-        return results
+        return daemon_route.run_via_daemon(
+            self.config,
+            plan_builder=lambda: self.partial_agg.with_children(
+                [self._raw_chain()]),
+            partitions=list(range(self.scan.output_partition_count())),
+            tag=daemon_route.stage_tag("stage", self.fingerprint),
+            fingerprint=self.fingerprint,
+            emit_pid=self.emit_pid,
+            est_bytes=int(getattr(self, "hbm_observed_input_bytes", 0) or 0))
 
     def _raw_chain(self) -> ExecutionPlan:
         """The original pre-aggregation subtree this wrapper replaced,

@@ -17,10 +17,14 @@ Three parties, three structures:
   construction, verified by `analysis.plan_check.verify_lease_bands`),
   expiry sweeping, revocation, and dispatch accounting for KEDA.
 - `LeaseTable` — executor side: admits a direct task only when the lease
-  is known, unexpired, unrevoked, inside its band, and under its
-  concurrency slice. A rejection reason string is the demotion signal —
-  the client falls back to the scheduled graph path, which produces
-  byte-identical results.
+  is known, unexpired, unrevoked, inside its band, under its concurrency
+  slice, and — when the executor runs attached to a device daemon —
+  only while the daemon's boot generation still matches the one the
+  grant was stamped with ("stale-daemon-generation" fences dispatch
+  against a silently restarted daemon; see
+  docs/device_daemon.md#failure-domain). A rejection reason string is
+  the demotion signal — the client falls back to the scheduled graph
+  path, which produces byte-identical results.
 
 Task ids: graph tasks stay below `FAST_TASK_ID_BASE` (1_000_000),
 fast-lane tasks live in [FAST_TASK_ID_BASE, DIRECT_TASK_ID_BASE), and
@@ -62,6 +66,13 @@ class ExecutorLease:
     band_start: int
     band_size: int
     revoked: bool = False
+    # device-daemon boot generation the warm capacity was promised
+    # against ("" = unfenced). A daemon that silently restarted between
+    # grant and dispatch has cold caches and a different failure history;
+    # the executor's LeaseTable compares this token against its live
+    # attachment and demotes mismatched dispatches to the scheduled path
+    # (docs/device_daemon.md#failure-domain).
+    daemon_generation: str = ""
     # client-side band cursor / executor-side accounting
     next_offset: int = 0
     inflight: int = 0
@@ -98,6 +109,7 @@ class ExecutorLease:
             "session_id": self.session_id, "slots": self.slots,
             "expires_at": self.expires_at,
             "band_start": self.band_start, "band_size": self.band_size,
+            "daemon_generation": self.daemon_generation,
         }
 
     @classmethod
@@ -108,6 +120,7 @@ class ExecutorLease:
             session_id=str(d.get("session_id", "")), slots=int(d["slots"]),
             expires_at=float(d["expires_at"]),
             band_start=int(d["band_start"]), band_size=int(d["band_size"]),
+            daemon_generation=str(d.get("daemon_generation", "")),
         )
 
     def clone(self) -> "ExecutorLease":
@@ -139,7 +152,8 @@ class LeaseRegistry:
 
     def mint(self, executor_id: str, host: str, flight_port: int,
              session_id: str, slots: int, ttl_s: float,
-             band_size: int | None = None) -> ExecutorLease:
+             band_size: int | None = None,
+             daemon_generation: str = "") -> ExecutorLease:
         size = self.default_band_size if band_size is None else int(band_size)
         with self._lock:
             self._seq += 1
@@ -151,6 +165,7 @@ class LeaseRegistry:
                 session_id=session_id, slots=max(1, int(slots)),
                 expires_at=time.time() + ttl_s,
                 band_start=band_start, band_size=size,
+                daemon_generation=daemon_generation,
             )
             self._leases[lease.lease_id] = lease
             self.minted += 1
@@ -223,15 +238,33 @@ class LeaseTable:
     direct-dispatch task on validity, band membership, and the lease's
     concurrency slice. Counters ride the executor heartbeat."""
 
-    def __init__(self):
+    def __init__(self, generation_probe=None):
         self._lock = threading.Lock()
         self._leases: dict[str, ExecutorLease] = {}
         self.tasks_total = 0  # direct_dispatch_tasks heartbeat gauge
         self.rejections = 0
+        # () -> str: the live device-daemon generation this executor is
+        # attached to ("" when unattached). Grants are stamped with it and
+        # admit re-probes — a silently restarted daemon fails the fence.
+        self._generation_probe = generation_probe
+
+    def _probe_generation(self) -> str:
+        if self._generation_probe is None:
+            return ""
+        try:
+            return str(self._generation_probe() or "")
+        except Exception:  # noqa: BLE001 — fencing must not break admits
+            return ""
 
     def grant(self, lease: ExecutorLease) -> None:
+        granted = lease.clone()
+        if not granted.daemon_generation:
+            # scheduler minted without a generation (it cannot see this
+            # executor's daemon): stamp the live one at grant time, so
+            # the fence measures drift from THIS moment
+            granted.daemon_generation = self._probe_generation()
         with self._lock:
-            self._leases[lease.lease_id] = lease.clone()
+            self._leases[lease.lease_id] = granted
 
     def revoke(self, lease_id: str) -> None:
         with self._lock:
@@ -250,6 +283,14 @@ class LeaseTable:
             reason = lease.rejection()
             if reason is None and not lease.owns_task_id(task_id):
                 reason = "band-violation"
+            if reason is None and lease.daemon_generation:
+                live = self._probe_generation()
+                if live != lease.daemon_generation:
+                    # the daemon restarted (or detached) since the grant:
+                    # the warm capacity this lease promised is gone, and a
+                    # replayed poison stage would meet an unfenced daemon.
+                    # Demote to the scheduled path — byte-identical there.
+                    reason = "stale-daemon-generation"
             if reason is None and lease.inflight >= lease.slots:
                 reason = "capacity"
             if reason is not None:

@@ -70,6 +70,13 @@ each daemon's structured probe report under "init_probe": which phase,
 how long, and a faulthandler stack snapshot of the hang — the claim is
 diagnosed per-phase instead of re-timed-out (the retired
 "skipped_init_timeout" state said only that time passed).
+
+With BALLISTA_BENCH_DAEMON_CHAOS=1 the device leg additionally runs
+`dev/daemon_chaos_exercise.py --quick` as a sanity probe before the
+timed iterations: the daemon failure domain (crash recovery, execute
+watchdog, poison quarantine — docs/device_daemon.md#failure-domain)
+must hold on this machine before the bench trusts the daemon with the
+real run. Divergence fails the leg (exit 5, chaos_smoke_failed event).
 """
 
 import json
@@ -259,6 +266,25 @@ def device_leg_main(out_path: str, progress_path: str, ready_path: str,
     leg_cfg = ready["fallback"] if use_fallback else ready["primary"]
     progress("data_ready_seen", scale=leg_cfg["scale"],
              fallback=bool(use_fallback))
+
+    if os.environ.get("BALLISTA_BENCH_DAEMON_CHAOS") == "1":
+        # opt-in sanity probe: the daemon failure domain (crash recovery,
+        # watchdog, poison quarantine) must hold on THIS machine before
+        # the timed iterations trust the daemon with the real run. The
+        # probe runs in a subprocess on its own sockets — it never
+        # touches this leg's daemon — and exits nonzero on divergence.
+        progress("chaos_smoke_start")
+        r = subprocess.run(
+            [sys.executable,
+             os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "dev", "daemon_chaos_exercise.py"), "--quick"],
+            capture_output=True, text=True,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"))
+        if r.returncode != 0:
+            progress("chaos_smoke_failed", exit_code=r.returncode,
+                     tail=(r.stdout + r.stderr)[-1500:])
+            sys.exit(5)
+        progress("chaos_smoke_ok")
 
     def run(cfg) -> float:
         from ballista_tpu.config import (

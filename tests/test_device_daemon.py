@@ -18,9 +18,14 @@ import pyarrow as pa
 import pytest
 
 from ballista_tpu.config import (
+    CHAOS_DAEMON_ARM,
+    CHAOS_DAEMON_ONCE,
+    CHAOS_ENABLED,
+    CHAOS_MODE,
     EXECUTOR_ENGINE,
     TPU_DAEMON_ATTACH_TIMEOUT_MS,
     TPU_DAEMON_ENABLED,
+    TPU_DAEMON_EXECUTE_TIMEOUT_S,
     TPU_DAEMON_SESSION_QUOTA_BYTES,
     TPU_DAEMON_SOCKET,
     TPU_DAEMON_SPAWN,
@@ -277,3 +282,198 @@ def test_clear_device_caches_routes_to_daemon(daemon):
     after = client.status()
     assert after["clear_count"] == clears + 1
     assert after["compiled_entries"] == 0
+
+
+# ------------------------------------------------------- failure domain
+
+def _ipc_bytes(t):
+    sink = io.BytesIO()
+    with pa.ipc.new_stream(sink, t.schema) as w:
+        w.write_table(t)
+    return sink.getvalue()
+
+
+def _shutdown_daemon(sock):
+    """Best-effort cleanup of a per-test daemon (alive or already dead)."""
+    try:
+        dclient.DaemonClient(sock, timeout_s=5.0).shutdown()
+    except Exception:  # noqa: BLE001 — a corpse is fine, that's the point
+        pass
+
+
+def _chaos_cfg(sock, mode, arm="mid_execute", once=True, **extra):
+    # spawn=True so the respawn-and-retry leg of the ladder can bring a
+    # fresh daemon back after the injected crash; generous attach timeout
+    # because each respawn pays a cold jax-CPU init
+    return _daemon_cfg(sock, **{
+        TPU_DAEMON_SPAWN: True, TPU_DAEMON_ATTACH_TIMEOUT_MS: 60_000,
+        CHAOS_ENABLED: True, CHAOS_MODE: mode,
+        CHAOS_DAEMON_ARM: arm, CHAOS_DAEMON_ONCE: once, **extra})
+
+
+def test_derived_execute_deadline():
+    assert dproto.derive_execute_timeout_s(120, 0) == 120.0
+    # +1s per 16 MiB of stage input
+    assert dproto.derive_execute_timeout_s(120, 1 << 30) == 184.0
+    assert dproto.derive_execute_timeout_s(10, 1 << 40) == 80.0  # cap: 8x floor
+    assert dproto.derive_execute_timeout_s(0, 0) == 1.0  # floor clamp
+
+
+def test_generation_token_minted_and_echoed(daemon):
+    sock, client = daemon
+    gen = client.ping().get("gen")
+    assert gen  # minted at bind time
+    assert client.status().get("gen") == gen
+    cfg = BallistaConfig(_daemon_cfg(sock))
+    c, mode, _ = dclient.attach(cfg)
+    assert mode == "attached"
+    assert dclient.attached_generation(sock) == gen
+
+
+def test_watchdog_kills_wedged_execute(tmp_path):
+    """daemon_hang wedges the execute thread before serde decode; the
+    watchdog overruns the shipped deadline, writes the post-mortem, and
+    exits 4 — the client sees a typed DaemonCrashed."""
+    sock = str(tmp_path / "hang.sock")
+    # a leftover post-mortem from a previous corpse must not survive a
+    # fresh bind (it would misclassify the NEXT crash as a watchdog kill)
+    with open(dproto.crash_report_path(sock), "w") as f:
+        f.write("{}")
+    proc, client = _spawn_and_wait(sock)
+    try:
+        assert not os.path.exists(dproto.crash_report_path(sock))
+        gen = client.ping()["gen"]
+        cfg = BallistaConfig(_chaos_cfg(sock, "daemon_hang", arm="pre_execute"))
+        with pytest.raises(dclient.DaemonCrashed):
+            client.execute(b"never-decoded", cfg.to_key_value_pairs(), [0],
+                           tag="stage_deadbeef", deadline_s=2.0)
+        assert proc.wait(timeout=30) == 4  # diagnosed death, not a raw abort
+        report = dclient.read_crash_report(sock)
+        assert report is not None
+        assert report["kind"] == "watchdog"
+        assert report["generation"] == gen
+        # the offending request header rode into the post-mortem — minus
+        # the bulky config pairs
+        assert report["request"]["tag"] == "stage_deadbeef"
+        assert "pairs" not in report["request"]
+        assert report["deadline_s"] == 2.0
+        assert report["stacks"]  # every thread's stack, via faulthandler
+    finally:
+        _shutdown_daemon(sock)
+
+
+@pytest.mark.parametrize("mode", ["daemon_crash", "daemon_hang"])
+def test_crash_recovery_respawn_byte_parity(tmp_path, mode):
+    """One injected daemon death mid-query (SIGKILL-style exit or a hang
+    the watchdog converts to one): the stage ladder respawns, retries
+    once, and the answer is byte-identical to the in-process run."""
+    sock = str(tmp_path / f"{mode}.sock")
+    tbl = _table()
+    base, _ = _run_query(tbl)
+    dclient.reset_failure_counters()
+    extra = {}
+    if mode == "daemon_hang":
+        # short deadline so the watchdog converts the hang into a death
+        # quickly; roomy enough that the retry's recompile+execute fits
+        extra[TPU_DAEMON_EXECUTE_TIMEOUT_S] = 12
+    try:
+        out, stats = _run_query(tbl, **_chaos_cfg(sock, mode, **extra))
+        assert out.equals(base)
+        assert _ipc_bytes(out) == _ipc_bytes(base)
+        c = dclient.failure_counters()
+        assert c["daemon_crashes_detected"] >= 1
+        assert c["daemon_restarts"] >= 1  # the respawn leg recovered it
+        assert c["poisoned_stages"] == 0  # once-armed: no quarantine
+        if mode == "daemon_hang":
+            # classified from the <socket>.crash.json post-mortem
+            assert c["watchdog_kills"] >= 1
+        # the recovery is visible in the run's stats (→ heartbeat gauges)
+        assert stats.get("daemon_restarts", 0) >= 1
+        import ballista_tpu.ops.tpu.stage_compiler as sc
+        recs = sc.RUN_STATS.stages().values()
+        assert any(r.get("daemon_failover") == "daemon_restarted"
+                   for r in recs)
+    finally:
+        _shutdown_daemon(sock)
+
+
+def test_poison_quarantine_demotes_after_second_crash(tmp_path):
+    """Without once-arming every daemon incarnation dies on the stage:
+    the second crash per fingerprint quarantines it and the stage demotes
+    to the in-process ladder — byte-identically, with no crash loop."""
+    sock = str(tmp_path / "poison.sock")
+    tbl = _table()
+    base, _ = _run_query(tbl)
+    dclient.reset_failure_counters()
+    try:
+        out, stats = _run_query(
+            tbl, **_chaos_cfg(sock, "daemon_crash", once=False))
+        assert out.equals(base)
+        assert _ipc_bytes(out) == _ipc_bytes(base)
+        c = dclient.failure_counters()
+        assert c["daemon_crashes_detected"] >= 2
+        assert c["poisoned_stages"] >= 1
+        assert stats.get("daemon_failover") == "poisoned"
+        # the quarantine is on disk, keyed by stage tag, TTL'd
+        entries = json.load(
+            open(dproto.poison_path(sock))).get("entries", {})
+        assert any(t.startswith("stage_") for t in entries)
+        assert all(e["crashes"] >= dclient.POISON_CRASH_THRESHOLD
+                   for e in entries.values())
+        # second run: quarantined stages demote WITHOUT touching a daemon
+        # (no new crashes, no respawn storm — the loop is broken)
+        before = dclient.failure_counters()["daemon_crashes_detected"]
+        out2, stats2 = _run_query(
+            tbl, **_chaos_cfg(sock, "daemon_crash", once=False))
+        assert _ipc_bytes(out2) == _ipc_bytes(base)
+        assert stats2.get("daemon_mode") == "in_process"
+        assert stats2.get("daemon_failover") == "poisoned"
+        assert dclient.failure_counters()["daemon_crashes_detected"] == before
+    finally:
+        _shutdown_daemon(sock)
+        dclient.clear_poison(sock)
+
+
+def test_poison_entries_expire_after_ttl(tmp_path):
+    sock = str(tmp_path / "ttl.sock")
+    assert dclient.record_stage_crash(sock, "stage_oldwound", "fp", 600) == 1
+    assert not dclient.is_poisoned(sock, "stage_oldwound", 600)  # 1 < threshold
+    assert dclient.record_stage_crash(sock, "stage_oldwound", "fp", 600) == 2
+    assert dclient.is_poisoned(sock, "stage_oldwound", 600)
+    # age the entry past the TTL window: the quarantine lifts
+    p = dproto.poison_path(sock)
+    data = json.load(open(p))
+    data["entries"]["stage_oldwound"]["updated"] = time.time() - 10_000
+    with open(p, "w") as f:
+        json.dump(data, f)
+    assert not dclient.is_poisoned(sock, "stage_oldwound", 600)
+    # and the count restarts from scratch — old crashes don't haunt
+    assert dclient.record_stage_crash(sock, "stage_oldwound", "fp", 600) == 1
+
+
+def test_lease_stale_generation_fences_direct_dispatch():
+    from ballista_tpu.serving.lease import LeaseRegistry, LeaseTable
+
+    live = {"gen": "boot-1"}
+    table = LeaseTable(generation_probe=lambda: live["gen"])
+    reg = LeaseRegistry()
+    lease = reg.mint("exec-1", "h", 50050, "s", slots=2, ttl_s=30.0)
+    assert lease.daemon_generation == ""  # scheduler can't see the daemon
+    # the generation survives the wire round trip (Flight action body)
+    from ballista_tpu.serving.lease import ExecutorLease
+    assert ExecutorLease.from_wire(lease.to_wire()).daemon_generation == ""
+    table.grant(lease)  # executor stamps its live generation at grant
+    tid = lease.take_task_id()
+    assert table.admit(lease.lease_id, tid) is None
+    table.release(lease.lease_id)
+    live["gen"] = "boot-2"  # the daemon silently restarted
+    tid2 = lease.take_task_id()
+    assert table.admit(lease.lease_id, tid2) == "stale-daemon-generation"
+    assert table.rejections >= 1
+    # an unfenced lease (executor not attached at grant time) never fences
+    live["gen"] = ""
+    table2 = LeaseTable(generation_probe=lambda: live["gen"])
+    lease2 = reg.mint("exec-2", "h", 50051, "s", slots=2, ttl_s=30.0)
+    table2.grant(lease2)
+    live["gen"] = "boot-9"
+    assert table2.admit(lease2.lease_id, lease2.take_task_id()) is None
