@@ -109,6 +109,7 @@ CHAOS_STRAGGLER_STAGE = "ballista.chaos.straggler.stage"
 CHAOS_SKEW_FRACTION = "ballista.chaos.skew.fraction"
 CHAOS_DAEMON_ARM = "ballista.chaos.daemon.arm"
 CHAOS_DAEMON_ONCE = "ballista.chaos.daemon.once"
+CHAOS_DISK_ONCE = "ballista.chaos.disk.once"
 # straggler defense (speculation / deadlines)
 SPECULATION_ENABLED = "ballista.scheduler.speculation.enabled"
 SPECULATION_QUANTILE = "ballista.scheduler.speculation.quantile"
@@ -124,6 +125,11 @@ REPARTITION_AGGREGATIONS = "ballista.repartition.aggregations"
 PARQUET_PRUNING = "ballista.parquet.pruning"
 EXECUTOR_ENGINE = "ballista.executor.engine"
 EXECUTOR_TASK_ISOLATION = "ballista.executor.task.isolation"
+# executor lifecycle & storage failure domain (docs/lifecycle.md)
+EXECUTOR_DISK_LOW_WATERMARK = "ballista.executor.disk.low.watermark"
+EXECUTOR_DISK_HIGH_WATERMARK = "ballista.executor.disk.high.watermark"
+EXECUTOR_DATA_TTL_S = "ballista.executor.data.ttl.seconds"
+EXECUTOR_DRAIN_TIMEOUT_S = "ballista.executor.drain.timeout.seconds"
 # TPU-native knobs
 TPU_SHAPE_BUCKETS = "ballista.tpu.shape.buckets"
 TPU_MAX_DEVICE_BYTES = "ballista.tpu.max.device.bytes"
@@ -580,10 +586,21 @@ _ENTRIES: list[ConfigEntry] = [
         "DaemonCrashed → respawn-and-retry → poison-quarantine ladder is "
         "exercised end to end; daemon_hang wedges the execute thread so the "
         "per-request watchdog trips, writes the <socket>.crash.json "
-        "post-mortem, and exits 4 (docs/device_daemon.md#failure-domain).",
+        "post-mortem, and exits 4 (docs/device_daemon.md#failure-domain). "
+        "'disk_full' faults the STORAGE path (no plan wrapping): the shuffle "
+        "writer's commit points and the spill pool's disk demotions raise a "
+        "typed DiskExhausted on a seeded roll keyed by (seed, job, stage, "
+        "partition) — with ballista.chaos.disk.once (the default) a hit is "
+        "recorded so the retried slice heals, proving an injected ENOSPC "
+        "fails no job. 'drain_kill' faults the graceful-drain state machine "
+        "(no plan wrapping): armed via env on the scheduler side — "
+        "BALLISTA_CHAOS_DRAIN_KILL_AFTER=N aborts a drain's shuffle-output "
+        "migration after N committed locations, exercising the hard-kill "
+        "fallback to the executor-lost recompute path (docs/lifecycle.md).",
         str, "transient",
         choices=("transient", "fatal", "panic", "delay", "straggler", "overload",
-                 "corrupt", "hbm_oom", "skew", "daemon_crash", "daemon_hang"),
+                 "corrupt", "hbm_oom", "skew", "daemon_crash", "daemon_hang",
+                 "disk_full", "drain_kill"),
     ),
     ConfigEntry(
         CHAOS_STRAGGLER_DELAY_S,
@@ -635,6 +652,15 @@ _ENTRIES: list[ConfigEntry] = [
         "respawn-and-retry recovery path succeeds deterministically. False "
         "= every incarnation dies, which exercises the poison-stage "
         "quarantine instead.",
+        bool, True,
+    ),
+    ConfigEntry(
+        CHAOS_DISK_ONCE,
+        "chaos mode=disk_full: inject the ENOSPC only on the FIRST hit per "
+        "(job, stage, partition) slice — the retry of the failed task finds "
+        "the recorded marker and heals, modelling transient disk pressure. "
+        "False = every attempt re-rolls (the attempt joins the seed key, so "
+        "a retry sees different luck).",
         bool, True,
     ),
     ConfigEntry(
@@ -1021,6 +1047,48 @@ _ENTRIES: list[ConfigEntry] = [
         int, 600, _pos,
     ),
     ConfigEntry(
+        EXECUTOR_DISK_LOW_WATERMARK,
+        "Low disk-pressure watermark: when the used fraction of the "
+        "executor work-dir filesystem (shutil.disk_usage) reaches this "
+        "level, the executor SHEDS SPILL ADMISSION — the sort-shuffle "
+        "writer stops demoting buffers to disk (falling back to the "
+        "in-memory overcommit ladder) and the HBM spill pool keeps cold "
+        "entries in the host tier instead of taking the disk tier. "
+        "Queries keep running; only optional disk writes stop "
+        "(docs/lifecycle.md#watermark-ladder).",
+        float, 0.90, lambda v: 0.0 < v <= 1.0,
+    ),
+    ConfigEntry(
+        EXECUTOR_DISK_HIGH_WATERMARK,
+        "High disk-pressure watermark: at/above this used fraction the "
+        "executor REJECTS NEW TASK ADMISSION with a retryable "
+        "DiskExhausted (RESOURCE_EXHAUSTED semantics, riding the overload "
+        "machinery) — the scheduler re-pends the slice and the "
+        "per-executor disk gauges on the heartbeat steer placement toward "
+        "nodes with headroom. Must be >= the low watermark.",
+        float, 0.95, lambda v: 0.0 < v <= 1.0,
+    ),
+    ConfigEntry(
+        EXECUTOR_DATA_TTL_S,
+        "Orphaned-data GC TTL in seconds: the scheduler's fleet sweep "
+        "removes scheduler state AND fans RemoveJobData to every live "
+        "executor for jobs that have been terminal (successful / failed / "
+        "cancelled) longer than this; the executor-local work-dir sweep "
+        "uses the same horizon for job directories no live scheduler "
+        "claims. 0 disables the scheduler-driven sweep (the executor "
+        "work-dir TTL remains the backstop).",
+        int, 6 * 3600, _nonneg,
+    ),
+    ConfigEntry(
+        EXECUTOR_DRAIN_TIMEOUT_S,
+        "Graceful-drain budget in seconds: how long a drain waits for the "
+        "executor's running tasks to finish before giving up and falling "
+        "back to the executor-lost recompute path. The shuffle-output "
+        "migration that follows the wait is not itself bounded by this "
+        "(a partially migrated drain still saves the migrated stages).",
+        float, 30.0, _pos,
+    ),
+    ConfigEntry(
         DEBUG_PLAN_VERIFY,
         "Run the static plan verifier (analysis/plan_check.py) over every "
         "staged plan at submit time and after each AQE replan, failing the "
@@ -1100,6 +1168,31 @@ _ENV_KNOBS: list[EnvKnob] = [
         "into the probe report at <socket>.probe.json, then the daemon "
         "exits — a hung platform claim is diagnosed, never waited out.",
         int, 240,
+    ),
+    EnvKnob(
+        "BALLISTA_CHAOS_DRAIN_KILL_AFTER",
+        "chaos mode=drain_kill arming: abort a graceful drain's shuffle-"
+        "output migration after this many committed locations (simulating "
+        "a hard kill mid-drain; the scheduler falls back to the executor-"
+        "lost recompute path). 0 = disarmed. Env-only: the migration runs "
+        "in scheduler/launcher context, which has no session config.",
+        int, 0,
+    ),
+    EnvKnob(
+        "BALLISTA_BENCH_DAEMON_CHAOS",
+        "bench.py opt-in: run dev/daemon_chaos_exercise.py --quick in the "
+        "device leg as a sanity probe before the timed iterations (the "
+        "daemon failure domain must hold on this machine; divergence fails "
+        "the leg). Env-only: bench plumbing, not engine config.",
+        bool, False,
+    ),
+    EnvKnob(
+        "BALLISTA_BENCH_LIFECYCLE",
+        "bench.py opt-in: run dev/lifecycle_exercise.py --quick (graceful "
+        "drain / disk_full / rolling-restart smoke, docs/lifecycle.md) and "
+        "record the verdict under lifecycle_smoke in the bench artifact. "
+        "Env-only: bench plumbing, not engine config.",
+        bool, False,
     ),
     EnvKnob(
         "BALLISTA_TPU_DAEMON_IDLE_TIMEOUT_S",

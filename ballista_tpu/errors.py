@@ -92,6 +92,19 @@ class DataCorrupted(IoError):
         self.actual = actual
 
 
+class DiskExhausted(IoError):
+    """A shuffle write or spill demotion hit ENOSPC (or the executor's
+    high disk watermark). Retryable and blame-aware like `DataCorrupted`:
+    the failure names the WRITING executor's disk, so the scheduler
+    re-pends the partition and the per-executor disk gauges steer the
+    retry toward a node with headroom instead of hammering the full one."""
+
+    def __init__(self, where: str, detail: str = ""):
+        extra = f": {detail}" if detail else ""
+        super().__init__(f"disk exhausted at {where}{extra}")
+        self.where = where
+
+
 class ShortRead(IoError):
     """A requested shuffle byte range extends past the file's actual size
     (torn write, truncated disk, stale index). Typed and retryable so the
@@ -164,6 +177,8 @@ def error_to_proto_kind(err: BaseException) -> str:
         return "TaskKilled"
     if isinstance(err, DataCorrupted):
         return "DataCorrupted"
+    if isinstance(err, DiskExhausted):
+        return "DiskExhausted"
     if isinstance(err, (IoError, GrpcError)):
         return "IoError"
     if isinstance(err, ExecutionError):

@@ -41,6 +41,19 @@ wedges until the execute watchdog fires (daemon_hang → diagnosed exit 4
 with a <socket>.crash.json post-mortem). Deterministic fuel for the
 crash-recovery / quarantine ladder in ops/tpu/daemon_route.py.
 
+Mode 'disk_full' faults the STORAGE path and never wraps the plan: the
+shuffle writer's commit points and the spill pool's disk demotions poll
+`maybe_disk_full` below, which raises a typed DiskExhausted on a seeded
+roll keyed by (seed, job, stage, partition[, attempt]). With
+`ballista.chaos.disk.once` (the default) a hit is recorded so the
+RETRY of the same slice heals — the injected ENOSPC is transient
+storage pressure, and robustness tests assert no job ever fails to it.
+
+Mode 'drain_kill' faults the DRAIN state machine (docs/lifecycle.md):
+BALLISTA_CHAOS_DRAIN_KILL_AFTER=N makes a graceful drain's shuffle
+migration die after N committed locations (`drain_kill_after` below),
+exercising the hard-kill-mid-drain fallback to the recompute path.
+
 Mode 'hbm_oom' is the other plan-wrapping exception: it faults the DEVICE memory path,
 which chaos cannot reach by wrapping plan leaves — the TPU engine seam
 runs after chaos injection, and a ChaosExec-wrapped scan would hide the
@@ -100,6 +113,74 @@ def flip_bit(data: bytes, seed: int, key: str) -> bytes:
     out = bytearray(data)
     out[pos] ^= 1 << bit
     return bytes(out)
+
+
+# disk_full once-mode ledger: keys that already fired, so the RETRY of an
+# injected ENOSPC heals (the module's determinism principle, applied to a
+# fault whose whole point is "transient storage pressure"). Keyed without
+# the attempt so the marker survives into the retry.
+from ballista_tpu.utils.lru import LruDict
+
+_DISK_FULL_FIRED = LruDict(max_entries=4096)
+
+
+def disk_full_params(config: BallistaConfig) -> tuple[int, float, bool] | None:
+    """(seed, probability, once) when chaos mode=disk_full is armed, else
+    None. The shuffle writer and the spill pool poll this at their write
+    points — disk_full never wraps the plan (the fault lives in the
+    storage path, not leaf execution)."""
+    try:
+        if not bool(config.get(CHAOS_ENABLED)):
+            return None
+        if str(config.get(CHAOS_MODE)) != "disk_full":
+            return None
+        from ballista_tpu.config import CHAOS_DISK_ONCE
+
+        return (int(config.get(CHAOS_SEED)), float(config.get(CHAOS_PROBABILITY)),
+                bool(config.get(CHAOS_DISK_ONCE)))
+    except Exception:
+        return None
+
+
+def maybe_disk_full(config: BallistaConfig | None, job_id: str, stage_id: int,
+                    partition: int, attempt: int, where: str) -> None:
+    """Raise a synthetic DiskExhausted at a shuffle-write / spill-demote
+    point when chaos mode=disk_full rolls a hit. In once mode the roll is
+    keyed WITHOUT the attempt and a hit is recorded, so the retried task
+    finds the marker and heals; otherwise the attempt joins the key and a
+    retry simply sees different luck."""
+    if config is None:
+        return
+    params = disk_full_params(config)
+    if params is None:
+        return
+    seed, p, once = params
+    key = f"{job_id}|{stage_id}|{partition}"
+    if once:
+        if _DISK_FULL_FIRED.get(key) is not None:
+            return  # already failed this slice once: the retry heals
+        h = hashlib.sha256(f"{seed}|disk_full|{key}".encode()).digest()
+        if int.from_bytes(h[:8], "big") / 2**64 >= p:
+            return
+        _DISK_FULL_FIRED.setdefault(key, True)
+    else:
+        h = hashlib.sha256(f"{seed}|disk_full|{key}|{attempt}".encode()).digest()
+        if int.from_bytes(h[:8], "big") / 2**64 >= p:
+            return
+    from ballista_tpu.errors import DiskExhausted
+
+    raise DiskExhausted(where, "chaos: injected ENOSPC (os error 28)")
+
+
+def drain_kill_after() -> int:
+    """Chaos mode=drain_kill arming: BALLISTA_CHAOS_DRAIN_KILL_AFTER=N
+    hard-kills a drain's migration after N committed locations (0 =
+    disarmed). Env-armed like the serve-time corrupt knobs: the migration
+    runs in the scheduler/launcher context, which has no session config."""
+    try:
+        return int(os.environ.get("BALLISTA_CHAOS_DRAIN_KILL_AFTER", "0"))
+    except ValueError:
+        return 0
 
 
 def skew_params(config: BallistaConfig) -> tuple[int, float] | None:
@@ -250,10 +331,12 @@ def maybe_inject_chaos(plan: ExecutionPlan, config: BallistaConfig, stage_attemp
     enabled = bool(config.get(CHAOS_ENABLED))
     mode = str(config.get(CHAOS_MODE)) if enabled else ""
     _sync_hbm_chaos(enabled, mode)
-    if not enabled or mode in ("hbm_oom", "skew", "daemon_crash", "daemon_hang"):
+    if not enabled or mode in ("hbm_oom", "skew", "daemon_crash", "daemon_hang",
+                               "disk_full", "drain_kill"):
         # these modes never wrap the plan (see module docstring): the
         # faults live in the device upload path / the shuffle partitioner /
-        # the device-daemon process, not in leaf execution
+        # the device-daemon process / the storage and drain paths, not in
+        # leaf execution
         return plan
     seed = int(config.get(CHAOS_SEED))
     prob = float(config.get(CHAOS_PROBABILITY))

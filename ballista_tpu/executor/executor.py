@@ -110,6 +110,18 @@ class Executor:
         # already saturated (reported in heartbeats; scheduler retries
         # them elsewhere)
         self.pressure_rejections = 0
+        # lifecycle & storage counters (docs/lifecycle.md), mirrored onto
+        # the heartbeat by the executor process: tasks rejected past the
+        # disk high watermark, map outputs handed off by a drain, and
+        # bytes reclaimed by the GC sweeps
+        self.disk_rejections = 0
+        self.migrated_partitions = 0
+        self.migrated_bytes = 0
+        self.gc_reclaimed_bytes = 0
+        self.orphans_reclaimed = 0
+        # set while a drain is in progress (SIGTERM or scheduler-initiated);
+        # surfaces as lifecycle_state=draining on the heartbeat
+        self.draining = False
         self.memory_limit_per_task = 0  # bytes; set by the executor process
         # "thread" (in-process, shared GIL) or "process" (spawned worker per
         # task: true parallelism, crash isolation, preemptive cancel —
@@ -158,6 +170,8 @@ class Executor:
         if getattr(task, "fast_lane", False):
             self.fast_lane_tasks += 1
         rejected = self._reject_if_saturated(task)
+        if rejected is None:
+            rejected = self._reject_if_disk_full(task, cfg)
         if rejected is not None:
             return rejected
         iso = self.isolation
@@ -217,6 +231,32 @@ class Executor:
             error=(f"executor {self.metadata.id} rejected task at admission: "
                    f"session memory pool saturated ({pool.reserved}/{pool.capacity} bytes)"),
             error_kind="ResourceExhausted", retryable=True,
+        )
+
+    def _reject_if_disk_full(self, task: TaskDescription, cfg: BallistaConfig) -> TaskResult | None:
+        """High-watermark admission gate (docs/lifecycle.md#watermark-ladder):
+        a task admitted onto a nearly-full disk would ENOSPC mid-shuffle-
+        write anyway — reject it up front, typed and retryable, so the
+        scheduler re-pends the slice and the heartbeat disk gauges steer
+        the retry toward an executor with headroom."""
+        from ballista_tpu.executor import disk
+
+        if not disk.admission_blocked(cfg, self.work_dir):
+            return None
+        self.disk_rejections += 1
+        used_frac, used, free = disk.disk_status(self.work_dir)
+        log.warning(
+            "rejecting task %s/%s at admission: disk %.0f%% used (%d bytes free) "
+            "is past the high watermark", task.job_id, task.task_id,
+            used_frac * 100, free)
+        return TaskResult(
+            task_id=task.task_id, job_id=task.job_id, stage_id=task.stage_id,
+            stage_attempt=task.stage_attempt, partitions=list(task.partitions),
+            state="failed",
+            error=(f"executor {self.metadata.id} rejected task at admission: "
+                   f"disk {used_frac * 100:.0f}% used ({free} bytes free) past "
+                   "the high watermark"),
+            error_kind="DiskExhausted", retryable=True,
         )
 
     def execute_task(self, task: TaskDescription, config: BallistaConfig | None = None) -> TaskResult:

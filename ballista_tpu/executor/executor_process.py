@@ -199,8 +199,18 @@ class ExecutorProcess:
             id=str(new_executor_id()), host=host, flight_port=bound_flight, vcores=vcores,
             device_ordinal=device_ordinal,
         )
+        self.config = config
         self.executor = Executor(self.work_dir, self.metadata, config=config)
         self.executor.isolation = task_isolation
+        # startup orphan sweep: a crashed prior incarnation that reused this
+        # work dir leaves job dirs no scheduler will remove_job_data for;
+        # age-gated by the same TTL the background sweep uses, so a fresh
+        # restart never races live job files (docs/lifecycle.md#gc)
+        from ballista_tpu.executor import lifecycle
+
+        orphans, freed = lifecycle.sweep_stale_dirs(self.work_dir, self.work_dir_ttl_s)
+        self.executor.orphans_reclaimed += orphans
+        self.executor.gc_reclaimed_bytes += freed
         # per-task static floor (backstop when no session pool is present)
         self.executor.memory_limit_per_task = max(
             64 * 1024 * 1024, self.memory_pool_bytes // max(1, vcores)
@@ -303,6 +313,24 @@ class ExecutorProcess:
             ("checksum_failures", float(integrity["checksum_failures"])),
             ("corruption_retries", float(integrity["corruption_retries"])),
         ]
+        # lifecycle + disk-pressure gauges (docs/lifecycle.md): the
+        # scheduler derives lifecycle_state, steers placement away from
+        # full nodes, and triggers the drain state machine off these
+        from ballista_tpu.executor import disk as _disk
+
+        _frac, used_b, free_b = _disk.disk_status(self.work_dir)
+        metrics.extend([
+            ("lifecycle_draining", 1.0 if self.executor.draining else 0.0),
+            ("disk_used_bytes", float(used_b)),
+            ("disk_free_bytes", float(free_b)),
+            ("disk_rejecting",
+             1.0 if _disk.admission_blocked(self.config, self.work_dir) else 0.0),
+            ("disk_rejections", float(self.executor.disk_rejections)),
+            ("migrated_partitions", float(self.executor.migrated_partitions)),
+            ("migrated_bytes", float(self.executor.migrated_bytes)),
+            ("gc_reclaimed_bytes", float(self.executor.gc_reclaimed_bytes)),
+            ("orphans_reclaimed", float(self.executor.orphans_reclaimed)),
+        ])
         metrics.extend(self._tpu_metrics())
         return metrics
 
@@ -386,19 +414,27 @@ class ExecutorProcess:
             out.append(("tpu_persist_cache_hits", float(cc["hits"])))
         return out
 
+    def _heartbeat_once(self) -> bool:
+        """One heartbeat round-trip. Returns the scheduler's reregister
+        flag; while draining we do NOT act on it — the scheduler pops a
+        drained executor from its fleet, so reregister-while-draining
+        means the handoff finished, not that we should rejoin."""
+        req = pb.HeartBeatParams(
+            executor_id=self.metadata.id,
+            metadata=encode_executor_metadata(self.metadata),
+            status="active",
+        )
+        for name, value in self._overload_metrics():
+            req.metrics.add(name=name, value=value)
+        resp = self._scheduler.HeartBeatFromExecutor(req, timeout=5)
+        if resp.reregister and not self.executor.draining:
+            self._register()
+        return bool(resp.reregister)
+
     def _heartbeat_loop(self) -> None:
         while not self._stopping.wait(HEARTBEAT_INTERVAL_S):
             try:
-                req = pb.HeartBeatParams(
-                    executor_id=self.metadata.id,
-                    metadata=encode_executor_metadata(self.metadata),
-                    status="active",
-                )
-                for name, value in self._overload_metrics():
-                    req.metrics.add(name=name, value=value)
-                resp = self._scheduler.HeartBeatFromExecutor(req, timeout=5)
-                if resp.reregister:
-                    self._register()
+                self._heartbeat_once()
             except grpc.RpcError as e:
                 log.warning("heartbeat failed: %s", e.code() if hasattr(e, "code") else e)
 
@@ -430,16 +466,53 @@ class ExecutorProcess:
                 self.service._queue.put((task, cfg))
 
     def _dir_ttl_loop(self) -> None:
+        from ballista_tpu.executor.lifecycle import _dir_bytes
+
         while not self._stopping.wait(DIR_TTL_CHECK_S):
             cutoff = time.time() - self.work_dir_ttl_s
             try:
                 for name in os.listdir(self.work_dir):
                     p = os.path.join(self.work_dir, name)
                     if os.path.isdir(p) and os.path.getmtime(p) < cutoff:
+                        nbytes = _dir_bytes(p)
                         shutil.rmtree(p, ignore_errors=True)
-                        log.info("TTL-swept job dir %s", p)
+                        self.executor.gc_reclaimed_bytes += nbytes
+                        log.info("TTL-swept job dir %s (%d bytes)", p, nbytes)
             except OSError:
                 pass
+
+    def drain(self, timeout_s: float | None = None) -> None:
+        """SIGTERM-initiated graceful drain (docs/lifecycle.md
+        #drain-protocol). Advertises lifecycle_draining=1 on an immediate
+        heartbeat — the scheduler's heartbeat handler runs the drain state
+        machine (lease revocation, bounded wait, shuffle handoff) — then
+        keeps the data plane up until the scheduler drops us from its
+        fleet (reregister-while-draining) or the drain timeout lapses,
+        and finally shuts down. A second SIGTERM hard-stops immediately;
+        anything not handed off recovers via the recompute path."""
+        if self._stopping.is_set():
+            return
+        if self.executor.draining:
+            log.info("second SIGTERM during drain: hard stop")
+            self.shutdown()
+            return
+        self.executor.draining = True
+        log.info("draining executor %s (SIGTERM)", self.metadata.id)
+        if timeout_s is None:
+            from ballista_tpu.config import EXECUTOR_DRAIN_TIMEOUT_S
+
+            timeout_s = float(BallistaConfig().get(EXECUTOR_DRAIN_TIMEOUT_S))
+        deadline = time.time() + max(0.0, timeout_s)
+        while time.time() < deadline and not self._stopping.is_set():
+            try:
+                dropped = self._heartbeat_once()
+            except grpc.RpcError:
+                dropped = False
+            if dropped and self.service._queue.unfinished_tasks == 0:
+                log.info("drain handoff complete; shutting down")
+                break
+            time.sleep(1.0)
+        self.shutdown()
 
     def shutdown(self) -> None:
         if self._stopping.is_set():
@@ -526,7 +599,12 @@ def main(argv=None) -> None:
         tls_cert=args.tls_cert, tls_key=args.tls_key, tls_ca=args.tls_ca,
         task_isolation=args.task_isolation,
     )
-    signal.signal(signal.SIGTERM, lambda *_: proc.shutdown())
+    # SIGTERM = graceful drain (handoff shuffle outputs, then exit); a
+    # second SIGTERM hard-stops. The handler must not block, so the drain
+    # state machine runs on its own thread.
+    signal.signal(signal.SIGTERM,
+                  lambda *_: threading.Thread(target=proc.drain, daemon=True,
+                                              name="drain").start())
     proc.start()
     proc.wait()
 

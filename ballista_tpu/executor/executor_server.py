@@ -46,11 +46,17 @@ class ExecutorGrpcService:
             except queue.Empty:
                 continue
             task, config = item
-            result = self.executor.run_task(task, config)
             try:
-                self.status_sender([result])
-            except Exception:  # noqa: BLE001
-                log.exception("failed to report task status")
+                result = self.executor.run_task(task, config)
+                try:
+                    self.status_sender([result])
+                except Exception:  # noqa: BLE001
+                    log.exception("failed to report task status")
+            finally:
+                # unfinished_tasks hits 0 only when queued AND running work
+                # is done — the drain path polls it to know the executor is
+                # idle (docs/lifecycle.md#drain-protocol)
+                self._queue.task_done()
 
     def stop(self) -> None:
         self._running = False

@@ -24,13 +24,23 @@ def start_health_server(executor, stopping_event, host: str = "0.0.0.0", port: i
             from ballista_tpu.shuffle.integrity import INTEGRITY
 
             pools = executor.session_pools
+            stopping = stopping_event.is_set()
             body = json.dumps({
-                "status": "draining" if stopping_event.is_set() else "healthy",
+                "status": "draining" if (stopping or executor.draining) else "healthy",
+                # lifecycle facts (docs/lifecycle.md): draining = handoff in
+                # progress, stopping = shutdown begun
+                "lifecycle_state": ("stopping" if stopping
+                                    else "draining" if executor.draining else "active"),
                 "executor_id": executor.metadata.id,
                 "tasks_run": executor.tasks_run,
                 "tasks_failed": executor.tasks_failed,
                 "device_ordinal": executor.metadata.device_ordinal,
                 "pressure_rejections": executor.pressure_rejections,
+                "disk_rejections": executor.disk_rejections,
+                "migrated_partitions": executor.migrated_partitions,
+                "migrated_bytes": executor.migrated_bytes,
+                "gc_reclaimed_bytes": executor.gc_reclaimed_bytes,
+                "orphans_reclaimed": executor.orphans_reclaimed,
                 "memory_pressure": round(pools.aggregate_pressure(), 4) if pools else 0.0,
                 "pool_overcommitted_bytes": pools.total_overcommitted() if pools else 0,
                 # shuffle-integrity counters (reader-side verification)

@@ -48,6 +48,28 @@ class InProcessTaskLauncher(TaskLauncher):
             for task_id, stage_id in items:
                 ex.cancel_task(job_id, stage_id, task_id)
 
+    def remove_job_data(self, executor_id: str, job_id: str, server: SchedulerServer) -> None:
+        """Shuffle-GC push, mirroring the daemon's RemoveJobData rpc
+        (executor_server.py): containment-checked rmtree of the job dir +
+        cancellation-ledger cleanup, with reclaimed bytes counted."""
+        import os
+        import shutil
+
+        from ballista_tpu.executor.lifecycle import _dir_bytes
+        from ballista_tpu.shuffle.paths import contained_path, job_dir, validate_job_id
+
+        ex = self.executors.get(executor_id)
+        if ex is None:
+            return
+        try:
+            d = contained_path(ex.work_dir, job_dir(ex.work_dir, validate_job_id(job_id)))
+        except (ValueError, PermissionError):
+            return
+        if os.path.isdir(d):
+            ex.gc_reclaimed_bytes += _dir_bytes(d)
+            shutil.rmtree(d, ignore_errors=True)
+        ex.clear_cancellations(job_id)
+
     def grant_lease(self, executor_id: str, lease, server: SchedulerServer) -> None:
         ex = self.executors.get(executor_id)
         if ex is not None:
@@ -58,27 +80,72 @@ class InProcessTaskLauncher(TaskLauncher):
         if ex is not None:
             ex.lease_table.revoke(lease_id)
 
+    def migrate_partitions(self, src_executor_id: str, dest_executor_id: str,
+                           locations: list, server: SchedulerServer) -> tuple[int, int]:
+        """Drain handoff for in-process fleets (docs/lifecycle.md). With
+        per-executor work dirs + data planes the destination pulls the
+        ranges over the real migrate_pull Flight path; with ONE shared
+        work dir + data plane (the classic standalone shape) the files are
+        already readable by the surviving endpoint, so the handoff is pure
+        relabeling."""
+        from ballista_tpu.executor import lifecycle
+
+        src = self.executors.get(src_executor_id)
+        dest = self.executors.get(dest_executor_id)
+        if dest is None or not locations:
+            return 0, 0
+        if (src is not None and src.work_dir != dest.work_dir
+                and src.metadata.flight_port and dest.metadata.flight_port):
+            count, nbytes = lifecycle.migrate_via_flight(
+                f"{src.metadata.host}:{src.metadata.flight_port}",
+                f"{dest.metadata.host}:{dest.metadata.flight_port}",
+                locations, dest.metadata)
+        else:
+            count, nbytes = lifecycle.migrate_local(locations, dest.metadata)
+        dest.migrated_partitions += count
+        dest.migrated_bytes += nbytes
+        return count, nbytes
+
 
 class StandaloneCluster:
     def __init__(self, num_executors: int = 1, vcores: int = 4,
                  work_dir: str | None = None, config: BallistaConfig | None = None,
                  with_flight: bool = True, engine_factory=None,
-                 shards: int | None = None, job_state=None):
+                 shards: int | None = None, job_state=None,
+                 per_executor_work_dirs: bool = False):
+        import os
+
         self.work_dir = work_dir or tempfile.mkdtemp(prefix="ballista-tpu-")
+        self.per_executor_work_dirs = per_executor_work_dirs
         self.flight_server = None
+        # per-executor data planes: each executor owns a work-dir subtree
+        # and its own Flight server, so drain migration moves real bytes
+        # between endpoints (the distributed shape, in-process)
+        self.flight_servers: dict[str, object] = {}
         flight_port = 0
-        if with_flight:
+        if with_flight and not per_executor_work_dirs:
             from ballista_tpu.flight.server import start_flight_server
 
             self.flight_server, flight_port = start_flight_server(self.work_dir, "localhost")
+        self._shared_flight_port = flight_port
         self.executors: dict[str, Executor] = {}
         for _ in range(num_executors):
-            meta = ExecutorMetadata(id=str(new_executor_id()), vcores=vcores,
+            eid = str(new_executor_id())
+            ex_work_dir = self.work_dir
+            if per_executor_work_dirs:
+                ex_work_dir = os.path.join(self.work_dir, eid)
+                os.makedirs(ex_work_dir, exist_ok=True)
+                if with_flight:
+                    from ballista_tpu.flight.server import start_flight_server
+
+                    srv, flight_port = start_flight_server(ex_work_dir, "localhost")
+                    self.flight_servers[eid] = srv
+            meta = ExecutorMetadata(id=eid, vcores=vcores,
                                     host="localhost", flight_port=flight_port)
             # engine_factory: the ExecutionEngine extension seam
             # (execution_engine.rs:51) for library embedders
             eng = engine_factory() if engine_factory is not None else None
-            ex = Executor(self.work_dir, meta, config=config, engine=eng)
+            ex = Executor(ex_work_dir, meta, config=config, engine=eng)
             if config is not None:
                 from ballista_tpu.config import EXECUTOR_TASK_ISOLATION
 
@@ -88,6 +155,8 @@ class StandaloneCluster:
                 # direct-dispatch target: lease grants + scheduler-less
                 # task execution arrive as Flight actions
                 self.flight_server.attach_executor(ex)
+            elif eid in self.flight_servers:
+                self.flight_servers[eid].attach_executor(ex)
         self.launcher = InProcessTaskLauncher(self.executors)
         if shards is None and config is not None:
             from ballista_tpu.config import SCHEDULER_SHARDS
@@ -99,11 +168,45 @@ class StandaloneCluster:
         for ex in self.executors.values():
             self.scheduler.register_executor(ex.metadata)
 
+    def add_executor(self, vcores: int = 4, config: BallistaConfig | None = None,
+                     engine_factory=None) -> str:
+        """Join a fresh executor to the running fleet (the rolling-restart
+        harness: drain a node, then add_executor() is its replacement).
+        Honors the cluster's data-plane shape — own work dir + Flight
+        server under per_executor_work_dirs, shared otherwise."""
+        import os
+
+        eid = str(new_executor_id())
+        ex_work_dir = self.work_dir
+        flight_port = 0
+        if self.per_executor_work_dirs:
+            ex_work_dir = os.path.join(self.work_dir, eid)
+            os.makedirs(ex_work_dir, exist_ok=True)
+            from ballista_tpu.flight.server import start_flight_server
+
+            srv, flight_port = start_flight_server(ex_work_dir, "localhost")
+            self.flight_servers[eid] = srv
+        elif self.flight_server is not None:
+            flight_port = self._shared_flight_port
+        meta = ExecutorMetadata(id=eid, vcores=vcores,
+                                host="localhost", flight_port=flight_port)
+        eng = engine_factory() if engine_factory is not None else None
+        ex = Executor(ex_work_dir, meta, config=config, engine=eng)
+        self.executors[eid] = ex
+        if self.flight_server is not None:
+            self.flight_server.attach_executor(ex)
+        elif eid in self.flight_servers:
+            self.flight_servers[eid].attach_executor(ex)
+        self.scheduler.register_executor(meta)
+        return eid
+
     def shutdown(self) -> None:
         self.scheduler.stop()
         self.launcher.pool.shutdown(wait=False)
         if self.flight_server is not None:
             self.flight_server.shutdown()
+        for srv in self.flight_servers.values():
+            srv.shutdown()
 
 
 class MultiSchedulerCluster:

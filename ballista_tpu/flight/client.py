@@ -450,6 +450,47 @@ def fetch_partitions_flight(locs: Sequence[PartitionLocation], ctx: TaskContext
     BREAKER.success(addr)
 
 
+def fetch_partitions_bytes(addr: str, tickets: Sequence[dict],
+                           tls: tuple[str, str | None, str | None] | None = None,
+                           ) -> Iterator[tuple[int, bytes, str | None]]:
+    """Raw-bytes coalesced fetch for shuffle MIGRATION (drain handoff,
+    docs/lifecycle.md): streams every ticket's stored IPC byte range from
+    `addr` over the existing io_coalesced_transport framing, verifies each
+    range against the source's declared checksum BEFORE yielding, and
+    returns the raw bytes untouched — the destination commits them as-is
+    (no decode/re-encode), so the migrated file is byte-identical to the
+    source range. Yields (index, bytes, crc_or_None) in request order."""
+    client = POOL.get(addr, tls=tls)
+    action = flight.Action(COALESCED_ACTION, json.dumps({"locations": list(tickets)}).encode())
+    completed = 0
+    cur_need = 0
+    cur_blocks: list = []
+    cur_crc: str | None = None
+    for r in client.do_action(action):
+        if cur_need == 0:
+            h = json.loads(r.body.to_pybytes().decode())
+            cur_need = int(h["nbytes"])
+            cur_crc = h.get("crc")
+            cur_blocks = []
+            if cur_need == 0:
+                yield completed, b"", None
+                completed += 1
+            continue
+        cur_blocks.append(r.body)
+        cur_need -= r.body.size
+        if cur_need == 0:
+            if cur_crc:
+                tk = tickets[completed]
+                _verify_or_raise(
+                    cur_blocks, cur_crc,
+                    f"migrate {tk.get('path')}#p{tk.get('output_partition', 0)}")
+            yield completed, b"".join(b.to_pybytes() for b in cur_blocks), cur_crc
+            completed += 1
+    if cur_need or completed < len(tickets):
+        raise EOFError(
+            f"migration stream from {addr} served {completed}/{len(tickets)} locations")
+
+
 def remove_job_data(host: str, flight_port: int, job_id: str) -> None:
     client = POOL.get(f"{host}:{flight_port}")
     action = flight.Action("remove_job_data", json.dumps({"job_id": job_id}).encode())

@@ -157,7 +157,8 @@ class BallistaFlightServer(flight.FlightServerBase):
                       "streams_rejected": 0, "streams_stalled": 0,
                       "checksum_failures": 0, "short_reads": 0,
                       "chaos_corruptions": 0,
-                      "lease_dispatch": 0, "lease_rejections": 0}
+                      "lease_dispatch": 0, "lease_rejections": 0,
+                      "migrations": 0, "migrated_bytes": 0}
         # executors attached for direct dispatch: lease grants/revocations
         # and scheduler-less task execution arrive as Flight actions
         self._executors: dict[str, object] = {}
@@ -352,6 +353,9 @@ class BallistaFlightServer(flight.FlightServerBase):
             finally:
                 self.gate.release()
             return
+        if action.type == "migrate_pull":
+            yield from self._migrate_pull(action.body.to_pybytes())
+            return
         if action.type == "remove_job_data":
             t = json.loads(action.body.to_pybytes().decode())
             import shutil
@@ -391,6 +395,52 @@ class BallistaFlightServer(flight.FlightServerBase):
             return
         raise flight.FlightServerError(f"unknown action {action.type}")
 
+    def _migrate_pull(self, body: bytes):
+        """Drain handoff (docs/lifecycle.md#migration-commit-rules): this
+        DESTINATION pulls shuffle byte ranges from a draining source over
+        the existing coalesced Flight path and commits each one under its
+        own work dir — hash layout, tmp + atomic rename, `.crc` sidecar
+        carried over — then reports the new path so the scheduler can
+        rewrite the PartitionLocation in place. Idempotent: the committed
+        name is a pure function of the location's identity, so a replayed
+        migration renames over an identical file."""
+        from ballista_tpu.flight.client import fetch_partitions_bytes
+
+        t = json.loads(body.decode())
+        source = str(t["source"])
+        locs = list(t.get("locations", []))
+        for i, data, crc in fetch_partitions_bytes(source, locs):
+            tk = locs[i]
+            try:
+                job_id = paths.validate_job_id(str(tk["job_id"]))
+            except ValueError as e:
+                raise flight.FlightUnauthorizedError(str(e))
+            dest = paths.hash_data_path(
+                self.work_dir, job_id, int(tk["stage_id"]),
+                int(tk.get("output_partition", 0)),
+                f"mig{int(tk.get('map_partition', 0))}")
+            os.makedirs(os.path.dirname(dest), exist_ok=True)
+            tmp = dest + ".tmp"
+            try:
+                with open(tmp, "wb") as f:
+                    f.write(data)
+                if crc:
+                    with open(paths.crc_path(dest) + ".tmp", "w") as f:
+                        f.write(crc)
+                    os.replace(paths.crc_path(dest) + ".tmp", paths.crc_path(dest))
+                os.replace(tmp, dest)
+            except BaseException:
+                for p in (tmp, paths.crc_path(dest) + ".tmp"):
+                    try:
+                        os.unlink(p)
+                    except OSError:
+                        pass
+                raise
+            self._bump("migrations")
+            self._bump("migrated_bytes", len(data))
+            yield flight.Result(pa.py_buffer(json.dumps(
+                {"i": i, "path": dest, "nbytes": len(data)}).encode()))
+
     def attach_executor(self, executor) -> None:
         """Register an in-process Executor as a direct-dispatch target of
         this data-plane endpoint (daemon/standalone wiring)."""
@@ -428,6 +478,7 @@ class BallistaFlightServer(flight.FlightServerBase):
         return [("io_block_transport", "raw IPC block stream"),
                 (COALESCED_ACTION, "framed multi-location raw IPC block stream"),
                 ("remove_job_data", "GC a job's shuffle files"),
+                ("migrate_pull", "pull + commit shuffle ranges from a draining executor"),
                 ("lease_grant", "install a direct-dispatch lease on an attached executor"),
                 ("lease_revoke", "revoke a direct-dispatch lease"),
                 ("lease_dispatch", "run one leased single-stage task scheduler-less")]

@@ -402,11 +402,26 @@ class HostSpillPool:
         self.spill_bytes = 0  # cumulative bytes demoted (host + disk tiers)
         self.spill_events = 0
         self.reupload_events = 0
+        # disk-pressure gate (docs/lifecycle.md#watermark-ladder): a
+        # callable returning False sheds DISK demotions — cold entries stay
+        # in the host tier (overcommitting it) instead of filling the last
+        # of the disk. None = disk always allowed.
+        self.spill_gate = None
 
-    def configure(self, max_host_bytes: int, spill_dir: str) -> None:
+    def configure(self, max_host_bytes: int, spill_dir: str, spill_gate=None) -> None:
         with self._lock:
             self.max_host_bytes = int(max_host_bytes)
             self.spill_dir = spill_dir
+            self.spill_gate = spill_gate
+
+    def _disk_tier_allowed(self) -> bool:
+        gate = self.spill_gate
+        if gate is None:
+            return True
+        try:
+            return bool(gate())
+        except Exception:  # noqa: BLE001 — a broken gate must not block demotion
+            return True
 
     def _dir(self) -> str:
         d = self.spill_dir or os.path.join(tempfile.gettempdir(), "ballista-hbm-spill")
@@ -422,11 +437,12 @@ class HostSpillPool:
             old = self._entries.pop(key, None)
             if old is not None:
                 self._drop_locked(old)
-            if nbytes > self.max_host_bytes:
+            if nbytes > self.max_host_bytes and self._disk_tier_allowed():
                 self._to_disk_locked(key, entry)
             else:
                 self.host_bytes += entry.nbytes
                 while (self.host_bytes > self.max_host_bytes and
+                       self._disk_tier_allowed() and
                        any(not e.on_disk and e is not entry
                            for e in self._entries.values())):
                     ck, cold = next((k, e) for k, e in self._entries.items()
@@ -450,6 +466,17 @@ class HostSpillPool:
             with open(tmp, "wb") as f:
                 np.savez(f, __mask__=np.asarray(mask, dtype=bool), **live)
             os.replace(tmp, path)
+        except OSError as e:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            from ballista_tpu.executor.disk import wrap_enospc
+
+            typed = wrap_enospc(e, "hbm spill demotion")
+            if typed is not None:
+                raise typed from e
+            raise
         except BaseException:
             try:
                 os.unlink(tmp)

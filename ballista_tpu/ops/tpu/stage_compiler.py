@@ -1219,10 +1219,19 @@ class TpuStageExec(ExecutionPlan):
             max_bytes = min(max_bytes, budget)
         spill_pool = None
         if bool(self.config.get(TPU_HBM_SPILL_ENABLED)):
+            import tempfile
+
+            from ballista_tpu.executor import disk as _disk
+
             spill_pool = hbm.SPILL_POOL
+            sdir = str(self.config.get(TPU_HBM_SPILL_DIR) or "")
+            cfg = self.config
             spill_pool.configure(
-                int(self.config.get(TPU_HBM_SPILL_HOST_BYTES)),
-                str(self.config.get(TPU_HBM_SPILL_DIR) or ""))
+                int(self.config.get(TPU_HBM_SPILL_HOST_BYTES)), sdir,
+                # low-watermark shed: under disk pressure demotions stay in
+                # the host tier (docs/lifecycle.md#watermark-ladder)
+                spill_gate=lambda: _disk.spill_allowed(
+                    cfg, sdir or tempfile.gettempdir()))
         mesh = _stage_mesh(self.config)
         cc_dir = str(self.config.get(TPU_COMPILE_CACHE_DIR) or "")
         if cc_dir:

@@ -102,6 +102,12 @@ def start_rest_api(scheduler: SchedulerServer, metrics: InMemoryMetricsCollector
                     "shards": scheduler.shards_snapshot(),
                     "leases": scheduler.leases.snapshot(),
                     "fanin": dict(scheduler._fanin),
+                    # executor lifecycle (docs/lifecycle.md): drain/migration
+                    # counters + the terminal drained-executor ledger
+                    "lifecycle": {
+                        **scheduler.lifecycle_stats,
+                        "drained_executors": scheduler.executors.drained_snapshot(),
+                    },
                 })
             if p == "/api/executors":
                 out = []

@@ -83,6 +83,16 @@ class TaskLauncher:
         launchers ship the wire form)."""
         return
 
+    def migrate_partitions(self, src_executor_id: str, dest_executor_id: str,
+                           locations: list, server: "SchedulerServer") -> tuple[int, int]:
+        """Drain handoff (docs/lifecycle.md): move `locations` (shuffle map
+        outputs held by the draining source) to the destination executor
+        and rewrite each PartitionLocation in place. Returns
+        (migrated_count, migrated_bytes). The default launcher migrates
+        nothing — the drain then falls back to the recompute path exactly
+        like an executor loss."""
+        return 0, 0
+
     def revoke_lease(self, executor_id: str, lease_id: str,
                      server: "SchedulerServer") -> None:
         """Best-effort revocation push; the executor-side expiry check is
@@ -150,6 +160,14 @@ class SchedulerServer:
         self._fast_jobs: dict[str, FastJob] = {}
         # graph jobs whose results should fill a result-cache slot on finish
         self._rc_pending: dict[str, tuple] = {}
+        # lifecycle (docs/lifecycle.md): drains in flight (guards against
+        # duplicate heartbeat triggers) + fleet drain/GC counters surfaced
+        # on /api/state
+        self._drains_inflight: set[str] = set()
+        self._drain_lock = threading.Lock()
+        self.lifecycle_stats = {"drains": 0, "drain_kills": 0,
+                                "migrated_partitions": 0, "migrated_bytes": 0,
+                                "gc_swept_jobs": 0}
         # catalog changes orphan the table's cached results
         self.sessions.on_catalog_change = self.serving.table_versions.bump
 
@@ -999,6 +1017,7 @@ class SchedulerServer:
         if self.executors.probes_due():
             self._offer_reservation(shard)
         self._sweep_leases(now)
+        self._sweep_job_data_ttl(now)
         self.metrics.set_quarantined_executors(self.executors.quarantined_count())
         pressure = self.executors.aggregate_pressure()
         transition = self.admission.update(self._loop_lag_s, pressure)
@@ -1010,6 +1029,33 @@ class SchedulerServer:
                 # give the shed its headroom: drop the serving caches so
                 # memory-pressure recovery isn't fighting cached results
                 self.serving.clear()
+
+    def _sweep_job_data_ttl(self, now: float) -> None:
+        """Orphaned-data GC, scheduler-driven half (docs/lifecycle.md#gc):
+        terminal jobs past their `ballista.executor.data.ttl.seconds` get
+        their scheduler state dropped and a shuffle-GC RPC fanned out over
+        the existing remove_job_data seam. Per-job TTL (it is a session
+        knob); 0 disables. Bounded work: clean_job_data fans the executor
+        RPCs off-thread, so the sweep itself never blocks the loop."""
+        from ballista_tpu.config import EXECUTOR_DATA_TTL_S
+
+        with self._jobs_lock:
+            terminal = [g for g in self.jobs.values()
+                        if g.status in (JobState.SUCCESSFUL, JobState.FAILED,
+                                        JobState.CANCELLED)
+                        and not isinstance(g, FastJob)]
+        for g in terminal:
+            try:
+                ttl = float(g.config.get(EXECUTOR_DATA_TTL_S))
+            except Exception:  # noqa: BLE001 — a broken config must not kill the sweep
+                continue
+            ended = float(g.ended_at or 0.0)
+            if ttl <= 0 or not ended or now - ended < ttl:
+                continue
+            log.info("job %s terminal for %.0fs (ttl %.0fs): sweeping its data",
+                     g.job_id, now - ended, ttl)
+            self.lifecycle_stats["gc_swept_jobs"] += 1
+            self.clean_job_data(g.job_id)
 
     # -- executor lifecycle -----------------------------------------------------------
 
@@ -1033,7 +1079,117 @@ class SchedulerServer:
             grown = int(metrics["pressure_rejections"] - prev_n)
             for _ in range(max(0, grown)):
                 self.metrics.record_pressure_rejection(executor_id)
-        return self.executors.heartbeat(executor_id, metrics)
+        known = self.executors.heartbeat(executor_id, metrics)
+        if known and metrics and float(metrics.get("lifecycle_draining", 0.0)) >= 1.0:
+            # SIGTERM-initiated drain announcement: run the drain state
+            # machine off-thread (it waits on running tasks and migrates
+            # files — never on a caller's RPC thread or the event loop)
+            self._spawn_drain(executor_id)
+        return known
+
+    # -- drain state machine (docs/lifecycle.md#drain-protocol) ---------------
+
+    def _spawn_drain(self, executor_id: str) -> None:
+        with self._drain_lock:
+            if executor_id in self._drains_inflight:
+                return
+            self._drains_inflight.add(executor_id)
+
+        def run():
+            try:
+                self.drain_executor(executor_id)
+            except Exception:  # noqa: BLE001 — a died drain must not leak the guard
+                log.exception("drain of %s failed", executor_id)
+            finally:
+                with self._drain_lock:
+                    self._drains_inflight.discard(executor_id)
+
+        threading.Thread(target=run, daemon=True, name=f"drain-{executor_id}").start()
+
+    def _executor_has_running(self, executor_id: str) -> bool:
+        with self._jobs_lock:
+            graphs = [g for g in self.jobs.values()
+                      if g.status is JobState.RUNNING and not isinstance(g, FastJob)]
+        for g in graphs:
+            with g._lock:
+                for s in g.stages.values():
+                    if any(t.executor_id == executor_id for t in s.running.values()):
+                        return True
+        return False
+
+    def _locations_on(self, executor_id: str) -> list:
+        """Every completed PartitionLocation a draining executor still
+        holds — across RUNNING graphs (partial stage outputs included: a
+        running stage's finished map tasks are exactly what downstream
+        readers will fetch) and SUCCESSFUL ones (clients fetch final-stage
+        partitions after the job ends)."""
+        out = []
+        with self._jobs_lock:
+            graphs = [g for g in self.jobs.values()
+                      if g.status in (JobState.RUNNING, JobState.SUCCESSFUL)
+                      and not isinstance(g, FastJob)]
+        for g in graphs:
+            with g._lock:
+                for s in g.stages.values():
+                    for locs in s.completed.values():
+                        out.extend(l for l in locs if l.executor_id == executor_id)
+        return out
+
+    def drain_executor(self, executor_id: str, timeout_s: float | None = None) -> dict:
+        """Graceful decommission (docs/lifecycle.md): stop offering to the
+        executor, revoke its direct-dispatch leases, wait (bounded) for its
+        running tasks, hand its map outputs off to a survivor, then retire
+        it with a `drained` ledger entry. The closing `executor_lost` event
+        is the safety net: fully migrated locations no longer name the
+        executor (zero stage reruns), while anything left behind — hard
+        kill mid-migration, no survivor, launcher without a migration
+        path — recomputes through today's recovery machinery, byte-
+        identical. MUST run off the event loop (it sleeps)."""
+        slot = self.executors.get(executor_id)
+        if slot is None or not self.executors.begin_drain(executor_id):
+            return {"executor_id": executor_id, "status": "unknown"}
+        log.info("draining executor %s", executor_id)
+        self.lifecycle_stats["drains"] += 1
+        for lease in [l for l in self.leases.active() if l.executor_id == executor_id]:
+            self.revoke_executor_lease(lease.lease_id)
+        if timeout_s is None:
+            from ballista_tpu.config import EXECUTOR_DRAIN_TIMEOUT_S
+
+            timeout_s = float(BallistaConfig().get(EXECUTOR_DRAIN_TIMEOUT_S))
+        deadline = time.time() + max(0.0, timeout_s)
+        while time.time() < deadline and self._executor_has_running(executor_id):
+            time.sleep(0.05)
+        locations = self._locations_on(executor_id)
+        migrated = migrated_bytes = 0
+        status = "drained"
+        if locations and self.launcher is not None:
+            survivors = [e for e in self.executors.alive_executors()
+                         if e.schedulable and e.metadata.id != executor_id]
+            if survivors:
+                dest = max(survivors, key=lambda e: e.free_slots)
+                try:
+                    migrated, migrated_bytes = self.launcher.migrate_partitions(
+                        executor_id, dest.metadata.id, locations, self)
+                except Exception as e:  # noqa: BLE001 — hard-kill fallback is the contract
+                    status = "drain-killed"
+                    self.lifecycle_stats["drain_kills"] += 1
+                    log.warning("drain of %s died mid-migration (%s); unmigrated "
+                                "outputs fall back to recompute", executor_id, e)
+            else:
+                log.warning("drain of %s found no survivor; %d locations fall "
+                            "back to recompute", executor_id, len(locations))
+        if migrated:
+            log.info("drain of %s migrated %d/%d locations (%d bytes)",
+                     executor_id, migrated, len(locations), migrated_bytes)
+        self.lifecycle_stats["migrated_partitions"] += migrated
+        self.lifecycle_stats["migrated_bytes"] += migrated_bytes
+        self.executors.mark_drained(executor_id, migrated, migrated_bytes, reason=status)
+        # safety net + remainder recovery: locations rewritten by the
+        # migration no longer match the lost executor id
+        self.post(Event("executor_lost", executor_id))
+        return {"executor_id": executor_id, "status": status,
+                "locations": len(locations), "migrated_partitions": migrated,
+                "migrated_bytes": migrated_bytes}
 
     def _on_executor_lost(self, executor_id: str,
                           shard: SchedulerShard | None = None) -> None:

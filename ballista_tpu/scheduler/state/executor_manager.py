@@ -54,6 +54,18 @@ class ExecutorSlot:
     tpu_hbm_spill_bytes: float = 0.0
     tpu_hbm_spill_events: float = 0.0
     tpu_grace_splits: float = 0.0
+    # -- lifecycle & storage (docs/lifecycle.md) -----------------------------
+    lifecycle_state: str = "active"  # active | draining (drained = ledger)
+    disk_used_bytes: float = 0.0
+    disk_free_bytes: float = 0.0
+    # executor self-reports it is past its high watermark: placement skips
+    # it until the next heartbeat says otherwise
+    disk_rejecting: float = 0.0
+    disk_rejections: float = 0.0
+    migrated_partitions: float = 0.0
+    migrated_bytes: float = 0.0
+    gc_reclaimed_bytes: float = 0.0
+    orphans_reclaimed: float = 0.0
 
     @property
     def failure_rate(self) -> float:
@@ -63,8 +75,10 @@ class ExecutorSlot:
     @property
     def schedulable(self) -> bool:
         """Eligible for regular offers: quarantined/probation executors only
-        receive work through the probe gate."""
-        return not self.terminating and self.health_state == "healthy"
+        receive work through the probe gate; a disk past its high watermark
+        would reject the task at admission anyway, so placement skips it."""
+        return (not self.terminating and self.health_state == "healthy"
+                and self.disk_rejecting < 1.0)
 
 
 class ExecutorManager:
@@ -84,6 +98,14 @@ class ExecutorManager:
         self.probe_backoff_s = probe_backoff_s
         self._lock = threading.RLock()
         self._rr = 0
+        # terminal lifecycle ledger (docs/lifecycle.md): executors that
+        # left THROUGH the drain state machine, with their handoff
+        # counters — the quarantine/health ledger's "drained" terminal
+        # reason. Bounded: a long-lived scheduler sees endless rolling
+        # restarts.
+        from ballista_tpu.utils.lru import LruDict
+
+        self.drained = LruDict(max_entries=256)
 
     def register(self, metadata: ExecutorMetadata) -> None:
         if metadata.wire_version != WIRE_PROTOCOL_VERSION:
@@ -126,6 +148,27 @@ class ExecutorManager:
                     metrics.get("tpu_hbm_spill_events", ex.tpu_hbm_spill_events))
                 ex.tpu_grace_splits = float(
                     metrics.get("tpu_grace_splits", ex.tpu_grace_splits))
+                ex.disk_used_bytes = float(
+                    metrics.get("disk_used_bytes", ex.disk_used_bytes))
+                ex.disk_free_bytes = float(
+                    metrics.get("disk_free_bytes", ex.disk_free_bytes))
+                ex.disk_rejecting = float(
+                    metrics.get("disk_rejecting", ex.disk_rejecting))
+                ex.disk_rejections = float(
+                    metrics.get("disk_rejections", ex.disk_rejections))
+                ex.migrated_partitions = float(
+                    metrics.get("migrated_partitions", ex.migrated_partitions))
+                ex.migrated_bytes = float(
+                    metrics.get("migrated_bytes", ex.migrated_bytes))
+                ex.gc_reclaimed_bytes = float(
+                    metrics.get("gc_reclaimed_bytes", ex.gc_reclaimed_bytes))
+                ex.orphans_reclaimed = float(
+                    metrics.get("orphans_reclaimed", ex.orphans_reclaimed))
+                if float(metrics.get("lifecycle_draining", 0.0)) >= 1.0:
+                    # executor-initiated (SIGTERM) drain announcement; the
+                    # scheduler's drain path notices and runs the handoff
+                    if ex.lifecycle_state == "active":
+                        ex.lifecycle_state = "draining"
             return True
 
     def aggregate_pressure(self) -> float:
@@ -142,6 +185,40 @@ class ExecutorManager:
     def deregister(self, executor_id: str) -> None:
         with self._lock:
             self.executors.pop(executor_id, None)
+
+    # -- lifecycle: drain state machine (docs/lifecycle.md) -------------------
+
+    def begin_drain(self, executor_id: str) -> bool:
+        """Move an executor into the draining state: no new offers bind to
+        it (terminating), but it stays registered so in-flight tasks report
+        and its map outputs stay addressable for the handoff. Returns False
+        for an unknown executor, and idempotently True for one already
+        draining."""
+        with self._lock:
+            e = self.executors.get(executor_id)
+            if e is None:
+                return False
+            e.terminating = True
+            e.lifecycle_state = "draining"
+            return True
+
+    def mark_drained(self, executor_id: str, migrated_partitions: int = 0,
+                     migrated_bytes: int = 0, reason: str = "drained") -> None:
+        """Terminal drain transition: deregister the executor and record it
+        in the bounded drained ledger with its handoff counters."""
+        with self._lock:
+            self.executors.pop(executor_id, None)
+            self.drained[executor_id] = {
+                "state": "drained",
+                "reason": reason,
+                "at": time.time(),
+                "migrated_partitions": int(migrated_partitions),
+                "migrated_bytes": int(migrated_bytes),
+            }
+
+    def drained_snapshot(self) -> dict[str, dict]:
+        with self._lock:
+            return {eid: dict(info) for eid, info in self.drained.items()}
 
     def get(self, executor_id: str) -> ExecutorSlot | None:
         with self._lock:
@@ -418,5 +495,14 @@ class ExecutorManager:
                     "hbm_spill_bytes": int(e.tpu_hbm_spill_bytes),
                     "hbm_spill_events": int(e.tpu_hbm_spill_events),
                     "grace_splits": int(e.tpu_grace_splits),
+                    "lifecycle_state": e.lifecycle_state,
+                    "disk_used_bytes": int(e.disk_used_bytes),
+                    "disk_free_bytes": int(e.disk_free_bytes),
+                    "disk_rejecting": bool(e.disk_rejecting >= 1.0),
+                    "disk_rejections": int(e.disk_rejections),
+                    "migrated_partitions": int(e.migrated_partitions),
+                    "migrated_bytes": int(e.migrated_bytes),
+                    "gc_reclaimed_bytes": int(e.gc_reclaimed_bytes),
+                    "orphans_reclaimed": int(e.orphans_reclaimed),
                 }
             return out

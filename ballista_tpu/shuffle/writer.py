@@ -40,6 +40,8 @@ from ballista_tpu.config import (
     SORT_SHUFFLE_MEMORY_LIMIT,
 )
 from ballista_tpu.errors import ExecutionError
+from ballista_tpu.executor import disk
+from ballista_tpu.executor.chaos import maybe_disk_full
 from ballista_tpu.shuffle.integrity import ChecksumSink
 from ballista_tpu.ops.hashing import partition_indices
 from ballista_tpu.ops.phys_expr import bind_expr, evaluate_to_array
@@ -162,6 +164,8 @@ class ShuffleWriterExec(ExecutionPlan):
             # crash) must never leave a truncated file under the final name
             path = paths.hash_data_path(ctx.work_dir, self.job_id, self.stage_id, map_partition, task_id)
             os.makedirs(os.path.dirname(path), exist_ok=True)
+            maybe_disk_full(ctx.config, self.job_id, self.stage_id, map_partition,
+                            ctx.task_attempt, "shuffle passthrough write")
             try:
                 with open(path + ".tmp", "wb") as f:
                     sink = ChecksumSink(f, enabled=_checksum_on(ctx))
@@ -174,6 +178,12 @@ class ShuffleWriterExec(ExecutionPlan):
                                 rows += b.num_rows
                                 batches += 1
                     nbytes = f.tell()
+            except OSError as e:
+                _unlink_quiet(path + ".tmp")
+                typed = disk.wrap_enospc(e, f"shuffle write {self.job_id}/{self.stage_id}/{map_partition}")
+                if typed is not None:
+                    raise typed from e
+                raise
             except BaseException:
                 # an attempt killed mid-write (cancel, deadline, crash) must
                 # not leave its .tmp around — it will never be renamed
@@ -201,13 +211,28 @@ class ShuffleWriterExec(ExecutionPlan):
 
         def spill_largest() -> bool:
             nonlocal buffered, pool_held
+            # low-watermark shed: spills are the OPTIONAL disk writes, so
+            # they stop first under disk pressure. Returning False pushes
+            # the caller onto the memory-overcommit ladder (grow_wait)
+            # instead of filling the last of the disk.
+            if not disk.spill_allowed(ctx.config, ctx.work_dir):
+                return False
             k = max(range(K), key=lambda i: sum(b.nbytes for b in buckets[i]))
             if not buckets[k]:
                 return False
+            maybe_disk_full(ctx.config, self.job_id, self.stage_id, map_partition,
+                            ctx.task_attempt, "sort-shuffle spill")
             sp = paths.sort_data_path(ctx.work_dir, self.job_id, self.stage_id, map_partition, task_id) + f".spill{len(spills[k])}.{k}"
             os.makedirs(os.path.dirname(sp), exist_ok=True)
-            with open(sp, "wb") as f:
-                _, sp_bytes = write_ipc_stream(buckets[k], schema, f, ctx)
+            try:
+                with open(sp, "wb") as f:
+                    _, sp_bytes = write_ipc_stream(buckets[k], schema, f, ctx)
+            except OSError as e:
+                _unlink_quiet(sp)
+                typed = disk.wrap_enospc(e, f"sort-shuffle spill {self.job_id}/{self.stage_id}/{map_partition}")
+                if typed is not None:
+                    raise typed from e
+                raise
             spills[k].append(sp)
             freed = sum(b.nbytes for b in buckets[k])
             buffered -= freed
@@ -308,6 +333,8 @@ class ShuffleWriterExec(ExecutionPlan):
         live = [k for k in range(len(buckets)) if rows[k]]
         if not live:
             return self._meta([])
+        maybe_disk_full(ctx.config, self.job_id, self.stage_id, map_partition,
+                        ctx.task_attempt, "hash-shuffle commit")
 
         def drain(k: int):
             path = paths.hash_data_path(ctx.work_dir, self.job_id, self.stage_id, k, task_id)
@@ -316,6 +343,12 @@ class ShuffleWriterExec(ExecutionPlan):
                 with open(path + ".tmp", "wb") as f:
                     sink = ChecksumSink(f, enabled=_checksum_on(ctx))
                     _, nbytes = write_ipc_stream(buckets[k], schema, sink, ctx)
+            except OSError as e:
+                _unlink_quiet(path + ".tmp")
+                typed = disk.wrap_enospc(e, f"shuffle write {self.job_id}/{self.stage_id}/{k}")
+                if typed is not None:
+                    raise typed from e
+                raise
             except BaseException:
                 _unlink_quiet(path + ".tmp")
                 raise
@@ -353,6 +386,8 @@ class ShuffleWriterExec(ExecutionPlan):
         scheduler first decides which set readers ever see."""
         data_path = paths.sort_data_path(ctx.work_dir, self.job_id, self.stage_id, map_partition, task_id)
         os.makedirs(os.path.dirname(data_path), exist_ok=True)
+        maybe_disk_full(ctx.config, self.job_id, self.stage_id, map_partition,
+                        ctx.task_attempt, "sort-shuffle commit")
         index: dict[str, list] = {}
         out = []
         idx_path = paths.index_path(data_path)
@@ -382,6 +417,12 @@ class ShuffleWriterExec(ExecutionPlan):
             os.replace(data_path + ".tmp", data_path)
             with open(idx_path + ".tmp", "w") as f:
                 json.dump(index, f)
+        except OSError as e:
+            _unlink_quiet(data_path + ".tmp", idx_path + ".tmp")
+            typed = disk.wrap_enospc(e, f"sort-shuffle commit {self.job_id}/{self.stage_id}/{map_partition}")
+            if typed is not None:
+                raise typed from e
+            raise
         except BaseException:
             _unlink_quiet(data_path + ".tmp", idx_path + ".tmp")
             raise

@@ -82,6 +82,12 @@ class LruDict:
                 self.evictions += 1
             return default
 
+    def items(self) -> list:
+        """Point-in-time [(key, value)] snapshot (LRU → MRU order) without
+        touching recency — observability reads must not distort eviction."""
+        with self._lock:
+            return [(k, v[0]) for k, v in self._od.items()]
+
     def __contains__(self, key) -> bool:
         with self._lock:
             return key in self._od

@@ -77,6 +77,14 @@ timed iterations: the daemon failure domain (crash recovery, execute
 watchdog, poison quarantine — docs/device_daemon.md#failure-domain)
 must hold on this machine before the bench trusts the daemon with the
 real run. Divergence fails the leg (exit 5, chaos_smoke_failed event).
+
+With BALLISTA_BENCH_LIFECYCLE=1 the bench additionally runs
+`dev/lifecycle_exercise.py --quick` (CPU-only, own subprocess): the
+executor lifecycle failure domain (graceful drain with zero-rerun
+shuffle handoff, ENOSPC retry, rolling restart under load —
+docs/lifecycle.md) is smoke-checked and its verdict recorded under
+"lifecycle_smoke" in the artifact; a nonzero exit marks ok=false with
+the output tail rather than discarding the round.
 """
 
 import json
@@ -750,6 +758,9 @@ def main() -> None:
         run_ev = [e for e in trail if e.get("event") in ("warmup", "iter")]
         result["device_progress"] = init_ev + run_ev[-40:]
 
+    if os.environ.get("BALLISTA_BENCH_LIFECYCLE") == "1":
+        result["lifecycle_smoke"] = lifecycle_smoke_leg()
+
     if os.environ.get("BENCH_SERVING", "1") == "1":
         result["serving"] = serving_leg()
 
@@ -758,6 +769,30 @@ def main() -> None:
         result["tpcds_skew"] = tpcds_skew_leg()
 
     print(json.dumps(result))
+
+
+def lifecycle_smoke_leg() -> dict:
+    """Opt-in lifecycle probe (BALLISTA_BENCH_LIFECYCLE=1): run
+    dev/lifecycle_exercise.py --quick in a CPU-pinned subprocess — the
+    drain/disk_full/rolling-restart failure domain must hold on this
+    machine. The verdict lands in the artifact; a failure does NOT
+    zero the round (the timed numbers are still real), it just marks
+    the smoke as failed with the output tail."""
+    log("lifecycle smoke: dev/lifecycle_exercise.py --quick ...")
+    t0 = time.time()
+    r = subprocess.run(
+        [sys.executable,
+         os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "dev", "lifecycle_exercise.py"), "--quick"],
+        capture_output=True, text=True,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    out = {"ok": r.returncode == 0, "exit_code": r.returncode,
+           "elapsed_s": round(time.time() - t0, 1)}
+    if r.returncode != 0:
+        out["tail"] = (r.stdout + r.stderr)[-1500:]
+    log(f"lifecycle smoke: {'ok' if out['ok'] else 'FAILED'} "
+        f"({out['elapsed_s']}s)")
+    return out
 
 
 def serving_leg() -> dict:

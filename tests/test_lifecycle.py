@@ -1,0 +1,337 @@
+"""Executor lifecycle & storage failure domain (docs/lifecycle.md).
+
+Graceful drain with shuffle handoff (zero upstream-stage reruns,
+byte-identical results), hard-kill mid-drain recompute fallback,
+disk-pressure watermarks (typed ENOSPC, shed/reject ladder, placement
+gating), orphaned-data GC (scheduler TTL sweep + executor startup sweep),
+and a rolling restart of a multi-executor fleet under live query load.
+"""
+
+import os
+import threading
+import time
+from types import SimpleNamespace
+
+import pytest
+
+from ballista_tpu.config import (
+    BallistaConfig,
+    CHAOS_ENABLED,
+    CHAOS_MODE,
+    CHAOS_PROBABILITY,
+    CHAOS_SEED,
+    DEFAULT_SHUFFLE_PARTITIONS,
+    EXECUTOR_DATA_TTL_S,
+)
+from ballista_tpu.executor.executor import ExecutionEngine, Executor, ExecutorMetadata
+from ballista_tpu.executor.standalone import StandaloneCluster
+from ballista_tpu.testing.reference import compare_results, run_reference
+
+from .conftest import tpch_query
+
+
+class SlowEngine(ExecutionEngine):
+    """Stretches every task by a few ms so a drain reliably lands while
+    the job is mid-flight (upstream outputs committed, consumers pending)."""
+
+    def create_query_stage_exec(self, plan, config, stage_attempt=0):
+        time.sleep(0.05)
+        return super().create_query_stage_exec(plan, config, stage_attempt)
+
+
+def _drain_cluster(tpch_dir, cfg, num_executors=2):
+    """SessionContext over a per-executor-work-dir standalone fleet: each
+    executor owns its work-dir subtree and Flight server, so drain
+    migration moves real bytes between data planes."""
+    from ballista_tpu.client.context import SessionContext
+    from ballista_tpu.testing.tpchgen import register_tpch
+
+    ctx = SessionContext.standalone(cfg, num_executors=num_executors)
+    ctx._cluster = StandaloneCluster(
+        num_executors, 4, config=cfg, per_executor_work_dirs=True,
+        engine_factory=SlowEngine)
+    register_tpch(ctx, tpch_dir)
+    return ctx
+
+
+def _drain_midflight(ctx, cfg, q, drain_timeout=60.0):
+    """Submit query q, wait until some executor holds committed map
+    outputs while the job is still running, then drain that executor.
+    Returns (job_id, drain_result, final_status)."""
+    cluster = ctx._cluster
+    sched = cluster.scheduler
+    sid = sched.sessions.create_or_update(cfg.to_key_value_pairs(), "s-lifecycle")
+    job_id = sched.submit_sql(tpch_query(q), sid)
+    victim = None
+    deadline = time.time() + 60
+    while time.time() < deadline and victim is None:
+        for eid in list(cluster.executors):
+            if sched._locations_on(eid):
+                victim = eid
+                break
+        else:
+            time.sleep(0.01)
+    assert victim is not None, "no committed map outputs ever appeared"
+    res = sched.drain_executor(victim, timeout_s=drain_timeout)
+    status = sched.wait_for_job(job_id, timeout=120)
+    return job_id, res, status
+
+
+def test_drain_migration_zero_reruns(tpch_dir, tpch_ref_tables):
+    """Tentpole: draining an executor mid-query hands its shuffle outputs
+    off to the survivor — the job completes byte-identical with ZERO
+    upstream-stage reruns and nonzero migration counters."""
+    from ballista_tpu.client.context import fetch_job_results
+
+    cfg = BallistaConfig({DEFAULT_SHUFFLE_PARTITIONS: 4})
+    ctx = _drain_cluster(tpch_dir, cfg)
+    sched = ctx._cluster.scheduler
+    try:
+        job_id, res, status = _drain_midflight(ctx, cfg, q=3)
+        assert status["state"] == "successful", status.get("error")
+        assert res["status"] == "drained", res
+        assert res["migrated_partitions"] > 0 and res["migrated_bytes"] > 0, res
+        # zero reruns: no stage ever re-attempted (FetchFailed would bump these)
+        g = sched.jobs.get(job_id)
+        attempts = {sid: s.attempt for sid, s in g.stages.items()}
+        assert all(a == 0 for a in attempts.values()), attempts
+        # byte parity vs the reference oracle, fetched through the
+        # REWRITTEN locations on the surviving data plane
+        out = fetch_job_results(status, cfg)
+        problems = compare_results(out, run_reference(3, tpch_ref_tables), 3)
+        assert not problems, "\n".join(problems)
+        # terminal ledger + stats surfaced for /api/state
+        drained = sched.executors.drained_snapshot()
+        assert res["executor_id"] in drained
+        assert drained[res["executor_id"]]["reason"] == "drained"
+        assert sched.lifecycle_stats["drains"] == 1
+        assert sched.lifecycle_stats["migrated_partitions"] == res["migrated_partitions"]
+        # the drained executor left the fleet
+        alive = [e.metadata.id for e in sched.executors.alive_executors()]
+        assert res["executor_id"] not in alive
+    finally:
+        ctx.shutdown()
+
+
+def test_drain_kill_recompute_parity(tpch_dir, tpch_ref_tables, monkeypatch):
+    """Hard-kill mid-migration (chaos mode=drain_kill): the unmigrated
+    remainder falls back to today's recompute path and the job still
+    produces byte-identical results."""
+    from ballista_tpu.client.context import fetch_job_results
+
+    monkeypatch.setenv("BALLISTA_CHAOS_DRAIN_KILL_AFTER", "1")
+    cfg = BallistaConfig({DEFAULT_SHUFFLE_PARTITIONS: 4})
+    ctx = _drain_cluster(tpch_dir, cfg)
+    sched = ctx._cluster.scheduler
+    try:
+        job_id, res, status = _drain_midflight(ctx, cfg, q=3)
+        assert res["status"] == "drain-killed", res
+        assert sched.lifecycle_stats["drain_kills"] == 1
+        assert status["state"] == "successful", status.get("error")
+        out = fetch_job_results(status, cfg)
+        problems = compare_results(out, run_reference(3, tpch_ref_tables), 3)
+        assert not problems, "\n".join(problems)
+        drained = sched.executors.drained_snapshot()
+        assert drained[res["executor_id"]]["reason"] == "drain-killed"
+    finally:
+        ctx.shutdown()
+
+
+def test_disk_full_chaos_retry_heals(tpch_dir):
+    """Injected ENOSPC at shuffle-write points (chaos mode=disk_full,
+    once-mode) fails tasks typed + retryable; the retry of the same slice
+    heals and the job converges to the correct result — no job failure.
+    p=1.0 + once-mode is DETERMINISTIC: every task's first shuffle write
+    ENOSPCs and every retry heals, with the per-stage task count (2)
+    safely under the stage retry budget."""
+    from ballista_tpu.client.context import SessionContext
+    from ballista_tpu.executor import chaos
+    from ballista_tpu.testing.tpchgen import register_tpch
+
+    chaos._DISK_FULL_FIRED.clear()
+    cfg = BallistaConfig({
+        CHAOS_ENABLED: True, CHAOS_MODE: "disk_full",
+        CHAOS_PROBABILITY: 1.0, CHAOS_SEED: 11,
+        DEFAULT_SHUFFLE_PARTITIONS: 2,
+    })
+    ctx = SessionContext.standalone(cfg, num_executors=1, vcores=4)
+    register_tpch(ctx, tpch_dir)
+    # every task fails exactly once by design; don't let the health ledger
+    # quarantine the only executor over the injected faults
+    ctx._ensure_cluster().scheduler.executors.quarantine_threshold = 2.0
+    try:
+        out = ctx.sql(
+            "select n_name, count(*) as c from nation group by n_name order by n_name"
+        ).collect()
+        assert len(chaos._DISK_FULL_FIRED) > 0, "no ENOSPC ever injected — test vacuous"
+        assert out.num_rows == 25
+        assert all(c == 1 for c in out.column("c").to_pylist())
+    finally:
+        ctx.shutdown()
+        chaos._DISK_FULL_FIRED.clear()
+
+
+def test_watermark_ladder(tmp_path):
+    """Shed order: the low watermark stops OPTIONAL spill writes first;
+    the high watermark rejects new task admission with a typed retryable
+    DiskExhausted; below both, everything is allowed."""
+    from ballista_tpu.executor import disk
+
+    cfg = BallistaConfig()
+    wd = str(tmp_path)
+    try:
+        # between the watermarks: spills shed, tasks still admitted
+        disk.force_used_fraction(0.92)
+        assert not disk.spill_allowed(cfg, wd)
+        assert not disk.admission_blocked(cfg, wd)
+
+        # past the high watermark: task admission rejects typed + retryable
+        disk.force_used_fraction(0.97)
+        assert disk.admission_blocked(cfg, wd)
+        ex = Executor(wd, ExecutorMetadata(id="ex-disk", vcores=1), config=cfg)
+        task = SimpleNamespace(task_id=1, job_id="job-x", stage_id=1,
+                               stage_attempt=0, partitions=[0],
+                               session_id="s", fast_lane=False)
+        r = ex.run_task(task, cfg)
+        assert r.state == "failed"
+        assert r.error_kind == "DiskExhausted"
+        assert r.retryable
+        assert ex.disk_rejections == 1
+
+        # with headroom the whole ladder opens back up
+        disk.force_used_fraction(0.5)
+        assert disk.spill_allowed(cfg, wd)
+        assert not disk.admission_blocked(cfg, wd)
+    finally:
+        disk.force_used_fraction(None)
+
+
+def test_disk_rejecting_gates_placement():
+    """A heartbeat reporting disk_rejecting=1 takes the executor out of
+    the schedulable set (placement steers away from full nodes); the
+    pressure clearing restores it."""
+    from ballista_tpu.scheduler.state.executor_manager import ExecutorManager
+
+    m = ExecutorManager()
+    meta = ExecutorMetadata(id="ex-full", vcores=2)
+    m.register(meta)
+    assert m.executors["ex-full"].schedulable
+    m.heartbeat("ex-full", {"disk_rejecting": 1.0, "disk_used_bytes": 99.0,
+                            "disk_free_bytes": 1.0})
+    slot = m.executors["ex-full"]
+    assert not slot.schedulable
+    assert slot.disk_used_bytes == 99.0
+    snap = m.health_snapshot()["ex-full"]
+    assert snap["disk_rejecting"] is True
+    m.heartbeat("ex-full", {"disk_rejecting": 0.0})
+    assert m.executors["ex-full"].schedulable
+
+
+def test_ttl_gc_sweeps_terminal_not_live(tpch_dir):
+    """The scheduler TTL sweep removes a terminal job's data once it ages
+    past ballista.executor.data.ttl.seconds — and never touches a job
+    that is still inside its TTL."""
+    from ballista_tpu.client.context import SessionContext
+    from ballista_tpu.testing.tpchgen import register_tpch
+
+    cfg = BallistaConfig({DEFAULT_SHUFFLE_PARTITIONS: 2, EXECUTOR_DATA_TTL_S: 1})
+    ctx = SessionContext.standalone(cfg, num_executors=1)
+    register_tpch(ctx, tpch_dir)
+    try:
+        sql = "select l_returnflag, count(*) from lineitem group by l_returnflag"
+        ctx.sql(sql).collect()
+        ctx.sql(sql).collect()
+        cluster = ctx._cluster
+        sched = cluster.scheduler
+        with sched._jobs_lock:
+            job_old, job_live = sorted(sched.jobs)[:2]
+        dir_old = os.path.join(cluster.work_dir, job_old)
+        dir_live = os.path.join(cluster.work_dir, job_live)
+        assert os.path.isdir(dir_old) and os.path.isdir(dir_live)
+        # age one job past its TTL; leave the other fresh
+        sched.jobs[job_old].ended_at = time.time() - 30
+        sched.jobs[job_live].ended_at = time.time()
+        sched._sweep_job_data_ttl(time.time())
+        assert sched.lifecycle_stats["gc_swept_jobs"] == 1
+        deadline = time.time() + 10
+        while time.time() < deadline and os.path.isdir(dir_old):
+            time.sleep(0.05)
+        assert not os.path.isdir(dir_old), "expired job data not reclaimed"
+        assert os.path.isdir(dir_live), "GC touched a job inside its TTL"
+        with sched._jobs_lock:
+            assert job_old not in sched.jobs
+            assert job_live in sched.jobs
+    finally:
+        ctx.shutdown()
+
+
+def test_startup_orphan_sweep(tmp_path):
+    """sweep_stale_dirs reclaims dirs older than the TTL, keeps fresh
+    ones, and is a no-op when the TTL is 0 (disabled)."""
+    from ballista_tpu.executor import lifecycle
+
+    old = tmp_path / "job-old"
+    old.mkdir()
+    (old / "data.arrow").write_bytes(b"x" * 128)
+    os.utime(old, (time.time() - 7200, time.time() - 7200))
+    fresh = tmp_path / "job-fresh"
+    fresh.mkdir()
+    (fresh / "data.arrow").write_bytes(b"y" * 64)
+
+    orphans, nbytes = lifecycle.sweep_stale_dirs(str(tmp_path), 3600)
+    assert orphans == 1 and nbytes == 128
+    assert not old.exists()
+    assert fresh.exists()
+    # disabled TTL sweeps nothing
+    os.utime(fresh, (time.time() - 7200, time.time() - 7200))
+    assert lifecycle.sweep_stale_dirs(str(tmp_path), 0) == (0, 0)
+    assert fresh.exists()
+
+
+def test_rolling_restart_under_load(tpch_dir, tpch_ref_tables):
+    """Rolling restart: drain each of a 3-executor fleet's original nodes
+    one at a time (adding a replacement after each) while queries run —
+    every query must keep succeeding with byte-identical results."""
+    cfg = BallistaConfig({DEFAULT_SHUFFLE_PARTITIONS: 4})
+    ctx = _drain_cluster(tpch_dir, cfg, num_executors=3)
+    cluster = ctx._cluster
+    sched = cluster.scheduler
+    originals = list(cluster.executors)
+    results, errors = [], []
+    stop = threading.Event()
+
+    def load():
+        while not stop.is_set():
+            try:
+                results.append(ctx.sql(tpch_query(6)).collect())
+            except Exception as e:  # noqa: BLE001 — surfaced as a test failure
+                errors.append(e)
+                return
+
+    t = threading.Thread(target=load, daemon=True, name="query-load")
+    t.start()
+    try:
+        for eid in originals:
+            # drain only once this node actually holds shuffle outputs, so
+            # every handoff in the rolling restart moves real data
+            deadline = time.time() + 30
+            while time.time() < deadline and not sched._locations_on(eid):
+                time.sleep(0.01)
+            res = sched.drain_executor(eid, timeout_s=60)
+            assert res["status"] == "drained", res
+            cluster.add_executor(vcores=4, config=cfg, engine_factory=SlowEngine)
+        assert sched.lifecycle_stats["migrated_partitions"] > 0
+        stop.set()
+        t.join(timeout=120)
+        assert not errors, errors
+        assert results, "load thread never completed a query"
+        ref = run_reference(6, tpch_ref_tables)
+        for out in results:
+            problems = compare_results(out, ref, 6)
+            assert not problems, "\n".join(problems)
+        assert len(sched.executors.alive_executors()) == 3
+        assert len(sched.executors.drained_snapshot()) == 3
+        assert sched.lifecycle_stats["drains"] == 3
+    finally:
+        stop.set()
+        ctx.shutdown()

@@ -5,7 +5,14 @@ demand). Excluded from the default run by pytest.ini; invoke explicitly:
     python -m pytest -m sf1    # all 22 queries, 2 daemons, remote reads
     python -m pytest -m sf10   # SF10-shaped single-query leg
 
-Data generates once into /tmp and is reused across invocations."""
+Data generates once into /tmp and is reused across invocations.
+
+Every gate also carries the `slow` marker: an explicit command-line
+`-m` (like the bounded tier-1 run's `-m 'not slow'`) REPLACES the
+pytest.ini addopts exclusion, and these gates need far more wall time
+than that run's budget — without the marker they'd eat the whole
+budget mid-suite and silently starve every test file sorting after
+this one."""
 
 import os
 import time
@@ -13,6 +20,8 @@ import time
 import pytest
 
 from .conftest import tpch_query
+
+pytestmark = pytest.mark.slow
 
 
 def _dataset(scale: float, tag: str) -> str:
