@@ -127,6 +127,41 @@ class SessionContext:
     def deregister_table(self, name: str) -> None:
         self.catalog.deregister(name)
 
+    # -- append ingestion ----------------------------------------------------
+
+    def append(self, table: str, data) -> dict:
+        """Append rows to a registered table without rewriting its files.
+
+        Bumps the table's version: cached results over the table either
+        maintain incrementally from the retained delta or recompute
+        (docs/streaming.md). `data` is a pa.Table, RecordBatch, or list of
+        batches; columns match the table schema by name and are cast to its
+        types. Returns {"table", "version", "rows"}.
+        """
+        name = table.lower()
+        provider = self.catalog.get(name)
+        schema = provider.arrow_schema() if provider is not None else None
+        batches = conform_append_batches(data, schema)
+        rows = sum(b.num_rows for b in batches)
+        if self.mode == "standalone":
+            scheduler = self._ensure_cluster().scheduler
+            sid = scheduler.sessions.create_or_update(
+                self.config.to_key_value_pairs(), str(self.session_id))
+            return scheduler.append_data(name, batches, sid)
+        if self.mode == "remote":
+            return self._ensure_remote().append_data(name, batches)
+        # local mode: overlay the registered provider in place; the planner
+        # unions the base scan with the overlay (AppendedTable)
+        if provider is None:
+            raise PlanningError(f"table not found: {table}")
+        from ballista_tpu.plan.provider import AppendedTable
+
+        if not isinstance(provider, AppendedTable):
+            provider = AppendedTable(provider)
+            self.catalog.register(name, provider)
+        version = provider.append(batches)
+        return {"table": name, "version": version, "rows": rows}
+
     # -- SQL ---------------------------------------------------------------
 
     def sql(self, query: str) -> "DataFrame":
@@ -292,10 +327,66 @@ class ClientPreparedStatement:
         physical = self.ctx.create_physical_plan(bound)
         return self.ctx.execute_collect(physical)
 
+    def subscribe(self, params=None) -> "ClientSubscription":
+        """Continuous-query mode: subscribe this statement to the versions
+        of the tables it reads. Every append (or DDL) that touches one of
+        them re-executes the statement — incrementally when the plan shape
+        is maintainable — and pushes the refreshed result; `next()` blocks
+        for it. The first result (current table state) arrives immediately."""
+        if self.ctx.mode == "standalone" and self._local_lift is None:
+            scheduler = self.ctx._ensure_cluster().scheduler
+            sub = scheduler.subscribe_statement(
+                self.statement_id, params, str(self.ctx.session_id))
+            return ClientSubscription(self.ctx, sub=sub)
+        if self.ctx.mode == "remote" and self._local_lift is None:
+            stream = self.ctx._ensure_remote().subscribe_query(self.statement_id, params)
+            return ClientSubscription(self.ctx, stream=stream)
+        raise PlanningError(
+            "continuous queries need a scheduler (standalone or remote mode)")
+
     def close(self) -> None:
         if (self.ctx.mode == "standalone" and self._local_lift is None
                 and self.ctx._cluster is not None):
             self.ctx._cluster.scheduler.close_prepared(self.statement_id)
+
+
+class ClientSubscription:
+    """Handle for a continuous query. `next(timeout)` blocks for the next
+    refreshed result table; `close()` unsubscribes. Standalone mode drains
+    the scheduler's in-process subscription queue; remote mode drains the
+    SubscribeQuery push stream and fetches each refresh's partitions."""
+
+    def __init__(self, ctx: SessionContext, sub=None, stream=None):
+        self.ctx = ctx
+        self._sub = sub
+        self._stream = stream
+        self.subscription_id = sub.sub_id if sub is not None else ""
+
+    def next(self, timeout: float = 30.0) -> pa.Table:
+        from ballista_tpu.errors import ExecutionError
+
+        if self._sub is not None:
+            import queue as _q
+
+            try:
+                st = self._sub.queue.get(timeout=timeout)
+            except _q.Empty:
+                raise ExecutionError(
+                    f"no refresh within {timeout}s on {self.subscription_id}") from None
+        else:
+            st = self._stream.next(timeout=timeout)
+            if not self.subscription_id:
+                self.subscription_id = self._stream.sub_id
+        if st.get("state") != "successful":
+            raise ExecutionError(
+                f"subscription refresh {st.get('state')}: {st.get('error', '')}")
+        return fetch_job_results(st, self.ctx.config)
+
+    def close(self) -> None:
+        if self._sub is not None and self.ctx._cluster is not None:
+            self.ctx._cluster.scheduler.unsubscribe(self._sub.sub_id)
+        elif self._stream is not None:
+            self._stream.close()
 
 
 class DataFrame:
@@ -485,6 +576,34 @@ class DataFrame:
 
     def show(self, n: int = 20) -> None:
         print(self.collect().slice(0, n).to_pandas().to_string())
+
+
+def conform_append_batches(data, schema: pa.Schema | None) -> list[pa.RecordBatch]:
+    """Normalize append input (Table / RecordBatch / list of batches) to
+    record batches conforming to the table schema: columns match by NAME
+    (not position) and cast to the declared types, so callers can append a
+    column subset order-independently. Missing columns are an explicit
+    error rather than a silent null fill — appends must be self-complete.
+    With no schema (table unknown client-side) the rows ship as-is and the
+    server-side scan alignment does the work."""
+    if isinstance(data, pa.RecordBatch):
+        tbl = pa.Table.from_batches([data])
+    elif isinstance(data, pa.Table):
+        tbl = data
+    else:
+        batches = list(data)
+        if not batches:
+            raise PlanningError("append needs at least one row batch")
+        tbl = pa.Table.from_batches(batches)
+    if schema is None:
+        return tbl.combine_chunks().to_batches()
+    cols = []
+    for f in schema:
+        idx = tbl.schema.get_field_index(f.name)
+        if idx < 0:
+            raise PlanningError(f"append is missing column {f.name!r}")
+        cols.append(tbl.column(idx).cast(f.type))
+    return pa.Table.from_arrays(cols, schema=schema).combine_chunks().to_batches()
 
 
 def fetch_job_results(status: dict, config: BallistaConfig) -> pa.Table:

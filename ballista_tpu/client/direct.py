@@ -199,6 +199,15 @@ class DirectDispatcher:
             return self._demote(params, f"bind-failed: {e}")
         if stage is None:
             return self._demote(params, "not-single-stage" if cfg else "template-evicted")
+        # append ingestion: retained deltas live on the scheduler and graft
+        # at dispatch time — a direct launch of the cached template would
+        # scan stale base files, so any appended table demotes
+        if not self.scheduler.ingest.empty():
+            from ballista_tpu.serving.normalize import collect_scan_tables
+
+            touched = collect_scan_tables(stage.plan)
+            if touched & self.scheduler.ingest.tables_with_deltas():
+                return self._demote(params, "appended-table")
         lease = self._acquire_lease()
         if lease is None:
             return self._demote(params, "no-lease")

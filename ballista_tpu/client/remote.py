@@ -256,6 +256,49 @@ class RemoteSchedulerClient:
                     continue
                 raise GrpcError(f"ExecutePrepared failed: {e}") from None
 
+    # -- append ingestion / continuous queries -------------------------------
+
+    def append_data(self, table: str, batches: list[pa.RecordBatch]) -> dict:
+        """AppendData rpc: ship appended rows to the scheduler's ingest
+        registry. The rpc reuses the ExecuteQuery message pair — the table
+        name rides in job_name, the batches ride as a MemoryScanNode plan
+        (the same IPC carrier memory-table submissions use), and the
+        response's job_id field carries {table, version, rows} as JSON."""
+        import json
+
+        from ballista_tpu.plan.physical import MemoryScanExec
+        from ballista_tpu.plan.schema import DFSchema
+
+        sid = self.ensure_session()
+        schema = batches[0].schema if batches else pa.schema([])
+        scan = MemoryScanExec(DFSchema.from_arrow(schema), batches, 1)
+        req = pb.ExecuteQueryParams(session_id=sid, job_name=table)
+        req.physical_plan.CopyFrom(encode_plan(scan))
+        req.settings.extend(self._settings())
+        try:
+            resp = self.stub.AppendData(req, timeout=30)
+        except grpc.RpcError as e:
+            raise GrpcError(f"AppendData failed: {e}") from None
+        return json.loads(resp.job_id)
+
+    def subscribe_query(self, statement_id: str, params=None) -> "SubscriptionStream":
+        """SubscribeQuery rpc: open a server-streaming continuous query on
+        a prepared statement. The first frame is a handshake carrying the
+        subscription id; each subsequent frame is a refreshed job status
+        whose partitions the caller fetches."""
+        import json
+
+        from ballista_tpu.serving.normalize import encode_params
+
+        sid = self.ensure_session()
+        body = {"statement_id": statement_id}
+        if params is not None:
+            body["params"] = encode_params(params)
+        req = pb.ExecuteQueryParams(sql=json.dumps(body), session_id=sid)
+        req.settings.extend(self._settings())
+        call = self.stub.SubscribeQuery(req)
+        return SubscriptionStream(call)
+
     def cancel_job(self, job_id: str) -> None:
         self.stub.CancelJob(pb.CancelJobParams(job_id=job_id), timeout=10)
 
@@ -285,3 +328,46 @@ class RemoteSchedulerClient:
                 f"job {status.get('job_id', '?')} {status['state']}: {status.get('error', '')}"
             )
         return fetch_job_results(status, self.config)
+
+
+class SubscriptionStream:
+    """Client side of a SubscribeQuery stream: a drain thread decouples the
+    gRPC iterator from the caller so `next(timeout)` can time out without
+    tearing down the stream. The handshake frame (job_id only, no status)
+    carries the subscription id; every later frame is a refresh status."""
+
+    def __init__(self, call):
+        import queue
+        import threading
+
+        self.call = call
+        self.sub_id = ""
+        self.queue: "queue.Queue[dict]" = queue.Queue()
+        self._thread = threading.Thread(
+            target=self._drain, name="subscription-drain", daemon=True)
+        self._thread.start()
+
+    def _drain(self) -> None:
+        try:
+            for event in self.call:
+                if event.HasField("status"):
+                    self.queue.put(decode_job_status(event.status))
+                elif not self.sub_id and event.job_id:
+                    self.sub_id = event.job_id
+        except grpc.RpcError as e:
+            code = e.code() if hasattr(e, "code") else None
+            if code != grpc.StatusCode.CANCELLED:  # close() cancels; not an error
+                self.queue.put({"state": "failed", "error": f"subscription stream: {e}"})
+
+    def next(self, timeout: float = 30.0) -> dict:
+        import queue
+
+        try:
+            return self.queue.get(timeout=timeout)
+        except queue.Empty:
+            raise ExecutionError(
+                f"no refresh within {timeout}s on subscription {self.sub_id or '?'}"
+            ) from None
+
+    def close(self) -> None:
+        self.call.cancel()

@@ -89,6 +89,14 @@ SERVING_RESULT_CACHE_BYTES = "ballista.serving.result.cache.max.bytes"
 SERVING_RESULT_MAX_BYTES = "ballista.serving.result.cache.max.result.bytes"
 SERVING_FAST_LANE = "ballista.serving.fast.lane.enabled"
 SERVING_FAST_LANE_TIMEOUT_S = "ballista.serving.fast.lane.timeout.seconds"
+# streaming ingestion + incremental maintenance (docs/streaming.md)
+SERVING_INCREMENTAL = "ballista.serving.incremental.enabled"
+SERVING_INCREMENTAL_STATE_ENTRIES = "ballista.serving.incremental.state.max.entries"
+SERVING_INCREMENTAL_STATE_BYTES = "ballista.serving.incremental.state.max.bytes"
+SERVING_SUBSCRIPTION_QUEUE = "ballista.serving.incremental.subscription.queue.depth"
+INGEST_DELTA_RETAIN_BYTES = "ballista.ingest.delta.retained.max.bytes"
+INGEST_DELTA_RETAIN_VERSIONS = "ballista.ingest.delta.retained.max.versions"
+INGEST_COMPACTION_DIR = "ballista.ingest.compaction.dir"
 # overload protection: Flight data plane
 FLIGHT_MAX_STREAMS = "ballista.flight.max.streams"
 FLIGHT_ACCEPT_QUEUE = "ballista.flight.accept.queue.depth"
@@ -500,6 +508,62 @@ _ENTRIES: list[ConfigEntry] = [
         "to the full DAG path (covers executors lost mid-flight, which fast "
         "jobs otherwise would not notice).",
         float, 30.0, _pos,
+    ),
+    ConfigEntry(
+        SERVING_INCREMENTAL,
+        "Serving tier: maintain eligible cached results incrementally on "
+        "append (delta query over retained appends merged into cached "
+        "aggregation state) instead of recomputing from scratch. Ineligible "
+        "shapes fall back to full recompute with a recorded reason. "
+        "Env escape hatch: BALLISTA_SERVING_INCREMENTAL=0.",
+        bool, _env_bool("BALLISTA_SERVING_INCREMENTAL", True),
+    ),
+    ConfigEntry(
+        SERVING_INCREMENTAL_STATE_ENTRIES,
+        "Aggregation-state cache entry cap (LRU): one entry per (plan "
+        "template, bound values) holds the pre-finisher accumulator rows a "
+        "maintained refresh merges deltas into. "
+        "Env: BALLISTA_SERVING_INCREMENTAL_STATE_ENTRIES.",
+        int, _env_int("BALLISTA_SERVING_INCREMENTAL_STATE_ENTRIES", 256), _pos,
+    ),
+    ConfigEntry(
+        SERVING_INCREMENTAL_STATE_BYTES,
+        "Aggregation-state cache byte budget (LRU evicts past it; an evicted "
+        "state falls back to bootstrap recompute on the next refresh). "
+        "Env: BALLISTA_SERVING_INCREMENTAL_STATE_BYTES.",
+        int, _env_int("BALLISTA_SERVING_INCREMENTAL_STATE_BYTES", 64 * 1024 * 1024), _pos,
+    ),
+    ConfigEntry(
+        SERVING_SUBSCRIPTION_QUEUE,
+        "Continuous queries: bounded per-subscription push queue depth; when "
+        "a slow consumer falls behind, the oldest undelivered refresh is "
+        "dropped (freshest-wins) and counted. "
+        "Env: BALLISTA_SERVING_SUBSCRIPTION_QUEUE.",
+        int, _env_int("BALLISTA_SERVING_SUBSCRIPTION_QUEUE", 32), _pos,
+    ),
+    ConfigEntry(
+        INGEST_DELTA_RETAIN_BYTES,
+        "Append ingestion: byte budget for retained per-version delta sets "
+        "across all tables. Crossing it folds the oldest deltas into the "
+        "table's base version (parquet spool) instead of dropping rows, so "
+        "memory cannot grow with append rate. "
+        "Env: BALLISTA_INGEST_DELTA_RETAIN_BYTES.",
+        int, _env_int("BALLISTA_INGEST_DELTA_RETAIN_BYTES", 64 * 1024 * 1024), _pos,
+    ),
+    ConfigEntry(
+        INGEST_DELTA_RETAIN_VERSIONS,
+        "Append ingestion: max retained delta versions per table; older "
+        "versions are folded (compacted) into the base. A maintained refresh "
+        "older than the fold horizon falls back to full recompute with "
+        "reason delta-compacted. Env: BALLISTA_INGEST_DELTA_RETAIN_VERSIONS.",
+        int, _env_int("BALLISTA_INGEST_DELTA_RETAIN_VERSIONS", 64), _pos,
+    ),
+    ConfigEntry(
+        INGEST_COMPACTION_DIR,
+        "Append ingestion: directory delta compaction spools folded parquet "
+        "parts into (empty = a per-scheduler temp dir). "
+        "Env: BALLISTA_INGEST_COMPACTION_DIR.",
+        str, _env_str("BALLISTA_INGEST_COMPACTION_DIR", ""),
     ),
     ConfigEntry(
         FLIGHT_MAX_STREAMS,

@@ -79,7 +79,7 @@ from ballista_tpu.plan.physical import (
     SortPreservingMergeExec,
     UnionExec,
 )
-from ballista_tpu.plan.provider import MemoryTable, ParquetTable
+from ballista_tpu.plan.provider import AppendedTable, MemoryTable, ParquetTable
 from ballista_tpu.plan.schema import DFField, DFSchema
 
 
@@ -202,6 +202,8 @@ class PhysicalPlanner:
 
     def _plan_scan(self, node: TableScan) -> ExecutionPlan:
         provider = node.provider
+        if isinstance(provider, AppendedTable):
+            return self._plan_appended_scan(node, provider)
         if isinstance(provider, MemoryTable):
             child = MemoryScanExec(node.schema, provider.batches, provider.partitions)
             if node.filters:
@@ -231,6 +233,41 @@ class PhysicalPlanner:
             keep = [Column(f.name, f.qualifier) for f in node.schema]
             return ProjectionExec(scan, keep, node.schema)
         return ParquetScanExec(node.schema, partitions, proj_names, node.filters, node.table_name)
+
+    def _plan_appended_scan(self, node: TableScan, provider: AppendedTable) -> ExecutionPlan:
+        """Base scan ∪ memory scan of the append overlay (local-mode
+        ingestion). The delta leg re-applies the scan's predicates — the
+        base leg gets them via pushdown — and mirrors the parquet branch's
+        filter-only-column handling."""
+        import copy
+
+        base_node = copy.copy(node)
+        base_node.provider = provider.base
+        base_plan = self._plan_scan(base_node)
+        if not provider.batches:
+            return base_plan
+        from ballista_tpu.plan.expressions import and_, collect_columns
+
+        proj_names = [f.name for f in node.schema]
+        filter_cols: list[str] = []
+        for f in node.filters:
+            for c in collect_columns(f):
+                if c.name not in proj_names and c.name not in filter_cols:
+                    filter_cols.append(c.name)
+        if filter_cols:
+            full = provider.df_schema().with_qualifier(node.alias or node.table_name)
+            read_fields = list(node.schema.fields) + [
+                full.field(full.index_of(n)) for n in filter_cols
+            ]
+            delta: ExecutionPlan = MemoryScanExec(DFSchema(read_fields), provider.batches, 1)
+            delta = FilterExec(delta, and_(*node.filters))
+            keep = [Column(f.name, f.qualifier) for f in node.schema]
+            delta = ProjectionExec(delta, keep, node.schema)
+        else:
+            delta = MemoryScanExec(node.schema, provider.batches, 1)
+            if node.filters:
+                delta = FilterExec(delta, and_(*node.filters))
+        return UnionExec([base_plan, delta], node.schema)
 
     # ------------------------------------------------------------------
 

@@ -355,6 +355,7 @@ class ExecutorProcess:
                     "sort_kernel_s", "sort_invocations", "topk_invocations",
                     "topk_rows_kept", "window_invocations",
                     "window_partitions", "sort_full_materializations",
+                    "delta_fill_rows",
                     "daemon_attached", "init_platform_probe_s",
                     "init_jax_devices_s", "init_first_compile_s"):
             if key in stats:

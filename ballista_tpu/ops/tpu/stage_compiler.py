@@ -171,7 +171,10 @@ class RunStats(Mapping):
     (runtime join mode switches in either direction), and
     aqe_mesh_replans (mesh stages whose bucket count was replanned or
     whose fused exchange was demoted on skew) — all cumulative, all
-    forwarded to the heartbeat under their own names."""
+    forwarded to the heartbeat under their own names. Append ingestion
+    (serving/incremental.py, docs/streaming.md): delta_fill_rows — rows
+    a memory-backed (delta-grafted) scan filled onto the device, so the
+    heartbeat shows ingested-delta volume reaching the TPU tier."""
 
     _MAX_STAGES = 32
 
@@ -418,6 +421,12 @@ class DeviceTableCache:
                                 chunk_rows=chunk_rows, stats=stats, on_spec=on_spec)
             RUN_STATS.set("fill_s", round(time.time() - t0, 3), rec=stats)
             RUN_STATS.set("device_bytes", dt.nbytes, rec=stats)
+            if getattr(scan, "mem_token", None) is not None:
+                # memory-backed fill = ingested delta rows riding a grafted
+                # scan (serving/incremental.py) — surfaced so operators can
+                # watch delta volume reach the device tier
+                RUN_STATS.set("delta_fill_rows",
+                              int(sum(int(r) for r in dt.part_rows)), rec=stats)
             with self._lock:
                 total = sum(v.nbytes for v in self._cache.values())
                 while self._cache and total + dt.nbytes > max_bytes:

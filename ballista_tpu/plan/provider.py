@@ -194,6 +194,40 @@ class MemoryTable(TableProvider):
         return [{"memory_partition": i, "of": n} for i in range(n)]
 
 
+class AppendedTable(TableProvider):
+    """A base provider overlaid with appended in-memory batches — local
+    mode's mirror of the scheduler's ingest DeltaRegistry. `ctx.append`
+    wraps the registered provider once and extends the overlay on each
+    call; the planner unions the base scan with a memory scan of the
+    overlay (engine/physical_planner.py::_plan_scan), so reads always see
+    base + appends without rewriting files."""
+
+    def __init__(self, base: TableProvider):
+        self.base = base
+        self.batches: list[pa.RecordBatch] = []
+        self.version = 0
+
+    def append(self, batches: list[pa.RecordBatch]) -> int:
+        self.batches.extend(batches)
+        self.version += 1
+        return self.version
+
+    def arrow_schema(self) -> pa.Schema:
+        return self.base.arrow_schema()
+
+    def statistics(self) -> TableStats:
+        base = self.base.statistics()
+        if base.num_rows is None:
+            return TableStats()
+        rows = sum(b.num_rows for b in self.batches)
+        nbytes = sum(b.nbytes for b in self.batches)
+        return TableStats(base.num_rows + rows, (base.total_bytes or 0) + nbytes,
+                          base.columns)
+
+    def scan_partitions(self, target_partitions: int) -> list[dict]:
+        return self.base.scan_partitions(target_partitions)
+
+
 class Catalog:
     """Session table registry (names → providers)."""
 

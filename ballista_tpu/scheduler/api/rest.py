@@ -97,6 +97,10 @@ def start_rest_api(scheduler: SchedulerServer, metrics: InMemoryMetricsCollector
                         scheduler.executors.aggregate_pressure(), 4),
                     # serving tier: plan/result cache hit rates + fast lane
                     "serving": scheduler.serving.snapshot(),
+                    # append ingestion: retained delta versions/bytes,
+                    # compaction counters; continuous-query subscriptions
+                    "ingest": scheduler.ingest.snapshot(),
+                    "subscriptions": scheduler.subscriptions.snapshot(),
                     # scheduler scale-out: per-shard queue depth/lag/job
                     # counts, direct-dispatch lease ledger, heartbeat fan-in
                     "shards": scheduler.shards_snapshot(),
@@ -224,6 +228,21 @@ def start_rest_api(scheduler: SchedulerServer, metrics: InMemoryMetricsCollector
             if m:
                 scheduler.cancel_job(m.group(1))
                 return self._json({"cancelled": m.group(1)})
+            m = re.match(r"^/api/table/([^/]+)/append$", self.path.rstrip("/"))
+            if m:
+                # body: one Arrow IPC stream of appended rows
+                import pyarrow as pa
+
+                length = int(self.headers.get("Content-Length", 0))
+                if length <= 0:
+                    return self._json({"error": "empty body"}, 400)
+                try:
+                    reader = pa.ipc.open_stream(self.rfile.read(length))
+                    batches = [b for b in reader if b.num_rows]
+                    out = scheduler.append_data(m.group(1), batches)
+                except Exception as e:  # noqa: BLE001 — malformed IPC → client error
+                    return self._json({"error": str(e)}, 400)
+                return self._json(out)
             return self._json({"error": "not found"}, 404)
 
     server = ThreadingHTTPServer((host, port), Handler)

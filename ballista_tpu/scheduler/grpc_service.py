@@ -184,6 +184,62 @@ class SchedulerGrpcService:
                     return
             _time.sleep(0.05)
 
+    def AppendData(self, request: pb.ExecuteQueryParams, context) -> pb.ExecuteQueryResult:
+        """Append-oriented ingestion. Reuses the ExecuteQuery message pair
+        (no protoc here): job_name carries the table name, physical_plan
+        carries a MemoryScanExec whose IPC payload is the appended rows.
+        The response's job_id field carries JSON {table, version, rows}."""
+        import json
+
+        from ballista_tpu.serde import decode_plan
+
+        session_id = request.session_id or self.scheduler.sessions.create_or_update(
+            [(kv.key, kv.value) for kv in request.settings]
+        )
+        if not request.job_name:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                          "AppendData requires the table name in job_name")
+        plan = decode_plan(request.physical_plan)
+        batches = [b for b in getattr(plan, "batches", []) if b.num_rows]
+        out = self.scheduler.append_data(request.job_name, batches, session_id)
+        return pb.ExecuteQueryResult(job_id=json.dumps(out), session_id=session_id)
+
+    def SubscribeQuery(self, request: pb.ExecuteQueryParams, context):
+        """Continuous-query push stream: subscribe a prepared statement to
+        its tables' versions; every append/DDL bump re-executes it
+        (incrementally when eligible) and pushes the fresh terminal status.
+        sql carries JSON {statement_id, params} like ExecutePrepared; the
+        first frame's job_id is the subscription handle. Remote clients
+        fetch each refresh's partitions like any other job."""
+        import json
+        import queue as _queue
+
+        from ballista_tpu.serving.normalize import decode_params
+
+        body = json.loads(request.sql)
+        params = decode_params(body["params"]) if body.get("params") else None
+        try:
+            sub = self.scheduler.subscribe_statement(
+                body["statement_id"], params, request.session_id,
+                inline_results=False)
+        except BallistaError as e:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+        yield pb.ExecuteQueryPushResult(job_id=sub.sub_id,
+                                        session_id=request.session_id)
+        try:
+            while context.is_active():
+                try:
+                    st = sub.queue.get(timeout=0.25)
+                except _queue.Empty:
+                    continue
+                out = pb.ExecuteQueryPushResult(
+                    job_id=str(st.get("job_id", "")),
+                    session_id=request.session_id)
+                out.status.CopyFrom(encode_job_status(st))
+                yield out
+        finally:
+            self.scheduler.unsubscribe(sub.sub_id)
+
     def CreateUpdateSession(self, request: pb.CreateSessionParams, context) -> pb.CreateSessionResult:
         sid = self.scheduler.sessions.create_or_update(
             [(kv.key, kv.value) for kv in request.settings], request.session_id
@@ -265,6 +321,9 @@ _RPCS = {
     # here): handles/params travel as JSON in the sql/job_id string fields
     "PrepareStatement": (pb.ExecuteQueryParams, pb.ExecuteQueryResult),
     "ExecutePrepared": (pb.ExecuteQueryParams, pb.ExecuteQueryResult),
+    # append ingestion rides the same pair: table in job_name, rows as a
+    # MemoryScanExec in physical_plan, {table, version, rows} JSON back
+    "AppendData": (pb.ExecuteQueryParams, pb.ExecuteQueryResult),
     "GetJobStatus": (pb.GetJobStatusParams, pb.GetJobStatusResult),
     "CreateUpdateSession": (pb.CreateSessionParams, pb.CreateSessionResult),
     "RemoveSession": (pb.RemoveSessionParams, pb.RemoveSessionResult),
@@ -281,6 +340,7 @@ _RPCS = {
 # server-streaming rpcs (reference: execute_query_push, grpc.rs:419)
 _STREAM_RPCS = {
     "ExecuteQueryPush": (pb.ExecuteQueryParams, pb.ExecuteQueryPushResult),
+    "SubscribeQuery": (pb.ExecuteQueryParams, pb.ExecuteQueryPushResult),
 }
 
 

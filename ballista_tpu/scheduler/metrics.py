@@ -56,6 +56,12 @@ class SchedulerMetricsCollector:
 
     def record_direct_dispatch(self, outcome: str) -> None: ...
 
+    # -- incremental maintenance (append ingestion, delta refresh) ---------
+
+    def record_append(self, rows: int) -> None: ...
+
+    def record_incremental(self, outcome: str) -> None: ...
+
 
 class NoopMetricsCollector(SchedulerMetricsCollector):
     pass
@@ -120,6 +126,10 @@ class InMemoryMetricsCollector(SchedulerMetricsCollector):
         # direct dispatch: lease lifecycle + dispatch outcomes
         self.lease_events: dict[str, int] = {}  # minted | revoked | expired
         self.direct_dispatch: dict[str, int] = {}  # dispatched | reconciled | demoted
+        # incremental maintenance: appends + refresh outcomes
+        self.appends = 0
+        self.appended_rows = 0
+        self.incremental: dict[str, int] = {}  # maintained | state_render | bootstrap | recompute
         self.exec_hist = _Histogram(_LATENCY_BUCKETS)
         self.plan_hist = _Histogram(_PLANNING_BUCKETS)
 
@@ -199,6 +209,15 @@ class InMemoryMetricsCollector(SchedulerMetricsCollector):
         with self._lock:
             self.direct_dispatch[outcome] = self.direct_dispatch.get(outcome, 0) + 1
 
+    def record_append(self, rows: int) -> None:
+        with self._lock:
+            self.appends += 1
+            self.appended_rows += rows
+
+    def record_incremental(self, outcome: str) -> None:
+        with self._lock:
+            self.incremental[outcome] = self.incremental.get(outcome, 0) + 1
+
     def set_overload_state(self, state: str) -> None:
         with self._lock:
             self.overload_state = state
@@ -262,6 +281,16 @@ class InMemoryMetricsCollector(SchedulerMetricsCollector):
             lines.append("# TYPE ballista_scheduler_direct_dispatch_total counter")
             for outcome in sorted(self.direct_dispatch):
                 lines.append(f'ballista_scheduler_direct_dispatch_total{{outcome="{outcome}"}} {self.direct_dispatch[outcome]}')
+            lines.append("# HELP ballista_scheduler_appends_total Append-ingestion calls accepted")
+            lines.append("# TYPE ballista_scheduler_appends_total counter")
+            lines.append(f"ballista_scheduler_appends_total {self.appends}")
+            lines.append("# HELP ballista_scheduler_appended_rows_total Rows accepted by append ingestion")
+            lines.append("# TYPE ballista_scheduler_appended_rows_total counter")
+            lines.append(f"ballista_scheduler_appended_rows_total {self.appended_rows}")
+            lines.append("# HELP ballista_scheduler_incremental_total Version-bumped serving refreshes, by outcome")
+            lines.append("# TYPE ballista_scheduler_incremental_total counter")
+            for outcome in sorted(self.incremental):
+                lines.append(f'ballista_scheduler_incremental_total{{outcome="{outcome}"}} {self.incremental[outcome]}')
             lines.append("# HELP ballista_scheduler_overload_state Overload posture (0=normal 1=shedding 2=draining)")
             lines.append("# TYPE ballista_scheduler_overload_state gauge")
             state_code = {"normal": 0, "shedding": 1, "draining": 2}.get(self.overload_state, 0)

@@ -26,8 +26,10 @@ from typing import Callable, Optional
 from ballista_tpu.config import (
     SERVING_FAST_LANE,
     SERVING_FAST_LANE_TIMEOUT_S,
+    SERVING_INCREMENTAL,
     SERVING_PLAN_CACHE,
     SERVING_RESULT_CACHE,
+    SERVING_SUBSCRIPTION_QUEUE,
     BallistaConfig,
 )
 from ballista_tpu.errors import BallistaError, ClusterOverloaded, PlanningError
@@ -45,6 +47,16 @@ from ballista_tpu.scheduler.state.execution_graph import (
 from ballista_tpu.scheduler.state.executor_manager import ExecutorManager
 from ballista_tpu.scheduler.state.session_manager import SessionManager
 from ballista_tpu.serving.fast_lane import FAST_TASK_ID_BASE, FastJob
+from ballista_tpu.serving.incremental import (
+    DeltaRegistry,
+    SubscriptionRegistry,
+    build_maintain_plan,
+    decide,
+    graft_append_scans,
+    graft_delta_scan,
+    render_finisher,
+    split_finisher,
+)
 from ballista_tpu.serving.lease import (
     DEFAULT_LEASE_SLOTS, DEFAULT_LEASE_TTL_S, ExecutorLease, LeaseRegistry)
 from ballista_tpu.serving.normalize import (
@@ -54,7 +66,12 @@ from ballista_tpu.serving.normalize import (
     config_fingerprint,
     lift_parameters,
 )
-from ballista_tpu.serving.tier import PlanTemplate, PreparedStatement, ServingTier
+from ballista_tpu.serving.tier import (
+    PlanTemplate,
+    PreparedStatement,
+    ServingTier,
+    StateEntry,
+)
 
 log = logging.getLogger(__name__)
 
@@ -109,6 +126,33 @@ class Event:
     posted_at: float = field(default_factory=time.monotonic)
 
 
+@dataclass
+class _RcFill:
+    """What to do with a dispatched job's output before serving it.
+
+    kind "plain": the output IS the result — store under `rkey`.
+    kind "state": the output is accumulator state (the plan was truncated
+    at the final aggregate) — persist it as a StateEntry, render the
+    finisher chain over it, and serve/store the rendered table. The job
+    must not look successful until the render lands (`_rc_render_pending`
+    masks `job_status`), or clients would fetch raw accumulators.
+    kind "append": the output is the delta rows of an append-maintained
+    plan — concatenate onto `base` (the cached prior result), persist,
+    serve.
+    """
+
+    rkey: tuple
+    kind: str = "plain"  # plain | state | append
+    template_key: str = ""
+    values: tuple = ()
+    vector: tuple = ()  # table-version vector snapshotted at submit
+    finisher: list = field(default_factory=list)
+    final: object = None  # the final HashAggregateExec (kind "state")
+    base: object = None  # prior result table (kind "append" maintain)
+    mode: str = ""  # maintained | bootstrap
+    inline_result: object = None  # set when no job needs dispatching
+
+
 class SchedulerServer:
     def __init__(self, launcher: TaskLauncher | None = None,
                  metrics: SchedulerMetricsCollector | None = None,
@@ -159,7 +203,14 @@ class SchedulerServer:
         self.serving = ServingTier()
         self._fast_jobs: dict[str, FastJob] = {}
         # graph jobs whose results should fill a result-cache slot on finish
-        self._rc_pending: dict[str, tuple] = {}
+        self._rc_pending: dict[str, _RcFill] = {}
+        # jobs whose terminal transition is owned by the post-finish render
+        # (incremental state/append fills): job_status masks success until
+        # the rendered result is attached
+        self._rc_render_pending: set[str] = set()
+        # streaming ingestion: retained append deltas + continuous queries
+        self.ingest = DeltaRegistry()
+        self.subscriptions = SubscriptionRegistry()
         # lifecycle (docs/lifecycle.md): drains in flight (guards against
         # duplicate heartbeat triggers) + fleet drain/GC counters surfaced
         # on /api/state
@@ -168,8 +219,9 @@ class SchedulerServer:
         self.lifecycle_stats = {"drains": 0, "drain_kills": 0,
                                 "migrated_partitions": 0, "migrated_bytes": 0,
                                 "gc_swept_jobs": 0}
-        # catalog changes orphan the table's cached results
-        self.sessions.on_catalog_change = self.serving.table_versions.bump
+        # catalog changes orphan the table's cached results AND its
+        # retained deltas (new lineage), and wake continuous queries
+        self.sessions.on_catalog_change = self._on_catalog_change
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -360,7 +412,7 @@ class SchedulerServer:
                     # (the planning context handles them); catalog-visible
                     # DDL orphans the table's cached results
                     if isinstance(stmt, (CreateExternalTable, DropTable)):
-                        self.serving.table_versions.bump(stmt.name.lower())
+                        self._on_catalog_change(stmt.name.lower())
                     return self._enqueue_legacy_sql(job_id, sql, session_id, job_name)
                 ctx = self.sessions.create_planning_context(session_id)
                 optimized = optimize(SqlPlanner(ctx.catalog).plan_query(stmt))
@@ -398,10 +450,17 @@ class SchedulerServer:
             else:
                 rkey = None
             bound = bind_physical(template.physical, values)
+            physical, fill = self._incremental_or_plain(template, values, bound,
+                                                        rkey, cfg)
             self.metrics.record_planning_ms(job_id, (time.time() - t0) * 1000)
+            if physical is None:
+                # cached state already covers the current versions
+                self.serving.store_result(rkey, fill.inline_result)
+                return self._serve_inline(job_id, job_name, session_id, cfg,
+                                          fill.inline_result)
             return self._dispatch_serving(job_id, job_name, session_id, cfg,
-                                          bound, template, values, inline_results,
-                                          rkey=rkey)
+                                          physical, template, values,
+                                          inline_results, fill=fill)
         except BaseException as e:  # noqa: BLE001 — same contract as _plan_job
             log.warning("serving submit failed for %s: %s", job_id, e, exc_info=True)
             with self._jobs_lock:
@@ -416,24 +475,27 @@ class SchedulerServer:
 
     def _dispatch_serving(self, job_id: str, job_name: str, session_id: str,
                           cfg: BallistaConfig, physical, template, values,
-                          inline_results: bool, rkey=None) -> str:
+                          inline_results: bool, fill: _RcFill | None = None) -> str:
         """Stage the bound plan and dispatch: fast lane for single-stage
         plans with slots available, the ordinary execution graph otherwise."""
         from ballista_tpu.scheduler.planner import merge_mesh_stages
 
+        physical = self._graft_deltas(physical)
         stages = merge_mesh_stages(DistributedPlanner(job_id).plan_query_stages(physical), cfg)
         self._maybe_verify_stages(stages, cfg, job_id)
         if template is not None and template.single_stage is None:
             template.single_stage = len(stages) == 1
         if (len(stages) == 1 and self.launcher is not None
                 and bool(cfg.get(SERVING_FAST_LANE))
-                and self._try_fast_lane(job_id, job_name, session_id, cfg, stages, rkey)):
+                and self._try_fast_lane(job_id, job_name, session_id, cfg, stages, fill)):
             return job_id
         graph = ExecutionGraph(job_id, job_name, session_id, stages, cfg)
         with self._jobs_lock:
             self.jobs[job_id] = graph
-            if rkey is not None:
-                self._rc_pending[job_id] = rkey
+            if fill is not None:
+                self._rc_pending[job_id] = fill
+                if fill.kind != "plain":
+                    self._rc_render_pending.add(job_id)
         if self.job_state.acquire(job_id, self.scheduler_id):
             self.job_state.save_graph(graph)
         self.post(Event("revive", job_id))
@@ -455,7 +517,7 @@ class SchedulerServer:
             check_stages(stages)
 
     def _try_fast_lane(self, job_id: str, job_name: str, session_id: str,
-                       cfg: BallistaConfig, stages, rkey) -> bool:
+                       cfg: BallistaConfig, stages, fill) -> bool:
         """Dispatch a single-stage job straight to warm executors from the
         submit thread — no graph, no event-loop round trip. Declines (and
         the caller falls back to the graph) unless every partition gets a
@@ -469,7 +531,7 @@ class SchedulerServer:
             for executor_id, count in reservations:
                 self.executors.free_slot(executor_id, count)
             return False
-        job = FastJob(job_id, job_name, session_id, cfg, stages=stages, rc_key=rkey)
+        job = FastJob(job_id, job_name, session_id, cfg, stages=stages, rc_key=fill)
         with self._jobs_lock:
             self.jobs[job_id] = job
             self._fast_jobs[job_id] = job
@@ -487,6 +549,224 @@ class SchedulerServer:
         self.serving.note_fast_lane("executed")
         self.metrics.record_fast_lane("executed")
         return True
+
+    # -- streaming ingestion + incremental maintenance ------------------------
+
+    def _on_catalog_change(self, table: str) -> None:
+        self.serving.table_versions.bump(table)
+        self.ingest.reset(table)
+        self._notify_subscriptions(table)
+
+    def append_data(self, table: str, batches, session_id: str = "") -> dict:
+        """Append-oriented ingestion: bump the table's version AND retain
+        the delta batches under the new version, so eligible cached
+        results maintain instead of recomputing. Every read path sees the
+        appended rows immediately via the dispatch-time scan graft."""
+        table = str(table).lower()
+        rows = int(sum(b.num_rows for b in batches))
+        cfg = self.sessions.get(session_id)
+        if cfg is not None:
+            self.ingest.configure(cfg)
+        version = self.serving.table_versions.bump(table)
+        self.ingest.append(table, version, list(batches))
+        self.serving.note_append(rows)
+        self.metrics.record_append(rows)
+        self._notify_subscriptions(table)
+        log.debug("append %d rows to %s -> version %d", rows, table, version)
+        return {"table": table, "version": version, "rows": rows}
+
+    def _graft_deltas(self, physical):
+        """Bind-time delta stamping: planning contexts and cached templates
+        stay base-only; every dispatch path unions named scans with the
+        ingest registry's folded parts + retained appends. Stage planning
+        runs AFTER the graft, so AQE and plan verification see the real
+        DAG."""
+        if self.ingest.empty():
+            return physical
+        return graft_append_scans(physical, self.ingest.view())
+
+    def _serve_inline(self, job_id: str, job_name: str, session_id: str,
+                      cfg: BallistaConfig, result) -> str:
+        """Finish a submission whose result exists without dispatching."""
+        job = FastJob(job_id, job_name, session_id, cfg, inline_result=result)
+        with self._jobs_lock:
+            self.jobs[job_id] = job
+        self.metrics.record_completed(job_id, 0.0)
+        self._notify(job_id)
+        return job_id
+
+    def _incremental_or_plain(self, template: PlanTemplate, values: tuple,
+                              bound, rkey, cfg: BallistaConfig):
+        """The maintain-on-bump ladder for a result-cache miss. Returns
+        (physical_to_dispatch, fill); physical is None when the cached
+        state already covers the current versions (fill.inline_result is
+        the rendered answer, no job needed)."""
+        if rkey is None:
+            return bound, None
+        fill = _RcFill(rkey=rkey)
+        if not bool(cfg.get(SERVING_INCREMENTAL)):
+            return bound, fill
+        decision = decide(template)
+        if decision.mode == "none":
+            self.serving.note_incremental("recompute", decision.reason)
+            self.metrics.record_incremental("recompute")
+            return bound, fill
+        vector = rkey[2]  # version vector snapshotted into the result key
+        fill.template_key, fill.values, fill.vector = template.key, values, vector
+        entry = self.serving.lookup_state(template.key, values)
+        stale = entry if (entry is not None and entry.kind != decision.mode) else None
+        if stale is not None:
+            entry = None  # template re-analyzed differently; state unusable
+        changed = None
+        if entry is not None and len(entry.vector) == len(vector):
+            changed = [(t, old, new) for (t, old), (_, new)
+                       in zip(entry.vector, vector) if new != old]
+        if decision.mode == "aggregate":
+            final, chain = split_finisher(bound)
+            fill.kind, fill.final, fill.finisher = "state", final, chain
+            if changed is not None and not changed:
+                # result cache evicted but state is current: render only
+                result = render_finisher(chain, final, entry.table.to_batches(), cfg)
+                self.serving.note_incremental("state_render")
+                self.metrics.record_incremental("state_render")
+                fill.inline_result = result
+                return None, fill
+            if changed is not None and len(changed) == 1 and changed[0][2] > changed[0][1]:
+                t, old, new = changed[0]
+                deltas, why = self.ingest.range(t, old, new)
+                if deltas is not None:
+                    plan = build_maintain_plan(bound, t, deltas,
+                                               entry.table.to_batches())
+                    fill.mode = "maintained"
+                    self.serving.note_incremental("maintained")
+                    self.metrics.record_incremental("maintained")
+                    return plan, fill
+                self.serving.note_incremental("recompute", why)
+                self.metrics.record_incremental("recompute")
+            elif changed is not None:
+                reason = ("multi-table-append" if len(changed) > 1
+                          else "version-regressed")
+                self.serving.note_incremental("recompute", reason)
+                self.metrics.record_incremental("recompute")
+            # bootstrap: run the state computation once so the NEXT bump
+            # maintains; the finisher renders scheduler-side either way
+            fill.mode = "bootstrap"
+            if changed is None:  # fallbacks above already counted recompute
+                self.serving.note_incremental("bootstrap")
+                self.metrics.record_incremental("bootstrap")
+            return final, fill
+        # decision.mode == "append": stateless plans maintain by
+        # concatenating the delta query's rows onto the cached result
+        fill.kind = "append"
+        if changed is not None and not changed:
+            self.serving.note_incremental("state_render")
+            self.metrics.record_incremental("state_render")
+            fill.inline_result = entry.table
+            return None, fill
+        if changed is not None and len(changed) == 1 and changed[0][2] > changed[0][1]:
+            t, old, new = changed[0]
+            deltas, why = self.ingest.range(t, old, new)
+            if deltas is not None:
+                fill.base, fill.mode = entry.table, "maintained"
+                self.serving.note_incremental("maintained")
+                self.metrics.record_incremental("maintained")
+                return graft_delta_scan(bound, t, deltas), fill
+            self.serving.note_incremental("recompute", why)
+            self.metrics.record_incremental("recompute")
+        elif changed is not None:
+            self.serving.note_incremental("recompute", "multi-table-append")
+            self.metrics.record_incremental("recompute")
+        fill.mode = "bootstrap"
+        if changed is None:
+            self.serving.note_incremental("bootstrap")
+            self.metrics.record_incremental("bootstrap")
+        return bound, fill
+
+    def _finish_fill(self, fill: _RcFill, tbl, cfg) -> object:
+        """Turn a finished job's fetched output into the served result per
+        the fill kind, persisting maintenance state for the next bump."""
+        if fill.kind == "state":
+            result = render_finisher(fill.finisher, fill.final,
+                                     tbl.to_batches(), cfg)
+            self.serving.store_state(fill.template_key, fill.values,
+                                     StateEntry(fill.vector, tbl, "aggregate"))
+            self.serving.store_result(fill.rkey, result)
+            return result
+        if fill.kind == "append":
+            import pyarrow as pa
+
+            if fill.base is not None:
+                result = pa.concat_tables(
+                    [fill.base, tbl.cast(fill.base.schema)]).combine_chunks()
+            else:
+                result = tbl
+            self.serving.store_state(fill.template_key, fill.values,
+                                     StateEntry(fill.vector, result, "append"))
+            self.serving.store_result(fill.rkey, result)
+            return result
+        self.serving.store_result(fill.rkey, tbl)
+        return tbl
+
+    # -- continuous queries ----------------------------------------------------
+
+    def subscribe_statement(self, statement_id: str, params=None,
+                            session_id: str = "",
+                            inline_results: bool = True):
+        """Continuous-query mode: re-execute a prepared statement
+        (incrementally when eligible) on every bump of its tables, pushing
+        fresh results into the subscription's queue. Returns the
+        Subscription; the gRPC push stream drains its queue."""
+        stmt = self.serving.get_prepared(statement_id)
+        if stmt is None:
+            raise BallistaError(f"unknown prepared statement {statement_id}")
+        sid = session_id or stmt.session_id
+        cfg = self.sessions.get(sid) or BallistaConfig()
+        template = self.serving.plan_cache.get(stmt.key)
+        tables = template.tables if template is not None else ()
+        sub = self.subscriptions.register(
+            statement_id, tuple(params) if params is not None else None,
+            sid, tables, int(cfg.get(SERVING_SUBSCRIPTION_QUEUE)),
+            inline_results)
+        # push the current result immediately so subscribers start warm
+        self._spawn_subscription_refresh(sub)
+        return sub
+
+    def unsubscribe(self, sub_id: str) -> None:
+        self.subscriptions.remove(sub_id)
+
+    def _notify_subscriptions(self, table: str) -> None:
+        for sub in self.subscriptions.for_table(table):
+            self._spawn_subscription_refresh(sub)
+
+    def _spawn_subscription_refresh(self, sub) -> None:
+        if not sub.begin_refresh():
+            return  # in-flight refresh absorbs the bump (dirty mark)
+
+        def run():
+            while True:
+                try:
+                    job_id = self.execute_prepared(
+                        sub.statement_id, sub.params, session_id=sub.session_id,
+                        inline_results=sub.inline)
+                    st = self.wait_for_job(job_id, timeout=300.0)
+                    st = dict(st)
+                    st["subscription_id"] = sub.sub_id
+                    sub.offer(st)
+                    if not sub.tables:
+                        stmt = self.serving.get_prepared(sub.statement_id)
+                        peek = (self.serving.plan_cache.get(stmt.key)
+                                if stmt is not None else None)
+                        if peek is not None and peek.tables:
+                            self.subscriptions.bind_tables(sub, peek.tables)
+                except BaseException as e:  # noqa: BLE001 — push the error, keep the stream
+                    log.warning("subscription %s refresh failed: %s",
+                                sub.sub_id, e)
+                    sub.note_error(str(e))
+                if not sub.end_refresh():
+                    return
+
+        threading.Thread(target=run, daemon=True,
+                         name=f"subscription-{sub.sub_id}").start()
 
     # -- prepared statements ---------------------------------------------------
 
@@ -584,9 +864,16 @@ class SchedulerServer:
                     key=stmt.key, physical=physical, type_tags=lift.type_tags,
                     values=lift.values, tables=lift.tables, bindable=bindable)
                 self.serving.store_template(template)
+            physical, fill = self._incremental_or_plain(template, values, bound,
+                                                        rkey, cfg)
             self.metrics.record_planning_ms(job_id, (time.time() - t0) * 1000)
-            return self._dispatch_serving(job_id, job_name, sid, cfg, bound,
-                                          template, values, inline_results, rkey=rkey)
+            if physical is None:
+                self.serving.store_result(rkey, fill.inline_result)
+                return self._serve_inline(job_id, job_name, sid, cfg,
+                                          fill.inline_result)
+            return self._dispatch_serving(job_id, job_name, sid, cfg, physical,
+                                          template, values, inline_results,
+                                          fill=fill)
         except BaseException as e:  # noqa: BLE001 — same contract as _plan_job
             log.warning("execute_prepared failed for %s: %s", job_id, e, exc_info=True)
             with self._jobs_lock:
@@ -623,6 +910,7 @@ class SchedulerServer:
                 physical = ctx.create_physical_plan(df.plan)
             else:
                 physical = body
+            physical = self._graft_deltas(physical)
             stages = DistributedPlanner(job_id).plan_query_stages(physical)
             cfg = self.sessions.get(session_id) or BallistaConfig()
             from ballista_tpu.scheduler.planner import merge_mesh_stages
@@ -843,36 +1131,63 @@ class SchedulerServer:
         self.post(Event("revive", job.job_id))
 
     def _maybe_cache_result(self, job: FastJob) -> None:
-        """Fetch a finished fast job's partitions and fill its result-cache
-        slot, also serving THIS submission inline (the fetch already ran)."""
-        if job.rc_key is None:
+        """Fetch a finished fast job's partitions and finish its fill
+        (cache store + any incremental render), also serving THIS
+        submission inline (the fetch already ran). Runs before the
+        terminal notify, so incremental outputs never leak raw."""
+        fill = job.rc_key
+        if fill is None:
             return
         try:
             from ballista_tpu.client.context import fetch_job_results
 
             tbl = fetch_job_results(job.job_status(), job.config)
-            self.serving.store_result(job.rc_key, tbl)
-            job.inline_result = tbl
-        except Exception as e:  # noqa: BLE001 — cache fill is best-effort
-            log.debug("result-cache fill for %s failed: %s", job.job_id, e)
+            job.inline_result = self._finish_fill(fill, tbl, job.config)
+        except Exception as e:  # noqa: BLE001 — plain cache fill is best-effort
+            if fill.kind != "plain":
+                # the fetched bytes are accumulator state / delta rows,
+                # not the answer: fail rather than serve them
+                job.status = JobState.FAILED
+                job.error = f"incremental render failed: {e}"
+                log.warning("incremental render for %s failed: %s", job.job_id, e)
+            else:
+                log.debug("result-cache fill for %s failed: %s", job.job_id, e)
 
-    def _fill_result_cache_from_graph(self, g) -> None:
-        """Graph-path result-cache fill: on job_finished, fetch the final
-        partitions off the event loop and store under the pending key."""
+    def _fill_result_cache_from_graph(self, g) -> bool:
+        """Graph-path fill: on job_finished, fetch the final partitions off
+        the event loop and finish the fill. Returns True when the job's
+        terminal notify is DEFERRED to the fill thread — incremental
+        state/append outputs must render into `g.inline_result` before
+        clients observe success (`job_status` masks until then)."""
         with self._jobs_lock:
-            rkey = self._rc_pending.pop(g.job_id, None)
-        if rkey is None:
-            return
+            fill = self._rc_pending.pop(g.job_id, None)
+        if fill is None:
+            return False
+        deferred = fill.kind != "plain"
 
         def run():
             try:
                 from ballista_tpu.client.context import fetch_job_results
 
-                self.serving.store_result(rkey, fetch_job_results(g.job_status(), g.config))
+                tbl = fetch_job_results(g.job_status(), g.config)
+                result = self._finish_fill(fill, tbl, g.config)
+                if deferred:
+                    g.inline_result = result
             except Exception as e:  # noqa: BLE001
-                log.debug("result-cache fill for %s failed: %s", g.job_id, e)
+                if deferred:
+                    g.status = JobState.FAILED
+                    g.error = f"incremental render failed: {e}"
+                    log.warning("incremental render for %s failed: %s", g.job_id, e)
+                else:
+                    log.debug("result-cache fill for %s failed: %s", g.job_id, e)
+            finally:
+                if deferred:
+                    with self._jobs_lock:
+                        self._rc_render_pending.discard(g.job_id)
+                    self._notify(g.job_id)
 
         threading.Thread(target=run, daemon=True, name="result-cache-fill").start()
+        return deferred
 
     def _apply_task_updates(self, executor_id: str, results: list[TaskResult],
                             free_slots_managed: bool = True) -> None:
@@ -925,8 +1240,8 @@ class SchedulerServer:
             for ev in events:
                 if ev == "job_finished":
                     self.metrics.record_completed(g.job_id, time.time() - g.queued_at)
-                    self._fill_result_cache_from_graph(g)
-                    self._notify(g.job_id)
+                    if not self._fill_result_cache_from_graph(g):
+                        self._notify(g.job_id)
                 elif ev == "job_failed":
                     self.metrics.record_failed(g.job_id)
                     self._notify(g.job_id)
@@ -1354,15 +1669,26 @@ class SchedulerServer:
     def job_status(self, job_id: str) -> dict | None:
         with self._jobs_lock:
             g = self.jobs.get(job_id)
-        return None if g is None else g.job_status()
+            pending_render = job_id in self._rc_render_pending
+        if g is None:
+            return None
+        st = g.job_status()
+        if pending_render and st.get("state") == "successful":
+            # an incremental fill owns the terminal transition: the stage
+            # partitions hold raw accumulator state, not the result —
+            # clients must keep polling until the render attaches it
+            st = dict(st)
+            st["state"] = "running"
+            st.pop("partitions", None)
+        return st
 
     def wait_for_job(self, job_id: str, timeout: float = 300.0) -> dict:
         ev = threading.Event()
         with self._jobs_lock:
             self._watchers.setdefault(job_id, []).append(ev)
-            g = self.jobs.get(job_id)
-        if g is not None and g.status in (JobState.SUCCESSFUL, JobState.FAILED, JobState.CANCELLED):
-            return g.job_status()
+        st = self.job_status(job_id)
+        if st is not None and st["state"] in ("successful", "failed", "cancelled"):
+            return st
         deadline = time.time() + timeout
         while time.time() < deadline:
             if ev.wait(timeout=0.5):
@@ -1393,6 +1719,7 @@ class SchedulerServer:
             self.jobs.pop(job_id, None)
             self._fast_jobs.pop(job_id, None)
             self._rc_pending.pop(job_id, None)
+            self._rc_render_pending.discard(job_id)
         self.admission.finish(job_id)  # backstop; no-op if already released
         self.job_state.remove_job(job_id)
         if self.launcher is None:

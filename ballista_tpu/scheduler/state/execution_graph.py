@@ -723,6 +723,11 @@ class ExecutionGraph:
                 out["schema"] = final.spec.plan.input.df_schema
             if self.status is JobState.SUCCESSFUL and final is not None:
                 out["partitions"] = final.output_locations()
+            if getattr(self, "inline_result", None) is not None:
+                # an incremental render attached the served table — clients
+                # take it over the raw stage partitions (accumulator state)
+                out["inline_result"] = self.inline_result
+                out["partitions"] = []
             return out
 
     def display(self) -> str:

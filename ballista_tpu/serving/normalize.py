@@ -230,6 +230,23 @@ def collect_physical_params(plan: ExecutionPlan) -> set[int]:
     return out
 
 
+def collect_scan_tables(plan: ExecutionPlan) -> set[str]:
+    """Named tables a physical plan scans (lower-cased). Used to decide
+    whether a cached plan is exposed to append ingestion: direct dispatch
+    demotes to the scheduler when any of these tables has retained deltas,
+    and the serving tier subscribes continuous queries to exactly this
+    set. Memory scans have no name and so never appear."""
+    out: set[str] = set()
+    stack = [plan]
+    while stack:
+        node = stack.pop()
+        name = getattr(node, "table_name", "")
+        if name:
+            out.add(str(name).lower())
+        stack.extend(node.children())
+    return out
+
+
 def bind_physical(template: ExecutionPlan, values: tuple) -> ExecutionPlan:
     """Fresh executable copy of a cached template with `values` bound into
     its parameter slots. Always rebuilds — even for the template's own

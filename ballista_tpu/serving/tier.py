@@ -22,6 +22,8 @@ import time
 from dataclasses import dataclass, field
 
 from ballista_tpu.config import (
+    SERVING_INCREMENTAL_STATE_BYTES,
+    SERVING_INCREMENTAL_STATE_ENTRIES,
     SERVING_PLAN_CACHE_ENTRIES,
     SERVING_RESULT_CACHE_BYTES,
     SERVING_RESULT_CACHE_ENTRIES,
@@ -45,6 +47,12 @@ class PlanTemplate:
     bindable: bool  # every slot survived into the physical tree
     single_stage: bool | None = None  # learned at first stage planning
     hits: int = 0
+    # merge-eligibility decision, analyzed once per template by
+    # serving/incremental.py: "aggregate" | "append" | "none" (+ reason),
+    # surfaced in the serving snapshot so fallbacks are diagnosable
+    incremental_mode: str | None = None
+    incremental_reason: str = ""
+    incremental_tables: tuple[str, ...] = ()
 
     def accepts(self, values: tuple) -> bool:
         """A non-bindable template (the physical planner consumed a slot)
@@ -78,14 +86,31 @@ class _TableVersions:
         self._versions: dict[str, int] = {}
         self.bumps = 0
 
-    def bump(self, table: str) -> None:
+    def bump(self, table: str) -> int:
+        """Returns the new version — append ingestion retains each delta
+        set under the version its bump produced."""
         with self._lock:
-            self._versions[table] = self._versions.get(table, 0) + 1
+            v = self._versions.get(table, 0) + 1
+            self._versions[table] = v
             self.bumps += 1
+            return v
 
     def vector(self, tables: tuple[str, ...]) -> tuple:
         with self._lock:
             return tuple((t, self._versions.get(t, 0)) for t in tables)
+
+
+@dataclass
+class StateEntry:
+    """Cached maintenance state for one (template, values) pair: the
+    accumulator table of an aggregate (pre-finisher) or the full result
+    of an append-mode plan, tagged with the table-version vector it
+    reflects. A maintained refresh merges only the delta versions between
+    `vector` and the current one."""
+
+    vector: tuple  # ((table, version), ...) in template-table order
+    table: object  # pa.Table — accumulator rows or append-mode result
+    kind: str  # "aggregate" | "append"
 
 
 class ServingTier:
@@ -106,6 +131,14 @@ class ServingTier:
             sizer=lambda t: int(t.nbytes),
         )
         self.result_max_bytes = int(cfg.get(SERVING_RESULT_MAX_BYTES))
+        # maintenance state: (plan key, values) → StateEntry. Unlike the
+        # result cache it is NOT version-keyed — an entry at an older
+        # vector is exactly what a maintained refresh merges deltas into.
+        self.state_cache: LruDict = LruDict(
+            int(cfg.get(SERVING_INCREMENTAL_STATE_ENTRIES)),
+            max_bytes=int(cfg.get(SERVING_INCREMENTAL_STATE_BYTES)),
+            sizer=lambda e: int(e.table.nbytes),
+        )
         self.table_versions = _TableVersions()
         self.prepared: dict[str, PreparedStatement] = {}
         self._lock = threading.Lock()
@@ -118,6 +151,13 @@ class ServingTier:
         self.fast_lane_fallbacks = 0
         self.uncacheable = 0
         self.cleared = 0
+        self.maintained = 0
+        self.bootstraps = 0
+        self.state_renders = 0
+        self.recomputes = 0
+        self.recompute_reasons: dict[str, int] = {}
+        self.appends = 0
+        self.appended_rows = 0
 
     # -- text (L1) ---------------------------------------------------------
 
@@ -186,6 +226,36 @@ class ServingTier:
             return
         self.result_cache[rkey] = table
 
+    # -- incremental maintenance state ---------------------------------------
+
+    def lookup_state(self, key: str, values: tuple) -> StateEntry | None:
+        return self.state_cache.get((key, values))
+
+    def store_state(self, key: str, values: tuple, entry: StateEntry) -> None:
+        self.state_cache[(key, values)] = entry
+
+    def note_incremental(self, outcome: str, reason: str = "") -> None:
+        """Record a refresh decision: "maintained" (delta merge),
+        "bootstrap" (first state computation), "state_render" (result
+        rebuilt from current state, no job), "recompute" (+ reason)."""
+        with self._lock:
+            if outcome == "maintained":
+                self.maintained += 1
+            elif outcome == "bootstrap":
+                self.bootstraps += 1
+            elif outcome == "state_render":
+                self.state_renders += 1
+            else:
+                self.recomputes += 1
+                if reason:
+                    self.recompute_reasons[reason] = (
+                        self.recompute_reasons.get(reason, 0) + 1)
+
+    def note_append(self, rows: int) -> None:
+        with self._lock:
+            self.appends += 1
+            self.appended_rows += int(rows)
+
     # -- prepared statements -----------------------------------------------
 
     def register_prepared(self, stmt: PreparedStatement) -> None:
@@ -208,6 +278,7 @@ class ServingTier:
         self.plan_cache.clear()
         self.text_cache.clear()
         self.result_cache.clear()
+        self.state_cache.clear()
         with self._lock:
             self.cleared += 1
 
@@ -233,6 +304,23 @@ class ServingTier:
                 "fast_lane": {
                     "executed": self.fast_lane_executed,
                     "fallbacks": self.fast_lane_fallbacks,
+                },
+                "incremental": {
+                    "maintained": self.maintained,
+                    "bootstraps": self.bootstraps,
+                    "state_renders": self.state_renders,
+                    "recomputes": self.recomputes,
+                    "recompute_reasons": dict(self.recompute_reasons),
+                    "state_entries": len(self.state_cache),
+                    "state_nbytes": self.state_cache.nbytes(),
+                    "state_evictions": self.state_cache.evictions,
+                    "appends": self.appends,
+                    "appended_rows": self.appended_rows,
+                    "modes": {
+                        key: {"mode": t.incremental_mode or "unanalyzed",
+                              "reason": t.incremental_reason}
+                        for key, t in self.plan_cache.items()
+                    },
                 },
                 "prepared_statements": len(self.prepared),
                 "cleared": self.cleared,

@@ -82,6 +82,17 @@ class LruDict:
                 self.evictions += 1
             return default
 
+    def pop(self, key, default=None):
+        """Remove and return an entry without counting it as an eviction
+        (callers that fold data elsewhere first — e.g. delta compaction —
+        own the removal; `evictions` stays a pure pressure signal)."""
+        with self._lock:
+            got = self._od.pop(key, None)
+            if got is None:
+                return default
+            self._bytes -= got[1]
+            return got[0]
+
     def items(self) -> list:
         """Point-in-time [(key, value)] snapshot (LRU → MRU order) without
         touching recency — observability reads must not distort eviction."""

@@ -804,14 +804,21 @@ def serving_leg() -> dict:
     try:
         sys.path.insert(0, os.path.join(
             os.path.dirname(os.path.abspath(__file__)), "dev"))
-        from qps_exercise import run_qps_comparison, run_shard_comparison
+        from qps_exercise import (
+            run_qps_comparison,
+            run_refresh_comparison,
+            run_shard_comparison,
+        )
 
         from ballista_tpu.testing.tpchgen import generate_tpch
 
+        refresh_stats = None
         with tempfile.TemporaryDirectory(prefix="bench_qps_") as qd:
             generate_tpch(qd, scale=0.01, seed=42, files_per_table=2)
             stats = run_qps_comparison(qd)
             shard_stats = run_shard_comparison(qd)
+            if os.environ.get("BENCH_INCREMENTAL", "1") == "1":
+                refresh_stats = run_refresh_comparison(qd)
         out = {
             "speedup_qps": stats["speedup_qps"],
             "speedup_p50": stats["speedup_p50"],
@@ -835,9 +842,15 @@ def serving_leg() -> dict:
             s = shard_stats[key]
             out[key] = {k: s[k] for k in
                         ("queries", "wall_s", "qps", "p50_ms", "p99_ms")}
+        # incremental maintenance: append-then-refresh, maintained vs
+        # from-scratch, byte-identical (skip with BENCH_INCREMENTAL=0)
+        if refresh_stats is not None:
+            out["refresh"] = refresh_stats
         log(f"serving leg: {out['speedup_qps']}x QPS, {out['speedup_p50']}x p50, "
             f"shard scale-out {out['shard_speedup_qps']}x, "
-            f"direct rate {out['direct_dispatch_rate']}")
+            f"direct rate {out['direct_dispatch_rate']}"
+            + (f", refresh {refresh_stats['speedup']}x maintained"
+               if refresh_stats else ""))
         return out
     except (Exception, SystemExit) as e:  # noqa: BLE001 — recorded, not fatal
         log(f"serving leg failed: {e}")

@@ -25,9 +25,15 @@ runs the prepared-statement hot path through an executor lease
 (`client/direct.py`), checks byte parity against the scheduler path, and
 reports `direct_dispatch_rate`.
 
-Exits non-zero if any check fails. `run_qps_comparison` and
-`run_shard_comparison` are importable (bench.py's serving leg reuses
-them).
+A fourth leg exercises incremental maintenance (docs/streaming.md): an
+exact-accumulator aggregate is prepared and bootstrapped, rows are
+appended between refreshes, and each maintained refresh (delta query
+merged into cached state) must be byte-identical to — and in aggregate
+faster than — a from-scratch execution in a caches-off session.
+
+Exits non-zero if any check fails. `run_qps_comparison`,
+`run_shard_comparison`, and `run_refresh_comparison` are importable
+(bench.py's serving leg reuses them).
 """
 
 import os
@@ -433,6 +439,140 @@ def run_shard_comparison(data_dir: str) -> dict:
     return out
 
 
+# incremental-refresh leg: a q1-shaped grouped aggregate whose accumulators
+# are all exact (COUNT, int64 SUM, MIN/MAX — the generator's monetary
+# columns are float64, and float SUMs are ineligible by design), so the
+# serving tier maintains the cached result from retained deltas instead of
+# recomputing. The leg appends rows between refreshes and enforces that the
+# maintained refresh is BOTH faster than a from-scratch execution and
+# byte-identical to it (docs/streaming.md).
+REFRESH_QUERY = (
+    "SELECT l_returnflag, l_linestatus, COUNT(*) AS cnt, "
+    "SUM(l_orderkey) AS sum_ok, MIN(l_quantity) AS min_qty, "
+    "MAX(l_quantity) AS max_qty FROM lineitem WHERE l_quantity < 45 "
+    "GROUP BY l_returnflag, l_linestatus ORDER BY l_returnflag, l_linestatus")
+REFRESH_ROUNDS = int(os.environ.get("QPS_REFRESH_ROUNDS", "5"))
+REFRESH_APPEND_ROWS = int(os.environ.get("QPS_REFRESH_APPEND_ROWS", "512"))
+
+
+def run_refresh_comparison(data_dir: str) -> dict:
+    """Append-then-refresh on one cluster: the maintained path (delta query
+    merged into cached aggregation state) vs a from-scratch execution of
+    the same statement in a caches-off session. Asserts byte identity per
+    round, that the maintenance counters actually moved, and that the
+    maintained refresh is faster in aggregate."""
+    import glob
+
+    import pyarrow.parquet as pq
+
+    from ballista_tpu.client.context import SessionContext, fetch_job_results
+    from ballista_tpu.config import (
+        DEFAULT_SHUFFLE_PARTITIONS,
+        SERVING_FAST_LANE,
+        SERVING_PLAN_CACHE,
+        SERVING_RESULT_CACHE,
+        BallistaConfig,
+    )
+    from ballista_tpu.testing.tpchgen import register_tpch
+
+    cfg = BallistaConfig({
+        DEFAULT_SHUFFLE_PARTITIONS: 2,
+        SERVING_PLAN_CACHE: True,
+        SERVING_FAST_LANE: False,
+        # the result cache (and with it the maintenance ladder) is opt-in
+        SERVING_RESULT_CACHE: True,
+    })
+    ctx = SessionContext.standalone(config=cfg, num_executors=2, vcores=4)
+    register_tpch(ctx, data_dir)
+
+    # the appended rows: real lineitem rows re-sent, so every round changes
+    # the aggregate and both paths must agree on the new answer
+    src = sorted(glob.glob(os.path.join(data_dir, "lineitem", "*.parquet")))[0]
+    pool = pq.read_table(src).slice(0, REFRESH_ROUNDS * REFRESH_APPEND_ROWS)
+    if pool.num_rows < REFRESH_ROUNDS * REFRESH_APPEND_ROWS:
+        raise SystemExit(f"[refresh] delta pool too small: {pool.num_rows} rows")
+
+    maintained_s: list[float] = []
+    full_s: list[float] = []
+    try:
+        stmt = ctx.prepare(REFRESH_QUERY)
+        scheduler = ctx._cluster.scheduler
+
+        # from-scratch leg: same scheduler, a session with the result cache
+        # off, so every submit re-executes the full plan (appended rows are
+        # still visible — the dispatch-time scan graft serves them). Copy
+        # the context's config: table registrations ride the session config
+        # as ballista.catalog.table.* pairs.
+        full_cfg = ctx.config.copy()
+        full_cfg.set(SERVING_RESULT_CACHE, "false")
+        full_sid = scheduler.sessions.create_or_update(
+            full_cfg.to_key_value_pairs(), "refresh-full")
+
+        def full_exec():
+            jid = scheduler.submit_sql(REFRESH_QUERY, full_sid,
+                                       inline_results=True)
+            status = scheduler.wait_for_job(jid, timeout=120)
+            if status["state"] != "successful":
+                raise SystemExit(f"[refresh] from-scratch execution failed: "
+                                 f"{status.get('error')}")
+            return fetch_job_results(status, full_cfg)
+
+        # warm both paths outside the timed window: the first prepared
+        # execution bootstraps the accumulator state, the first full run
+        # pays executor compile
+        t0 = time.monotonic()
+        boot = stmt.execute()
+        bootstrap_ms = round((time.monotonic() - t0) * 1000, 1)
+        if _fingerprint(boot) != _fingerprint(full_exec()):
+            raise SystemExit("[refresh] bootstrap bytes diverge from scratch")
+
+        for r in range(REFRESH_ROUNDS):
+            delta = pool.slice(r * REFRESH_APPEND_ROWS, REFRESH_APPEND_ROWS)
+            ctx.append("lineitem", delta)
+            t0 = time.monotonic()
+            got = stmt.execute()
+            maintained_s.append(time.monotonic() - t0)
+            t0 = time.monotonic()
+            full = full_exec()
+            full_s.append(time.monotonic() - t0)
+            if _fingerprint(got) != _fingerprint(full):
+                raise SystemExit(f"[refresh] round {r}: maintained bytes "
+                                 f"diverge from a from-scratch execution")
+
+        snap = scheduler.serving.snapshot()["incremental"]
+    finally:
+        ctx.shutdown()
+
+    # the cheap path actually ran: every refresh maintained, none recomputed
+    if snap["maintained"] < REFRESH_ROUNDS:
+        raise SystemExit(f"[refresh] only {snap['maintained']} of "
+                         f"{REFRESH_ROUNDS} refreshes maintained: {snap}")
+    if snap["bootstraps"] < 1 or snap["appends"] < REFRESH_ROUNDS:
+        raise SystemExit(f"[refresh] counters implausible: {snap}")
+    modes = {m["mode"] for m in snap["modes"].values()}
+    if "aggregate" not in modes:
+        raise SystemExit(f"[refresh] no template analyzed as aggregate: {snap}")
+
+    m_total, f_total = sum(maintained_s), sum(full_s)
+    if m_total >= f_total:
+        raise SystemExit(f"[refresh] maintained refresh {m_total:.3f}s not "
+                         f"faster than from-scratch {f_total:.3f}s")
+    m_sorted, f_sorted = sorted(maintained_s), sorted(full_s)
+    return {
+        "rounds": REFRESH_ROUNDS,
+        "append_rows": REFRESH_APPEND_ROWS,
+        "bootstrap_ms": bootstrap_ms,
+        "maintained_total_s": round(m_total, 3),
+        "full_total_s": round(f_total, 3),
+        "speedup": round(f_total / max(m_total, 1e-9), 2),
+        "maintained_p50_ms": round(_pct(m_sorted, 50) * 1000, 1),
+        "full_p50_ms": round(_pct(f_sorted, 50) * 1000, 1),
+        "incremental": {k: snap[k] for k in
+                        ("maintained", "bootstraps", "state_renders",
+                         "recomputes", "appends", "appended_rows")},
+    }
+
+
 def main() -> None:
     from ballista_tpu.testing.tpchgen import generate_tpch
 
@@ -463,6 +603,16 @@ def main() -> None:
               f"stats={shard_stats['direct']['stats']}")
         print(f"shard exercise passed: {shard_stats['shard_speedup_qps']}x QPS "
               f"at N=4, direct dispatch byte-identical")
+
+        refresh = run_refresh_comparison(d)
+        print(f"[refresh ] {refresh['rounds']} appends x "
+              f"{refresh['append_rows']} rows: maintained "
+              f"{refresh['maintained_total_s']}s vs from-scratch "
+              f"{refresh['full_total_s']}s "
+              f"(p50 {refresh['maintained_p50_ms']}ms vs "
+              f"{refresh['full_p50_ms']}ms)  counters={refresh['incremental']}")
+        print(f"refresh exercise passed: {refresh['speedup']}x, "
+              f"maintained results byte-identical")
 
 
 if __name__ == "__main__":

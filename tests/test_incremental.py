@@ -1,0 +1,474 @@
+"""Incremental materialized views: append ingestion, delta-maintained
+result refresh, continuous queries.
+
+The contract under test is byte equivalence: a maintained refresh (delta
+query merged into cached aggregation state) must return exactly the bytes
+a from-scratch execution of the same statement returns — across nulls,
+strings, duplicate group keys, global (no-GROUP-BY) aggregates, and the
+one-side delta-join — while the serving counters prove the cheap path
+actually ran. Ineligible shapes must fall back with a recorded reason,
+retention bounds must fold (never drop) delta data, and continuous
+queries must push a fresh result per version bump.
+"""
+
+import hashlib
+import threading
+import time
+
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from ballista_tpu.client.context import SessionContext
+from ballista_tpu.config import (
+    INGEST_DELTA_RETAIN_VERSIONS,
+    SERVING_INCREMENTAL,
+    SERVING_RESULT_CACHE,
+    BallistaConfig,
+)
+from ballista_tpu.errors import PlanningError
+from ballista_tpu.serving.incremental import DeltaRegistry, analyze_plan
+from ballista_tpu.sql.optimizer import optimize
+from ballista_tpu.sql.parser import parse_sql
+from ballista_tpu.sql.planner import SqlPlanner
+
+
+def _fingerprint(tbl: pa.Table) -> str:
+    """Order-independent byte fingerprint (same bar as dev/qps_exercise)."""
+    rows = sorted(str(r) for r in tbl.to_pylist())
+    return hashlib.sha256("\n".join(rows).encode()).hexdigest()
+
+
+def _write(tmp_path, name: str, tbl: pa.Table) -> str:
+    d = tmp_path / name
+    d.mkdir()
+    pq.write_table(tbl, str(d / f"{name}.parquet"))
+    return str(d)
+
+
+BASE_T = pa.table({
+    "k": ["a", "b", "a", None, "c", "b"],
+    "v": [1, 2, 3, 4, None, 6],
+    "s": ["x", "y", "z", "x", None, "y"],
+})
+DELTA_T = pa.table({
+    "k": ["a", None, "d", "b"],
+    "v": [10, 20, None, 40],
+    "s": [None, "x", "q", "y"],
+})
+DIM_U = pa.table({"k": ["a", "b", "c", "d"], "w": [100, 200, 300, 400]})
+
+
+def _incremental_cfg() -> BallistaConfig:
+    cfg = BallistaConfig()
+    # the result cache (and with it the maintenance ladder) is opt-in
+    cfg.set(SERVING_RESULT_CACHE, "true")
+    return cfg
+
+
+@pytest.fixture()
+def cluster_ctx(tmp_path):
+    ctx = SessionContext.standalone(config=_incremental_cfg(), num_executors=1, vcores=2)
+    ctx.register_parquet("t", _write(tmp_path, "t", BASE_T))
+    ctx.register_parquet("u", _write(tmp_path, "u", DIM_U))
+    yield ctx
+    ctx.shutdown()
+
+
+def _sched(ctx):
+    return ctx._cluster.scheduler
+
+
+def _inc_counters(ctx) -> dict:
+    return _sched(ctx).serving.snapshot()["incremental"]
+
+
+# ---------------------------------------------------------------------------
+# eligibility analysis (no cluster)
+
+
+class TestEligibility:
+    def _physical(self, ctx, sql):
+        return ctx.create_physical_plan(
+            optimize(SqlPlanner(ctx.catalog).plan_query(parse_sql(sql))))
+
+    @pytest.fixture()
+    def local_ctx(self, tmp_path):
+        ctx = SessionContext()
+        ctx.register_parquet("t", _write(tmp_path, "t", BASE_T))
+        ctx.register_parquet("u", _write(tmp_path, "u", DIM_U))
+        ctx.register_parquet("f", _write(tmp_path, "f", pa.table(
+            {"k": ["a", "b"], "x": [1.5, 2.5]})))
+        return ctx
+
+    def test_distributive_aggregate_is_maintainable(self, local_ctx):
+        for sql in [
+            "SELECT k, SUM(v) AS s FROM t GROUP BY k",
+            "SELECT k, COUNT(*) AS c, MIN(v) AS lo, MAX(v) AS hi FROM t GROUP BY k",
+            "SELECT k, AVG(v) AS a FROM t GROUP BY k",  # pre-decomposed sum/count
+            "SELECT SUM(v) AS s FROM t",  # global aggregate, n_group == 0
+            "SELECT k, SUM(v) AS s FROM t GROUP BY k ORDER BY k LIMIT 3",  # finisher
+        ]:
+            d = analyze_plan(self._physical(local_ctx, sql))
+            assert d.mode == "aggregate", f"{sql}: {d.mode}/{d.reason}"
+            assert d.tables == ("t",)
+
+    def test_filter_project_is_append_maintainable(self, local_ctx):
+        d = analyze_plan(self._physical(local_ctx, "SELECT k, v FROM t WHERE v > 1"))
+        assert d.mode == "append" and d.tables == ("t",)
+
+    def test_one_side_equi_join_aggregate_is_maintainable(self, local_ctx):
+        d = analyze_plan(self._physical(
+            local_ctx,
+            "SELECT t.k, SUM(t.v) AS s FROM t JOIN u ON t.k = u.k GROUP BY t.k"))
+        assert d.mode == "aggregate"
+        assert set(d.tables) == {"t", "u"}
+
+    def test_ineligible_shapes_carry_reasons(self, local_ctx):
+        cases = {
+            # float SUM accumulators are not bit-stable under re-association
+            "SELECT k, SUM(x) AS s FROM f GROUP BY k": "float-sum",
+            # welford accumulators merge nonlinearly
+            "SELECT k, STDDEV(v) AS d FROM t GROUP BY k": "",
+            # self-join: both sides change on one append
+            "SELECT a.k, SUM(a.v) AS s FROM t a JOIN t b ON a.k = b.k "
+            "GROUP BY a.k": "self-join",
+            # ORDER BY changes row order under appends (append mode)
+            "SELECT k, v FROM t ORDER BY v": "shape-",
+        }
+        for sql, want in cases.items():
+            d = analyze_plan(self._physical(local_ctx, sql))
+            assert d.mode == "none", f"{sql} unexpectedly {d.mode}"
+            assert want in d.reason, f"{sql}: reason={d.reason!r}"
+
+
+# ---------------------------------------------------------------------------
+# the delta registry: retention, folding, reset
+
+
+class TestDeltaRegistry:
+    def _batches(self, n_rows: int):
+        return pa.table({"k": ["x"] * n_rows, "v": list(range(n_rows))}).to_batches()
+
+    def test_range_returns_exactly_the_appended_versions(self):
+        reg = DeltaRegistry()
+        reg.append("t", 2, self._batches(3))
+        reg.append("t", 3, self._batches(5))
+        got, why = reg.range("t", 1, 3)
+        assert why == "" and sum(b.num_rows for b in got) == 8
+        got, why = reg.range("t", 2, 3)
+        assert sum(b.num_rows for b in got) == 5
+
+    def test_missing_version_is_unavailable_not_wrong(self):
+        reg = DeltaRegistry()
+        reg.append("t", 5, self._batches(1))
+        got, why = reg.range("t", 3, 5)  # version 4 bumped without a delta
+        assert got is None and why == "delta-unavailable"
+
+    def test_version_cap_folds_oldest_to_parquet(self, tmp_path):
+        cfg = BallistaConfig()
+        cfg.set(INGEST_DELTA_RETAIN_VERSIONS, "2")
+        cfg.set("ballista.ingest.compaction.dir", str(tmp_path / "spool"))
+        reg = DeltaRegistry(cfg)
+        for v in range(1, 6):
+            reg.append("t", v, self._batches(4))
+        snap = reg.snapshot()
+        assert snap["folded_versions"] == 3
+        assert snap["retained_versions"] == 2
+        # folded data is table content: the view still carries every row
+        view = reg.view()["t"]
+        folded_rows = sum(pq.read_table(f).num_rows for f in view.folded_files)
+        live_rows = sum(b.num_rows for b in view.batches)
+        assert folded_rows + live_rows == 20
+        # a maintained refresh reaching past the fold horizon must decline
+        got, why = reg.range("t", 1, 5)
+        assert got is None and why == "delta-compacted"
+        # ... but the still-retained tail serves
+        got, why = reg.range("t", 3, 5)
+        assert got is not None and sum(b.num_rows for b in got) == 8
+
+    def test_byte_budget_folds_but_never_drops(self, tmp_path):
+        cfg = BallistaConfig()
+        cfg.set("ballista.ingest.delta.retained.max.bytes", "1")  # everything folds
+        cfg.set("ballista.ingest.compaction.dir", str(tmp_path / "spool"))
+        reg = DeltaRegistry(cfg)
+        reg.append("t", 1, self._batches(100))
+        reg.append("t", 2, self._batches(100))
+        view = reg.view()["t"]
+        total = sum(pq.read_table(f).num_rows for f in view.folded_files) + sum(
+            b.num_rows for b in view.batches)
+        assert total == 200, "budget pressure must compact, never drop rows"
+        assert reg.retained.nbytes() <= reg.retain_bytes or reg.retained.nbytes() == 0
+
+    def test_reset_clears_lineage(self):
+        reg = DeltaRegistry()
+        reg.append("t", 1, self._batches(2))
+        reg.reset("t")
+        assert reg.empty()
+        assert reg.range("t", 0, 1)[0] is None
+
+
+# ---------------------------------------------------------------------------
+# maintained refresh == full recompute, byte for byte
+
+
+class TestMaintainedParity:
+    AGG = ("SELECT k, SUM(v) AS sv, COUNT(*) AS c, COUNT(s) AS cs, "
+           "MIN(v) AS lo, MAX(s) AS hi, AVG(v) AS av FROM t GROUP BY k ORDER BY k")
+
+    def test_aggregate_maintained_and_byte_identical(self, cluster_ctx):
+        stmt = cluster_ctx.prepare(self.AGG)
+        stmt.execute()  # bootstrap: caches accumulator state
+        assert _inc_counters(cluster_ctx)["bootstraps"] == 1
+        cluster_ctx.append("t", DELTA_T)
+        maintained = stmt.execute()
+        counters = _inc_counters(cluster_ctx)
+        assert counters["maintained"] == 1
+        assert counters["recomputes"] == 0
+        full = cluster_ctx.sql(self.AGG).collect()
+        assert _fingerprint(maintained) == _fingerprint(full)
+        assert maintained.to_pydict() == full.to_pydict()
+
+    def test_repeated_appends_keep_maintaining(self, cluster_ctx):
+        stmt = cluster_ctx.prepare("SELECT k, SUM(v) AS s FROM t GROUP BY k ORDER BY k")
+        stmt.execute()
+        for i in range(3):
+            cluster_ctx.append("t", pa.table(
+                {"k": ["a", "e"], "v": [i, 2 * i], "s": [None, "n"]}))
+            got = stmt.execute()
+            full = cluster_ctx.sql(
+                "SELECT k, SUM(v) AS s FROM t GROUP BY k ORDER BY k").collect()
+            assert _fingerprint(got) == _fingerprint(full), f"append {i} diverged"
+        assert _inc_counters(cluster_ctx)["maintained"] == 3
+
+    def test_global_aggregate_no_group_by(self, cluster_ctx):
+        sql = "SELECT SUM(v) AS s, COUNT(*) AS c FROM t"
+        stmt = cluster_ctx.prepare(sql)
+        stmt.execute()
+        cluster_ctx.append("t", DELTA_T)
+        got = stmt.execute()
+        assert _inc_counters(cluster_ctx)["maintained"] == 1
+        assert got.to_pydict() == cluster_ctx.sql(sql).collect().to_pydict()
+
+    def test_delta_join_one_appended_side(self, cluster_ctx):
+        sql = ("SELECT t.k, SUM(t.v * u.w) AS s FROM t JOIN u ON t.k = u.k "
+               "GROUP BY t.k ORDER BY t.k")
+        stmt = cluster_ctx.prepare(sql)
+        stmt.execute()
+        cluster_ctx.append("t", DELTA_T)
+        got = stmt.execute()
+        assert _inc_counters(cluster_ctx)["maintained"] == 1
+        full = cluster_ctx.sql(sql).collect()
+        assert _fingerprint(got) == _fingerprint(full)
+
+    def test_filter_project_appends_in_place(self, cluster_ctx):
+        sql = "SELECT k, v FROM t WHERE v > 1"
+        stmt = cluster_ctx.prepare(sql)
+        stmt.execute()
+        cluster_ctx.append("t", DELTA_T)
+        got = stmt.execute()
+        counters = _inc_counters(cluster_ctx)
+        assert counters["maintained"] == 1
+        full = cluster_ctx.sql(sql).collect()
+        assert _fingerprint(got) == _fingerprint(full)
+
+    def test_state_survives_result_cache_loss(self, cluster_ctx):
+        """Result cache evicted but accumulator state current: the refresh
+        renders the finisher locally, with no dispatched job."""
+        sql = "SELECT k, SUM(v) AS s FROM t GROUP BY k ORDER BY k"
+        stmt = cluster_ctx.prepare(sql)
+        stmt.execute()
+        cluster_ctx.append("t", DELTA_T)
+        want = stmt.execute().to_pydict()
+        _sched(cluster_ctx).serving.result_cache.clear()
+        got = stmt.execute()
+        assert got.to_pydict() == want
+        assert _inc_counters(cluster_ctx)["state_renders"] == 1
+
+
+# ---------------------------------------------------------------------------
+# fallback behavior
+
+
+class TestFallback:
+    def test_ineligible_recomputes_with_reason(self, cluster_ctx):
+        sql = ("SELECT a.k, SUM(a.v) AS s FROM t a JOIN t b ON a.k = b.k "
+               "GROUP BY a.k ORDER BY a.k")
+        stmt = cluster_ctx.prepare(sql)
+        stmt.execute()
+        cluster_ctx.append("t", DELTA_T)
+        got = stmt.execute()
+        counters = _inc_counters(cluster_ctx)
+        assert counters["maintained"] == 0
+        assert "self-join" in counters["recompute_reasons"]
+        full = cluster_ctx.sql(sql).collect()
+        assert _fingerprint(got) == _fingerprint(full)
+        mode = next(iter(counters["modes"].values()))
+        assert mode == {"mode": "none", "reason": "self-join"}
+
+    def test_compacted_delta_falls_back_but_stays_correct(self, tmp_path):
+        cfg = _incremental_cfg()
+        cfg.set(INGEST_DELTA_RETAIN_VERSIONS, "1")
+        ctx = SessionContext.standalone(config=cfg, num_executors=1, vcores=2)
+        try:
+            ctx.register_parquet("t", _write(tmp_path, "t", BASE_T))
+            sql = "SELECT k, SUM(v) AS s FROM t GROUP BY k ORDER BY k"
+            stmt = ctx.prepare(sql)
+            stmt.execute()
+            # two appends before the refresh: the older one folds to parquet,
+            # so the needed range is no longer fully in memory
+            ctx.append("t", DELTA_T)
+            ctx.append("t", DELTA_T)
+            got = stmt.execute()
+            counters = _inc_counters(ctx)
+            assert counters["recompute_reasons"].get("delta-compacted", 0) >= 1
+            full = ctx.sql(sql).collect()
+            assert _fingerprint(got) == _fingerprint(full)
+        finally:
+            ctx.shutdown()
+
+    def test_incremental_knob_off_still_serves_appends(self, tmp_path):
+        cfg = _incremental_cfg()
+        cfg.set(SERVING_INCREMENTAL, "false")
+        ctx = SessionContext.standalone(config=cfg, num_executors=1, vcores=2)
+        try:
+            ctx.register_parquet("t", _write(tmp_path, "t", BASE_T))
+            sql = "SELECT k, SUM(v) AS s FROM t GROUP BY k ORDER BY k"
+            stmt = ctx.prepare(sql)
+            stmt.execute()
+            ctx.append("t", DELTA_T)
+            got = stmt.execute()
+            counters = _inc_counters(ctx)
+            assert counters["maintained"] == 0 and counters["bootstraps"] == 0
+            assert got.to_pydict() == ctx.sql(sql).collect().to_pydict()
+        finally:
+            ctx.shutdown()
+
+    def test_ddl_resets_delta_lineage(self, cluster_ctx, tmp_path):
+        stmt = cluster_ctx.prepare("SELECT k, SUM(v) AS s FROM t GROUP BY k ORDER BY k")
+        stmt.execute()
+        cluster_ctx.append("t", DELTA_T)
+        sched = _sched(cluster_ctx)
+        assert not sched.ingest.empty()
+        sched._on_catalog_change("t")
+        assert sched.ingest.empty(), "DDL must orphan retained deltas"
+
+
+# ---------------------------------------------------------------------------
+# linearizability under concurrent appends
+
+
+class TestConcurrency:
+    def test_refreshes_are_monotonic_under_concurrent_appends(self, cluster_ctx):
+        """Appends race the refresh loop. Every served COUNT must be a value
+        the table actually passed through (4-row snapshots monotonically
+        growing by 2), and the final quiesced refresh must equal a full
+        recompute byte-for-byte — no refresh may mix state across
+        versions."""
+        sql = "SELECT COUNT(*) AS n, SUM(v) AS s FROM t"
+        stmt = cluster_ctx.prepare(sql)
+        stmt.execute()
+        n_appends = 12
+        done = threading.Event()
+
+        def feeder():
+            for i in range(n_appends):
+                cluster_ctx.append("t", pa.table(
+                    {"k": ["p", "q"], "v": [i, i], "s": ["w", None]}))
+                time.sleep(0.005)
+            done.set()
+
+        t = threading.Thread(target=feeder)
+        t.start()
+        counts = []
+        while not done.is_set():
+            counts.append(stmt.execute().to_pydict()["n"][0])
+        t.join()
+        base_rows = BASE_T.num_rows
+        valid = {base_rows + 2 * i for i in range(n_appends + 1)}
+        assert set(counts) <= valid, f"served a count outside any real version: {counts}"
+        assert counts == sorted(counts), "refresh results went backwards"
+        final = stmt.execute()
+        full = cluster_ctx.sql(sql).collect()
+        assert _fingerprint(final) == _fingerprint(full)
+
+
+# ---------------------------------------------------------------------------
+# continuous queries
+
+
+class TestContinuousQueries:
+    def test_push_on_every_bump(self, cluster_ctx):
+        stmt = cluster_ctx.prepare("SELECT COUNT(*) AS n FROM t")
+        sub = stmt.subscribe()
+        try:
+            first = sub.next(timeout=30)
+            assert first.to_pydict()["n"] == [BASE_T.num_rows]
+            cluster_ctx.append("t", DELTA_T)
+            nxt = sub.next(timeout=30)
+            assert nxt.to_pydict()["n"] == [BASE_T.num_rows + DELTA_T.num_rows]
+        finally:
+            sub.close()
+        snap = _sched(cluster_ctx).subscriptions.snapshot()
+        assert snap["active"] == 0 and snap["pushed"] >= 2
+
+    def test_unrelated_table_does_not_wake_subscription(self, cluster_ctx):
+        stmt = cluster_ctx.prepare("SELECT COUNT(*) AS n FROM u")
+        sub = stmt.subscribe()
+        try:
+            sub.next(timeout=30)  # warm snapshot
+            cluster_ctx.append("t", DELTA_T)  # different table
+            import queue as _q
+
+            with pytest.raises(Exception):
+                # bounded wait; nothing should arrive for a t-only bump
+                raw = sub._sub.queue.get(timeout=1.0)
+                raise AssertionError(f"unexpected push: {raw}")
+        finally:
+            sub.close()
+
+    def test_unknown_statement_rejected(self, cluster_ctx):
+        cluster_ctx.prepare("SELECT COUNT(*) AS n FROM t")  # warm the cluster
+        with pytest.raises(Exception):
+            _sched(cluster_ctx).subscribe_statement("no-such-stmt", None, "")
+
+
+# ---------------------------------------------------------------------------
+# local mode append
+
+
+class TestLocalAppend:
+    def test_local_append_overlays_provider(self, tmp_path):
+        ctx = SessionContext()
+        ctx.register_parquet("t", _write(tmp_path, "t", BASE_T))
+        before = ctx.sql("SELECT COUNT(*) AS n FROM t").collect().to_pydict()["n"][0]
+        out = ctx.append("t", DELTA_T)
+        assert out == {"table": "t", "version": 1, "rows": DELTA_T.num_rows}
+        after = ctx.sql("SELECT COUNT(*) AS n FROM t").collect().to_pydict()["n"][0]
+        assert after == before + DELTA_T.num_rows
+        # aggregates see the merged view
+        got = ctx.sql("SELECT k, SUM(v) AS s FROM t GROUP BY k ORDER BY k").collect()
+        merged = pa.concat_tables([BASE_T, DELTA_T.cast(BASE_T.schema)])
+        want = merged.group_by("k").aggregate([("v", "sum")])
+        assert dict(zip(got.to_pydict()["k"], got.to_pydict()["s"])) == dict(
+            zip(want.to_pydict()["k"], want.to_pydict()["v_sum"]))
+
+    def test_append_conforms_by_name_and_casts(self, tmp_path):
+        ctx = SessionContext()
+        ctx.register_parquet("t", _write(tmp_path, "t", BASE_T))
+        # reordered columns + int32 values: conformance aligns and casts
+        ctx.append("t", pa.table({
+            "s": pa.array(["m"]), "v": pa.array([7], pa.int32()), "k": pa.array(["e"])}))
+        got = ctx.sql("SELECT v FROM t WHERE k = 'e'").collect()
+        assert got.to_pydict()["v"] == [7]
+
+    def test_append_missing_column_is_an_error(self, tmp_path):
+        ctx = SessionContext()
+        ctx.register_parquet("t", _write(tmp_path, "t", BASE_T))
+        with pytest.raises(PlanningError, match="missing column"):
+            ctx.append("t", pa.table({"k": ["e"]}))
+
+    def test_append_unknown_table_is_an_error(self):
+        ctx = SessionContext()
+        with pytest.raises(PlanningError, match="not found"):
+            ctx.append("nope", pa.table({"k": ["e"], "v": [1], "s": ["x"]}))
